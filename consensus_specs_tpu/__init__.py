@@ -1,0 +1,19 @@
+"""consensus_specs_tpu — a TPU-native executable-specification framework for
+the Ethereum proof-of-stake consensus layer.
+
+Re-designed from scratch for TPU (JAX/XLA/Pallas) with the same capabilities
+as the reference executable-spec system (ethereum/consensus-specs):
+
+- ``utils/``     SSZ engine (chunk-array merkleization), hashing, YAML/snappy IO
+- ``ops/``       compute kernels: batched SHA-256 (numpy + JAX/TPU), BLS12-381
+                 (pure-Python oracle + batched JAX limb arithmetic), KZG, FFT
+- ``models/``    the fork specs (phase0 .. fulu) + the spec build pipeline that
+                 assembles flat per-(fork, preset) executable spec namespaces
+- ``parallel/``  jax.sharding mesh layouts and collective sweeps for the
+                 validator-registry and attestation-batch scale axes
+
+Layer map mirrors SURVEY.md §1: L0 = utils+ops, L2 = models/builder,
+L3 = built spec namespaces, L4 = tests/ DSL, L5 = generator stack.
+"""
+
+__version__ = "1.6.0a3+tpu0"

@@ -1,0 +1,13 @@
+"""BLS facade — pluggable backend front-end (filled in by M3).
+
+Mirrors the reference's backend-switchable `eth2spec/utils/bls.py` seam.
+"""
+
+bls_active = True
+_backend = "py"
+
+
+def use_backend(name: str) -> None:
+    global _backend
+    assert name in ("py", "jax"), name
+    _backend = name

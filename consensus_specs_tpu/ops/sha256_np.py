@@ -1,0 +1,168 @@
+"""Vectorized SHA-256 over numpy uint32 lanes.
+
+The Merkle hot path of SSZ (`hash_tree_root`) hashes *pairs of 32-byte
+chunks*: each parent = SHA-256(left ‖ right) where the message is exactly 64
+bytes, i.e. one message block plus one constant padding block.  This module
+implements the compression function over arrays of N messages at once, so a
+whole Merkle tree level is hashed in two batched compressions — the same
+data layout the JAX/TPU kernel (`ops.sha256_jax`) uses, which keeps the two
+implementations bit-for-bit comparable.
+
+Replaces the per-object `hashlib` loop of the reference
+(`eth2spec/utils/hash_function.py:8` + remerkleable's per-node hashing).
+"""
+
+import numpy as np
+
+# fmt: off
+_K = np.array([
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5, 0x3956c25b, 0x59f111f1,
+    0x923f82a4, 0xab1c5ed5, 0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174, 0xe49b69c1, 0xefbe4786,
+    0x0fc19dc6, 0x240ca1cc, 0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7, 0xc6e00bf3, 0xd5a79147,
+    0x06ca6351, 0x14292967, 0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85, 0xa2bfe8a1, 0xa81a664b,
+    0xc24b8b70, 0xc76c51a3, 0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5, 0x391c0cb3, 0x4ed8aa4a,
+    0x5b9cca4f, 0x682e6ff3, 0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2], dtype=np.uint32)
+# fmt: on
+
+_IV = np.array(
+    [0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+     0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19], dtype=np.uint32)
+
+# Padding block for a 64-byte message: 0x80 then zeros then bit-length 512.
+_PAD64 = np.zeros(16, dtype=np.uint32)
+_PAD64[0] = 0x80000000
+_PAD64[15] = 512
+
+
+def _rotr(x: np.ndarray, n: int) -> np.ndarray:
+    return (x >> np.uint32(n)) | (x << np.uint32(32 - n))
+
+
+def compress(state: np.ndarray, block: np.ndarray) -> np.ndarray:
+    """One SHA-256 compression over a batch.
+
+    state: (N, 8) uint32;  block: (N, 16) or (16,) uint32 (broadcast).
+    Returns the new (N, 8) state.
+    """
+    w = [None] * 64
+    if block.ndim == 1:
+        block = np.broadcast_to(block, (state.shape[0], 16))
+    for t in range(16):
+        w[t] = block[:, t]
+    for t in range(16, 64):
+        s0 = _rotr(w[t - 15], 7) ^ _rotr(w[t - 15], 18) ^ (w[t - 15] >> np.uint32(3))
+        s1 = _rotr(w[t - 2], 17) ^ _rotr(w[t - 2], 19) ^ (w[t - 2] >> np.uint32(10))
+        w[t] = w[t - 16] + s0 + w[t - 7] + s1
+
+    a, b, c, d, e, f, g, h = (state[:, i] for i in range(8))
+    for t in range(64):
+        s1 = _rotr(e, 6) ^ _rotr(e, 11) ^ _rotr(e, 25)
+        ch = (e & f) ^ (~e & g)
+        t1 = h + s1 + ch + _K[t] + w[t]
+        s0 = _rotr(a, 2) ^ _rotr(a, 13) ^ _rotr(a, 22)
+        maj = (a & b) ^ (a & c) ^ (b & c)
+        t2 = s0 + maj
+        h, g, f, e, d, c, b, a = g, f, e, d + t1, c, b, a, t1 + t2
+
+    out = np.empty_like(state)
+    for i, v in enumerate((a, b, c, d, e, f, g, h)):
+        out[:, i] = state[:, i] + v
+    return out
+
+
+def sha256_64B_words(blocks: np.ndarray) -> np.ndarray:
+    """SHA-256 of N 64-byte messages given as (N, 16) big-endian uint32 words.
+
+    Returns digests as (N, 8) uint32 words.  This is the Merkle-parent hash:
+    block = left_chunk_words ‖ right_chunk_words.
+    """
+    n = blocks.shape[0]
+    state = np.broadcast_to(_IV, (n, 8)).copy()
+    state = compress(state, blocks)
+    state = compress(state, _PAD64)
+    return state
+
+
+def chunks_to_words(chunks: np.ndarray) -> np.ndarray:
+    """(N, 32) uint8 chunk bytes -> (N, 8) big-endian uint32 words."""
+    w = chunks.reshape(-1, 8, 4).astype(np.uint32)
+    return w[..., 0] << 24 | w[..., 1] << 16 | w[..., 2] << 8 | w[..., 3]
+
+
+def words_to_chunks(words: np.ndarray) -> np.ndarray:
+    """(N, 8) big-endian uint32 words -> (N, 32) uint8 chunk bytes."""
+    out = np.empty(words.shape[:-1] + (8, 4), dtype=np.uint8)
+    out[..., 0] = (words >> np.uint32(24)).astype(np.uint8)
+    out[..., 1] = (words >> np.uint32(16)).astype(np.uint8)
+    out[..., 2] = (words >> np.uint32(8)).astype(np.uint8)
+    out[..., 3] = words.astype(np.uint8)
+    return out.reshape(words.shape[:-1] + (32,))
+
+
+def hash_pairs_words(words: np.ndarray) -> np.ndarray:
+    """One Merkle level: (2N, 8) word chunks -> (N, 8) parent word chunks."""
+    pairs = words.reshape(-1, 16)
+    return sha256_64B_words(pairs)
+
+
+# --- zero-subtree hashes -----------------------------------------------------
+
+_MAX_DEPTH = 65  # depths 0..64 inclusive (SSZ gindex space caps at 2**64 leaves)
+
+
+def _compute_zero_hashes() -> np.ndarray:
+    """zero_hashes[i] = root (as 8 words) of a depth-i all-zero subtree."""
+    zh = np.zeros((_MAX_DEPTH, 8), dtype=np.uint32)
+    for i in range(1, _MAX_DEPTH):
+        zh[i] = sha256_64B_words(np.concatenate([zh[i - 1], zh[i - 1]])[None, :])[0]
+    return zh
+
+
+ZERO_HASH_WORDS = _compute_zero_hashes()
+ZERO_HASH_BYTES = [words_to_chunks(ZERO_HASH_WORDS[i][None, :])[0].tobytes()
+                   for i in range(_MAX_DEPTH)]
+
+
+def merkleize_words(words: np.ndarray, limit_depth: int) -> np.ndarray:
+    """Merkle root of chunk words (N, 8) padded (virtually) to 2**limit_depth
+    leaves.  Returns the root as (8,) uint32 words.
+
+    Level-by-level batched reduction: odd tails are padded with the zero-hash
+    of the current level, virtual all-zero subtrees above the data are folded
+    in with precomputed zero hashes — the same algorithm
+    `ssz/simple-serialize.md` specifies as `merkleize(chunks, limit)`.
+    """
+    n = words.shape[0]
+    assert n <= (1 << limit_depth)
+    if n == 0:
+        return np.array(ZERO_HASH_WORDS[limit_depth], copy=True)
+    level = words.astype(np.uint32)
+    d = 0
+    while level.shape[0] > 1:
+        if level.shape[0] % 2:
+            level = np.concatenate([level, ZERO_HASH_WORDS[d][None, :]])
+        level = hash_pairs_words(level)
+        d += 1
+    root = level[0]
+    while d < limit_depth:
+        block = np.concatenate([root, ZERO_HASH_WORDS[d]])[None, :]
+        root = sha256_64B_words(block)[0]
+        d += 1
+    return root
+
+
+def merkleize_chunks_bytes(chunks: bytes, limit: int | None = None) -> bytes:
+    """Merkle root of serialized chunk bytes (len % 32 == 0), as 32 bytes."""
+    assert len(chunks) % 32 == 0
+    arr = np.frombuffer(chunks, dtype=np.uint8).reshape(-1, 32)
+    count = arr.shape[0]
+    cap = count if limit is None else limit
+    depth = max(cap - 1, 0).bit_length()
+    words = chunks_to_words(arr) if count else np.zeros((0, 8), dtype=np.uint32)
+    root = merkleize_words(words, depth)
+    return words_to_chunks(root[None, :])[0].tobytes()

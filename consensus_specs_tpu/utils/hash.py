@@ -1,0 +1,18 @@
+"""SHA-256 hashing front-end.
+
+Host-side scalar path wraps hashlib; the batched paths live in
+``consensus_specs_tpu.ops.sha256_np`` (vectorized numpy) and
+``ops.sha256_jax`` (JAX/TPU).  Mirrors the role of the reference's
+``eth2spec/utils/hash_function.py:8`` (``hash(x) = sha256(x).digest()``).
+"""
+
+from hashlib import sha256 as _sha256
+
+
+def hash_eth2(data: bytes) -> bytes:
+    """32-byte SHA-256 digest (the only hash the consensus spec uses)."""
+    return _sha256(data).digest()
+
+
+# Spec modules bind this under the name `hash`.
+hash = hash_eth2

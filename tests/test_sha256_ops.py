@@ -1,0 +1,63 @@
+"""Batched SHA-256 kernels vs hashlib ground truth (host and JAX paths)."""
+
+import hashlib
+
+import numpy as np
+
+from consensus_specs_tpu.ops import sha256_np
+
+
+def _ref_parent(left: bytes, right: bytes) -> bytes:
+    return hashlib.sha256(left + right).digest()
+
+
+def test_sha256_64B_matches_hashlib():
+    rng = np.random.default_rng(1234)
+    msgs = rng.integers(0, 256, size=(33, 64), dtype=np.uint8)
+    words = sha256_np.chunks_to_words(msgs.reshape(-1, 32)).reshape(-1, 16)
+    got = sha256_np.words_to_chunks(sha256_np.sha256_64B_words(words))
+    for i in range(msgs.shape[0]):
+        assert got[i].tobytes() == hashlib.sha256(msgs[i].tobytes()).digest()
+
+
+def test_zero_hashes():
+    z = b"\x00" * 32
+    for i in range(5):
+        assert sha256_np.ZERO_HASH_BYTES[i + 1] == _ref_parent(
+            sha256_np.ZERO_HASH_BYTES[i], sha256_np.ZERO_HASH_BYTES[i])
+    assert sha256_np.ZERO_HASH_BYTES[0] == z
+
+
+def _naive_merkle(chunks: list[bytes], limit: int) -> bytes:
+    n = 1
+    while n < limit:
+        n *= 2
+    padded = chunks + [b"\x00" * 32] * (n - len(chunks))
+    while len(padded) > 1:
+        padded = [_ref_parent(padded[i], padded[i + 1])
+                  for i in range(0, len(padded), 2)]
+    return padded[0]
+
+
+def test_merkleize_chunks_bytes():
+    rng = np.random.default_rng(7)
+    for count, limit in [(1, 1), (2, 2), (3, 4), (5, 8), (8, 8), (5, 64),
+                         (0, 4), (1, 16)]:
+        chunks = [rng.integers(0, 256, 32, dtype=np.uint8).tobytes()
+                  for _ in range(count)]
+        got = sha256_np.merkleize_chunks_bytes(b"".join(chunks), limit)
+        assert got == _naive_merkle(chunks, max(limit, 1)), (count, limit)
+
+
+def test_jax_path_matches_numpy():
+    from consensus_specs_tpu.ops import sha256_jax
+
+    rng = np.random.default_rng(99)
+    words = rng.integers(0, 2**32, size=(16, 8), dtype=np.uint64).astype(np.uint32)
+    np_root = sha256_np.merkleize_words(words, 4)
+    jx_root = sha256_jax.merkleize_words_jax(words, 4)
+    assert np.array_equal(np_root, jx_root)
+    # non-power-of-two + virtual limit
+    np_root = sha256_np.merkleize_words(words[:5], 10)
+    jx_root = sha256_jax.merkleize_words_jax(words[:5], 10)
+    assert np.array_equal(np_root, jx_root)
