@@ -1,0 +1,71 @@
+"""Standalone padded Merkle tree + proofs — the independent oracle.
+
+Deliberately naive (per-node hashlib recursion) so it cross-checks the
+batched kernels and the SSZ engine from a totally different code path.
+Mirrors the role of the reference's `eth2spec/utils/merkle_minimal.py:39-91`.
+"""
+
+from .hash import hash_eth2
+
+ZERO_BYTES32 = b"\x00" * 32
+
+zerohashes = [ZERO_BYTES32]
+for _layer in range(1, 100):
+    zerohashes.append(hash_eth2(zerohashes[_layer - 1] + zerohashes[_layer - 1]))
+
+
+def calc_merkle_tree_from_leaves(values: list[bytes], layer_count: int = 32):
+    """All tree layers bottom-up, zero-padded to 2**layer_count leaves."""
+    values = list(values)
+    tree = [values[:]]
+    for h in range(layer_count):
+        if len(values) % 2 == 1:
+            values.append(zerohashes[h])
+        values = [hash_eth2(values[i] + values[i + 1])
+                  for i in range(0, len(values), 2)]
+        tree.append(values[:])
+    return tree
+
+
+def get_merkle_tree(values: list[bytes], pad_to: int | None = None):
+    layer_count = (max(pad_to, 1) - 1).bit_length() if pad_to else \
+        max(len(values) - 1, 0).bit_length()
+    if len(values) == 0:
+        return zerohashes[layer_count]
+    return calc_merkle_tree_from_leaves(values, layer_count)
+
+
+def get_merkle_root(values: list[bytes], pad_to: int = 1) -> bytes:
+    if pad_to == 0:
+        return zerohashes[0]
+    layer_count = (pad_to - 1).bit_length()
+    if len(values) == 0:
+        return zerohashes[layer_count]
+    return calc_merkle_tree_from_leaves(values, layer_count)[-1][0]
+
+
+def get_merkle_proof(tree, item_index: int, tree_len: int | None = None):
+    proof = []
+    for i in range(tree_len if tree_len is not None else len(tree)):
+        subindex = (item_index // 2**i) ^ 1
+        proof.append(tree[i][subindex] if subindex < len(tree[i])
+                     else zerohashes[i])
+    return proof
+
+
+def merkleize_chunks(chunks: list[bytes], limit: int | None = None) -> bytes:
+    """The SSZ `merkleize(chunks, limit)` primitive, naive level-by-level form."""
+    count = len(chunks)
+    if limit is None:
+        limit = count
+    assert count <= limit
+    if limit == 0:
+        return ZERO_BYTES32
+    max_depth = (limit - 1).bit_length()
+    level = list(chunks) if chunks else [zerohashes[0]]
+    for d in range(max_depth):
+        if len(level) % 2 == 1:
+            level.append(zerohashes[d])
+        level = [hash_eth2(level[i] + level[i + 1])
+                 for i in range(0, len(level), 2)]
+    return level[0]

@@ -1,0 +1,34 @@
+"""SSZ front-door functions, mirroring the reference facade
+(`eth2spec/utils/ssz/ssz_impl.py:8-37`): serialize / deserialize /
+hash_tree_root / uint_to_bytes / copy.
+"""
+
+from .types import View, uint
+
+
+def serialize(obj: View) -> bytes:
+    return obj.encode_bytes()
+
+
+def deserialize(typ: type, data: bytes) -> View:
+    return typ.decode_bytes(data)
+
+
+def hash_tree_root(obj) -> bytes:
+    """Root as a 32-byte value (spec code wraps it in Root/Bytes32)."""
+    from .types import Bytes32
+
+    if isinstance(obj, bytes) and not isinstance(obj, View):
+        raise TypeError("hash_tree_root takes an SSZ view, not raw bytes")
+    return Bytes32(obj.hash_tree_root())
+
+
+def uint_to_bytes(n: uint) -> bytes:
+    """Little-endian encoding at the uint's own byte length
+    (reference: `ssz_impl.py:28-30`)."""
+    assert isinstance(n, uint)
+    return n.encode_bytes()
+
+
+def copy(obj: View) -> View:
+    return obj.copy()

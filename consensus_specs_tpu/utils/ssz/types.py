@@ -1,0 +1,1114 @@
+"""SSZ type system with TPU-shaped merkleization.
+
+A ground-up redesign of the SSZ engine (the role remerkleable plays for the
+reference, see `eth2spec/utils/ssz/ssz_typing.py` re-exports): instead of a
+pointer-based persistent binary tree, composite values hold their leaves in
+contiguous buffers — packed basic lists/vectors are numpy arrays — so
+`hash_tree_root` is a *batched* Merkle reduction over chunk arrays
+(`ops.sha256_np` on host, `ops.sha256_jax` on TPU) rather than a per-node
+Python recursion.
+
+Semantics preserved from the reference engine that spec code and tests rely
+on:
+
+- views are mutable (`state.balances[i] += x`, `state.validators.append(v)`)
+  and `obj.copy()` produces an independent value (`ssz_impl.py:36`)
+- element access on composite lists returns the live child object; mutating
+  it dirties every ancestor's cached root (parent-pointer invalidation
+  replaces remerkleable's immutable re-binding)
+- assigning a child that already lives inside another composite stores a
+  copy, keeping single-ownership (value semantics at the assignment
+  boundary, like remerkleable's backing rebind)
+- equality = type + hash_tree_root
+
+Wire format + merkleization follow `ssz/simple-serialize.md` of the spec
+(chunk packing, limits, length/selector mix-ins, offset encoding).
+"""
+
+from __future__ import annotations
+
+import io
+from typing import Any, Iterable
+
+import numpy as np
+
+from ...ops import sha256_np
+from ..hash import hash_eth2
+
+BYTES_PER_CHUNK = 32
+OFFSET_BYTE_LENGTH = 4
+ZERO_CHUNK = b"\x00" * 32
+
+
+def _mix_in_length(root: bytes, length: int) -> bytes:
+    return hash_eth2(root + length.to_bytes(32, "little"))
+
+
+def _merkleize_chunks(chunks: bytes, limit: int | None = None) -> bytes:
+    return sha256_np.merkleize_chunks_bytes(chunks, limit)
+
+
+def _merkleize_roots(roots: list[bytes], limit: int | None = None) -> bytes:
+    return sha256_np.merkleize_chunks_bytes(b"".join(roots), limit)
+
+
+# ---------------------------------------------------------------------------
+# View protocol
+# ---------------------------------------------------------------------------
+
+
+class View:
+    """Common SSZ interface.  Class-level metadata + instance serialization.
+
+    Immutable leaf types (uints, booleans, byte arrays) subclass Python
+    builtins; mutable composites subclass MutableView below.
+    """
+
+    @classmethod
+    def is_fixed_size(cls) -> bool:
+        raise NotImplementedError
+
+    @classmethod
+    def type_byte_length(cls) -> int:
+        """Serialized length; only valid for fixed-size types."""
+        raise NotImplementedError
+
+    @classmethod
+    def default(cls) -> "View":
+        raise NotImplementedError
+
+    @classmethod
+    def decode_bytes(cls, data: bytes) -> "View":
+        raise NotImplementedError
+
+    def encode_bytes(self) -> bytes:
+        raise NotImplementedError
+
+    def hash_tree_root(self) -> bytes:
+        raise NotImplementedError
+
+    def copy(self):
+        return self  # immutable default
+
+    @classmethod
+    def coerce_view(cls, value: Any) -> "View":
+        if type(value) is cls:
+            return value
+        if isinstance(value, View):
+            if isinstance(value, cls):
+                return value  # subclass instance (custom-type alias), keep
+            if not isinstance(value, (int, bytes)):
+                raise TypeError(
+                    f"cannot coerce {type(value).__name__} to {cls.__name__}")
+        return cls(value)  # type: ignore[call-arg]
+
+
+class MutableView(View):
+    """Mutable composite with cached root + upward dirty propagation."""
+
+    __slots__ = ("_parent", "_root")
+
+    def __init__(self):
+        object.__setattr__(self, "_parent", None)
+        object.__setattr__(self, "_root", None)
+
+    def _mark_dirty(self) -> None:
+        # Walk the full ancestor chain: a clean ancestor can sit above a
+        # dirty node only if we ever stopped early, so never stop early.
+        node: MutableView | None = self
+        while node is not None:
+            object.__setattr__(node, "_root", None)
+            node = node._parent
+
+    def _adopt(self, child: Any) -> Any:
+        """Claim ownership of a mutable child, copying if already owned.
+
+        Copying also when the present owner is `self` preserves value
+        semantics for self-assignments like
+        `state.previous_justified_checkpoint = state.current_justified_checkpoint`.
+        """
+        if isinstance(child, MutableView):
+            if child._parent is not None:
+                child = child.copy()
+            object.__setattr__(child, "_parent", self)
+        return child
+
+    def hash_tree_root(self) -> bytes:
+        if self._root is None:
+            object.__setattr__(self, "_root", self._compute_root())
+        return self._root
+
+    def _compute_root(self) -> bytes:
+        raise NotImplementedError
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, View)
+            and type(other) is type(self)
+            and other.hash_tree_root() == self.hash_tree_root()
+        )
+
+    def __hash__(self):
+        return hash((type(self).__name__, self.hash_tree_root()))
+
+
+# ---------------------------------------------------------------------------
+# Basic types
+# ---------------------------------------------------------------------------
+
+
+class uint(int, View):
+    _byte_len = 0
+
+    def __new__(cls, value: int = 0):
+        if not isinstance(value, (int, np.integer)):
+            raise TypeError(f"uints are constructed from ints, got {type(value).__name__}")
+        v = int(value)
+        if v < 0 or v >> (cls._byte_len * 8):
+            raise ValueError(f"{cls.__name__} out of range: {value}")
+        return super().__new__(cls, v)
+
+    @classmethod
+    def is_fixed_size(cls) -> bool:
+        return True
+
+    @classmethod
+    def type_byte_length(cls) -> int:
+        return cls._byte_len
+
+    @classmethod
+    def default(cls):
+        return cls(0)
+
+    @classmethod
+    def decode_bytes(cls, data: bytes):
+        if len(data) != cls._byte_len:
+            raise ValueError(f"{cls.__name__}: expected {cls._byte_len} bytes")
+        return cls(int.from_bytes(data, "little"))
+
+    def encode_bytes(self) -> bytes:
+        return int(self).to_bytes(self._byte_len, "little")
+
+    def hash_tree_root(self) -> bytes:
+        return self.encode_bytes().ljust(32, b"\x00")
+
+
+class uint8(uint):
+    _byte_len = 1
+
+
+class uint16(uint):
+    _byte_len = 2
+
+
+class uint32(uint):
+    _byte_len = 4
+
+
+class uint64(uint):
+    _byte_len = 8
+
+
+class uint128(uint):
+    _byte_len = 16
+
+
+class uint256(uint):
+    _byte_len = 32
+
+
+byte = uint8  # SSZ alias
+
+
+class boolean(int, View):
+    def __new__(cls, value: int = 0):
+        if value not in (0, 1, False, True):
+            raise ValueError(f"boolean out of range: {value}")
+        return super().__new__(cls, int(value))
+
+    @classmethod
+    def is_fixed_size(cls) -> bool:
+        return True
+
+    @classmethod
+    def type_byte_length(cls) -> int:
+        return 1
+
+    @classmethod
+    def default(cls):
+        return cls(0)
+
+    @classmethod
+    def decode_bytes(cls, data: bytes):
+        if len(data) != 1 or data[0] > 1:
+            raise ValueError("invalid boolean encoding")
+        return cls(data[0])
+
+    def encode_bytes(self) -> bytes:
+        return bytes([int(self)])
+
+    def hash_tree_root(self) -> bytes:
+        return self.encode_bytes().ljust(32, b"\x00")
+
+
+bit = boolean
+
+_BASIC_NP_DTYPES: dict[type, Any] = {}
+
+
+def _register_np_dtypes():
+    _BASIC_NP_DTYPES.update({
+        uint8: np.dtype("<u1"),
+        uint16: np.dtype("<u2"),
+        uint32: np.dtype("<u4"),
+        uint64: np.dtype("<u8"),
+        boolean: np.dtype("<u1"),
+    })
+
+
+_register_np_dtypes()
+
+
+def is_basic_type(t: type) -> bool:
+    return isinstance(t, type) and issubclass(t, (uint, boolean))
+
+
+# ---------------------------------------------------------------------------
+# Byte arrays (immutable)
+# ---------------------------------------------------------------------------
+
+
+class _ParamMeta(type):
+    """Metaclass giving parametrized types (List[T, N] etc.) a cache."""
+
+    _cache: dict = {}
+
+    def __getitem__(cls, params):
+        if not isinstance(params, tuple):
+            params = (params,)
+        key = (cls, params)
+        cached = _ParamMeta._cache.get(key)
+        if cached is None:
+            cached = cls._parametrize(params)
+            _ParamMeta._cache[key] = cached
+        return cached
+
+
+class ByteVector(bytes, View, metaclass=_ParamMeta):
+    _length: int = 0
+
+    @classmethod
+    def _parametrize(cls, params):
+        (n,) = params
+        return type(f"ByteVector[{n}]", (ByteVector,), {"_length": int(n)})
+
+    def __new__(cls, value: bytes = b"", *args):
+        if args:
+            value = bytes([value, *args])  # ByteVector(1, 2, 3) form
+        if isinstance(value, (int,)):
+            raise TypeError("ByteVector takes bytes")
+        if isinstance(value, str):
+            value = bytes.fromhex(value.replace("0x", ""))
+        b = bytes(value)
+        if cls._length == 0:
+            raise TypeError("cannot instantiate unparametrized ByteVector")
+        if len(b) == 0:
+            b = b"\x00" * cls._length
+        if len(b) != cls._length:
+            raise ValueError(f"{cls.__name__}: expected {cls._length} bytes, got {len(b)}")
+        return super().__new__(cls, b)
+
+    @classmethod
+    def is_fixed_size(cls):
+        return True
+
+    @classmethod
+    def type_byte_length(cls):
+        return cls._length
+
+    @classmethod
+    def default(cls):
+        return cls(b"\x00" * cls._length)
+
+    @classmethod
+    def decode_bytes(cls, data: bytes):
+        return cls(data)
+
+    def encode_bytes(self) -> bytes:
+        return bytes(self)
+
+    def hash_tree_root(self) -> bytes:
+        padded = bytes(self)
+        if len(padded) % 32:
+            padded += b"\x00" * (32 - len(padded) % 32)
+        return _merkleize_chunks(padded)
+
+    def __repr__(self):
+        return f"{type(self).__name__}(0x{bytes(self).hex()})"
+
+
+class ByteList(bytes, View, metaclass=_ParamMeta):
+    _limit: int = 0
+
+    @classmethod
+    def _parametrize(cls, params):
+        (n,) = params
+        return type(f"ByteList[{n}]", (ByteList,), {"_limit": int(n)})
+
+    def __new__(cls, value: bytes = b""):
+        if isinstance(value, str):
+            value = bytes.fromhex(value.replace("0x", ""))
+        b = bytes(value)
+        if len(b) > cls._limit:
+            raise ValueError(f"{cls.__name__}: length {len(b)} exceeds limit {cls._limit}")
+        return super().__new__(cls, b)
+
+    @classmethod
+    def is_fixed_size(cls):
+        return False
+
+    @classmethod
+    def default(cls):
+        return cls(b"")
+
+    @classmethod
+    def decode_bytes(cls, data: bytes):
+        return cls(data)
+
+    def encode_bytes(self) -> bytes:
+        return bytes(self)
+
+    def hash_tree_root(self) -> bytes:
+        padded = bytes(self)
+        if len(padded) % 32:
+            padded += b"\x00" * (32 - len(padded) % 32)
+        limit_chunks = (self._limit + 31) // 32
+        return _mix_in_length(_merkleize_chunks(padded, limit_chunks), len(self))
+
+    def __repr__(self):
+        return f"{type(self).__name__}(0x{bytes(self).hex()})"
+
+
+Bytes1 = ByteVector[1]
+Bytes4 = ByteVector[4]
+Bytes8 = ByteVector[8]
+Bytes20 = ByteVector[20]
+Bytes31 = ByteVector[31]
+Bytes32 = ByteVector[32]
+Bytes48 = ByteVector[48]
+Bytes96 = ByteVector[96]
+
+
+# ---------------------------------------------------------------------------
+# Bitfields
+# ---------------------------------------------------------------------------
+
+
+class _BitsBase(MutableView):
+    __slots__ = ("_bits",)
+
+    def __init__(self, *args):
+        super().__init__()
+        if len(args) == 1 and not isinstance(args[0], (int, bool, np.bool_)) \
+                and isinstance(args[0], (Iterable,)):
+            bits = list(args[0])
+        else:
+            bits = list(args)
+        self._bits = np.array([bool(b) for b in bits], dtype=np.uint8)
+
+    def __len__(self):
+        return len(self._bits)
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return [bool(x) for x in self._bits[i]]
+        return bool(self._bits[int(i)])
+
+    def __setitem__(self, i, v):
+        self._bits[int(i)] = bool(v)
+        self._mark_dirty()
+
+    def __iter__(self):
+        return iter(bool(x) for x in self._bits)
+
+    def _packed_bytes(self) -> bytes:
+        return np.packbits(self._bits, bitorder="little").tobytes()
+
+    def _chunks(self) -> bytes:
+        packed = self._packed_bytes()
+        if len(packed) % 32:
+            packed += b"\x00" * (32 - len(packed) % 32)
+        return packed
+
+    def __repr__(self):
+        return f"{type(self).__name__}({[bool(b) for b in self._bits]})"
+
+
+class Bitvector(_BitsBase, metaclass=_ParamMeta):
+    _length: int = 0
+
+    @classmethod
+    def _parametrize(cls, params):
+        (n,) = params
+        assert n > 0
+        return type(f"Bitvector[{n}]", (Bitvector,), {"_length": int(n), "__slots__": ()})
+
+    def __init__(self, *args):
+        super().__init__(*args)
+        if len(self._bits) == 0:
+            self._bits = np.zeros(self._length, dtype=np.uint8)
+        if len(self._bits) != self._length:
+            raise ValueError(f"{type(self).__name__}: need {self._length} bits")
+
+    @classmethod
+    def is_fixed_size(cls):
+        return True
+
+    @classmethod
+    def type_byte_length(cls):
+        return (cls._length + 7) // 8
+
+    @classmethod
+    def default(cls):
+        return cls()
+
+    @classmethod
+    def decode_bytes(cls, data: bytes):
+        if len(data) != cls.type_byte_length():
+            raise ValueError(f"{cls.__name__}: bad byte length")
+        bits = np.unpackbits(np.frombuffer(data, dtype=np.uint8), bitorder="little")
+        if bits[cls._length:].any():
+            raise ValueError(f"{cls.__name__}: padding bits set")
+        return cls(bits[: cls._length])
+
+    def encode_bytes(self) -> bytes:
+        return self._packed_bytes()
+
+    def _compute_root(self) -> bytes:
+        return _merkleize_chunks(self._chunks(), (self._length + 255) // 256)
+
+    def copy(self):
+        return type(self)(self._bits.copy())
+
+
+class Bitlist(_BitsBase, metaclass=_ParamMeta):
+    _limit: int = 0
+
+    @classmethod
+    def _parametrize(cls, params):
+        (n,) = params
+        return type(f"Bitlist[{n}]", (Bitlist,), {"_limit": int(n), "__slots__": ()})
+
+    def __init__(self, *args):
+        super().__init__(*args)
+        if len(self._bits) > self._limit:
+            raise ValueError(f"{type(self).__name__}: exceeds limit {self._limit}")
+
+    @classmethod
+    def is_fixed_size(cls):
+        return False
+
+    @classmethod
+    def default(cls):
+        return cls()
+
+    @classmethod
+    def decode_bytes(cls, data: bytes):
+        if len(data) == 0:
+            raise ValueError("Bitlist: empty encoding (delimiter bit required)")
+        bits = np.unpackbits(np.frombuffer(data, dtype=np.uint8), bitorder="little")
+        # find delimiter: highest set bit
+        nz = np.nonzero(bits)[0]
+        if len(nz) == 0:
+            raise ValueError("Bitlist: missing delimiter bit")
+        delim = nz[-1]
+        if delim < (len(data) - 1) * 8:
+            raise ValueError("Bitlist: delimiter not in last byte")
+        return cls(bits[:delim])
+
+    def encode_bytes(self) -> bytes:
+        with_delim = np.concatenate([self._bits, np.array([1], dtype=np.uint8)])
+        return np.packbits(with_delim, bitorder="little").tobytes()
+
+    def _compute_root(self) -> bytes:
+        return _mix_in_length(
+            _merkleize_chunks(self._chunks(), (self._limit + 255) // 256),
+            len(self._bits),
+        )
+
+    def copy(self):
+        return type(self)(self._bits.copy())
+
+    def append(self, v):
+        if len(self._bits) + 1 > self._limit:
+            raise ValueError("Bitlist: append exceeds limit")
+        self._bits = np.append(self._bits, np.uint8(bool(v)))
+        self._mark_dirty()
+
+
+# ---------------------------------------------------------------------------
+# Homogeneous collections: List / Vector
+# ---------------------------------------------------------------------------
+
+
+def _chunk_pack_np(arr: np.ndarray) -> bytes:
+    """Pack a little-endian basic-value array into 32-byte-aligned bytes."""
+    raw = arr.tobytes()
+    if len(raw) % 32:
+        raw += b"\x00" * (32 - len(raw) % 32)
+    return raw
+
+
+class _SequenceBase(MutableView):
+    """Shared machinery for List/Vector.
+
+    Storage: numpy array for basic element types (uint8..64/boolean),
+    Python list of child views otherwise.  uint128/uint256 elements use the
+    Python-list path (no numpy dtype) with packed-byte merkleization.
+
+    Numpy storage uses an over-allocated buffer `_data` with logical length
+    `_len` (amortized O(1) append); `_np_view()` is the live window.
+    """
+
+    __slots__ = ("_data", "_len")
+    _element_type: type = None  # type: ignore[assignment]
+
+    @classmethod
+    def _np_dtype(cls):
+        return _BASIC_NP_DTYPES.get(cls._element_type)
+
+    @classmethod
+    def _validate_np(cls, arr) -> np.ndarray:
+        """Bulk-validate an array for the packed storage path."""
+        et = cls._element_type
+        arr = np.asarray(arr)
+        if arr.ndim != 1:
+            raise ValueError(f"{cls.__name__}: need a 1-D array")
+        if not (np.issubdtype(arr.dtype, np.integer) or arr.dtype == np.bool_):
+            raise TypeError(f"{cls.__name__}: need an integer array, got {arr.dtype}")
+        if arr.size:
+            mn, mx = int(arr.min()), int(arr.max())
+            if mn < 0:
+                raise ValueError(f"{cls.__name__}: negative element")
+            if et is boolean:
+                if mx > 1:
+                    raise ValueError(f"{cls.__name__}: boolean element > 1")
+            else:
+                bits = et.type_byte_length() * 8
+                if bits < 64 and mx >> bits:
+                    raise ValueError(f"{cls.__name__}: element out of range")
+        return np.ascontiguousarray(arr, dtype=_BASIC_NP_DTYPES[et])
+
+    def _np_view(self) -> np.ndarray:
+        return self._data[: self._len]
+
+    def _set_np(self, arr: np.ndarray) -> None:
+        object.__setattr__(self, "_data", arr)
+        object.__setattr__(self, "_len", len(arr))
+
+    def __init__(self, *args):
+        super().__init__()
+        dtype = self._np_dtype()
+        if (len(args) == 1 and isinstance(args[0], np.ndarray)
+                and dtype is not None):
+            self._set_np(self._validate_np(args[0]))
+            return
+        if len(args) == 1 and not isinstance(args[0], (bytes, str, int, View)) \
+                and isinstance(args[0], Iterable):
+            elems = list(args[0])
+        elif len(args) == 1 and isinstance(args[0], _SequenceBase):
+            elems = list(args[0])
+        else:
+            elems = list(args)
+        if dtype is not None:
+            self._set_np(np.array([int(self._element_type(e)) for e in elems],
+                                  dtype=dtype))
+        else:
+            self._data = [self._adopt(self._element_type.coerce_view(e)) for e in elems]
+            self._len = len(self._data)
+
+    # -- sequence protocol --
+
+    def __len__(self):
+        return self._len if self._np_dtype() is not None else len(self._data)
+
+    def __iter__(self):
+        et = self._element_type
+        if self._np_dtype() is not None:
+            return iter(et(int(x)) for x in self._np_view())
+        return iter(self._data)
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return list(self)[i]
+        i = int(i)
+        n = len(self)
+        if i < 0 or i >= n:
+            raise IndexError(f"index {i} out of range for length {n}")
+        if self._np_dtype() is not None:
+            return self._element_type(int(self._data[i]))
+        return self._data[i]
+
+    def __setitem__(self, i, value):
+        i = int(i)
+        n = len(self)
+        if i < 0 or i >= n:
+            raise IndexError(f"index {i} out of range for length {n}")
+        if self._np_dtype() is not None:
+            self._data[i] = int(self._element_type(value))
+        else:
+            self._data[i] = self._adopt(self._element_type.coerce_view(value))
+        self._mark_dirty()
+
+    def __contains__(self, item):
+        return any(x == item for x in self)
+
+    def index(self, item):
+        for j, x in enumerate(self):
+            if x == item:
+                return j
+        raise ValueError(f"{item!r} not in sequence")
+
+    # -- ssz plumbing --
+
+    def _element_roots(self) -> list[bytes]:
+        return [el.hash_tree_root() for el in self._data]
+
+    def _merkle_over_elements(self, limit: int | None) -> bytes:
+        et = self._element_type
+        if is_basic_type(et):
+            if self._np_dtype() is not None:
+                chunks = _chunk_pack_np(self._np_view())
+            else:  # uint128/uint256 python-list storage
+                raw = b"".join(e.encode_bytes() for e in self._data)
+                if len(raw) % 32:
+                    raw += b"\x00" * (32 - len(raw) % 32)
+                chunks = raw
+            chunk_limit = None
+            if limit is not None:
+                chunk_limit = (limit * et.type_byte_length() + 31) // 32
+            return _merkleize_chunks(chunks, chunk_limit)
+        return _merkleize_roots(self._element_roots(), limit)
+
+    def _serialize_elements(self) -> bytes:
+        et = self._element_type
+        if self._np_dtype() is not None:
+            return self._np_view().tobytes()
+        if et.is_fixed_size():
+            return b"".join(e.encode_bytes() for e in self._data)
+        parts = [e.encode_bytes() for e in self._data]
+        offset = OFFSET_BYTE_LENGTH * len(parts)
+        out = io.BytesIO()
+        for p in parts:
+            out.write(offset.to_bytes(4, "little"))
+            offset += len(p)
+        for p in parts:
+            out.write(p)
+        return out.getvalue()
+
+    @classmethod
+    def _deserialize_elements(cls, data: bytes, count_hint: int | None) -> list:
+        et = cls._element_type
+        if et.is_fixed_size():
+            size = et.type_byte_length()
+            if len(data) % size:
+                raise ValueError(f"{cls.__name__}: byte length not multiple of element size")
+            return [et.decode_bytes(data[i:i + size]) for i in range(0, len(data), size)]
+        if len(data) == 0:
+            return []
+        first_offset = int.from_bytes(data[:4], "little")
+        if first_offset % OFFSET_BYTE_LENGTH or first_offset > len(data):
+            raise ValueError(f"{cls.__name__}: bad first offset {first_offset}")
+        count = first_offset // OFFSET_BYTE_LENGTH
+        offsets = [int.from_bytes(data[4 * i:4 * i + 4], "little") for i in range(count)]
+        offsets.append(len(data))
+        elems = []
+        for i in range(count):
+            if offsets[i + 1] < offsets[i] or offsets[i + 1] > len(data):
+                raise ValueError(f"{cls.__name__}: bad offsets")
+            elems.append(et.decode_bytes(data[offsets[i]:offsets[i + 1]]))
+        return elems
+
+    def copy(self):
+        new = type(self).__new__(type(self))
+        MutableView.__init__(new)
+        if self._np_dtype() is not None:
+            new._set_np(self._np_view().copy())
+        else:
+            object.__setattr__(new, "_data",
+                               [new._adopt(e.copy()) for e in self._data])
+            object.__setattr__(new, "_len", len(self._data))
+        object.__setattr__(new, "_root", self._root)
+        return new
+
+    def __repr__(self):
+        return f"{type(self).__name__}({list(self)!r})"
+
+    # numpy escape hatch for the TPU sweeps (read-only contract)
+    def to_numpy(self) -> np.ndarray:
+        if self._np_dtype() is None:
+            raise TypeError("to_numpy only for packed basic sequences")
+        return self._np_view()
+
+    def set_numpy(self, arr: np.ndarray) -> None:
+        if self._np_dtype() is None:
+            raise TypeError("set_numpy only for packed basic sequences")
+        arr = self._validate_np(arr)
+        if isinstance(self, Vector) and len(arr) != type(self)._length:
+            raise ValueError("wrong length")
+        if isinstance(self, List) and len(arr) > type(self)._limit:
+            raise ValueError(f"{type(self).__name__}: exceeds limit")
+        self._set_np(arr)
+        self._mark_dirty()
+
+
+class List(_SequenceBase, metaclass=_ParamMeta):
+    _limit: int = 0
+
+    @classmethod
+    def _parametrize(cls, params):
+        et, limit = params
+        assert isinstance(et, type) and issubclass(et, View), et
+        return type(f"List[{getattr(et, '__name__', et)},{limit}]", (List,),
+                    {"_element_type": et, "_limit": int(limit), "__slots__": ()})
+
+    def __init__(self, *args):
+        super().__init__(*args)
+        if len(self) > self._limit:
+            raise ValueError(f"{type(self).__name__}: exceeds limit {self._limit}")
+
+    @classmethod
+    def is_fixed_size(cls):
+        return False
+
+    @classmethod
+    def default(cls):
+        return cls()
+
+    @classmethod
+    def decode_bytes(cls, data: bytes):
+        elems = cls._deserialize_elements(data, None)
+        if len(elems) > cls._limit:
+            raise ValueError(f"{cls.__name__}: exceeds limit")
+        return cls(elems)
+
+    def encode_bytes(self) -> bytes:
+        return self._serialize_elements()
+
+    def _compute_root(self) -> bytes:
+        return _mix_in_length(self._merkle_over_elements(self._limit), len(self))
+
+    def append(self, value):
+        if len(self) + 1 > self._limit:
+            raise ValueError(f"{type(self).__name__}: append exceeds limit")
+        if self._np_dtype() is not None:
+            v = int(self._element_type(value))
+            if self._len == len(self._data):  # grow buffer, amortized O(1)
+                cap = max(8, 2 * len(self._data))
+                buf = np.zeros(cap, dtype=self._np_dtype())
+                buf[: self._len] = self._data[: self._len]
+                object.__setattr__(self, "_data", buf)
+            self._data[self._len] = v
+            object.__setattr__(self, "_len", self._len + 1)
+        else:
+            self._data.append(self._adopt(self._element_type.coerce_view(value)))
+            object.__setattr__(self, "_len", len(self._data))
+        self._mark_dirty()
+
+    def pop(self):
+        if len(self) == 0:
+            raise IndexError("pop from empty List")
+        if self._np_dtype() is not None:
+            last = self._element_type(int(self._data[self._len - 1]))
+            object.__setattr__(self, "_len", self._len - 1)
+        else:
+            last = self._data.pop()
+            object.__setattr__(self, "_len", len(self._data))
+        self._mark_dirty()
+        return last
+
+
+class Vector(_SequenceBase, metaclass=_ParamMeta):
+    _length: int = 0
+
+    @classmethod
+    def _parametrize(cls, params):
+        et, n = params
+        assert isinstance(et, type) and issubclass(et, View), et
+        assert int(n) > 0
+        return type(f"Vector[{getattr(et, '__name__', et)},{n}]", (Vector,),
+                    {"_element_type": et, "_length": int(n), "__slots__": ()})
+
+    def __init__(self, *args):
+        super().__init__(*args)
+        if len(self) == 0:
+            dtype = self._np_dtype()
+            if dtype is not None:
+                self._set_np(np.zeros(self._length, dtype=dtype))
+            else:
+                self._data = [self._adopt(self._element_type.default())
+                              for _ in range(self._length)]
+                self._len = self._length
+        if len(self) != self._length:
+            raise ValueError(f"{type(self).__name__}: need {self._length} elements, "
+                             f"got {len(self)}")
+
+    @classmethod
+    def is_fixed_size(cls):
+        return cls._element_type.is_fixed_size()
+
+    @classmethod
+    def type_byte_length(cls):
+        return cls._element_type.type_byte_length() * cls._length
+
+    @classmethod
+    def default(cls):
+        return cls()
+
+    @classmethod
+    def decode_bytes(cls, data: bytes):
+        elems = cls._deserialize_elements(data, cls._length)
+        return cls(elems)
+
+    def encode_bytes(self) -> bytes:
+        return self._serialize_elements()
+
+    def _compute_root(self) -> bytes:
+        return self._merkle_over_elements(self._length)
+
+
+# ---------------------------------------------------------------------------
+# Container
+# ---------------------------------------------------------------------------
+
+
+class Container(MutableView):
+    """SSZ container; fields declared via class annotations.
+
+    class Checkpoint(Container):
+        epoch: uint64
+        root: Bytes32
+    """
+
+    __slots__ = ("_values",)
+    _field_types: dict[str, type] | None = None
+
+    @classmethod
+    def fields(cls) -> dict[str, type]:
+        if cls.__dict__.get("_field_types") is None:
+            out: dict[str, type] = {}
+            for klass in reversed(cls.__mro__):
+                anns = klass.__dict__.get("__annotations__", {})
+                for name, t in anns.items():
+                    if name.startswith("_"):
+                        continue
+                    out[name] = t
+            cls._field_types = out
+        return cls._field_types
+
+    def __init__(self, **kwargs):
+        super().__init__()
+        values: dict[str, View] = {}
+        ftypes = self.fields()
+        for name, t in ftypes.items():
+            if name in kwargs:
+                values[name] = self._adopt(t.coerce_view(kwargs.pop(name)))
+            else:
+                values[name] = self._adopt(t.default())
+        if kwargs:
+            raise TypeError(f"{type(self).__name__}: unknown fields {list(kwargs)}")
+        object.__setattr__(self, "_values", values)
+
+    def __getattr__(self, name):
+        # only called when normal lookup fails (i.e. not a slot/classattr)
+        try:
+            return object.__getattribute__(self, "_values")[name]
+        except KeyError:
+            raise AttributeError(f"{type(self).__name__} has no field {name!r}") from None
+
+    def __setattr__(self, name, value):
+        ftypes = self.fields()
+        if name in ftypes:
+            self._values[name] = self._adopt(ftypes[name].coerce_view(value))
+            self._mark_dirty()
+        else:
+            raise AttributeError(f"{type(self).__name__} has no field {name!r}")
+
+    @classmethod
+    def is_fixed_size(cls):
+        return all(t.is_fixed_size() for t in cls.fields().values())
+
+    @classmethod
+    def type_byte_length(cls):
+        assert cls.is_fixed_size()
+        return sum(t.type_byte_length() for t in cls.fields().values())
+
+    @classmethod
+    def default(cls):
+        return cls()
+
+    def encode_bytes(self) -> bytes:
+        ftypes = self.fields()
+        fixed_parts: list[bytes | None] = []
+        var_parts: list[bytes] = []
+        for name, t in ftypes.items():
+            v = self._values[name]
+            if t.is_fixed_size():
+                fixed_parts.append(v.encode_bytes())
+            else:
+                fixed_parts.append(None)
+                var_parts.append(v.encode_bytes())
+        fixed_len = sum(
+            len(p) if p is not None else OFFSET_BYTE_LENGTH for p in fixed_parts)
+        out = io.BytesIO()
+        offset = fixed_len
+        vi = 0
+        for p in fixed_parts:
+            if p is None:
+                out.write(offset.to_bytes(4, "little"))
+                offset += len(var_parts[vi])
+                vi += 1
+            else:
+                out.write(p)
+        for p in var_parts:
+            out.write(p)
+        return out.getvalue()
+
+    @classmethod
+    def decode_bytes(cls, data: bytes):
+        ftypes = cls.fields()
+        # pass 1: fixed segment layout
+        pos = 0
+        offsets: list[int] = []
+        fixed_raw: dict[str, bytes] = {}
+        for name, t in ftypes.items():
+            if t.is_fixed_size():
+                size = t.type_byte_length()
+                if pos + size > len(data):
+                    raise ValueError(f"{cls.__name__}: truncated at field {name}")
+                fixed_raw[name] = data[pos:pos + size]
+                pos += size
+            else:
+                if pos + 4 > len(data):
+                    raise ValueError(f"{cls.__name__}: truncated offset at {name}")
+                offsets.append(int.from_bytes(data[pos:pos + 4], "little"))
+                pos += 4
+        if offsets:
+            if offsets[0] != pos:
+                raise ValueError(f"{cls.__name__}: first offset {offsets[0]} != fixed end {pos}")
+            bounds = offsets + [len(data)]
+            for a, b in zip(bounds, bounds[1:]):
+                if b < a:
+                    raise ValueError(f"{cls.__name__}: offsets not monotonic")
+        elif pos != len(data):
+            raise ValueError(f"{cls.__name__}: trailing bytes")
+        # pass 2: decode
+        values: dict[str, View] = {}
+        vi = 0
+        for name, t in ftypes.items():
+            if t.is_fixed_size():
+                values[name] = t.decode_bytes(fixed_raw[name])
+            else:
+                a, b = offsets[vi], (offsets + [len(data)])[vi + 1]
+                values[name] = t.decode_bytes(data[a:b])
+                vi += 1
+        return cls(**values)
+
+    def _compute_root(self) -> bytes:
+        roots = [self._values[n].hash_tree_root() for n in self.fields()]
+        return _merkleize_roots(roots, len(roots))
+
+    def copy(self):
+        new = type(self).__new__(type(self))
+        MutableView.__init__(new)
+        object.__setattr__(new, "_values",
+                           {n: new._adopt(v.copy()) for n, v in self._values.items()})
+        object.__setattr__(new, "_root", self._root)
+        return new
+
+    def __repr__(self):
+        inner = ", ".join(f"{n}={v!r}" for n, v in self._values.items())
+        return f"{type(self).__name__}({inner})"
+
+
+# ---------------------------------------------------------------------------
+# Union
+# ---------------------------------------------------------------------------
+
+
+class Union(MutableView, metaclass=_ParamMeta):
+    """SSZ union; options given as Union[TypeA, TypeB, ...]; option 0 may be None."""
+
+    __slots__ = ("_selector", "_value")
+    _options: tuple = ()
+
+    @classmethod
+    def _parametrize(cls, params):
+        opts = tuple(params)
+        assert len(opts) >= 1
+        if opts[0] is None:
+            assert len(opts) >= 2, "None-only union is invalid"
+        names = ",".join("None" if o is None else o.__name__ for o in opts)
+        return type(f"Union[{names}]", (Union,), {"_options": opts, "__slots__": ()})
+
+    def __init__(self, selector: int = 0, value: Any = None):
+        super().__init__()
+        selector = int(selector)
+        if selector >= len(self._options):
+            raise ValueError("Union selector out of range")
+        opt = self._options[selector]
+        if opt is None:
+            if value is not None:
+                raise ValueError("Union option None takes no value")
+            v = None
+        else:
+            v = self._adopt(opt.coerce_view(value if value is not None else opt.default()))
+        object.__setattr__(self, "_selector", selector)
+        object.__setattr__(self, "_value", v)
+
+    @property
+    def selector(self) -> int:
+        return self._selector
+
+    @property
+    def value(self):
+        return self._value
+
+    @classmethod
+    def is_fixed_size(cls):
+        return False
+
+    @classmethod
+    def default(cls):
+        return cls(0, None if cls._options[0] is None else cls._options[0].default())
+
+    def encode_bytes(self) -> bytes:
+        sel = bytes([self._selector])
+        if self._value is None:
+            return sel
+        return sel + self._value.encode_bytes()
+
+    @classmethod
+    def decode_bytes(cls, data: bytes):
+        if len(data) == 0:
+            raise ValueError("Union: empty encoding")
+        sel = data[0]
+        if sel >= len(cls._options):
+            raise ValueError("Union: selector out of range")
+        opt = cls._options[sel]
+        if opt is None:
+            if len(data) != 1:
+                raise ValueError("Union: trailing bytes after None")
+            return cls(0, None)
+        return cls(sel, opt.decode_bytes(data[1:]))
+
+    def _compute_root(self) -> bytes:
+        inner = ZERO_CHUNK if self._value is None else self._value.hash_tree_root()
+        return hash_eth2(inner + self._selector.to_bytes(32, "little"))
+
+    def copy(self):
+        return type(self)(self._selector,
+                          None if self._value is None else self._value.copy())
+
+    def __repr__(self):
+        return f"{type(self).__name__}(selector={self._selector}, value={self._value!r})"

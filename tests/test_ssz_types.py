@@ -1,0 +1,263 @@
+"""SSZ engine: serialization round-trips, roots vs the naive oracle,
+mutation/caching semantics, copy independence."""
+
+import pytest
+
+from consensus_specs_tpu.utils.hash import hash_eth2
+from consensus_specs_tpu.utils.merkle_minimal import merkleize_chunks, zerohashes
+from consensus_specs_tpu.utils.ssz.ssz_impl import (
+    deserialize,
+    hash_tree_root,
+    serialize,
+    uint_to_bytes,
+)
+from consensus_specs_tpu.utils.ssz.ssz_typing import (
+    Bitlist,
+    Bitvector,
+    ByteList,
+    Bytes32,
+    Bytes48,
+    Container,
+    List,
+    Union,
+    Vector,
+    boolean,
+    uint8,
+    uint16,
+    uint64,
+    uint256,
+)
+
+
+def mix_len(root, n):
+    return hash_eth2(root + n.to_bytes(32, "little"))
+
+
+class Checkpoint(Container):
+    epoch: uint64
+    root: Bytes32
+
+
+class Wrapper(Container):
+    a: uint8
+    cp: Checkpoint
+    items: List[uint64, 1024]
+    flags: Bitlist[10]
+    name: ByteList[48]
+
+
+# ---- basics ----------------------------------------------------------------
+
+def test_uint_roundtrip_and_bounds():
+    assert serialize(uint64(0x0102030405060708)) == bytes.fromhex("0807060504030201")
+    assert deserialize(uint64, b"\x01" + b"\x00" * 7) == 1
+    assert uint_to_bytes(uint16(0x1234)) == b"\x34\x12"
+    with pytest.raises(ValueError):
+        uint8(256)
+    with pytest.raises(ValueError):
+        uint64(-1)
+    assert hash_tree_root(uint64(5)) == (5).to_bytes(8, "little") + b"\x00" * 24
+
+
+def test_boolean():
+    assert serialize(boolean(True)) == b"\x01"
+    with pytest.raises(ValueError):
+        boolean(2)
+    with pytest.raises(ValueError):
+        deserialize(boolean, b"\x02")
+
+
+def test_uint256():
+    v = uint256(2**255 + 7)
+    assert len(serialize(v)) == 32
+    assert deserialize(uint256, serialize(v)) == v
+    assert hash_tree_root(v) == serialize(v)
+
+
+# ---- byte arrays -----------------------------------------------------------
+
+def test_bytes32():
+    b = Bytes32(b"\x11" * 32)
+    assert serialize(b) == b"\x11" * 32
+    assert hash_tree_root(b) == b"\x11" * 32
+    with pytest.raises(ValueError):
+        Bytes32(b"\x11" * 31)
+    assert Bytes32() == b"\x00" * 32
+
+
+def test_bytes48_root_is_two_chunks():
+    b = Bytes48(b"\xaa" * 48)
+    expected = hash_eth2(b"\xaa" * 32 + b"\xaa" * 16 + b"\x00" * 16)
+    assert hash_tree_root(b) == expected
+
+
+def test_bytelist():
+    bl = ByteList[48](b"hello")
+    assert serialize(bl) == b"hello"
+    expected = mix_len(merkleize_chunks([b"hello".ljust(32, b"\x00")], 2), 5)
+    assert hash_tree_root(bl) == expected
+    assert deserialize(ByteList[48], b"hello") == bl
+    with pytest.raises(ValueError):
+        ByteList[4](b"hello")
+
+
+# ---- bitfields -------------------------------------------------------------
+
+def test_bitvector():
+    bv = Bitvector[10](1, 0, 1, 0, 0, 0, 0, 0, 1, 1)
+    enc = serialize(bv)
+    assert enc == bytes([0b00000101, 0b00000011])
+    assert deserialize(Bitvector[10], enc) == bv
+    with pytest.raises(ValueError):
+        deserialize(Bitvector[10], bytes([0xFF, 0xFF]))  # padding bits set
+    assert hash_tree_root(bv) == enc.ljust(32, b"\x00")
+
+
+def test_bitlist():
+    bl = Bitlist[10](1, 1, 0, 1)
+    enc = serialize(bl)
+    assert enc == bytes([0b00011011])  # 4 bits + delimiter at position 4
+    assert deserialize(Bitlist[10], enc) == bl
+    assert hash_tree_root(bl) == mix_len(bytes([0b00001011]).ljust(32, b"\x00"), 4)
+    empty = Bitlist[10]()
+    assert serialize(empty) == b"\x01"
+    assert sum(bl) == 3
+    bl[2] = True
+    assert sum(bl) == 4
+    with pytest.raises(ValueError):
+        Bitlist[3](1, 1, 1, 1)
+    with pytest.raises(ValueError):
+        deserialize(Bitlist[10], b"")
+
+
+# ---- lists / vectors -------------------------------------------------------
+
+def test_list_uint64():
+    lst = List[uint64, 1024](1, 2, 3)
+    assert serialize(lst) == b"".join(i.to_bytes(8, "little") for i in (1, 2, 3))
+    chunk = serialize(lst).ljust(32, b"\x00")
+    assert hash_tree_root(lst) == mix_len(merkleize_chunks([chunk], 256), 3)
+    lst.append(4)
+    assert len(lst) == 4 and lst[3] == 4
+    assert lst.pop() == 4
+    lst[0] = 100
+    assert lst[0] == 100
+    assert deserialize(List[uint64, 1024], serialize(lst)) == lst
+
+
+def test_vector_bytes32():
+    v = Vector[Bytes32, 4](b"\x01" * 32, b"\x02" * 32, b"\x03" * 32, b"\x04" * 32)
+    assert serialize(v) == b"\x01" * 32 + b"\x02" * 32 + b"\x03" * 32 + b"\x04" * 32
+    assert hash_tree_root(v) == merkleize_chunks(
+        [b"\x01" * 32, b"\x02" * 32, b"\x03" * 32, b"\x04" * 32])
+    v[1] = Bytes32(b"\xff" * 32)
+    assert v[1] == b"\xff" * 32
+    with pytest.raises(IndexError):
+        v[4]
+    with pytest.raises(ValueError):
+        Vector[Bytes32, 4](b"\x01" * 32)
+
+
+def test_list_of_containers_variable():
+    class Small(Container):
+        x: uint8
+        data: ByteList[8]
+
+    lst = List[Small, 4](Small(x=1, data=b"ab"), Small(x=2, data=b""))
+    enc = serialize(lst)
+    got = deserialize(List[Small, 4], enc)
+    assert got == lst
+    assert hash_tree_root(got) == hash_tree_root(lst)
+    roots = [hash_tree_root(e) for e in lst]
+    assert hash_tree_root(lst) == mix_len(merkleize_chunks(roots, 4), 2)
+
+
+# ---- containers ------------------------------------------------------------
+
+def test_container_roundtrip_and_root():
+    cp = Checkpoint(epoch=7, root=b"\x0a" * 32)
+    assert serialize(cp) == (7).to_bytes(8, "little") + b"\x0a" * 32
+    expect = merkleize_chunks(
+        [(7).to_bytes(8, "little").ljust(32, b"\x00"), b"\x0a" * 32])
+    assert hash_tree_root(cp) == expect
+    assert deserialize(Checkpoint, serialize(cp)) == cp
+
+
+def test_container_defaults_and_unknown_field():
+    cp = Checkpoint()
+    assert cp.epoch == 0 and cp.root == b"\x00" * 32
+    with pytest.raises(TypeError):
+        Checkpoint(bogus=1)
+    with pytest.raises(AttributeError):
+        cp.bogus = 1
+
+
+def test_nested_mutation_dirties_ancestors():
+    w = Wrapper(a=1, cp=Checkpoint(epoch=1, root=b"\x01" * 32),
+                items=[1, 2, 3], flags=[True, False], name=b"x")
+    r0 = hash_tree_root(w)
+    w.cp.epoch = 2  # mutate via live child reference
+    r1 = hash_tree_root(w)
+    assert r0 != r1
+    w.items[1] = 99
+    r2 = hash_tree_root(w)
+    assert r2 != r1
+    w.flags[1] = True
+    assert hash_tree_root(w) != r2
+
+
+def test_copy_independence():
+    w = Wrapper(a=1, cp=Checkpoint(epoch=1), items=[1, 2, 3])
+    w2 = w.copy()
+    w2.cp.epoch = 9
+    w2.items.append(4)
+    assert w.cp.epoch == 1
+    assert len(w.items) == 3
+    assert hash_tree_root(w) != hash_tree_root(w2)
+
+
+def test_adopt_copies_owned_child():
+    cp = Checkpoint(epoch=3)
+    w1 = Wrapper(cp=cp)
+    w2 = Wrapper(cp=w1.cp)  # child already owned by w1 -> copied
+    w2.cp.epoch = 5
+    assert w1.cp.epoch == 3
+
+
+def test_variable_container_offsets():
+    w = Wrapper(a=7, items=[5], flags=[True], name=b"hi")
+    enc = serialize(w)
+    got = deserialize(Wrapper, enc)
+    assert got == w
+    # corrupt the first offset (fixed part: a=1B + cp=40B -> offset at 41)
+    bad = bytearray(enc)
+    bad[41:45] = (0xFFFF).to_bytes(4, "little")
+    with pytest.raises(ValueError):
+        deserialize(Wrapper, bytes(bad))
+
+
+# ---- union -----------------------------------------------------------------
+
+def test_union():
+    U = Union[None, uint64, Bytes32]
+    u0 = U(0)
+    u1 = U(1, 42)
+    u2 = U(2, b"\x05" * 32)
+    assert serialize(u0) == b"\x00"
+    assert serialize(u1) == b"\x01" + (42).to_bytes(8, "little")
+    assert deserialize(U, serialize(u2)) == u2
+    assert hash_tree_root(u1) == hash_eth2(
+        hash_tree_root(uint64(42)) + (1).to_bytes(32, "little"))
+    with pytest.raises(ValueError):
+        U(3)
+
+
+# ---- equality spans storage modes ------------------------------------------
+
+def test_numpy_and_python_storage_equal():
+    import numpy as np
+
+    a = List[uint64, 64](np.array([1, 2, 3], dtype=np.uint64))
+    b = List[uint64, 64](1, 2, 3)
+    assert a == b
+    assert serialize(a) == serialize(b)
