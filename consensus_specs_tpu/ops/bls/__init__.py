@@ -1,13 +1,110 @@
-"""BLS facade — pluggable backend front-end (filled in by M3).
+"""BLS facade — pluggable-backend front-end.
 
-Mirrors the reference's backend-switchable `eth2spec/utils/bls.py` seam.
+Mirrors the reference's backend-switchable `eth2spec/utils/bls.py` seam:
+a module-global backend, a `bls_active` kill-switch returning stub values
+(used by the test framework's `--disable-bls` fast path), and the full
+Sign/Verify/aggregate + point API re-exported at module level.
+
+Backends:
+- "py":  pure-Python oracle (fields/curve/pairing/hash_to_curve here)
+- "jax": batched device path for the hot aggregate checks (falls back to
+         "py" per-call semantics; batch entry points live in ops.bls_batch)
 """
 
+from . import ciphersuite as _py
+
 bls_active = True
-_backend = "py"
+_backend_name = "py"
+
+STUB_SIGNATURE = b"\x11" * 96
+STUB_PUBKEY = b"\x22" * 48
+G1_POINT_AT_INFINITY = _py.G1_POINT_AT_INFINITY
+G2_POINT_AT_INFINITY = _py.G2_POINT_AT_INFINITY
+STUB_COORDINATES = None
 
 
 def use_backend(name: str) -> None:
-    global _backend
+    global _backend_name
     assert name in ("py", "jax"), name
-    _backend = name
+    _backend_name = name
+
+
+def backend_name() -> str:
+    return _backend_name
+
+
+# --- scheme functions, honoring the kill-switch -----------------------------
+
+
+def Sign(privkey, message):
+    if not bls_active:
+        return STUB_SIGNATURE
+    return _py.Sign(int(privkey), bytes(message))
+
+
+def Verify(pubkey, message, signature):
+    if not bls_active:
+        return True
+    return _py.Verify(bytes(pubkey), bytes(message), bytes(signature))
+
+
+def Aggregate(signatures):
+    if not bls_active:
+        return STUB_SIGNATURE
+    return _py.Aggregate([bytes(s) for s in signatures])
+
+
+def AggregateVerify(pubkeys, messages, signature):
+    if not bls_active:
+        return True
+    return _py.AggregateVerify([bytes(p) for p in pubkeys],
+                               [bytes(m) for m in messages],
+                               bytes(signature))
+
+
+def FastAggregateVerify(pubkeys, message, signature):
+    if not bls_active:
+        return True
+    return _py.FastAggregateVerify([bytes(p) for p in pubkeys],
+                                   bytes(message), bytes(signature))
+
+
+def AggregatePKs(pubkeys):
+    if not bls_active:
+        return STUB_PUBKEY
+    return _py.AggregatePKs([bytes(p) for p in pubkeys])
+
+
+def SkToPk(privkey):
+    if not bls_active:
+        return STUB_PUBKEY
+    return _py.SkToPk(int(privkey))
+
+
+def KeyValidate(pubkey):
+    if not bls_active:
+        return True
+    return _py.KeyValidate(bytes(pubkey))
+
+
+# --- point API (always active; KZG needs real math even with sigs off) ------
+
+add = _py.add
+multiply = _py.multiply
+neg = _py.neg
+multi_exp = _py.multi_exp
+eq = _py.eq
+Z1 = _py.Z1
+Z2 = _py.Z2
+G1 = _py.G1
+G2 = _py.G2
+G1_to_bytes48 = _py.G1_to_bytes48
+G2_to_bytes96 = _py.G2_to_bytes96
+bytes48_to_G1 = _py.bytes48_to_G1
+bytes96_to_G2 = _py.bytes96_to_G2
+
+
+def pairing_check(values):
+    if not bls_active:
+        return True
+    return _py.pairing_check(values)

@@ -332,6 +332,9 @@ class ByteVector(bytes, View, metaclass=_ParamMeta):
 
     @classmethod
     def decode_bytes(cls, data: bytes):
+        if len(data) != cls._length:
+            raise ValueError(f"{cls.__name__}: expected {cls._length} bytes, "
+                             f"got {len(data)}")
         return cls(data)
 
     def encode_bytes(self) -> bytes:
@@ -405,7 +408,7 @@ Bytes96 = ByteVector[96]
 
 
 class _BitsBase(MutableView):
-    __slots__ = ("_bits",)
+    __slots__ = ("_bits", "_nbits")
 
     def __init__(self, *args):
         super().__init__()
@@ -415,24 +418,34 @@ class _BitsBase(MutableView):
         else:
             bits = list(args)
         self._bits = np.array([bool(b) for b in bits], dtype=np.uint8)
+        self._nbits = len(self._bits)
+
+    def _view(self) -> np.ndarray:
+        return self._bits[: self._nbits]
 
     def __len__(self):
-        return len(self._bits)
+        return self._nbits
 
     def __getitem__(self, i):
         if isinstance(i, slice):
-            return [bool(x) for x in self._bits[i]]
-        return bool(self._bits[int(i)])
+            return [bool(x) for x in self._view()[i]]
+        i = int(i)
+        if i < 0 or i >= self._nbits:
+            raise IndexError(f"bit index {i} out of range for length {self._nbits}")
+        return bool(self._bits[i])
 
     def __setitem__(self, i, v):
-        self._bits[int(i)] = bool(v)
+        i = int(i)
+        if i < 0 or i >= self._nbits:
+            raise IndexError(f"bit index {i} out of range for length {self._nbits}")
+        self._bits[i] = bool(v)
         self._mark_dirty()
 
     def __iter__(self):
-        return iter(bool(x) for x in self._bits)
+        return iter(bool(x) for x in self._view())
 
     def _packed_bytes(self) -> bytes:
-        return np.packbits(self._bits, bitorder="little").tobytes()
+        return np.packbits(self._view(), bitorder="little").tobytes()
 
     def _chunks(self) -> bytes:
         packed = self._packed_bytes()
@@ -441,7 +454,7 @@ class _BitsBase(MutableView):
         return packed
 
     def __repr__(self):
-        return f"{type(self).__name__}({[bool(b) for b in self._bits]})"
+        return f"{type(self).__name__}({[bool(b) for b in self._view()]})"
 
 
 class Bitvector(_BitsBase, metaclass=_ParamMeta):
@@ -455,9 +468,10 @@ class Bitvector(_BitsBase, metaclass=_ParamMeta):
 
     def __init__(self, *args):
         super().__init__(*args)
-        if len(self._bits) == 0:
+        if self._nbits == 0:
             self._bits = np.zeros(self._length, dtype=np.uint8)
-        if len(self._bits) != self._length:
+            self._nbits = self._length
+        if self._nbits != self._length:
             raise ValueError(f"{type(self).__name__}: need {self._length} bits")
 
     @classmethod
@@ -488,7 +502,7 @@ class Bitvector(_BitsBase, metaclass=_ParamMeta):
         return _merkleize_chunks(self._chunks(), (self._length + 255) // 256)
 
     def copy(self):
-        return type(self)(self._bits.copy())
+        return type(self)(self._view().copy())
 
 
 class Bitlist(_BitsBase, metaclass=_ParamMeta):
@@ -501,7 +515,7 @@ class Bitlist(_BitsBase, metaclass=_ParamMeta):
 
     def __init__(self, *args):
         super().__init__(*args)
-        if len(self._bits) > self._limit:
+        if self._nbits > self._limit:
             raise ValueError(f"{type(self).__name__}: exceeds limit {self._limit}")
 
     @classmethod
@@ -527,22 +541,28 @@ class Bitlist(_BitsBase, metaclass=_ParamMeta):
         return cls(bits[:delim])
 
     def encode_bytes(self) -> bytes:
-        with_delim = np.concatenate([self._bits, np.array([1], dtype=np.uint8)])
+        with_delim = np.concatenate([self._view(),
+                                     np.array([1], dtype=np.uint8)])
         return np.packbits(with_delim, bitorder="little").tobytes()
 
     def _compute_root(self) -> bytes:
         return _mix_in_length(
             _merkleize_chunks(self._chunks(), (self._limit + 255) // 256),
-            len(self._bits),
+            self._nbits,
         )
 
     def copy(self):
-        return type(self)(self._bits.copy())
+        return type(self)(self._view().copy())
 
     def append(self, v):
-        if len(self._bits) + 1 > self._limit:
+        if self._nbits + 1 > self._limit:
             raise ValueError("Bitlist: append exceeds limit")
-        self._bits = np.append(self._bits, np.uint8(bool(v)))
+        if self._nbits == len(self._bits):  # grow buffer, amortized O(1)
+            buf = np.zeros(max(8, 2 * len(self._bits)), dtype=np.uint8)
+            buf[: self._nbits] = self._bits[: self._nbits]
+            self._bits = buf
+        self._bits[self._nbits] = bool(v)
+        self._nbits += 1
         self._mark_dirty()
 
 
@@ -868,6 +888,9 @@ class Vector(_SequenceBase, metaclass=_ParamMeta):
     @classmethod
     def decode_bytes(cls, data: bytes):
         elems = cls._deserialize_elements(data, cls._length)
+        if len(elems) != cls._length:
+            raise ValueError(f"{cls.__name__}: expected {cls._length} elements, "
+                             f"got {len(elems)}")
         return cls(elems)
 
     def encode_bytes(self) -> bytes:

@@ -1,0 +1,186 @@
+"""BLS12-381: field/curve/pairing laws, serialization, scheme behavior.
+
+No external vectors exist in this environment; correctness is pinned by
+algebraic laws (bilinearity, group laws, derived-vs-known cofactors) and
+scheme-level roundtrips, which together determine the implementation up to
+the hash-to-curve suite choice (documented in ops/bls/hash_to_curve.py).
+"""
+
+import pytest
+
+from consensus_specs_tpu.ops import bls
+from consensus_specs_tpu.ops.bls.curve import (
+    G1_GEN,
+    G2_GEN,
+    H1,
+    H2,
+    g1,
+    g1_from_bytes,
+    g1_to_bytes,
+    g2,
+    g2_from_bytes,
+    g2_to_bytes,
+    subgroup_check_g2,
+)
+from consensus_specs_tpu.ops.bls.fields import (
+    FQ2_ONE,
+    FQ12_ONE,
+    Q,
+    R,
+    Fq2,
+)
+from consensus_specs_tpu.ops.bls.hash_to_curve import (
+    expand_message_xmd,
+    hash_to_g2,
+)
+from consensus_specs_tpu.ops.bls.pairing import pairing
+
+
+def test_known_cofactors():
+    # published BLS12-381 cofactors, vs our derived-from-CM values
+    assert H1 == 0x396C8C005555E1568C00AAAB0000AAAB
+    assert H2 == 0x5D543A95414E7F1091D50792876A202CD91DE4547085ABAA68A205B2E5A7DDFA628F1CB4D9E82EF21537E293A6691AE1616EC6E786F0C70CF1C38E31C7238E5
+
+
+def test_field_tower_laws():
+    a = Fq2(123456789, 987654321)
+    b = Fq2(555, 777)
+    assert (a * b) == (b * a)
+    assert a * a.inv() == FQ2_ONE
+    assert (a + b) * (a - b) == a * a - b * b
+    s = a.sqrt()
+    if s is not None:
+        assert s.square() == a
+
+
+def test_fq12_frobenius_is_qth_power():
+    from consensus_specs_tpu.ops.bls.pairing import untwist
+    f = untwist(G2_GEN)[0]  # a generic Fq12 element
+    assert f.frobenius(1) == f.pow(Q)
+    assert f.frobenius(2) == f.frobenius(1).frobenius(1)
+
+
+def test_g1_group_law():
+    p2 = g1.mul(G1_GEN, 2)
+    assert g1.eq_points(g1.add(G1_GEN, G1_GEN), p2)
+    assert g1.eq_points(g1.add(p2, g1.neg(G1_GEN)), G1_GEN)
+    assert g1.is_inf(g1.mul(G1_GEN, R))
+    assert g1.on_curve(g1.mul(G1_GEN, 12345))
+
+
+def test_g2_group_law():
+    p3 = g2.mul(G2_GEN, 3)
+    assert g2.eq_points(g2.add(g2.add(G2_GEN, G2_GEN), G2_GEN), p3)
+    assert g2.is_inf(g2.mul(G2_GEN, R))
+
+
+def test_serialization_roundtrip():
+    for k in (1, 2, 31415):
+        p = g1.mul(G1_GEN, k)
+        assert g1.eq_points(g1_from_bytes(g1_to_bytes(p)), p)
+        assert g1.eq_points(g1_from_bytes(g1_to_bytes(p, compressed=False)), p)
+        q = g2.mul(G2_GEN, k)
+        assert g2.eq_points(g2_from_bytes(g2_to_bytes(q)), q)
+        assert g2.eq_points(g2_from_bytes(g2_to_bytes(q, compressed=False)), q)
+    assert g1.is_inf(g1_from_bytes(b"\xc0" + b"\x00" * 47))
+    assert g1_to_bytes(g1.infinity()) == b"\xc0" + b"\x00" * 47
+
+
+def test_known_generator_compressed_bytes():
+    # The canonical compressed G1 generator (public constant, e.g. in the
+    # KZG trusted setup): flags 0x97 prefix
+    enc = g1_to_bytes(G1_GEN)
+    assert enc[0] & 0x80
+    assert g1.eq_points(g1_from_bytes(enc), G1_GEN)
+
+
+def test_serialization_rejects_garbage():
+    with pytest.raises(ValueError):
+        g1_from_bytes(b"\x00" * 48)  # no compression flag
+    with pytest.raises(ValueError):
+        g1_from_bytes((Q).to_bytes(48, "big")[:48])  # x >= q w/o flag
+    with pytest.raises(ValueError):
+        g2_from_bytes(b"\xff" * 96)  # x >= q
+
+
+def test_pairing_bilinearity():
+    e = pairing(G1_GEN, G2_GEN)
+    assert not e.is_one()
+    assert e.pow(R).is_one()
+    assert pairing(g1.mul(G1_GEN, 6), G2_GEN) == e.pow(6)
+    assert pairing(G1_GEN, g2.mul(G2_GEN, 6)) == e.pow(6)
+    assert pairing(g1.mul(G1_GEN, 5), g2.mul(G2_GEN, 7)) == e.pow(35)
+
+
+def test_expand_message_xmd_properties():
+    # deterministic, length-exact, dst-separated
+    a = expand_message_xmd(b"msg", b"DST-A", 96)
+    b = expand_message_xmd(b"msg", b"DST-A", 96)
+    c = expand_message_xmd(b"msg", b"DST-B", 96)
+    assert a == b and a != c and len(a) == 96
+    assert expand_message_xmd(b"", b"D", 32) != expand_message_xmd(b"\x00", b"D", 32)
+
+
+def test_hash_to_g2_on_curve_and_in_subgroup():
+    for msg in (b"", b"hello", b"\x00" * 32):
+        p = hash_to_g2(msg)
+        assert subgroup_check_g2(p)
+        assert not g2.is_inf(p)
+    assert not g2.eq_points(hash_to_g2(b"a"), hash_to_g2(b"b"))
+    assert g2.eq_points(hash_to_g2(b"a"), hash_to_g2(b"a"))
+
+
+def test_sign_verify():
+    sk = 12345
+    pk = bls.SkToPk(sk)
+    msg = b"\x12" * 32
+    sig = bls.Sign(sk, msg)
+    assert len(sig) == 96 and len(pk) == 48
+    assert bls.Verify(pk, msg, sig)
+    assert not bls.Verify(pk, b"\x13" * 32, sig)
+    assert not bls.Verify(bls.SkToPk(54321), msg, sig)
+    assert not bls.Verify(pk, msg, bls.Sign(54321, msg))
+
+
+def test_verify_rejects_malformed():
+    assert not bls.Verify(b"\x00" * 48, b"m", b"\x00" * 96)
+    assert not bls.Verify(bls.G1_POINT_AT_INFINITY, b"m",
+                          bls.Sign(5, b"m"))
+
+
+def test_aggregate_verify():
+    sks = [10, 20, 30]
+    msgs = [b"\x01" * 32, b"\x02" * 32, b"\x03" * 32]
+    pks = [bls.SkToPk(sk) for sk in sks]
+    sigs = [bls.Sign(sk, m) for sk, m in zip(sks, msgs)]
+    agg = bls.Aggregate(sigs)
+    assert bls.AggregateVerify(pks, msgs, agg)
+    assert not bls.AggregateVerify(pks, msgs[::-1], agg)
+    assert not bls.AggregateVerify(pks[:2], msgs[:2], agg)
+
+
+def test_fast_aggregate_verify():
+    sks = [7, 8, 9]
+    msg = b"\x42" * 32
+    pks = [bls.SkToPk(sk) for sk in sks]
+    agg = bls.Aggregate([bls.Sign(sk, msg) for sk in sks])
+    assert bls.FastAggregateVerify(pks, msg, agg)
+    assert not bls.FastAggregateVerify(pks, b"\x43" * 32, agg)
+    assert not bls.FastAggregateVerify(pks[:2], msg, agg)
+    # equivalent via aggregated pubkey + plain Verify
+    assert bls.Verify(bls.AggregatePKs(pks), msg, agg)
+
+
+def test_multi_exp_and_point_api():
+    pts = [bls.multiply(bls.G1(), k) for k in (1, 2, 3)]
+    got = bls.multi_exp(pts, [5, 6, 7])
+    want = bls.multiply(bls.G1(), 1 * 5 + 2 * 6 + 3 * 7)
+    assert bls.eq(got, want)
+    assert bls.eq(bls.add(bls.G1(), bls.Z1()), bls.G1())
+    assert bls.bytes48_to_G1(bls.G1_to_bytes48(bls.G1()))
+
+
+def test_key_validate():
+    assert bls.KeyValidate(bls.SkToPk(99))
+    assert not bls.KeyValidate(bls.G1_POINT_AT_INFINITY)
+    assert not bls.KeyValidate(b"\x01" * 48)
