@@ -233,17 +233,14 @@ def _derive_cofactors():
     valid = [n for n in candidates if n % R == 0]
     assert valid, "no twist order divisible by r"
     h2 = None
+    q_pt = _random_twist_point(12345)  # out-of-subgroup witness point
     for n in valid:
         h = n // R
-        # verify: clearing by h lands points in the r-torsion
-        p = g2.mul_full(G2_GEN, 7)  # already in subgroup; r*p must vanish
-        if g2.is_inf(g2.mul_full(p, R)):
-            # now verify with an out-of-subgroup point
-            q_pt = _random_twist_point(12345)
-            cleared = g2.mul_full(q_pt, h)
-            if g2.is_inf(g2.mul_full(cleared, R)) and not g2.is_inf(cleared):
-                h2 = h
-                break
+        # verify: clearing by h lands the witness in the r-torsion
+        cleared = g2.mul_full(q_pt, h)
+        if g2.is_inf(g2.mul_full(cleared, R)) and not g2.is_inf(cleared):
+            h2 = h
+            break
     assert h2 is not None, "cofactor derivation failed"
     return h1, h2
 
@@ -341,7 +338,7 @@ def g1_from_bytes(data: bytes):
         if flags & 0x80:
             raise ValueError("96-byte G1 must be uncompressed")
         if flags & 0x40:
-            if any(data[1:]):
+            if any(data[1:]) or flags & 0x3F:
                 raise ValueError("bad infinity encoding")
             return g1.infinity()
         x = int.from_bytes(data[:48], "big")
@@ -398,7 +395,7 @@ def g2_from_bytes(data: bytes):
         if flags & 0x80:
             raise ValueError("192-byte G2 must be uncompressed")
         if flags & 0x40:
-            if any(data[1:]):
+            if any(data[1:]) or flags & 0x3F:
                 raise ValueError("bad infinity encoding")
             return g2.infinity()
         x = Fq2(int.from_bytes(data[48:96], "big"),

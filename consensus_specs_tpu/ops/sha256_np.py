@@ -156,13 +156,37 @@ def merkleize_words(words: np.ndarray, limit_depth: int) -> np.ndarray:
     return root
 
 
+# Below this chunk count the per-call overhead of the batched numpy kernel
+# dwarfs the work; OpenSSL-backed hashlib (SHA-NI) wins decisively.  The
+# batched path exists for registry-scale trees (and mirrors the TPU layout).
+SMALL_TREE_CHUNKS = 1024
+
+
+def _merkleize_small(chunks: bytes, depth: int) -> bytes:
+    """hashlib level-by-level reduction, bit-identical to the batched path."""
+    from hashlib import sha256
+
+    level = [chunks[i:i + 32] for i in range(0, len(chunks), 32)] or [ZERO_HASH_BYTES[0]]
+    for d in range(depth):
+        if len(level) % 2:
+            level.append(ZERO_HASH_BYTES[d])
+        level = [sha256(level[i] + level[i + 1]).digest()
+                 for i in range(0, len(level), 2)]
+    return level[0]
+
+
 def merkleize_chunks_bytes(chunks: bytes, limit: int | None = None) -> bytes:
     """Merkle root of serialized chunk bytes (len % 32 == 0), as 32 bytes."""
     assert len(chunks) % 32 == 0
-    arr = np.frombuffer(chunks, dtype=np.uint8).reshape(-1, 32)
-    count = arr.shape[0]
+    count = len(chunks) // 32
     cap = count if limit is None else limit
     depth = max(cap - 1, 0).bit_length()
-    words = chunks_to_words(arr) if count else np.zeros((0, 8), dtype=np.uint32)
+    assert count <= (1 << depth), "chunk count exceeds limit"
+    if count == 1 and depth == 0:
+        return chunks
+    if count <= SMALL_TREE_CHUNKS:
+        return _merkleize_small(chunks, depth)
+    arr = np.frombuffer(chunks, dtype=np.uint8).reshape(-1, 32)
+    words = chunks_to_words(arr)
     root = merkleize_words(words, depth)
     return words_to_chunks(root[None, :])[0].tobytes()

@@ -1,0 +1,268 @@
+"""Test context / decorator DSL (layer L4).
+
+Builds the (fork × preset) spec matrix per test, provides cached genesis
+states, BLS switches, and config overrides.  Mirrors the surface of
+`eth2spec/test/context.py:74-860` (`spec_state_test`, `with_all_phases`,
+`with_presets`, `with_config_overrides`, `always_bls`/`never_bls`,
+balance-scenario helpers).
+"""
+
+from __future__ import annotations
+
+import functools
+
+from ..models.builder import ALL_FORKS, build_spec, spec_with_config
+from ..ops import bls as bls_mod
+from .utils import expect_assertion_error, vector_test  # noqa: F401 (re-export)
+
+# set by tests/conftest.py from CLI flags
+DEFAULT_TEST_PRESET = "minimal"
+DEFAULT_FORK_RESTRICTION: str | None = None
+
+MINIMAL = "minimal"
+MAINNET = "mainnet"
+
+# fork groups (mirror `test/context.py` phase selectors)
+PHASE0 = "phase0"
+ALTAIR = "altair"
+BELLATRIX = "bellatrix"
+CAPELLA = "capella"
+DENEB = "deneb"
+ELECTRA = "electra"
+FULU = "fulu"
+
+
+def _implemented_forks() -> list[str]:
+    from ..models.builder import PKG_ROOT, SPEC_SOURCES
+
+    out = []
+    for fork in ALL_FORKS:
+        files = SPEC_SOURCES.get(fork, [])
+        if files and any((PKG_ROOT / "models" / fork / f).exists()
+                         for f in files):
+            out.append(fork)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# genesis state cache (the reference's `_custom_state_cache_dict`,
+# `test/context.py:71-93`)
+# ---------------------------------------------------------------------------
+
+_GENESIS_CACHE: dict = {}
+
+
+def _cached_genesis(spec, balances_fn, threshold_fn):
+    from .helpers.genesis import create_genesis_state
+
+    key = (spec.fork, spec.preset_name, spec.config.CONFIG_NAME,
+           balances_fn.__name__, threshold_fn.__name__)
+    if key not in _GENESIS_CACHE:
+        balances = balances_fn(spec)
+        threshold = threshold_fn(spec)
+        _GENESIS_CACHE[key] = create_genesis_state(
+            spec, balances, activation_threshold=threshold)
+    return _GENESIS_CACHE[key].copy()
+
+
+# balance scenarios (`test/context.py:96-261`)
+
+
+def default_balances(spec):
+    num_validators = spec.SLOTS_PER_EPOCH * 8
+    return [spec.MAX_EFFECTIVE_BALANCE] * num_validators
+
+
+def scaled_churn_balances_min_churn_limit(spec):
+    num_validators = (spec.config.CHURN_LIMIT_QUOTIENT
+                      * spec.config.MIN_PER_EPOCH_CHURN_LIMIT)
+    return [spec.MAX_EFFECTIVE_BALANCE] * num_validators
+
+
+def low_balances(spec):
+    num_validators = spec.SLOTS_PER_EPOCH * 8
+    low_balance = 18 * 10**9
+    return [low_balance] * num_validators
+
+
+def misc_balances(spec):
+    num_validators = spec.SLOTS_PER_EPOCH * 8
+    balances = [spec.MAX_EFFECTIVE_BALANCE * 2 * i // num_validators
+                for i in range(num_validators)]
+    rng_order = list(range(num_validators))
+    import random
+    random.Random(1234).shuffle(rng_order)
+    return [balances[i] for i in rng_order]
+
+
+def one_validator_one_gwei_balances(spec):
+    return default_balances(spec)[:-1] + [1]
+
+
+def default_activation_threshold(spec):
+    return spec.MAX_EFFECTIVE_BALANCE
+
+
+def zero_activation_threshold(spec):
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# decorators
+# ---------------------------------------------------------------------------
+
+
+def with_phases(phases, other_phases=None):
+    """Run the test for each requested fork that is implemented."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, generator_mode=False, phase=None, preset=None,
+                    **kwargs):
+            implemented = _implemented_forks()
+            run_phases = [p for p in phases if p in implemented]
+            if DEFAULT_FORK_RESTRICTION is not None:
+                run_phases = [p for p in run_phases
+                              if p == DEFAULT_FORK_RESTRICTION]
+            if phase is not None:
+                run_phases = [phase]
+            results = None
+            for p in run_phases:
+                spec = build_spec(p, preset or DEFAULT_TEST_PRESET)
+                results = fn(*args, spec=spec, generator_mode=generator_mode,
+                             **kwargs)
+            return results
+
+        wrapper.phases = phases
+        # keep pytest from introspecting the wrapped signature and treating
+        # (spec, state) as fixtures
+        if hasattr(wrapper, "__wrapped__"):
+            del wrapper.__wrapped__
+        return wrapper
+
+    return deco
+
+
+def with_all_phases(fn):
+    return with_phases(ALL_FORKS)(fn)
+
+
+def with_all_phases_from(earliest):
+    idx = ALL_FORKS.index(earliest)
+    return with_phases(ALL_FORKS[idx:])
+
+
+def with_all_phases_except(excluded):
+    return with_phases([f for f in ALL_FORKS if f not in excluded])
+
+
+def with_presets(presets, reason=None):
+    """Skip unless the active preset is in `presets`."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, spec=None, **kwargs):
+            if spec is not None and spec.preset_name not in presets:
+                return None  # skipped
+            return fn(*args, spec=spec, **kwargs)
+        return wrapper
+
+    return deco
+
+
+def spec_test(fn):
+    """vector_test over the bls-switchable test (`test/context.py:308`)."""
+    return vector_test(fn)
+
+
+def single_phase(fn):
+    """Consume the spec kwarg only (no state)."""
+
+    @functools.wraps(fn)
+    def wrapper(*args, spec, generator_mode=False, **kwargs):
+        return fn(*args, spec=spec, **kwargs)
+
+    return wrapper
+
+
+def with_state(balances_fn=default_balances,
+               threshold_fn=default_activation_threshold):
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, spec, **kwargs):
+            state = _cached_genesis(spec, balances_fn, threshold_fn)
+            return fn(*args, spec=spec, state=state, **kwargs)
+        return wrapper
+
+    return deco
+
+
+def spec_state_test(fn):
+    """@with_state + @spec_test + single-phase consumption — the workhorse
+    (`test/context.py:318`)."""
+    inner = with_state()(fn)
+
+    @functools.wraps(fn)
+    def wrapper(*args, spec, generator_mode=False, **kwargs):
+        return vector_test(inner)(*args, spec=spec,
+                                  generator_mode=generator_mode, **kwargs)
+
+    return wrapper
+
+
+def spec_configured_state_test(config_overrides, balances_fn=default_balances,
+                               threshold_fn=default_activation_threshold):
+    """spec_state_test with per-test config overrides
+    (`with_config_overrides`, `test/context.py:693-734`)."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, spec, generator_mode=False, **kwargs):
+            overridden = spec_with_config(spec, config_overrides)
+            inner = with_state()(fn)
+            return vector_test(inner)(*args, spec=overridden,
+                                      generator_mode=generator_mode, **kwargs)
+        return wrapper
+
+    return deco
+
+
+def with_custom_state(balances_fn, threshold_fn):
+    return lambda fn: with_state(balances_fn, threshold_fn)(fn)
+
+
+def _bls_switch(value):
+    """BLS override that holds for the *iteration* of the wrapped test
+    generator, not just its creation (`test/context.py` `bls_switch`)."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            prev = bls_mod.bls_active
+            bls_mod.bls_active = value
+            try:
+                res = fn(*args, **kwargs)
+                if res is not None:
+                    yield from res
+            finally:
+                bls_mod.bls_active = prev
+
+        wrapper.bls = "always" if value else "never"
+        return wrapper
+
+    return deco
+
+
+def always_bls(fn):
+    """Force BLS on for this test regardless of the global switch."""
+    return _bls_switch(True)(fn)
+
+
+def never_bls(fn):
+    return _bls_switch(False)(fn)
+
+
+def dump_skipping_message(reason: str):
+    import pytest
+
+    pytest.skip(reason)

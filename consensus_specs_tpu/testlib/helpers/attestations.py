@@ -1,0 +1,234 @@
+"""Attestation construction + chain-driving with full participation
+(mirrors `test/helpers/attestations.py:17-493`)."""
+
+from __future__ import annotations
+
+from ..utils import expect_assertion_error
+from .block import build_empty_block_for_next_slot, get_parent_root
+from .keys import privkeys
+from .state import next_slot, state_transition_and_sign_block, transition_to
+
+
+def run_attestation_processing(spec, state, attestation, valid=True):
+    """Yield-protocol runner (mirrors `helpers/attestations.py:30-80`)."""
+    yield "pre", state
+    yield "attestation", attestation
+
+    if not valid:
+        expect_assertion_error(
+            lambda: spec.process_attestation(state, attestation))
+        yield "post", None
+        return
+
+    if attestation.data.target.epoch == spec.get_current_epoch(state):
+        current_epoch_count = len(state.current_epoch_attestations)
+    else:
+        previous_epoch_count = len(state.previous_epoch_attestations)
+
+    spec.process_attestation(state, attestation)
+
+    if attestation.data.target.epoch == spec.get_current_epoch(state):
+        assert (len(state.current_epoch_attestations)
+                == current_epoch_count + 1)
+    else:
+        assert (len(state.previous_epoch_attestations)
+                == previous_epoch_count + 1)
+
+    yield "post", state
+
+
+def build_attestation_data(spec, state, slot, index):
+    assert state.slot >= slot
+
+    if slot == state.slot:
+        block_root = get_parent_root(spec, state)
+    else:
+        block_root = spec.get_block_root_at_slot(state, slot)
+
+    current_epoch_start_slot = spec.compute_start_slot_at_epoch(
+        spec.get_current_epoch(state))
+    if slot < current_epoch_start_slot:
+        epoch_boundary_root = spec.get_block_root(
+            state, spec.get_previous_epoch(state))
+    elif slot == current_epoch_start_slot:
+        epoch_boundary_root = block_root
+    else:
+        epoch_boundary_root = spec.get_block_root(
+            state, spec.get_current_epoch(state))
+
+    if slot < current_epoch_start_slot:
+        source_checkpoint = state.previous_justified_checkpoint
+    else:
+        source_checkpoint = state.current_justified_checkpoint
+
+    return spec.AttestationData(
+        slot=slot,
+        index=index,
+        beacon_block_root=block_root,
+        source=spec.Checkpoint(epoch=source_checkpoint.epoch,
+                               root=source_checkpoint.root),
+        target=spec.Checkpoint(epoch=spec.compute_epoch_at_slot(slot),
+                               root=epoch_boundary_root),
+    )
+
+
+def get_valid_attestation(spec, state, slot=None, index=None,
+                          filter_participant_set=None, signed=False):
+    # If filter_participant_set is None, all committee members participate
+    if slot is None:
+        slot = state.slot
+    if index is None:
+        index = 0
+
+    attestation_data = build_attestation_data(spec, state, slot=slot,
+                                              index=index)
+    beacon_committee = spec.get_beacon_committee(
+        state, attestation_data.slot, attestation_data.index)
+
+    committee_size = len(beacon_committee)
+    aggregation_bits = spec.Bitlist[spec.MAX_VALIDATORS_PER_COMMITTEE](
+        [False] * committee_size)
+    attestation = spec.Attestation(
+        aggregation_bits=aggregation_bits,
+        data=attestation_data,
+    )
+    # fill the attestation with participants
+    fill_aggregate_attestation(
+        spec, state, attestation, signed=signed,
+        filter_participant_set=filter_participant_set)
+    return attestation
+
+
+def fill_aggregate_attestation(spec, state, attestation, signed=False,
+                               filter_participant_set=None):
+    beacon_committee = spec.get_beacon_committee(
+        state, attestation.data.slot, attestation.data.index)
+    participants = set(beacon_committee)
+    if filter_participant_set is not None:
+        participants = filter_participant_set(participants)
+    for i in range(len(beacon_committee)):
+        attestation.aggregation_bits[i] = beacon_committee[i] in participants
+    if signed and len(participants) > 0:
+        sign_attestation(spec, state, attestation)
+
+
+def sign_attestation(spec, state, attestation):
+    participants = spec.get_attesting_indices(state, attestation)
+    attestation.signature = sign_aggregate_attestation(
+        spec, state, attestation.data, participants)
+
+
+def sign_aggregate_attestation(spec, state, attestation_data, participants):
+    from ...ops import bls
+
+    domain = spec.get_domain(state, spec.DOMAIN_BEACON_ATTESTER,
+                             attestation_data.target.epoch)
+    signing_root = spec.compute_signing_root(attestation_data, domain)
+    signatures = [bls.Sign(privkeys[p], signing_root)
+                  for p in sorted(participants)]
+    return bls.Aggregate(signatures)
+
+
+def get_valid_attestation_at_slot(state, spec, slot_to_attest,
+                                  participation_fn=None):
+    """One attestation per committee of the slot."""
+    committees_per_slot = spec.get_committee_count_per_slot(
+        state, spec.compute_epoch_at_slot(slot_to_attest))
+    for index in range(committees_per_slot):
+        def participants_filter(comm):
+            if participation_fn is None:
+                return comm
+            return participation_fn(
+                spec.compute_epoch_at_slot(slot_to_attest),
+                slot_to_attest, comm)
+        yield get_valid_attestation(
+            spec, state, slot_to_attest,
+            index=spec.CommitteeIndex(index),
+            signed=True, filter_participant_set=participants_filter)
+
+
+def add_attestations_to_state(spec, state, attestations, slot):
+    transition_to(spec, state, slot)
+    for attestation in attestations:
+        spec.process_attestation(state, attestation)
+
+
+def next_slots_with_attestations(spec, state, slot_count,
+                                 fill_cur_epoch, fill_prev_epoch,
+                                 participation_fn=None):
+    post_state = state.copy()
+    signed_blocks = []
+    for _ in range(slot_count):
+        signed_block = state_transition_with_full_block(
+            spec, post_state, fill_cur_epoch, fill_prev_epoch,
+            participation_fn)
+        signed_blocks.append(signed_block)
+    return state, signed_blocks, post_state
+
+
+def next_epoch_with_attestations(spec, state, fill_cur_epoch,
+                                 fill_prev_epoch, participation_fn=None):
+    assert state.slot % spec.SLOTS_PER_EPOCH == 0
+    return next_slots_with_attestations(
+        spec, state, spec.SLOTS_PER_EPOCH, fill_cur_epoch, fill_prev_epoch,
+        participation_fn)
+
+
+def state_transition_with_full_block(spec, state, fill_cur_epoch,
+                                     fill_prev_epoch, participation_fn=None):
+    """Build and apply a block carrying attestations for the prior slots
+    (`helpers/attestations.py` `state_transition_with_full_block`)."""
+    block = build_empty_block_for_next_slot(spec, state)
+    if (fill_cur_epoch
+            and state.slot >= spec.MIN_ATTESTATION_INCLUSION_DELAY):
+        slot_to_attest = state.slot - spec.MIN_ATTESTATION_INCLUSION_DELAY + 1
+        if (slot_to_attest >= spec.compute_start_slot_at_epoch(
+                spec.get_current_epoch(state))):
+            attestations = get_valid_attestation_at_slot(
+                state, spec, slot_to_attest, participation_fn)
+            for attestation in attestations:
+                block.body.attestations.append(attestation)
+    if fill_prev_epoch and state.slot >= spec.SLOTS_PER_EPOCH:
+        slot_to_attest = state.slot - spec.SLOTS_PER_EPOCH + 1
+        attestations = get_valid_attestation_at_slot(
+            state, spec, slot_to_attest, participation_fn)
+        for attestation in attestations:
+            block.body.attestations.append(attestation)
+
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    return signed_block
+
+
+def prepare_state_with_attestations(spec, state, participation_fn=None):
+    """Advance until previous-epoch attestations cover a full epoch
+    (`helpers/attestations.py` `prepare_state_with_attestations`)."""
+    # advance some slots to leave the genesis edge
+    attestations = []
+    for _ in range(spec.SLOTS_PER_EPOCH + spec.MIN_ATTESTATION_INCLUSION_DELAY):
+        # create an attestation for each index in each slot in epoch
+        if state.slot < spec.SLOTS_PER_EPOCH:
+            for committee_index in range(
+                    spec.get_committee_count_per_slot(
+                        state, spec.get_current_epoch(state))):
+                attestation = get_valid_attestation(
+                    spec, state, index=committee_index,
+                    signed=True,
+                    filter_participant_set=participation_fn)
+                attestations.append(attestation)
+        # fill each created slot in state after inclusion delay
+        if state.slot >= spec.MIN_ATTESTATION_INCLUSION_DELAY:
+            inclusion_slot = (state.slot
+                              - spec.MIN_ATTESTATION_INCLUSION_DELAY)
+            include_attestations = [
+                att for att in attestations
+                if att.data.slot == inclusion_slot]
+            add_attestations_to_state(spec, state, include_attestations,
+                                      state.slot)
+        next_slot(spec, state)
+
+    assert state.slot == (spec.SLOTS_PER_EPOCH
+                          + spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    assert (len(state.previous_epoch_attestations)
+            == len(attestations))
+
+    return attestations

@@ -1,0 +1,79 @@
+"""Attester-slashing construction + runner
+(mirrors `test/helpers/attester_slashings.py`)."""
+
+from __future__ import annotations
+
+from ..utils import expect_assertion_error
+from .attestations import get_valid_attestation, sign_attestation
+from .state import get_balance
+
+
+def get_valid_attester_slashing(spec, state, slot=None,
+                                signed_1=False, signed_2=False):
+    """Double vote: same target epoch, different data."""
+    attestation_1 = get_valid_attestation(spec, state, slot=slot,
+                                          signed=signed_1)
+    attestation_2 = attestation_1.copy()
+    attestation_2.data.target.root = b"\x01" * 32
+    if signed_2:
+        sign_attestation(spec, state, attestation_2)
+
+    return spec.AttesterSlashing(
+        attestation_1=spec.get_indexed_attestation(state, attestation_1),
+        attestation_2=spec.get_indexed_attestation(state, attestation_2),
+    )
+
+
+def get_valid_attester_slashing_by_indices(spec, state, indices_1,
+                                           indices_2=None, slot=None,
+                                           signed_1=False, signed_2=False):
+    from .block import sign_indexed_attestation
+
+    if indices_2 is None:
+        indices_2 = indices_1
+    slashing = get_valid_attester_slashing(spec, state, slot=slot)
+    slashing.attestation_1.attesting_indices = sorted(indices_1)
+    slashing.attestation_2.attesting_indices = sorted(indices_2)
+    if signed_1:
+        sign_indexed_attestation(spec, state, slashing.attestation_1)
+    if signed_2:
+        sign_indexed_attestation(spec, state, slashing.attestation_2)
+    return slashing
+
+
+def get_indexed_attestation_participants(spec, indexed_att):
+    return list(indexed_att.attesting_indices)
+
+
+def run_attester_slashing_processing(spec, state, attester_slashing,
+                                     valid=True):
+    pre_state = state.copy()
+
+    yield "pre", state
+    yield "attester_slashing", attester_slashing
+
+    if not valid:
+        expect_assertion_error(
+            lambda: spec.process_attester_slashing(state, attester_slashing))
+        yield "post", None
+        return
+
+    slashed_indices = set(
+        attester_slashing.attestation_1.attesting_indices
+    ).intersection(attester_slashing.attestation_2.attesting_indices)
+
+    proposer_index = spec.get_beacon_proposer_index(state)
+    pre_proposer_balance = get_balance(state, proposer_index)
+
+    spec.process_attester_slashing(state, attester_slashing)
+
+    for slashed_index in slashed_indices:
+        if state.validators[slashed_index].slashed:
+            pass  # at least the newly slashed are marked
+    # at least one is newly slashed
+    assert any(state.validators[i].slashed for i in slashed_indices)
+    # proposer gained reward (unless proposer was among slashed)
+    if proposer_index not in slashed_indices:
+        assert get_balance(state, proposer_index) > pre_proposer_balance
+
+    yield "post", state

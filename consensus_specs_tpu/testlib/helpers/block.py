@@ -1,0 +1,101 @@
+"""Block construction + signing (mirrors `test/helpers/block.py`)."""
+
+from __future__ import annotations
+
+from .keys import privkeys
+
+
+def get_parent_root(spec, state):
+    """Root of the current head header, patching the pre-sealed state root
+    the way the next `process_slot` would."""
+    header = state.latest_block_header.copy()
+    if header.state_root == spec.Root():
+        header.state_root = spec.hash_tree_root(state)
+    return spec.hash_tree_root(header)
+
+
+def get_state_at_slot(spec, state, slot):
+    if state.slot < slot:
+        state = state.copy()
+        spec.process_slots(state, slot)
+    return state
+
+
+def build_empty_block(spec, state, slot=None, proposer_index=None):
+    if slot is None:
+        slot = state.slot
+    assert slot >= state.slot
+    state_at = get_state_at_slot(spec, state, slot)
+    if proposer_index is None:
+        proposer_index = spec.get_beacon_proposer_index(state_at)
+
+    block = spec.BeaconBlock(
+        slot=slot,
+        proposer_index=proposer_index,
+        parent_root=get_parent_root(spec, state_at),
+        body=spec.BeaconBlockBody(
+            randao_reveal=get_randao_reveal(spec, state_at, proposer_index),
+            eth1_data=spec.Eth1Data(
+                deposit_root=state_at.eth1_data.deposit_root,
+                deposit_count=state_at.eth1_deposit_index,
+                block_hash=state_at.eth1_data.block_hash,
+            ),
+        ),
+    )
+    return block
+
+
+def build_empty_block_for_next_slot(spec, state, proposer_index=None):
+    return build_empty_block(spec, state, state.slot + 1, proposer_index)
+
+
+def get_randao_reveal(spec, state, proposer_index):
+    from ...ops import bls
+
+    epoch = spec.compute_epoch_at_slot(state.slot)
+    domain = spec.get_domain(state, spec.DOMAIN_RANDAO, epoch)
+    signing_root = spec.compute_signing_root(spec.uint64(epoch), domain)
+    return bls.Sign(privkeys[proposer_index], signing_root)
+
+
+def sign_block(spec, state, block, proposer_index=None):
+    from ...ops import bls
+
+    if proposer_index is None:
+        proposer_index = block.proposer_index
+    domain = spec.get_domain(state, spec.DOMAIN_BEACON_PROPOSER,
+                             spec.compute_epoch_at_slot(block.slot))
+    signing_root = spec.compute_signing_root(block, domain)
+    return spec.SignedBeaconBlock(
+        message=block,
+        signature=bls.Sign(privkeys[proposer_index], signing_root),
+    )
+
+
+def transition_unsigned_block(spec, state, block):
+    assert state.slot < block.slot or state.slot == block.slot
+    if state.slot < block.slot:
+        spec.process_slots(state, block.slot)
+    spec.process_block(state, block)
+    return block
+
+
+def apply_empty_block(spec, state, slot=None):
+    """Advance via an empty block (signed), returning the signed block."""
+    from .state import state_transition_and_sign_block
+
+    block = build_empty_block(spec, state, slot)
+    return state_transition_and_sign_block(spec, state, block)
+
+
+def sign_indexed_attestation(spec, state, indexed_attestation):
+    from ...ops import bls
+
+    participants = indexed_attestation.attesting_indices
+    data = indexed_attestation.data
+    domain = spec.get_domain(state, spec.DOMAIN_BEACON_ATTESTER,
+                             data.target.epoch)
+    signing_root = spec.compute_signing_root(data, domain)
+    sigs = [bls.Sign(privkeys[p], signing_root) for p in participants]
+    indexed_attestation.signature = bls.Aggregate(sigs) if sigs else \
+        spec.BLSSignature()

@@ -1,0 +1,126 @@
+"""Deposit construction with real Merkle branches
+(mirrors `test/helpers/deposits.py`)."""
+
+from __future__ import annotations
+
+from ...ops import bls
+from ...utils.merkle_minimal import (
+    calc_merkle_tree_from_leaves,
+    get_merkle_proof,
+)
+from ..utils import expect_assertion_error
+from .keys import privkeys, pubkey
+
+
+def build_deposit_data(spec, pk, privkey_int, amount,
+                       withdrawal_credentials, signed=False):
+    deposit_data = spec.DepositData(
+        pubkey=pk,
+        withdrawal_credentials=withdrawal_credentials,
+        amount=amount,
+    )
+    if signed:
+        sign_deposit_data(spec, deposit_data, privkey_int)
+    return deposit_data
+
+
+def sign_deposit_data(spec, deposit_data, privkey_int):
+    deposit_message = spec.DepositMessage(
+        pubkey=deposit_data.pubkey,
+        withdrawal_credentials=deposit_data.withdrawal_credentials,
+        amount=deposit_data.amount,
+    )
+    domain = spec.compute_domain(spec.DOMAIN_DEPOSIT)
+    signing_root = spec.compute_signing_root(deposit_message, domain)
+    deposit_data.signature = bls.Sign(privkey_int, signing_root)
+
+
+def build_deposit(spec, deposit_data_list, pk, privkey_int, amount,
+                  withdrawal_credentials, signed):
+    deposit_data = build_deposit_data(
+        spec, pk, privkey_int, amount, withdrawal_credentials, signed)
+    index = len(deposit_data_list)
+    deposit_data_list.append(deposit_data)
+    return deposit_from_context(spec, deposit_data_list, index)
+
+
+def deposit_from_context(spec, deposit_data_list, index):
+    deposit_data = deposit_data_list[index]
+    root = spec.hash_tree_root(
+        spec.List[spec.DepositData, 2**spec.DEPOSIT_CONTRACT_TREE_DEPTH](
+            deposit_data_list))
+    tree = calc_merkle_tree_from_leaves(
+        [spec.hash_tree_root(d) for d in deposit_data_list],
+        spec.DEPOSIT_CONTRACT_TREE_DEPTH)
+    proof = (get_merkle_proof(tree, item_index=index,
+                              tree_len=spec.DEPOSIT_CONTRACT_TREE_DEPTH)
+             + [len(deposit_data_list).to_bytes(32, "little")])
+    leaf = spec.hash_tree_root(deposit_data)
+    assert spec.is_valid_merkle_branch(
+        leaf, proof, spec.DEPOSIT_CONTRACT_TREE_DEPTH + 1, index, root)
+    deposit = spec.Deposit(proof=proof, data=deposit_data)
+    return deposit, root, deposit_data_list
+
+
+def prepare_state_and_deposit(spec, state, validator_index, amount,
+                              withdrawal_credentials=None, signed=False):
+    """Prepare state for a deposit for validator_index (new or top-up),
+    returning the deposit object."""
+    deposit_data_list = []
+    pk = pubkey(validator_index)
+    privkey_int = privkeys[validator_index]
+    if withdrawal_credentials is None:
+        withdrawal_credentials = (
+            bytes(spec.BLS_WITHDRAWAL_PREFIX) + spec.hash(pk)[1:])
+    deposit, root, deposit_data_list = build_deposit(
+        spec, deposit_data_list, pk, privkey_int, amount,
+        withdrawal_credentials, signed)
+    state.eth1_deposit_index = 0
+    state.eth1_data.deposit_root = root
+    state.eth1_data.deposit_count = len(deposit_data_list)
+    return deposit
+
+
+def run_deposit_processing(spec, state, deposit, validator_index,
+                           valid=True, effective=True):
+    """Yield-protocol runner (mirrors `helpers/deposits.py`
+    `run_deposit_processing`)."""
+    pre_validator_count = len(state.validators)
+    pre_balance = 0
+    is_top_up = validator_index < pre_validator_count
+    if is_top_up:
+        pre_balance = state.balances[validator_index]
+        pre_effective_balance = \
+            state.validators[validator_index].effective_balance
+
+    yield "pre", state
+    yield "deposit", deposit
+
+    if not valid:
+        expect_assertion_error(lambda: spec.process_deposit(state, deposit))
+        yield "post", None
+        return
+
+    spec.process_deposit(state, deposit)
+
+    yield "post", state
+
+    if not effective or not bls.KeyValidate(deposit.data.pubkey):
+        assert len(state.validators) == pre_validator_count
+        assert len(state.balances) == pre_validator_count
+        if is_top_up:
+            assert state.balances[validator_index] == pre_balance
+    else:
+        if is_top_up:
+            # Top-ups do not change effective balance
+            assert (state.validators[validator_index].effective_balance
+                    == pre_effective_balance)
+            assert len(state.validators) == pre_validator_count
+            assert len(state.balances) == pre_validator_count
+        else:
+            # new validator
+            assert len(state.validators) == pre_validator_count + 1
+            assert len(state.balances) == pre_validator_count + 1
+        assert (state.balances[validator_index]
+                == pre_balance + deposit.data.amount)
+    assert state.eth1_deposit_index == state.eth1_data.deposit_count

@@ -1,0 +1,62 @@
+"""Genesis-state construction for tests (mirrors `test/helpers/genesis.py`).
+
+Builds the state directly (not via deposit processing) for speed; the
+deposit path is exercised by the genesis initialization tests instead.
+"""
+
+from __future__ import annotations
+
+from .keys import pubkey
+
+
+def build_mock_validator(spec, i: int, balance: int,
+                         activation_threshold: int):
+    pk = pubkey(i)
+    withdrawal_credentials = (
+        bytes(spec.BLS_WITHDRAWAL_PREFIX) + spec.hash(pk)[1:])
+    effective = min(balance - balance % spec.EFFECTIVE_BALANCE_INCREMENT,
+                    spec.MAX_EFFECTIVE_BALANCE)
+    return spec.Validator(
+        pubkey=pk,
+        withdrawal_credentials=withdrawal_credentials,
+        activation_eligibility_epoch=spec.FAR_FUTURE_EPOCH,
+        activation_epoch=spec.FAR_FUTURE_EPOCH,
+        exit_epoch=spec.FAR_FUTURE_EPOCH,
+        withdrawable_epoch=spec.FAR_FUTURE_EPOCH,
+        effective_balance=effective,
+    )
+
+
+def create_genesis_state(spec, validator_balances, activation_threshold):
+    deposit_root = b"\x42" * 32
+    eth1_block_hash = b"\xda" * 32
+    state = spec.BeaconState(
+        genesis_time=0,
+        eth1_deposit_index=len(validator_balances),
+        eth1_data=spec.Eth1Data(
+            deposit_root=deposit_root,
+            deposit_count=len(validator_balances),
+            block_hash=eth1_block_hash,
+        ),
+        fork=spec.Fork(
+            previous_version=spec.config.GENESIS_FORK_VERSION,
+            current_version=spec.config.GENESIS_FORK_VERSION,
+            epoch=spec.GENESIS_EPOCH,
+        ),
+        latest_block_header=spec.BeaconBlockHeader(
+            body_root=spec.hash_tree_root(spec.BeaconBlockBody())),
+        randao_mixes=[eth1_block_hash] * spec.EPOCHS_PER_HISTORICAL_VECTOR,
+    )
+
+    # Populate the registry
+    for i, balance in enumerate(validator_balances):
+        v = build_mock_validator(spec, i, balance, activation_threshold)
+        if v.effective_balance >= activation_threshold:
+            v.activation_eligibility_epoch = spec.GENESIS_EPOCH
+            v.activation_epoch = spec.GENESIS_EPOCH
+        state.validators.append(v)
+        state.balances.append(balance)
+
+    state.genesis_validators_root = spec.hash_tree_root(state.validators)
+
+    return state

@@ -1,0 +1,51 @@
+"""Deterministic validator keypairs (mirrors `test/helpers/keys.py:3-6`).
+
+privkey(i) = i + 1; pubkeys computed lazily (pure-Python scalar mult) and
+memoized — with BLS disabled, deterministic stub pubkeys keep tests fast
+while staying unique per validator.
+"""
+
+from __future__ import annotations
+
+from ...ops import bls as bls_mod
+from ...ops.bls import ciphersuite as _cs
+
+_PUBKEY_CACHE: dict[int, bytes] = {}
+
+
+def privkey(index: int) -> int:
+    return index + 1
+
+
+class _Privkeys:
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return [privkey(j) for j in range(*i.indices(1 << 20))]
+        return privkey(int(i))
+
+
+class _Pubkeys:
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return [pubkey(j) for j in range(*i.indices(1 << 20))]
+        return pubkey(int(i))
+
+
+def pubkey(index: int) -> bytes:
+    """Real BLS pubkey for validator `index` (memoized)."""
+    pk = _PUBKEY_CACHE.get(index)
+    if pk is None:
+        pk = _cs.SkToPk(privkey(index))
+        _PUBKEY_CACHE[index] = pk
+    return pk
+
+
+privkeys = _Privkeys()
+pubkeys = _Pubkeys()
+
+
+def pubkey_to_privkey(pk: bytes) -> int:
+    for i, cached in _PUBKEY_CACHE.items():
+        if cached == bytes(pk):
+            return privkey(i)
+    raise KeyError("unknown pubkey")

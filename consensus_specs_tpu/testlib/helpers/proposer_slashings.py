@@ -1,0 +1,87 @@
+"""Proposer-slashing construction + runner
+(mirrors `test/helpers/proposer_slashings.py`)."""
+
+from __future__ import annotations
+
+from ...ops import bls
+from ..utils import expect_assertion_error
+from .keys import privkeys
+from .state import get_balance
+
+
+def check_proposer_slashing_effect(spec, pre_state, state, slashed_index):
+    slashed_validator = state.validators[slashed_index]
+    assert slashed_validator.slashed
+    assert slashed_validator.exit_epoch < spec.FAR_FUTURE_EPOCH
+    assert slashed_validator.withdrawable_epoch < spec.FAR_FUTURE_EPOCH
+
+    proposer_index = spec.get_beacon_proposer_index(state)
+    if proposer_index != slashed_index:
+        # slashed validator lost whistleblower reward portions
+        assert (get_balance(state, slashed_index)
+                < get_balance(pre_state, slashed_index))
+        assert (get_balance(state, proposer_index)
+                > get_balance(pre_state, proposer_index))
+
+
+def get_valid_proposer_slashing(spec, state, random_root=b"\x99" * 32,
+                                slashed_index=None, slot=None,
+                                signed_1=False, signed_2=False):
+    if slashed_index is None:
+        current_epoch = spec.get_current_epoch(state)
+        slashed_index = spec.get_active_validator_indices(
+            state, current_epoch)[-1]
+    if slot is None:
+        slot = state.slot
+
+    header_1 = spec.BeaconBlockHeader(
+        slot=slot,
+        proposer_index=slashed_index,
+        parent_root=b"\x33" * 32,
+        state_root=b"\x44" * 32,
+        body_root=b"\x55" * 32,
+    )
+    header_2 = header_1.copy()
+    header_2.parent_root = random_root
+
+    signed_header_1 = spec.SignedBeaconBlockHeader(message=header_1)
+    signed_header_2 = spec.SignedBeaconBlockHeader(message=header_2)
+    if signed_1:
+        signed_header_1 = sign_block_header(
+            spec, state, header_1, privkeys[slashed_index])
+    if signed_2:
+        signed_header_2 = sign_block_header(
+            spec, state, header_2, privkeys[slashed_index])
+
+    return spec.ProposerSlashing(
+        signed_header_1=signed_header_1,
+        signed_header_2=signed_header_2,
+    )
+
+
+def sign_block_header(spec, state, header, privkey_int):
+    domain = spec.get_domain(state, spec.DOMAIN_BEACON_PROPOSER,
+                             spec.compute_epoch_at_slot(header.slot))
+    signing_root = spec.compute_signing_root(header, domain)
+    signature = bls.Sign(privkey_int, signing_root)
+    return spec.SignedBeaconBlockHeader(message=header, signature=signature)
+
+
+def run_proposer_slashing_processing(spec, state, proposer_slashing,
+                                     valid=True):
+    pre_state = state.copy()
+
+    yield "pre", state
+    yield "proposer_slashing", proposer_slashing
+
+    if not valid:
+        expect_assertion_error(
+            lambda: spec.process_proposer_slashing(state, proposer_slashing))
+        yield "post", None
+        return
+
+    spec.process_proposer_slashing(state, proposer_slashing)
+    yield "post", state
+
+    slashed_index = proposer_slashing.signed_header_1.message.proposer_index
+    check_proposer_slashing_effect(spec, pre_state, state, slashed_index)

@@ -1,0 +1,77 @@
+"""State-machine driving helpers (mirrors `test/helpers/state.py:18-115`)."""
+
+from __future__ import annotations
+
+from ..utils import expect_assertion_error
+from .block import apply_empty_block, build_empty_block_for_next_slot, \
+    sign_block, transition_unsigned_block
+
+
+def next_slot(spec, state):
+    spec.process_slots(state, state.slot + 1)
+
+
+def next_slots(spec, state, slots):
+    if slots > 0:
+        spec.process_slots(state, state.slot + slots)
+
+
+def next_epoch(spec, state):
+    slot = state.slot + spec.SLOTS_PER_EPOCH - (state.slot % spec.SLOTS_PER_EPOCH)
+    spec.process_slots(state, slot)
+
+
+def next_epoch_via_block(spec, state, insert_state_root=False):
+    """Transition to the next-epoch start slot via a (signed) empty block."""
+    slot = state.slot + spec.SLOTS_PER_EPOCH - (state.slot % spec.SLOTS_PER_EPOCH)
+    block = build_empty_block(spec, state, slot)
+    signed = state_transition_and_sign_block(spec, state, block)
+    return signed
+
+
+def build_empty_block(spec, state, slot=None):
+    from .block import build_empty_block as _b
+    return _b(spec, state, slot)
+
+
+def transition_to(spec, state, slot):
+    """Advance (forward only; no-op if already there)."""
+    assert state.slot <= slot
+    if state.slot < slot:
+        spec.process_slots(state, slot)
+
+
+def transition_to_slot_via_block(spec, state, slot):
+    assert state.slot < slot
+    block = build_empty_block(spec, state, slot)
+    return state_transition_and_sign_block(spec, state, block)
+
+
+def state_transition_and_sign_block(spec, state, block,
+                                    expect_fail: bool = False):
+    """Run the unsigned transition, seal the state root, sign — or expect
+    rejection (`helpers/state.py` `state_transition_and_sign_block`)."""
+    if expect_fail:
+        pre = state.copy()
+        expect_assertion_error(
+            lambda: transition_unsigned_block(spec, pre, block))
+        return None
+    transition_unsigned_block(spec, state, block)
+    block.state_root = spec.hash_tree_root(state)
+    return sign_block(spec, state, block)
+
+
+def get_balance(state, index):
+    return state.balances[index]
+
+
+def get_state_root(spec, state, slot):
+    assert slot < state.slot <= slot + spec.SLOTS_PER_HISTORICAL_ROOT
+    return state.state_roots[slot % spec.SLOTS_PER_HISTORICAL_ROOT]
+
+
+def has_active_balance_differential(spec, state) -> bool:
+    epoch = spec.get_current_epoch(state)
+    active = spec.get_total_active_balance(state)
+    total = sum(state.balances)
+    return active != total

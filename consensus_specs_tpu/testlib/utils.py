@@ -1,0 +1,80 @@
+"""Dual-mode test protocol.
+
+Every spec test is a generator yielding (name, value) or (name, kind,
+value): under pytest the yields are drained and in-test asserts validate
+the spec; in generator mode the same yields become reference-vector files.
+Mirrors `eth2spec/test/utils/utils.py:7-102` (`vector_test`).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+
+def _infer_kind(value: Any):
+    from ..utils.ssz.types import View
+
+    if isinstance(value, View):
+        return "ssz"
+    if isinstance(value, bytes):
+        return "ssz"
+    return "data"
+
+
+def vector_test(fn):
+    """Wrap a yielding test function.
+
+    - pytest mode (default): drain the generator, discard yields.
+    - generator mode (`generator_mode=True`): collect (name, kind, value)
+      triples and return them for the vector dumper.
+    """
+
+    @functools.wraps(fn)
+    def entry(*args, generator_mode: bool = False, **kwargs):
+        out = fn(*args, **kwargs)
+        if out is None:
+            return None
+        parts = []
+        for item in out:
+            if not generator_mode:
+                continue
+            if len(item) == 3:
+                name, kind, value = item
+            else:
+                name, value = item
+                kind = _infer_kind(value)
+            parts.append((name, kind, value))
+        return parts if generator_mode else None
+
+    return entry
+
+
+def with_meta_tags(tags: dict):
+    """Attach meta.yaml tags to a test's vector output."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def entry(*args, **kwargs):
+            result = fn(*args, **kwargs)
+            if result is not None:
+                yielded = False
+                for item in result:
+                    yield item
+                    yielded = True
+                if yielded or True:
+                    yield "meta", "meta", tags
+        return entry
+
+    return deco
+
+
+def expect_assertion_error(fn):
+    """Run fn expecting the spec to reject (AssertionError/IndexError/
+    ValueError from SSZ bounds) — the invalid-case convention
+    (`test/context.py:370-381`)."""
+    try:
+        fn()
+    except (AssertionError, IndexError, ValueError):
+        return
+    raise AssertionError("expected the spec to reject, but it accepted")

@@ -341,6 +341,8 @@ class ByteVector(bytes, View, metaclass=_ParamMeta):
         return bytes(self)
 
     def hash_tree_root(self) -> bytes:
+        if self._length <= 32:  # single chunk: the root IS the padded value
+            return bytes(self).ljust(32, b"\x00")
         padded = bytes(self)
         if len(padded) % 32:
             padded += b"\x00" * (32 - len(padded) % 32)

@@ -27,7 +27,12 @@ def pytest_addoption(parser):
         help="restrict spec tests to one fork")
     parser.addoption(
         "--disable-bls", action="store_true", default=False,
-        help="turn off BLS verification for speed")
+        help="turn off BLS verification for speed (kept for parity)")
+    parser.addoption(
+        "--enable-bls", action="store_true", default=False,
+        help="run ALL tests with real BLS (slow: pure-Python oracle); "
+             "default keeps BLS off except @always_bls tests, like the "
+             "reference's coverage runs")
     parser.addoption(
         "--bls-type", action="store", default="py",
         help="BLS backend: py | jax")
@@ -36,8 +41,11 @@ def pytest_addoption(parser):
 @pytest.fixture(autouse=True, scope="session")
 def _configure_backends(request):
     from consensus_specs_tpu.ops import bls
+    from consensus_specs_tpu.testlib import context
 
-    if request.config.getoption("--disable-bls"):
+    if not request.config.getoption("--enable-bls"):
         bls.bls_active = False
     bls.use_backend(request.config.getoption("--bls-type"))
+    context.DEFAULT_TEST_PRESET = request.config.getoption("--preset")
+    context.DEFAULT_FORK_RESTRICTION = request.config.getoption("--fork")
     yield

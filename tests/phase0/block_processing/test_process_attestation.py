@@ -1,0 +1,155 @@
+"""process_attestation valid/invalid matrix
+(parity: `test/phase0/block_processing/test_process_attestation.py`)."""
+
+from consensus_specs_tpu.testlib.context import (
+    always_bls,
+    spec_state_test,
+    with_all_phases,
+)
+from consensus_specs_tpu.testlib.helpers.attestations import (
+    get_valid_attestation,
+    run_attestation_processing,
+    sign_attestation,
+)
+from consensus_specs_tpu.testlib.helpers.state import (
+    next_epoch,
+    next_slot,
+    next_slots,
+    transition_to,
+)
+
+
+@with_all_phases
+@spec_state_test
+def test_one_basic_attestation(spec, state):
+    attestation = get_valid_attestation(spec, state, signed=True)
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    yield from run_attestation_processing(spec, state, attestation)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_invalid_attestation_signature(spec, state):
+    attestation = get_valid_attestation(spec, state)  # unsigned
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    yield from run_attestation_processing(spec, state, attestation,
+                                          valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_before_inclusion_delay(spec, state):
+    attestation = get_valid_attestation(spec, state, signed=True)
+    # do not advance; inclusion delay not satisfied
+    yield from run_attestation_processing(spec, state, attestation,
+                                          valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_after_epoch_slots(spec, state):
+    attestation = get_valid_attestation(spec, state, signed=True)
+    # advance past the inclusion window
+    next_slots(spec, state, spec.SLOTS_PER_EPOCH + 1)
+    yield from run_attestation_processing(spec, state, attestation,
+                                          valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_old_source_epoch(spec, state):
+    next_slots(spec, state, spec.SLOTS_PER_EPOCH * 5)
+    state.finalized_checkpoint.epoch = 2
+    state.previous_justified_checkpoint.epoch = 3
+    state.current_justified_checkpoint.epoch = 4
+    attestation = get_valid_attestation(
+        spec, state, slot=(spec.SLOTS_PER_EPOCH * 3) + 1)
+    # test logic sanity: attestation for the previous epoch
+    attestation.data.source.epoch = state.previous_justified_checkpoint.epoch - 1
+    sign_attestation(spec, state, attestation)
+    yield from run_attestation_processing(spec, state, attestation,
+                                          valid=False)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_invalid_wrong_index_for_committee_signature(spec, state):
+    attestation = get_valid_attestation(spec, state, signed=True)
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    # another committee's index: the signature no longer matches
+    attestation.data.index += 1
+    yield from run_attestation_processing(spec, state, attestation,
+                                          valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_index_over_committee_count(spec, state):
+    attestation = get_valid_attestation(spec, state)
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    attestation.data.index = spec.get_committee_count_per_slot(
+        state, attestation.data.target.epoch)
+    sign_attestation(spec, state, attestation)
+    yield from run_attestation_processing(spec, state, attestation,
+                                          valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_mismatched_target_and_slot(spec, state):
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    attestation = get_valid_attestation(spec, state,
+                                        slot=state.slot - spec.SLOTS_PER_EPOCH)
+    attestation.data.target.epoch = spec.get_current_epoch(state)
+    sign_attestation(spec, state, attestation)
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    yield from run_attestation_processing(spec, state, attestation,
+                                          valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_source_root_is_target_root(spec, state):
+    attestation = get_valid_attestation(spec, state)
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    attestation.data.source.root = attestation.data.target.root
+    sign_attestation(spec, state, attestation)
+    # source checkpoint mismatch -> rejected
+    if attestation.data.source.root == state.current_justified_checkpoint.root:
+        # degenerate genesis case: both zero roots; mutate differently
+        attestation.data.source.root = b"\x01" * 32
+        sign_attestation(spec, state, attestation)
+    yield from run_attestation_processing(spec, state, attestation,
+                                          valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_bad_aggregation_bits_length(spec, state):
+    attestation = get_valid_attestation(spec, state, signed=True)
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    attestation.aggregation_bits.append(False)
+    yield from run_attestation_processing(spec, state, attestation,
+                                          valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_previous_epoch_attestation(spec, state):
+    attestation = get_valid_attestation(spec, state, signed=True)
+    next_epoch(spec, state)
+    # still inside the inclusion window (SLOTS_PER_EPOCH)
+    yield from run_attestation_processing(spec, state, attestation)
+
+
+@with_all_phases
+@spec_state_test
+def test_attestation_since_max_epochs_ago(spec, state):
+    next_epoch(spec, state)
+    attestation = get_valid_attestation(spec, state, slot=state.slot,
+                                        signed=True)
+    next_slots(spec, state, spec.SLOTS_PER_EPOCH)
+    yield from run_attestation_processing(spec, state, attestation)

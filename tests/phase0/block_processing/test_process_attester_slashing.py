@@ -1,0 +1,104 @@
+"""process_attester_slashing matrix
+(parity: `test/phase0/block_processing/test_process_attester_slashing.py`)."""
+
+from consensus_specs_tpu.testlib.context import (
+    always_bls,
+    spec_state_test,
+    with_all_phases,
+)
+from consensus_specs_tpu.testlib.helpers.attestations import sign_attestation
+from consensus_specs_tpu.testlib.helpers.attester_slashings import (
+    get_valid_attester_slashing,
+    run_attester_slashing_processing,
+)
+from consensus_specs_tpu.testlib.helpers.block import sign_indexed_attestation
+
+
+@with_all_phases
+@spec_state_test
+def test_basic_double(spec, state):
+    attester_slashing = get_valid_attester_slashing(
+        spec, state, signed_1=True, signed_2=True)
+    yield from run_attester_slashing_processing(spec, state,
+                                                attester_slashing)
+
+
+@with_all_phases
+@spec_state_test
+def test_basic_surround(spec, state):
+    from consensus_specs_tpu.testlib.helpers.state import next_epoch
+    next_epoch(spec, state)
+    state.current_justified_checkpoint.epoch += 1  # source epoch now >= 1
+    attester_slashing = get_valid_attester_slashing(spec, state)
+    att_1 = attester_slashing.attestation_1
+    att_2 = attester_slashing.attestation_2
+    # set attestation_1 to surround attestation 2
+    att_1.data.source.epoch = att_2.data.source.epoch - 1
+    att_1.data.target.epoch = att_2.data.target.epoch + 1
+    sign_indexed_attestation(spec, state, att_1)
+    sign_indexed_attestation(spec, state, att_2)
+    yield from run_attester_slashing_processing(spec, state,
+                                                attester_slashing)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_invalid_sig_1(spec, state):
+    attester_slashing = get_valid_attester_slashing(
+        spec, state, signed_1=False, signed_2=True)
+    yield from run_attester_slashing_processing(
+        spec, state, attester_slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_same_data(spec, state):
+    attester_slashing = get_valid_attester_slashing(
+        spec, state, signed_1=True)
+    # make the two attestations identical -> not slashable
+    attester_slashing.attestation_2 = attester_slashing.attestation_1.copy()
+    yield from run_attester_slashing_processing(
+        spec, state, attester_slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_no_double_or_surround(spec, state):
+    attester_slashing = get_valid_attester_slashing(
+        spec, state, signed_1=True)
+    att_2 = attester_slashing.attestation_2
+    att_2.data.target.epoch += 1  # different target epoch, no surround
+    sign_indexed_attestation(spec, state, att_2)
+    yield from run_attester_slashing_processing(
+        spec, state, attester_slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_participants_already_slashed(spec, state):
+    attester_slashing = get_valid_attester_slashing(
+        spec, state, signed_1=True, signed_2=True)
+    # slash all participants of attestation 1 beforehand
+    for index in attester_slashing.attestation_1.attesting_indices:
+        state.validators[index].slashed = True
+    yield from run_attester_slashing_processing(
+        spec, state, attester_slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_unsorted_att_1(spec, state):
+    attester_slashing = get_valid_attester_slashing(
+        spec, state, signed_1=False, signed_2=True)
+    indices = list(attester_slashing.attestation_1.attesting_indices)
+    if len(indices) >= 2:
+        indices[0], indices[1] = indices[1], indices[0]
+        attester_slashing.attestation_1.attesting_indices = indices
+        sign_indexed_attestation(spec, state,
+                                 attester_slashing.attestation_1)
+        yield from run_attester_slashing_processing(
+            spec, state, attester_slashing, valid=False)
+    else:
+        yield from run_attester_slashing_processing(
+            spec, state, attester_slashing, valid=True)

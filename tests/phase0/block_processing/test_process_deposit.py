@@ -1,0 +1,103 @@
+"""process_deposit matrix
+(parity: `test/phase0/block_processing/test_process_deposit.py`)."""
+
+from consensus_specs_tpu.testlib.context import (
+    always_bls,
+    spec_state_test,
+    with_all_phases,
+)
+from consensus_specs_tpu.testlib.helpers.deposits import (
+    prepare_state_and_deposit,
+    run_deposit_processing,
+)
+
+
+@with_all_phases
+@spec_state_test
+def test_new_deposit_under_max(spec, state):
+    validator_index = len(state.validators)
+    amount = spec.MAX_EFFECTIVE_BALANCE - 1
+    deposit = prepare_state_and_deposit(spec, state, validator_index,
+                                        amount, signed=True)
+    yield from run_deposit_processing(spec, state, deposit, validator_index)
+
+
+@with_all_phases
+@spec_state_test
+def test_new_deposit_max(spec, state):
+    validator_index = len(state.validators)
+    amount = spec.MAX_EFFECTIVE_BALANCE
+    deposit = prepare_state_and_deposit(spec, state, validator_index,
+                                        amount, signed=True)
+    yield from run_deposit_processing(spec, state, deposit, validator_index)
+
+
+@with_all_phases
+@spec_state_test
+def test_new_deposit_over_max(spec, state):
+    validator_index = len(state.validators)
+    amount = spec.MAX_EFFECTIVE_BALANCE + 1
+    deposit = prepare_state_and_deposit(spec, state, validator_index,
+                                        amount, signed=True)
+    yield from run_deposit_processing(spec, state, deposit, validator_index)
+
+
+@with_all_phases
+@spec_state_test
+def test_top_up__max_effective_balance(spec, state):
+    validator_index = 0
+    amount = spec.MAX_EFFECTIVE_BALANCE // 4
+    deposit = prepare_state_and_deposit(spec, state, validator_index,
+                                        amount, signed=True)
+    yield from run_deposit_processing(spec, state, deposit, validator_index)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_invalid_sig_new_deposit(spec, state):
+    # invalid signatures on NEW deposits are accepted as ops but add no
+    # validator (proof of possession failure is non-fatal)
+    validator_index = len(state.validators)
+    amount = spec.MAX_EFFECTIVE_BALANCE
+    deposit = prepare_state_and_deposit(spec, state, validator_index,
+                                        amount, signed=False)
+    yield from run_deposit_processing(spec, state, deposit, validator_index,
+                                      effective=False)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_invalid_sig_top_up(spec, state):
+    # top-ups don't verify the signature at all
+    validator_index = 0
+    amount = spec.MAX_EFFECTIVE_BALANCE // 4
+    deposit = prepare_state_and_deposit(spec, state, validator_index,
+                                        amount, signed=False)
+    yield from run_deposit_processing(spec, state, deposit, validator_index)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_wrong_deposit_for_deposit_count(spec, state):
+    # build deposit for index 0 but claim a different deposit root
+    validator_index = len(state.validators)
+    amount = spec.MAX_EFFECTIVE_BALANCE
+    deposit = prepare_state_and_deposit(spec, state, validator_index,
+                                        amount, signed=True)
+    state.eth1_data.deposit_root = b"\x77" * 32
+    yield from run_deposit_processing(spec, state, deposit, validator_index,
+                                      valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_bad_merkle_proof(spec, state):
+    validator_index = len(state.validators)
+    amount = spec.MAX_EFFECTIVE_BALANCE
+    deposit = prepare_state_and_deposit(spec, state, validator_index,
+                                        amount, signed=True)
+    deposit.proof[0] = b"\x13" * 32
+    yield from run_deposit_processing(spec, state, deposit, validator_index,
+                                      valid=False)

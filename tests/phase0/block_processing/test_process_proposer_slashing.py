@@ -1,0 +1,118 @@
+"""process_proposer_slashing matrix
+(parity: `test/phase0/block_processing/test_process_proposer_slashing.py`)."""
+
+from consensus_specs_tpu.testlib.context import (
+    always_bls,
+    spec_state_test,
+    with_all_phases,
+)
+from consensus_specs_tpu.testlib.helpers.proposer_slashings import (
+    get_valid_proposer_slashing,
+    run_proposer_slashing_processing,
+)
+from consensus_specs_tpu.testlib.helpers.state import next_epoch
+
+
+@with_all_phases
+@spec_state_test
+def test_basic(spec, state):
+    proposer_slashing = get_valid_proposer_slashing(
+        spec, state, signed_1=True, signed_2=True)
+    yield from run_proposer_slashing_processing(spec, state,
+                                                proposer_slashing)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_invalid_sig_1(spec, state):
+    proposer_slashing = get_valid_proposer_slashing(
+        spec, state, signed_1=False, signed_2=True)
+    yield from run_proposer_slashing_processing(
+        spec, state, proposer_slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_invalid_sig_2(spec, state):
+    proposer_slashing = get_valid_proposer_slashing(
+        spec, state, signed_1=True, signed_2=False)
+    yield from run_proposer_slashing_processing(
+        spec, state, proposer_slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_incorrect_proposer_index(spec, state):
+    proposer_slashing = get_valid_proposer_slashing(
+        spec, state, signed_1=True, signed_2=True)
+    # invalid index: out of registry
+    proposer_slashing.signed_header_1.message.proposer_index = \
+        len(state.validators)
+    proposer_slashing.signed_header_2.message.proposer_index = \
+        len(state.validators)
+    yield from run_proposer_slashing_processing(
+        spec, state, proposer_slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_headers_are_same_sigs_are_same(spec, state):
+    proposer_slashing = get_valid_proposer_slashing(
+        spec, state, signed_1=True, signed_2=False)
+    proposer_slashing.signed_header_2 = proposer_slashing.signed_header_1
+    yield from run_proposer_slashing_processing(
+        spec, state, proposer_slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_slots_of_different_epochs(spec, state):
+    proposer_slashing = get_valid_proposer_slashing(
+        spec, state, signed_1=True, signed_2=False)
+    proposer_slashing.signed_header_2.message.slot += spec.SLOTS_PER_EPOCH
+    from consensus_specs_tpu.testlib.helpers.proposer_slashings import \
+        sign_block_header
+    from consensus_specs_tpu.testlib.helpers.keys import privkeys
+    proposer_slashing.signed_header_2 = sign_block_header(
+        spec, state, proposer_slashing.signed_header_2.message,
+        privkeys[proposer_slashing.signed_header_1.message.proposer_index])
+    yield from run_proposer_slashing_processing(
+        spec, state, proposer_slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_proposer_is_not_activated(spec, state):
+    proposer_slashing = get_valid_proposer_slashing(
+        spec, state, signed_1=True, signed_2=True)
+    index = proposer_slashing.signed_header_1.message.proposer_index
+    state.validators[index].activation_epoch = \
+        spec.get_current_epoch(state) + 1
+    yield from run_proposer_slashing_processing(
+        spec, state, proposer_slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_proposer_is_slashed(spec, state):
+    proposer_slashing = get_valid_proposer_slashing(
+        spec, state, signed_1=True, signed_2=True)
+    index = proposer_slashing.signed_header_1.message.proposer_index
+    state.validators[index].slashed = True
+    yield from run_proposer_slashing_processing(
+        spec, state, proposer_slashing, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_proposer_is_withdrawn(spec, state):
+    next_epoch(spec, state)
+    proposer_slashing = get_valid_proposer_slashing(
+        spec, state, signed_1=True, signed_2=True)
+    index = proposer_slashing.signed_header_1.message.proposer_index
+    state.validators[index].withdrawable_epoch = \
+        spec.get_current_epoch(state)
+    yield from run_proposer_slashing_processing(
+        spec, state, proposer_slashing, valid=False)

@@ -1,0 +1,115 @@
+"""process_voluntary_exit matrix
+(parity: `test/phase0/block_processing/test_process_voluntary_exit.py`)."""
+
+from consensus_specs_tpu.testlib.context import (
+    always_bls,
+    spec_state_test,
+    with_all_phases,
+)
+from consensus_specs_tpu.testlib.helpers.keys import privkeys
+from consensus_specs_tpu.testlib.helpers.state import next_epoch, next_slots
+from consensus_specs_tpu.testlib.helpers.voluntary_exits import (
+    prepare_signed_exits,
+    run_voluntary_exit_processing,
+    sign_voluntary_exit,
+)
+
+
+def _prepare_eligible_state(spec, state):
+    # move beyond SHARD_COMMITTEE_PERIOD so exits are allowed
+    next_slots(spec, state,
+               spec.config.SHARD_COMMITTEE_PERIOD * spec.SLOTS_PER_EPOCH)
+
+
+@with_all_phases
+@spec_state_test
+def test_basic_exit(spec, state):
+    _prepare_eligible_state(spec, state)
+    signed_exit = prepare_signed_exits(spec, state, [0])[0]
+    yield from run_voluntary_exit_processing(spec, state, signed_exit)
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_invalid_incorrect_signature(spec, state):
+    _prepare_eligible_state(spec, state)
+    voluntary_exit = spec.VoluntaryExit(
+        epoch=spec.get_current_epoch(state), validator_index=0)
+    signed_exit = sign_voluntary_exit(spec, state, voluntary_exit,
+                                      privkeys[1])
+    yield from run_voluntary_exit_processing(spec, state, signed_exit,
+                                             valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_validator_not_active(spec, state):
+    _prepare_eligible_state(spec, state)
+    state.validators[0].exit_epoch = spec.get_current_epoch(state) - 1
+    # re-activate for activity check... actually: set inactive
+    state.validators[0].activation_epoch = spec.FAR_FUTURE_EPOCH
+    signed_exit = prepare_signed_exits(spec, state, [0])[0]
+    yield from run_voluntary_exit_processing(spec, state, signed_exit,
+                                             valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_validator_already_exited(spec, state):
+    _prepare_eligible_state(spec, state)
+    state.validators[0].exit_epoch = spec.get_current_epoch(state) + 5
+    signed_exit = prepare_signed_exits(spec, state, [0])[0]
+    yield from run_voluntary_exit_processing(spec, state, signed_exit,
+                                             valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_validator_exit_in_future(spec, state):
+    _prepare_eligible_state(spec, state)
+    voluntary_exit = spec.VoluntaryExit(
+        epoch=spec.get_current_epoch(state) + 1, validator_index=0)
+    signed_exit = sign_voluntary_exit(spec, state, voluntary_exit,
+                                      privkeys[0])
+    yield from run_voluntary_exit_processing(spec, state, signed_exit,
+                                             valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_validator_incorrect_validator_index(spec, state):
+    _prepare_eligible_state(spec, state)
+    voluntary_exit = spec.VoluntaryExit(
+        epoch=spec.get_current_epoch(state),
+        validator_index=len(state.validators))
+    signed_exit = sign_voluntary_exit(spec, state, voluntary_exit,
+                                      privkeys[0])
+    yield from run_voluntary_exit_processing(spec, state, signed_exit,
+                                             valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_validator_not_active_long_enough(spec, state):
+    # do NOT advance: activation too recent
+    signed_exit = prepare_signed_exits(spec, state, [0])[0]
+    yield from run_voluntary_exit_processing(spec, state, signed_exit,
+                                             valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_success_exit_queue__min_churn(spec, state):
+    _prepare_eligible_state(spec, state)
+    churn_limit = spec.get_validator_churn_limit(state)
+    # exit `churn_limit` validators in the same epoch
+    initial_indices = spec.get_active_validator_indices(
+        state, spec.get_current_epoch(state))[:churn_limit]
+    signed_exits = prepare_signed_exits(spec, state, initial_indices)
+    for signed_exit in signed_exits[:-1]:
+        spec.process_voluntary_exit(state, signed_exit)
+    # the last one still fits the queue epoch
+    yield from run_voluntary_exit_processing(spec, state, signed_exits[-1])
+    exit_epochs = {state.validators[i].exit_epoch for i in initial_indices}
+    assert len(exit_epochs) == 1  # all in the same epoch (within churn)

@@ -1,0 +1,198 @@
+"""Sanity: block processing (parity: `test/phase0/sanity/test_blocks.py`)."""
+
+import pytest
+
+from consensus_specs_tpu.testlib.context import (
+    always_bls,
+    spec_state_test,
+    with_all_phases,
+)
+from consensus_specs_tpu.testlib.helpers.attestations import (
+    get_valid_attestation,
+)
+from consensus_specs_tpu.testlib.helpers.block import (
+    build_empty_block,
+    build_empty_block_for_next_slot,
+    sign_block,
+)
+from consensus_specs_tpu.testlib.helpers.state import (
+    next_epoch,
+    next_slot,
+    state_transition_and_sign_block,
+)
+
+
+@with_all_phases
+@spec_state_test
+def test_empty_block_transition(spec, state):
+    pre_slot = state.slot
+    pre_eth1_votes = len(state.eth1_data_votes)
+    pre_mix = spec.get_randao_mix(state, spec.get_current_epoch(state))
+
+    yield "pre", state
+
+    block = build_empty_block_for_next_slot(spec, state)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+
+    yield "blocks", [signed_block]
+    yield "post", state
+
+    assert len(state.eth1_data_votes) == pre_eth1_votes + 1
+    assert spec.get_block_root_at_slot(state, pre_slot) == block.parent_root
+    assert spec.get_randao_mix(state, spec.get_current_epoch(state)) != pre_mix
+
+
+@with_all_phases
+@spec_state_test
+def test_slots_then_empty_block(spec, state):
+    yield "pre", state
+    next_slot(spec, state)
+    next_slot(spec, state)
+    block = build_empty_block_for_next_slot(spec, state)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    yield "blocks", [signed_block]
+    yield "post", state
+    assert state.slot == block.slot
+
+
+@with_all_phases
+@spec_state_test
+def test_empty_epoch_transition(spec, state):
+    pre_slot = state.slot
+    yield "pre", state
+
+    block = build_empty_block(spec, state, state.slot + spec.SLOTS_PER_EPOCH)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+
+    yield "blocks", [signed_block]
+    yield "post", state
+
+    assert state.slot == block.slot
+    for slot in range(pre_slot, state.slot):
+        assert spec.get_block_root_at_slot(state, slot) != spec.Root()
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_prev_slot_block_transition(spec, state):
+    next_slot(spec, state)
+    block = build_empty_block(spec, state, state.slot)
+    proposer_index = spec.get_beacon_proposer_index(state)
+    # transition to next slot, above block slot
+    next_slot(spec, state)
+
+    yield "pre", state
+    signed_block = sign_block(spec, state, block, proposer_index)
+    expect_fail_block = state_transition_and_sign_block(
+        spec, state, block, expect_fail=True)
+    yield "blocks", [signed_block]
+    yield "post", None
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_same_slot_block_transition(spec, state):
+    # build block for the CURRENT slot (invalid: must be newer than header)
+    block = build_empty_block(spec, state, state.slot)
+    block.slot = state.slot  # stays at the in-progress slot
+    # tamper: force a slot equal to latest header's
+    block.slot = state.latest_block_header.slot
+
+    yield "pre", state
+    state_transition_and_sign_block(spec, state, block, expect_fail=True)
+    yield "post", None
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_incorrect_proposer_index_sig_from_proposer_index(spec, state):
+    block = build_empty_block_for_next_slot(spec, state)
+    # set invalid proposer index
+    active = spec.get_active_validator_indices(
+        state, spec.get_current_epoch(state))
+    block.proposer_index = (block.proposer_index + 1) % len(active)
+
+    yield "pre", state
+    state_transition_and_sign_block(spec, state, block, expect_fail=True)
+    yield "post", None
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_parent_root(spec, state):
+    block = build_empty_block_for_next_slot(spec, state)
+    block.parent_root = b"\x99" * 32
+
+    yield "pre", state
+    state_transition_and_sign_block(spec, state, block, expect_fail=True)
+    yield "post", None
+
+
+@with_all_phases
+@spec_state_test
+def test_attestation(spec, state):
+    next_epoch(spec, state)
+
+    yield "pre", state
+
+    attestation = get_valid_attestation(
+        spec, state, slot=state.slot, signed=True)
+
+    # Add to state via block transition
+    pre_current_attestations_len = len(state.current_epoch_attestations)
+    block = build_empty_block(
+        spec, state, state.slot + spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    block.body.attestations.append(attestation)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+
+    assert (len(state.current_epoch_attestations)
+            == pre_current_attestations_len + 1)
+
+    # Epoch transition should move to previous_epoch_attestations
+    pre_current_attestations_root = spec.hash_tree_root(
+        state.current_epoch_attestations)
+    from consensus_specs_tpu.testlib.helpers.state import next_epoch as ne
+    ne(spec, state)
+
+    assert len(state.current_epoch_attestations) == 0
+    assert (spec.hash_tree_root(state.previous_epoch_attestations)
+            == pre_current_attestations_root)
+
+    yield "blocks", [signed_block]
+    yield "post", state
+
+
+@with_all_phases
+@spec_state_test
+def test_duplicate_attestation_same_block(spec, state):
+    next_epoch(spec, state)
+    yield "pre", state
+    attestation = get_valid_attestation(
+        spec, state, slot=state.slot, signed=True)
+    block = build_empty_block(
+        spec, state, state.slot + spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    for _ in range(2):
+        block.body.attestations.append(attestation)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    yield "blocks", [signed_block]
+    yield "post", state
+    assert len(state.current_epoch_attestations) == 2
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_invalid_block_sig(spec, state):
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    # sign with the wrong key
+    invalid_signed_block = sign_block(
+        spec, state, block,
+        proposer_index=(block.proposer_index + 1)
+        % len(state.validators))
+
+    from consensus_specs_tpu.testlib.utils import expect_assertion_error
+    expect_assertion_error(
+        lambda: spec.state_transition(state, invalid_signed_block))
+    yield "blocks", [invalid_signed_block]
+    yield "post", None

@@ -9,6 +9,14 @@ the hash-to-curve suite choice (documented in ops/bls/hash_to_curve.py).
 import pytest
 
 from consensus_specs_tpu.ops import bls
+
+
+@pytest.fixture(autouse=True)
+def _bls_on():
+    prev = bls.bls_active
+    bls.bls_active = True
+    yield
+    bls.bls_active = prev
 from consensus_specs_tpu.ops.bls.curve import (
     G1_GEN,
     G2_GEN,
