@@ -1,0 +1,123 @@
+"""Benchmark: mainnet-preset epoch-processing sweep @ 1M validators.
+
+North-star config #4 (BASELINE.md): the per-validator epoch pipeline
+(rewards/penalties + slashings + effective-balance updates) plus the
+registry-scale merkleization (balances list root + validator registry root).
+
+- TPU path: `parallel.epoch_sweep` + device merkle kernels, one fused XLA
+  program over a 2**20-validator struct-of-arrays registry.
+- Baseline: the executable spec's pure-Python pipeline + SSZ engine
+  hash_tree_root, measured on a 1024-validator mainnet state and scaled
+  linearly (the pipeline is O(N); sorting terms are negligible).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def baseline_cpu_seconds_per_validator() -> float:
+    """Pure-Python spec pipeline + SSZ HTR, per validator."""
+    from consensus_specs_tpu.models.builder import build_spec
+    from consensus_specs_tpu.testlib.context import (
+        default_activation_threshold)
+    from consensus_specs_tpu.testlib.helpers.attestations import (
+        prepare_state_with_attestations)
+    from consensus_specs_tpu.testlib.helpers.genesis import (
+        create_genesis_state)
+    from consensus_specs_tpu.utils.ssz.ssz_impl import hash_tree_root
+
+    spec = build_spec("phase0", "mainnet")
+    n = 1024
+    balances = [spec.MAX_EFFECTIVE_BALANCE] * n
+    state = create_genesis_state(
+        spec, balances, default_activation_threshold(spec))
+    prepare_state_with_attestations(spec, state)
+
+    best = float("inf")
+    for _ in range(3):
+        st = state.copy()
+        t0 = time.perf_counter()
+        spec.process_justification_and_finalization(st)
+        spec.process_rewards_and_penalties(st)
+        spec.process_slashings(st)
+        spec.process_effective_balance_updates(st)
+        hash_tree_root(st.balances)
+        hash_tree_root(st.validators)
+        best = min(best, time.perf_counter() - t0)
+    log(f"baseline: {best:.3f}s @ {n} validators "
+        f"({best / n * 1e6:.1f} us/validator)")
+    return best / n
+
+
+def tpu_seconds_per_step(n: int) -> float:
+    import jax
+
+    from consensus_specs_tpu.models.builder import build_spec
+    from consensus_specs_tpu.parallel import (
+        EpochParams, EpochScalars, ValidatorLeaves, balances_list_root,
+        epoch_sweep, validator_records_root, validator_registry_root)
+
+    from __graft_entry__ import _synthetic_registry
+
+    params = EpochParams.from_spec(build_spec("phase0", "mainnet"))
+    reg = _synthetic_registry(n)
+    sc = EpochScalars(current_epoch=np.uint64(100_000),
+                      finality_delay=np.uint64(2),
+                      slashings_sum=np.uint64(32_000_000_000))
+    rng = np.random.RandomState(7)
+    pk_root = rng.randint(0, 2**32, (n, 8), dtype=np.uint64).astype(np.uint32)
+    cred = rng.randint(0, 2**32, (n, 8), dtype=np.uint64).astype(np.uint32)
+
+    @jax.jit
+    def step(reg, sc, length, pk_root, cred):
+        new_bal, new_eff = epoch_sweep(reg, sc, params, axis_name=None)
+        bal_root = balances_list_root(new_bal, length)
+        rec = validator_records_root(
+            ValidatorLeaves(pk_root, cred), new_eff, reg.slashed,
+            reg.activation_eligibility_epoch, reg.activation_epoch,
+            reg.exit_epoch, reg.withdrawable_epoch)
+        reg_root = validator_registry_root(rec, length)
+        return new_bal, new_eff, bal_root, reg_root
+
+    args = (reg, sc, np.uint64(n), pk_root, cred)
+    t0 = time.perf_counter()
+    jax.block_until_ready(step(*args))
+    log(f"tpu: compile+first run {time.perf_counter() - t0:.1f}s "
+        f"on {jax.devices()[0]}")
+    iters = 5
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = jax.block_until_ready(step(*args))
+    dt = (time.perf_counter() - t0) / iters
+    log(f"tpu: {dt * 1e3:.1f} ms/step @ {n} validators "
+        f"(root {np.asarray(out[3])[:2]})")
+    return dt
+
+
+def main():
+    n = 1 << 20
+    per_val_cpu = baseline_cpu_seconds_per_validator()
+    baseline_s = per_val_cpu * n
+    tpu_s = tpu_seconds_per_step(n)
+    print(json.dumps({
+        "metric": "mainnet_epoch_sweep_1m_validators_wall",
+        "value": round(tpu_s, 4),
+        "unit": "s",
+        "vs_baseline": round(baseline_s / tpu_s, 1),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,110 @@
+"""jax.sharding mesh layouts + the sharded epoch step.
+
+The scale axes of this domain (SURVEY.md §5.7) are validator count and
+attestation count; both shard on one `data` mesh axis.  `sharded_epoch_step`
+is the "full training step" of this framework: the per-validator epoch sweep
+(rewards, slashings, effective balances) fused with the balances- and
+registry-list merkleization, `shard_map`ped over the mesh with psum /
+all_gather collectives over ICI.
+"""
+
+from __future__ import annotations
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as jnp  # noqa: E402
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: E402
+
+from .bridge import (  # noqa: E402, F401
+    pad_pow2,
+    participation_from_pending,
+    registry_arrays_from_state,
+    validator_static_leaf_words,
+)
+from .epoch import EpochParams, EpochScalars, RegistryArrays, epoch_sweep  # noqa: E402, F401
+from .merkle import (  # noqa: E402, F401
+    ValidatorLeaves,
+    balances_list_root,
+    pack_u64_chunks,
+    u64_leaf_words,
+    validator_records_root,
+    validator_registry_root,
+)
+
+__all__ = [
+    "EpochParams", "EpochScalars", "RegistryArrays", "ValidatorLeaves",
+    "epoch_sweep", "balances_list_root", "validator_records_root",
+    "validator_registry_root", "make_mesh", "shard_registry",
+    "make_epoch_step", "make_sharded_epoch_step",
+    "registry_arrays_from_state", "validator_static_leaf_words",
+    "participation_from_pending", "pad_pow2",
+]
+
+
+def make_mesh(n_devices: int | None = None, axis: str = "data") -> Mesh:
+    import numpy as np
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    return Mesh(np.array(devs), (axis,))
+
+
+def shard_registry(mesh: Mesh, reg: RegistryArrays, axis: str = "data"):
+    """Place each (N,) registry array sharded on the mesh's data axis."""
+    sh = NamedSharding(mesh, P(axis))
+    return RegistryArrays(*(jax.device_put(a, sh) for a in reg))
+
+
+def make_epoch_step(params: EpochParams):
+    """Single-device jitted epoch step: sweep + balances root.
+
+    Returns f(reg: RegistryArrays, sc: EpochScalars, length)
+         -> (new_bal, new_eff, balances_root_words).
+    Registry arrays must be pre-padded to a power-of-two length; `length`
+    is the true validator count (for the SSZ length mix-in).
+    """
+
+    @jax.jit
+    def step(reg: RegistryArrays, sc: EpochScalars, length):
+        new_bal, new_eff = epoch_sweep(reg, sc, params, axis_name=None)
+        root = balances_list_root(new_bal, length, axis_name=None)
+        return new_bal, new_eff, root
+
+    return step
+
+
+def make_sharded_epoch_step(mesh: Mesh, params: EpochParams,
+                            axis: str = "data"):
+    """Mesh-sharded full step: sweep with psum totals + cross-shard
+    proposer-reward scatter + sharded balances/registry merkle roots.
+
+    Inputs are sharded (N,) arrays (N divisible by mesh size, power of two);
+    `pubkey_root`/`credentials` are the (N, 8) static leaf words.  Outputs:
+    (new_bal, new_eff, balances_root, registry_root) with the roots
+    replicated.
+    """
+    from jax import shard_map
+
+    def _step(reg: RegistryArrays, sc: EpochScalars, length,
+              pubkey_root, credentials):
+        new_bal, new_eff = epoch_sweep(reg, sc, params, axis_name=axis)
+        bal_root = balances_list_root(new_bal, length, axis_name=axis)
+        rec_roots = validator_records_root(
+            ValidatorLeaves(pubkey_root, credentials), new_eff, reg.slashed,
+            reg.activation_eligibility_epoch, reg.activation_epoch,
+            reg.exit_epoch, reg.withdrawable_epoch)
+        reg_root = validator_registry_root(rec_roots, length, axis_name=axis)
+        return new_bal, new_eff, bal_root, reg_root
+
+    data = P(axis)
+    repl = P()
+    sharded = shard_map(
+        _step, mesh=mesh,
+        in_specs=(RegistryArrays(*([data] * len(RegistryArrays._fields))),
+                  EpochScalars(*([repl] * len(EpochScalars._fields))),
+                  repl, data, data),
+        out_specs=(data, data, repl, repl),
+        check_vma=False)
+    return jax.jit(sharded)

@@ -1,0 +1,122 @@
+"""Host↔device bridge: spec `BeaconState` ⇄ struct-of-arrays registry.
+
+The executable spec stays Python/SSZ (exact integer semantics, data-dependent
+validity asserts); the per-validator epoch sweep and the registry-scale
+merkleization dispatch to the device kernels.  This module does the
+committee-expansion of PendingAttestations into per-validator participation
+flags (the only O(attestations·committee) host loop, once per epoch) and the
+array extraction.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .epoch import EpochScalars, RegistryArrays
+
+
+def participation_from_pending(spec, state):
+    """Expand previous-epoch PendingAttestations into per-validator
+    source/target/head flags + min inclusion delay + its proposer.
+
+    Mirrors `get_unslashed_attesting_indices` / `get_inclusion_delay_deltas`
+    matching rules (specs/phase0/beacon-chain.md epoch processing)."""
+    n = len(state.validators)
+    is_source = np.zeros(n, dtype=bool)
+    is_target = np.zeros(n, dtype=bool)
+    is_head = np.zeros(n, dtype=bool)
+    inclusion_delay = np.full(n, np.iinfo(np.uint64).max, dtype=np.uint64)
+    proposer = np.zeros(n, dtype=np.int32)
+
+    prev = spec.get_previous_epoch(state)
+    atts = spec.get_matching_source_attestations(state, prev)
+    target_root = spec.get_block_root(state, prev)
+    for a in atts:
+        indices = list(spec.get_attesting_indices(state, a))
+        matching_target = a.data.target.root == target_root
+        matching_head = (
+            matching_target
+            and a.data.beacon_block_root
+            == spec.get_block_root_at_slot(state, a.data.slot))
+        for i in indices:
+            i = int(i)
+            is_source[i] = True
+            if matching_target:
+                is_target[i] = True
+            if matching_head:
+                is_head[i] = True
+            if int(a.inclusion_delay) < int(inclusion_delay[i]):
+                inclusion_delay[i] = int(a.inclusion_delay)
+                proposer[i] = int(a.proposer_index)
+    inclusion_delay[~is_source] = 1
+    return is_source, is_target, is_head, inclusion_delay, proposer
+
+
+def registry_arrays_from_state(spec, state) -> tuple[RegistryArrays,
+                                                     EpochScalars]:
+    """Extract the sweep inputs from a (pre-epoch-processing) BeaconState."""
+    n = len(state.validators)
+    balance = np.fromiter((int(b) for b in state.balances), np.uint64, n)
+    eff = np.fromiter((int(v.effective_balance) for v in state.validators),
+                      np.uint64, n)
+    slashed = np.fromiter((bool(v.slashed) for v in state.validators),
+                          bool, n)
+    act_el = np.fromiter(
+        (int(v.activation_eligibility_epoch) for v in state.validators),
+        np.uint64, n)
+    act = np.fromiter((int(v.activation_epoch) for v in state.validators),
+                      np.uint64, n)
+    exit_e = np.fromiter((int(v.exit_epoch) for v in state.validators),
+                         np.uint64, n)
+    wd = np.fromiter((int(v.withdrawable_epoch) for v in state.validators),
+                     np.uint64, n)
+    src, tgt, head, delay, prop = participation_from_pending(spec, state)
+
+    reg = RegistryArrays(
+        balance=balance, effective_balance=eff, slashed=slashed,
+        activation_eligibility_epoch=act_el,
+        activation_epoch=act, exit_epoch=exit_e, withdrawable_epoch=wd,
+        is_source=src, is_target=tgt, is_head=head,
+        inclusion_delay=delay, proposer_index=prop)
+
+    cur = int(spec.get_current_epoch(state))
+    prev = int(spec.get_previous_epoch(state))
+    sc = EpochScalars(
+        current_epoch=np.uint64(cur),
+        finality_delay=np.uint64(prev - int(state.finalized_checkpoint.epoch)),
+        slashings_sum=np.uint64(sum(int(s) for s in state.slashings)))
+    return reg, sc
+
+
+def pad_pow2(arr: np.ndarray, multiple_of: int = 1) -> np.ndarray:
+    """Pad (N, ...) to the next power-of-two length that is also a multiple
+    of `multiple_of` (shard count; must itself be a power of two), with
+    zeros."""
+    assert multiple_of & (multiple_of - 1) == 0, \
+        "shard count must be a power of two"
+    n = arr.shape[0]
+    target = 1
+    while target < max(n, multiple_of):
+        target *= 2
+    if target == n:
+        return arr
+    pad = np.zeros((target - n,) + arr.shape[1:], dtype=arr.dtype)
+    return np.concatenate([arr, pad])
+
+
+def validator_static_leaf_words(spec, state):
+    """Precompute the static per-validator leaves (pubkey root, withdrawal
+    credentials) as (N, 8) big-endian uint32 words for the registry tree."""
+    from ..ops.sha256_np import chunks_to_words, sha256_64B_words
+
+    n = len(state.validators)
+    pk_bytes = np.zeros((n, 64), dtype=np.uint8)
+    cred_bytes = np.zeros((n, 32), dtype=np.uint8)
+    for i, v in enumerate(state.validators):
+        pk_bytes[i, :48] = np.frombuffer(bytes(v.pubkey), dtype=np.uint8)
+        cred_bytes[i] = np.frombuffer(
+            bytes(v.withdrawal_credentials), dtype=np.uint8)
+    pk_words = chunks_to_words(pk_bytes.reshape(-1, 32)).reshape(n, 16)
+    pubkey_root = sha256_64B_words(pk_words)
+    cred = chunks_to_words(cred_bytes)
+    return pubkey_root, cred

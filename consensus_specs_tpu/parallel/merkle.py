@@ -1,0 +1,169 @@
+"""Device-side SSZ merkleization of the registry-scale lists.
+
+The reference amortizes `hash_tree_root(state)` with remerkleable's cached
+pointer-tree (`eth2spec/utils/ssz/ssz_impl.py:25`).  The TPU redesign keeps
+the big lists (balances, validators) as flat arrays and re-hashes them as a
+batched tree reduction on device — at 1M validators the whole balances tree
+is ~19 SHA-256 levels of perfectly regular (N, 16)-word batches, exactly the
+shape `ops.sha256_jax` wants.
+
+Sharded form: each device reduces its local contiguous sub-tree, the (tiny)
+per-device roots are `all_gather`ed over the mesh axis and folded on every
+device (replicated), then the zero-subtree ladder up to the SSZ limit depth
+and the length mix-in finish the root.  Collectives ride the ICI: one
+all_gather of n_dev×32 bytes per list.
+
+Parity oracle: `utils.ssz.ssz_impl.hash_tree_root` on the spec containers
+(`tests/test_parallel_merkle.py`).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..ops.sha256_jax import hash_pairs, sha256_64B_words
+from ..ops.sha256_np import ZERO_HASH_WORDS
+
+# x64 (uint64 packing) is enabled once, in parallel/__init__.
+
+_ZEROS = jnp.asarray(np.stack(ZERO_HASH_WORDS[:64]))  # (64, 8) uint32
+
+
+def _bswap32(x):
+    x = x.astype(jnp.uint32)
+    return ((x & jnp.uint32(0xFF)) << 24) | ((x & jnp.uint32(0xFF00)) << 8) \
+        | ((x >> 8) & jnp.uint32(0xFF00)) | (x >> 24)
+
+
+def pack_u64_chunks(values):
+    """(N,) uint64 -> (ceil(N/4), 8) big-endian uint32 chunk words with SSZ
+    little-endian byte layout (4 uint64 per 32-byte chunk)."""
+    n = values.shape[0]
+    pad = (-n) % 4
+    if pad:
+        values = jnp.concatenate([values, jnp.zeros((pad,), dtype=jnp.uint64)])
+    v = values.reshape(-1, 4)
+    lo = _bswap32(v & jnp.uint64(0xFFFFFFFF))
+    hi = _bswap32(v >> jnp.uint64(32))
+    return jnp.stack([lo[:, 0], hi[:, 0], lo[:, 1], hi[:, 1],
+                      lo[:, 2], hi[:, 2], lo[:, 3], hi[:, 3]], axis=-1)
+
+
+def u64_leaf_words(values):
+    """(N,) uint64 -> (N, 8) chunk words: each value alone in a 32B chunk
+    (an SSZ uint64 field leaf)."""
+    lo = _bswap32(values & jnp.uint64(0xFFFFFFFF))
+    hi = _bswap32(values >> jnp.uint64(32))
+    z = jnp.zeros_like(lo)
+    return jnp.stack([lo, hi, z, z, z, z, z, z], axis=-1)
+
+
+def subtree_root(words, depth: int):
+    """Root of the 2**depth-leaf subtree containing `words` (N, 8), with the
+    tail padded by zero-subtree hashes.  N must be a power of two <= 2**depth
+    (pad on host); levels above the data fold against the zero ladder."""
+    n = words.shape[0]
+    assert n & (n - 1) == 0 and n >= 1
+    data_depth = n.bit_length() - 1
+    level = words
+    for _ in range(data_depth):
+        level = hash_pairs(level)
+    root = level[0]
+    for d in range(data_depth, depth):
+        blk = jnp.concatenate([root, _ZEROS[d]])
+        root = sha256_64B_words(blk[None, :])[0]
+    return root
+
+
+def mix_in_length(root_words, length):
+    """H(root || le64(length) || zeros) — SSZ list length mix-in."""
+    lo = _bswap32(length.astype(jnp.uint64) & jnp.uint64(0xFFFFFFFF))
+    hi = _bswap32(length.astype(jnp.uint64) >> jnp.uint64(32))
+    z = jnp.zeros((), dtype=jnp.uint32)
+    tail = jnp.stack([lo, hi, z, z, z, z, z, z])
+    blk = jnp.concatenate([root_words, tail])
+    return sha256_64B_words(blk[None, :])[0]
+
+
+def balances_list_root(balances, length, limit_depth: int = 38,
+                       axis_name: str | None = None):
+    """hash_tree_root of `List[uint64, 2**40]` (SSZ packed, limit 2**40
+    values -> 2**38 chunks).  `balances` is the (padded, pow2) local shard;
+    `length` the true global element count."""
+    chunks = pack_u64_chunks(balances)
+    if axis_name is None:
+        root = subtree_root(chunks, limit_depth)
+    else:
+        root = _sharded_list_root(chunks, limit_depth, axis_name)
+    return mix_in_length(root, length)
+
+
+def _sharded_list_root(local_chunks, limit_depth: int, axis_name: str):
+    """Each shard holds a contiguous power-of-two run of data chunks: reduce
+    it to its local root, all_gather the shard roots, finish the data tree,
+    THEN fold the zero-subtree ladder (padding sits above the whole data
+    tree, not inside each shard)."""
+    n_local = local_chunks.shape[0]
+    assert n_local & (n_local - 1) == 0
+    local_depth = n_local.bit_length() - 1
+    local = subtree_root(local_chunks, local_depth)
+    roots = lax.all_gather(local, axis_name)  # (n_dev, 8) on every device
+    n_dev = roots.shape[0]
+    shard_depth = (n_dev - 1).bit_length()
+    level = roots
+    for _ in range(shard_depth):
+        level = hash_pairs(level)
+    root = level[0]
+    for d in range(local_depth + shard_depth, limit_depth):
+        blk = jnp.concatenate([root, _ZEROS[d]])
+        root = sha256_64B_words(blk[None, :])[0]
+    return root
+
+
+class ValidatorLeaves:
+    """Precomputed per-validator leaf words for the registry tree.
+
+    A `Validator` container has 8 field leaves
+    (`specs/phase0/beacon-chain.md` `Validator`): [pubkey_root,
+    withdrawal_credentials, effective_balance, slashed, act_eligibility,
+    activation, exit, withdrawable].  pubkey_root and credentials are static
+    per validator (change only on deposit) and are precomputed host-side;
+    the dynamic uint64/bool fields come straight from the sweep arrays.
+    """
+
+    def __init__(self, pubkey_root_words, credentials_words):
+        self.pubkey_root = jnp.asarray(pubkey_root_words)    # (N, 8) uint32
+        self.credentials = jnp.asarray(credentials_words)    # (N, 8) uint32
+
+
+def validator_records_root(leaves: ValidatorLeaves, effective_balance,
+                           slashed, activation_eligibility_epoch,
+                           activation_epoch, exit_epoch, withdrawable_epoch):
+    """(N,) arrays -> (N, 8) root words of each Validator container (a full
+    depth-3 reduction over the 8 field leaves, batched over validators)."""
+    f = [leaves.pubkey_root,
+         leaves.credentials,
+         u64_leaf_words(effective_balance),
+         u64_leaf_words(slashed.astype(jnp.uint64)),
+         u64_leaf_words(activation_eligibility_epoch),
+         u64_leaf_words(activation_epoch),
+         u64_leaf_words(exit_epoch),
+         u64_leaf_words(withdrawable_epoch)]
+    level = jnp.stack(f, axis=1)            # (N, 8 leaves, 8 words)
+    for _ in range(3):
+        half = level.shape[1] // 2
+        level = sha256_64B_words(level.reshape(level.shape[0], half, 16))
+    return level[:, 0, :]
+
+
+def validator_registry_root(record_roots, length, limit_depth: int = 40,
+                            axis_name: str | None = None):
+    """hash_tree_root of `List[Validator, 2**40]` given the (padded, pow2)
+    local shard of per-record roots."""
+    if axis_name is None:
+        root = subtree_root(record_roots, limit_depth)
+    else:
+        root = _sharded_list_root(record_roots, limit_depth, axis_name)
+    return mix_in_length(root, length)

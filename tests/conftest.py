@@ -8,12 +8,20 @@ driver's `__graft_entry__.py` checks, not by the unit suite.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# The image's sitecustomize registers the axon TPU PJRT plugin and imports
+# jax at interpreter start, so the env var above can be too late — override
+# through the live config as well (safe: no backend is initialized yet at
+# conftest-import time).
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

@@ -1,0 +1,107 @@
+"""Parity: the TPU epoch-sweep kernel vs the executable spec.
+
+The kernel (`parallel.epoch.epoch_sweep`) must reproduce the spec's
+rewards/penalties + slashings + effective-balance pipeline bit-for-bit
+(arrays extracted AFTER `process_justification_and_finalization`, which is
+where the sweep's finality/justification inputs are read in `process_epoch`).
+"""
+
+import numpy as np
+import pytest
+
+from consensus_specs_tpu.models.builder import build_spec
+from consensus_specs_tpu.parallel import (
+    EpochParams,
+    balances_list_root,
+    make_epoch_step,
+    pad_pow2,
+    registry_arrays_from_state,
+    RegistryArrays,
+)
+from consensus_specs_tpu.testlib.context import (
+    default_activation_threshold,
+    default_balances,
+)
+from consensus_specs_tpu.testlib.helpers.attestations import (
+    prepare_state_with_attestations,
+)
+from consensus_specs_tpu.testlib.helpers.genesis import create_genesis_state
+from consensus_specs_tpu.testlib.helpers.state import next_epoch
+from consensus_specs_tpu.utils.ssz.ssz_impl import hash_tree_root
+
+
+@pytest.fixture(scope="module")
+def spec():
+    return build_spec("phase0", "minimal")
+
+
+def _fresh_state(spec, extra_slashed=(), leak_epochs=0):
+    state = create_genesis_state(
+        spec, default_balances(spec), default_activation_threshold(spec))
+    for _ in range(leak_epochs):
+        next_epoch(spec, state)
+    if not leak_epochs:
+        prepare_state_with_attestations(spec, state)
+    for i in extra_slashed:
+        state.validators[i].slashed = True
+        state.validators[i].withdrawable_epoch = spec.Epoch(
+            int(spec.get_current_epoch(state))
+            + int(spec.EPOCHS_PER_SLASHINGS_VECTOR) // 2)
+        state.slashings[0] += state.validators[i].effective_balance
+    return state
+
+
+def _run_both(spec, state):
+    """Run spec process_epoch tail vs kernel on the same pre-state."""
+    spec_state = state.copy()
+    spec.process_justification_and_finalization(spec_state)
+
+    reg, sc = registry_arrays_from_state(spec, spec_state)
+    n = len(state.validators)
+    reg = RegistryArrays(*(pad_pow2(np.asarray(a)) for a in reg))
+
+    step = make_epoch_step(EpochParams.from_spec(spec))
+    new_bal, new_eff, root = step(reg, sc, np.uint64(n))
+
+    spec.process_rewards_and_penalties(spec_state)
+    spec.process_slashings(spec_state)
+    spec.process_effective_balance_updates(spec_state)
+
+    want_bal = np.array([int(b) for b in spec_state.balances], dtype=np.uint64)
+    want_eff = np.array([int(v.effective_balance)
+                         for v in spec_state.validators], dtype=np.uint64)
+    return (np.asarray(new_bal)[:n], np.asarray(new_eff)[:n], root,
+            want_bal, want_eff, spec_state)
+
+
+def test_sweep_matches_spec_with_full_participation(spec):
+    state = _fresh_state(spec)
+    got_bal, got_eff, root, want_bal, want_eff, spec_state = _run_both(
+        spec, state)
+    np.testing.assert_array_equal(got_bal, want_bal)
+    np.testing.assert_array_equal(got_eff, want_eff)
+
+
+def test_sweep_matches_spec_with_slashed_validators(spec):
+    state = _fresh_state(spec, extra_slashed=(1, 5, 9))
+    got_bal, got_eff, _, want_bal, want_eff, _ = _run_both(spec, state)
+    np.testing.assert_array_equal(got_bal, want_bal)
+    np.testing.assert_array_equal(got_eff, want_eff)
+
+
+def test_sweep_matches_spec_in_inactivity_leak(spec):
+    # advance far past finality with zero attestations -> leak active
+    state = _fresh_state(
+        spec, leak_epochs=int(spec.MIN_EPOCHS_TO_INACTIVITY_PENALTY) + 3)
+    assert spec.is_in_inactivity_leak(state)
+    got_bal, got_eff, _, want_bal, want_eff, _ = _run_both(spec, state)
+    np.testing.assert_array_equal(got_bal, want_bal)
+    np.testing.assert_array_equal(got_eff, want_eff)
+
+
+def test_balances_root_matches_ssz(spec):
+    state = _fresh_state(spec)
+    got_bal, _, root, _, _, spec_state = _run_both(spec, state)
+    want = hash_tree_root(spec_state.balances)
+    got = np.asarray(root).astype(">u4").tobytes()
+    assert got == bytes(want)
