@@ -20,7 +20,11 @@ import json
 import sys
 import time
 
+import jax
 import numpy as np
+
+# entry points own the process-wide uint64 switch (parallel.require_x64)
+jax.config.update("jax_enable_x64", True)
 
 
 def log(*a):

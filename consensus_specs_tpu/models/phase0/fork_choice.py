@@ -1,0 +1,530 @@
+# Phase 0 -- Fork Choice (executable spec source).
+#
+# LMD-GHOST over an event-sourced Store: handlers `on_tick`, `on_block`,
+# `on_attestation`, `on_attester_slashing` mutate the Store; `get_head`
+# runs the weighted walk from the justified checkpoint.
+# Parity contract: specs/phase0/fork-choice.md of the reference
+# (Store :128, get_forkchoice_store :166, get_weight :267,
+#  filter_block_tree :320, get_head :387, proposer reorg helpers :442-563,
+#  pull-up tips :564, handlers :685-795).  Implementations here are
+# written fresh: ancestor walks are iterative, and the viable-tree filter
+# builds a children index once instead of scanning all blocks per node.
+
+# ---------------------------------------------------------------------------
+# Constant + helpers (fork-choice.md :98-127)
+# ---------------------------------------------------------------------------
+
+INTERVALS_PER_SLOT = uint64(3)
+
+
+@dataclass(eq=True, frozen=True)
+class LatestMessage(object):
+    epoch: Epoch
+    root: Root
+
+
+@dataclass
+class Store(object):
+    """Fork-choice state (fork-choice.md :128-163).
+
+    `justified_checkpoint`/`finalized_checkpoint` track what is realized
+    on-chain; the `unrealized_*` twins track what justification/finality
+    *would* be if the tip states were pulled up to the next epoch
+    boundary.  `unrealized_justifications` maps each block root to the
+    pulled-up justified checkpoint observed in that block's chain.
+    """
+    time: uint64
+    genesis_time: uint64
+    justified_checkpoint: Checkpoint
+    finalized_checkpoint: Checkpoint
+    unrealized_justified_checkpoint: Checkpoint
+    unrealized_finalized_checkpoint: Checkpoint
+    proposer_boost_root: Root
+    equivocating_indices: Set[ValidatorIndex]
+    blocks: Dict[Root, BeaconBlock] = field(default_factory=dict)
+    block_states: Dict[Root, BeaconState] = field(default_factory=dict)
+    block_timeliness: Dict[Root, bool] = field(default_factory=dict)
+    checkpoint_states: Dict[Checkpoint, BeaconState] = field(default_factory=dict)
+    latest_messages: Dict[ValidatorIndex, LatestMessage] = field(default_factory=dict)
+    unrealized_justifications: Dict[Root, Checkpoint] = field(default_factory=dict)
+
+
+def get_forkchoice_store(anchor_state: BeaconState,
+                         anchor_block: BeaconBlock) -> Store:
+    """Initialize a Store from a trusted anchor (fork-choice.md :166-199).
+    The anchor (normally genesis or a checkpoint-sync state) is never
+    rolled back past."""
+    assert anchor_block.state_root == hash_tree_root(anchor_state)
+    anchor_root = hash_tree_root(anchor_block)
+    anchor_epoch = get_current_epoch(anchor_state)
+    justified_checkpoint = Checkpoint(epoch=anchor_epoch, root=anchor_root)
+    finalized_checkpoint = Checkpoint(epoch=anchor_epoch, root=anchor_root)
+    return Store(
+        time=uint64(anchor_state.genesis_time
+                    + config.SECONDS_PER_SLOT * anchor_state.slot),
+        genesis_time=anchor_state.genesis_time,
+        justified_checkpoint=justified_checkpoint,
+        finalized_checkpoint=finalized_checkpoint,
+        unrealized_justified_checkpoint=justified_checkpoint,
+        unrealized_finalized_checkpoint=finalized_checkpoint,
+        proposer_boost_root=Root(),
+        equivocating_indices=set(),
+        blocks={anchor_root: copy(anchor_block)},
+        block_states={anchor_root: copy(anchor_state)},
+        checkpoint_states={justified_checkpoint: copy(anchor_state)},
+        unrealized_justifications={anchor_root: justified_checkpoint},
+    )
+
+
+def get_slots_since_genesis(store: Store) -> int:
+    return (store.time - store.genesis_time) // config.SECONDS_PER_SLOT
+
+
+def get_current_slot(store: Store) -> Slot:
+    return Slot(GENESIS_SLOT + get_slots_since_genesis(store))
+
+
+def get_current_store_epoch(store: Store) -> Epoch:
+    return compute_epoch_at_slot(get_current_slot(store))
+
+
+def compute_slots_since_epoch_start(slot: Slot) -> int:
+    return slot - compute_start_slot_at_epoch(compute_epoch_at_slot(slot))
+
+
+def get_ancestor(store: Store, root: Root, slot: Slot) -> Root:
+    """Root of the ancestor of `root` at (or before) `slot`
+    (fork-choice.md :229-237; iterative rather than recursive)."""
+    while store.blocks[root].slot > slot:
+        root = store.blocks[root].parent_root
+    return root
+
+
+def calculate_committee_fraction(state: BeaconState,
+                                 committee_percent: uint64) -> Gwei:
+    committee_weight = get_total_active_balance(state) // SLOTS_PER_EPOCH
+    return Gwei((committee_weight * committee_percent) // 100)
+
+
+def get_checkpoint_block(store: Store, root: Root, epoch: Epoch) -> Root:
+    """The checkpoint block for `epoch` in the chain containing `root`."""
+    return get_ancestor(store, root, compute_start_slot_at_epoch(epoch))
+
+
+def get_proposer_score(store: Store) -> Gwei:
+    justified_state = store.checkpoint_states[store.justified_checkpoint]
+    committee_weight = (get_total_active_balance(justified_state)
+                        // SLOTS_PER_EPOCH)
+    return (committee_weight * config.PROPOSER_SCORE_BOOST) // 100
+
+
+def get_weight(store: Store, root: Root) -> Gwei:
+    """LMD weight of the subtree rooted at `root`: effective balance of
+    every unslashed, non-equivocating active validator whose latest
+    message descends from `root`, plus the proposer boost when the
+    boosted block descends from `root` (fork-choice.md :267-299)."""
+    state = store.checkpoint_states[store.justified_checkpoint]
+    block_slot = store.blocks[root].slot
+    attestation_score = Gwei(sum(
+        state.validators[i].effective_balance
+        for i in get_active_validator_indices(state, get_current_epoch(state))
+        if (
+            not state.validators[i].slashed
+            and i in store.latest_messages
+            and i not in store.equivocating_indices
+            and get_ancestor(store, store.latest_messages[i].root,
+                             block_slot) == root
+        )
+    ))
+    if store.proposer_boost_root == Root():
+        return attestation_score
+    proposer_score = Gwei(0)
+    if get_ancestor(store, store.proposer_boost_root, block_slot) == root:
+        proposer_score = get_proposer_score(store)
+    return attestation_score + proposer_score
+
+
+def get_voting_source(store: Store, block_root: Root) -> Checkpoint:
+    """The justified checkpoint that validators voting for `block_root`
+    as head would use as their FFG source (fork-choice.md :304-317)."""
+    block = store.blocks[block_root]
+    current_epoch = get_current_store_epoch(store)
+    block_epoch = compute_epoch_at_slot(block.slot)
+    if current_epoch > block_epoch:
+        # Block from a prior epoch: the voting source is pulled up
+        return store.unrealized_justifications[block_root]
+    head_state = store.block_states[block_root]
+    return head_state.current_justified_checkpoint
+
+
+def _is_leaf_viable(store: Store, block_root: Root) -> bool:
+    """Leaf viability predicate of the block-tree filter
+    (fork-choice.md :327-370 leaf branch)."""
+    current_epoch = get_current_store_epoch(store)
+    voting_source = get_voting_source(store, block_root)
+    correct_justified = (
+        store.justified_checkpoint.epoch == GENESIS_EPOCH
+        or voting_source.epoch == store.justified_checkpoint.epoch
+        # allow a voting source at most two epochs stale
+        or voting_source.epoch + 2 >= current_epoch
+    )
+    finalized_checkpoint_block = get_checkpoint_block(
+        store, block_root, store.finalized_checkpoint.epoch)
+    correct_finalized = (
+        store.finalized_checkpoint.epoch == GENESIS_EPOCH
+        or store.finalized_checkpoint.root == finalized_checkpoint_block
+    )
+    return correct_justified and correct_finalized
+
+
+def filter_block_tree(store: Store, block_root: Root,
+                      blocks: Dict[Root, BeaconBlock]) -> bool:
+    """Keep the subtree under `block_root` iff some descendant leaf agrees
+    with the store's justified/finalized checkpoints; fills `blocks` with
+    the surviving nodes.  External callers MUST pass
+    `store.justified_checkpoint.root` (fork-choice.md :320-370).
+
+    Iterative post-order over a children index built once — the
+    reference's recursion re-scans every block per node."""
+    children_of: Dict[Root, PyList[Root]] = {}
+    for root, block in store.blocks.items():
+        children_of.setdefault(block.parent_root, []).append(root)
+
+    viable: Dict[Root, bool] = {}
+    stack = [(block_root, False)]
+    while stack:
+        root, expanded = stack.pop()
+        children = children_of.get(root, [])
+        # only children already in the store count (parent_root of the
+        # base block may collide with roots outside the subtree)
+        children = [c for c in children if c in store.blocks]
+        if not expanded and children:
+            stack.append((root, True))
+            stack.extend((c, False) for c in children)
+            continue
+        if children:
+            viable[root] = any(viable[c] for c in children)
+        else:
+            viable[root] = _is_leaf_viable(store, root)
+        if viable[root]:
+            blocks[root] = store.blocks[root]
+    return viable[block_root]
+
+
+def get_filtered_block_tree(store: Store) -> Dict[Root, BeaconBlock]:
+    """Block tree restricted to branches whose leaf states agree with the
+    store's justified/finalized checkpoints (fork-choice.md :373-384)."""
+    base = store.justified_checkpoint.root
+    blocks: Dict[Root, BeaconBlock] = {}
+    filter_block_tree(store, base, blocks)
+    return blocks
+
+
+def get_head(store: Store) -> Root:
+    """LMD-GHOST head: greedy heaviest-subtree walk from the justified
+    root over the viable tree; ties break toward the lexicographically
+    larger root (fork-choice.md :387-401)."""
+    blocks = get_filtered_block_tree(store)
+    children_of: Dict[Root, PyList[Root]] = {}
+    for root, block in blocks.items():
+        children_of.setdefault(block.parent_root, []).append(root)
+    head = store.justified_checkpoint.root
+    while True:
+        children = children_of.get(head, [])
+        if len(children) == 0:
+            return head
+        head = max(children, key=lambda root: (get_weight(store, root), root))
+
+
+def update_checkpoints(store: Store, justified_checkpoint: Checkpoint,
+                       finalized_checkpoint: Checkpoint) -> None:
+    """Adopt strictly newer justified/finalized checkpoints."""
+    if justified_checkpoint.epoch > store.justified_checkpoint.epoch:
+        store.justified_checkpoint = justified_checkpoint
+    if finalized_checkpoint.epoch > store.finalized_checkpoint.epoch:
+        store.finalized_checkpoint = finalized_checkpoint
+
+
+def update_unrealized_checkpoints(
+        store: Store, unrealized_justified_checkpoint: Checkpoint,
+        unrealized_finalized_checkpoint: Checkpoint) -> None:
+    """Adopt strictly newer unrealized checkpoints."""
+    if (unrealized_justified_checkpoint.epoch
+            > store.unrealized_justified_checkpoint.epoch):
+        store.unrealized_justified_checkpoint = unrealized_justified_checkpoint
+    if (unrealized_finalized_checkpoint.epoch
+            > store.unrealized_finalized_checkpoint.epoch):
+        store.unrealized_finalized_checkpoint = unrealized_finalized_checkpoint
+
+
+# ---------------------------------------------------------------------------
+# Proposer head / re-org helpers (fork-choice.md :442-563)
+# ---------------------------------------------------------------------------
+
+
+def is_head_late(store: Store, head_root: Root) -> bool:
+    return not store.block_timeliness[head_root]
+
+
+def is_shuffling_stable(slot: Slot) -> bool:
+    return slot % SLOTS_PER_EPOCH != 0
+
+
+def is_ffg_competitive(store: Store, head_root: Root,
+                       parent_root: Root) -> bool:
+    return (store.unrealized_justifications[head_root]
+            == store.unrealized_justifications[parent_root])
+
+
+def is_finalization_ok(store: Store, slot: Slot) -> bool:
+    epochs_since_finalization = (compute_epoch_at_slot(slot)
+                                 - store.finalized_checkpoint.epoch)
+    return (epochs_since_finalization
+            <= config.REORG_MAX_EPOCHS_SINCE_FINALIZATION)
+
+
+def is_proposing_on_time(store: Store) -> bool:
+    # Half of an attestation interval is the proposer re-org deadline
+    time_into_slot = ((store.time - store.genesis_time)
+                      % config.SECONDS_PER_SLOT)
+    proposer_reorg_cutoff = (config.SECONDS_PER_SLOT
+                             // INTERVALS_PER_SLOT // 2)
+    return time_into_slot <= proposer_reorg_cutoff
+
+
+def is_head_weak(store: Store, head_root: Root) -> bool:
+    justified_state = store.checkpoint_states[store.justified_checkpoint]
+    reorg_threshold = calculate_committee_fraction(
+        justified_state, config.REORG_HEAD_WEIGHT_THRESHOLD)
+    return get_weight(store, head_root) < reorg_threshold
+
+
+def is_parent_strong(store: Store, parent_root: Root) -> bool:
+    justified_state = store.checkpoint_states[store.justified_checkpoint]
+    parent_threshold = calculate_committee_fraction(
+        justified_state, config.REORG_PARENT_WEIGHT_THRESHOLD)
+    return get_weight(store, parent_root) > parent_threshold
+
+
+def get_proposer_head(store: Store, head_root: Root, slot: Slot) -> Root:
+    """Head a proposer should build on: its parent, when a late, weak
+    head can safely be re-orged by proposer boost (fork-choice.md
+    :510-560); otherwise the head itself."""
+    head_block = store.blocks[head_root]
+    parent_root = head_block.parent_root
+    parent_block = store.blocks[parent_root]
+
+    head_late = is_head_late(store, head_root)
+    shuffling_stable = is_shuffling_stable(slot)
+    ffg_competitive = is_ffg_competitive(store, head_root, parent_root)
+    finalization_ok = is_finalization_ok(store, slot)
+    proposing_on_time = is_proposing_on_time(store)
+
+    # Only a single-slot re-org is ever attempted
+    parent_slot_ok = parent_block.slot + 1 == head_block.slot
+    current_time_ok = head_block.slot + 1 == slot
+    single_slot_reorg = parent_slot_ok and current_time_ok
+
+    # The boost must have worn off the head before weighing it
+    assert store.proposer_boost_root != head_root
+    head_weak = is_head_weak(store, head_root)
+    parent_strong = is_parent_strong(store, parent_root)
+
+    if all([head_late, shuffling_stable, ffg_competitive, finalization_ok,
+            proposing_on_time, single_slot_reorg, head_weak, parent_strong]):
+        return parent_root
+    return head_root
+
+
+# ---------------------------------------------------------------------------
+# Pull-up tips (fork-choice.md :564-584)
+# ---------------------------------------------------------------------------
+
+
+def compute_pulled_up_tip(store: Store, block_root: Root) -> None:
+    """Eagerly compute the justification the block's state reaches once
+    pulled up to its next epoch boundary; realize it immediately if the
+    block is from a prior epoch."""
+    state = store.block_states[block_root].copy()
+    process_justification_and_finalization(state)
+
+    store.unrealized_justifications[block_root] = (
+        state.current_justified_checkpoint)
+    update_unrealized_checkpoints(store, state.current_justified_checkpoint,
+                                  state.finalized_checkpoint)
+
+    block_epoch = compute_epoch_at_slot(store.blocks[block_root].slot)
+    if block_epoch < get_current_store_epoch(store):
+        update_checkpoints(store, state.current_justified_checkpoint,
+                           state.finalized_checkpoint)
+
+
+# ---------------------------------------------------------------------------
+# Handlers (fork-choice.md :586-795)
+# ---------------------------------------------------------------------------
+
+
+def on_tick_per_slot(store: Store, time: uint64) -> None:
+    previous_slot = get_current_slot(store)
+    store.time = time
+    current_slot = get_current_slot(store)
+    # New slot: the proposer boost expires
+    if current_slot > previous_slot:
+        store.proposer_boost_root = Root()
+    # New epoch: realize the unrealized checkpoints
+    if (current_slot > previous_slot
+            and compute_slots_since_epoch_start(current_slot) == 0):
+        update_checkpoints(store, store.unrealized_justified_checkpoint,
+                           store.unrealized_finalized_checkpoint)
+
+
+def on_tick(store: Store, time: uint64) -> None:
+    # Catch up slot by slot so every boundary runs its per-slot logic
+    tick_slot = (time - store.genesis_time) // config.SECONDS_PER_SLOT
+    while get_current_slot(store) < tick_slot:
+        previous_time = (store.genesis_time
+                         + (get_current_slot(store) + 1)
+                         * config.SECONDS_PER_SLOT)
+        on_tick_per_slot(store, previous_time)
+    on_tick_per_slot(store, time)
+
+
+def on_block(store: Store, signed_block: SignedBeaconBlock) -> None:
+    """Validate + apply a block to the store (fork-choice.md :703-750)."""
+    block = signed_block.message
+    # Parent must be known
+    assert block.parent_root in store.block_states
+    pre_state = copy(store.block_states[block.parent_root])
+    # Future blocks wait until their slot arrives
+    assert get_current_slot(store) >= block.slot
+
+    # Must descend from (and be after) the finalized checkpoint
+    finalized_slot = compute_start_slot_at_epoch(
+        store.finalized_checkpoint.epoch)
+    assert block.slot > finalized_slot
+    finalized_checkpoint_block = get_checkpoint_block(
+        store, block.parent_root, store.finalized_checkpoint.epoch)
+    assert store.finalized_checkpoint.root == finalized_checkpoint_block
+
+    # Full state transition (asserts internally on invalid blocks)
+    state = pre_state.copy()
+    block_root = hash_tree_root(block)
+    state_transition(state, signed_block, True)
+    store.blocks[block_root] = block
+    store.block_states[block_root] = state
+
+    # Timeliness: arrived in its own slot, before the attesting interval
+    time_into_slot = ((store.time - store.genesis_time)
+                      % config.SECONDS_PER_SLOT)
+    is_before_attesting_interval = (
+        time_into_slot < config.SECONDS_PER_SLOT // INTERVALS_PER_SLOT)
+    is_timely = (get_current_slot(store) == block.slot
+                 and is_before_attesting_interval)
+    store.block_timeliness[block_root] = is_timely
+
+    # Boost the first timely block of the slot
+    if is_timely and store.proposer_boost_root == Root():
+        store.proposer_boost_root = block_root
+
+    update_checkpoints(store, state.current_justified_checkpoint,
+                       state.finalized_checkpoint)
+    compute_pulled_up_tip(store, block_root)
+
+
+def validate_target_epoch_against_current_time(
+        store: Store, attestation: Attestation) -> None:
+    target = attestation.data.target
+    current_epoch = get_current_store_epoch(store)
+    previous_epoch = (current_epoch - 1 if current_epoch > GENESIS_EPOCH
+                      else GENESIS_EPOCH)
+    # Future-epoch targets wait until their epoch arrives
+    assert target.epoch in [current_epoch, previous_epoch]
+
+
+def validate_on_attestation(store: Store, attestation: Attestation,
+                            is_from_block: bool) -> None:
+    target = attestation.data.target
+
+    # Wire attestations are epoch-scoped; in-block ones already were
+    if not is_from_block:
+        validate_target_epoch_against_current_time(store, attestation)
+
+    assert target.epoch == compute_epoch_at_slot(attestation.data.slot)
+    # Target and LMD blocks must be known (else: delay consideration)
+    assert target.root in store.blocks
+    assert attestation.data.beacon_block_root in store.blocks
+    # The LMD vote must not point into the future
+    assert (store.blocks[attestation.data.beacon_block_root].slot
+            <= attestation.data.slot)
+    # LMD vote must be consistent with the FFG target
+    assert target.root == get_checkpoint_block(
+        store, attestation.data.beacon_block_root, target.epoch)
+    # Attestations only influence the fork choice of later slots
+    assert get_current_slot(store) >= attestation.data.slot + 1
+
+
+def store_target_checkpoint_state(store: Store, target: Checkpoint) -> None:
+    if target not in store.checkpoint_states:
+        base_state = copy(store.block_states[target.root])
+        if base_state.slot < compute_start_slot_at_epoch(target.epoch):
+            process_slots(base_state, compute_start_slot_at_epoch(target.epoch))
+        store.checkpoint_states[target] = base_state
+
+
+def update_latest_messages(store: Store,
+                           attesting_indices: Sequence[ValidatorIndex],
+                           attestation: Attestation) -> None:
+    target = attestation.data.target
+    beacon_block_root = attestation.data.beacon_block_root
+    for i in attesting_indices:
+        if i in store.equivocating_indices:
+            continue
+        known = store.latest_messages.get(i)
+        if known is None or target.epoch > known.epoch:
+            store.latest_messages[i] = LatestMessage(
+                epoch=target.epoch, root=beacon_block_root)
+
+
+def on_attestation(store: Store, attestation: Attestation,
+                   is_from_block: bool = False) -> None:
+    """Apply an attestation (from gossip or a block) to fork-choice
+    weights.  An attestation rejected here may become valid later —
+    callers may re-schedule it (fork-choice.md :753-775)."""
+    validate_on_attestation(store, attestation, is_from_block)
+    store_target_checkpoint_state(store, attestation.data.target)
+
+    # Validate fully against the target checkpoint state
+    target_state = store.checkpoint_states[attestation.data.target]
+    indexed_attestation = get_indexed_attestation(target_state, attestation)
+    assert is_valid_indexed_attestation(target_state, indexed_attestation)
+
+    update_latest_messages(store, indexed_attestation.attesting_indices,
+                           attestation)
+
+
+def on_attester_slashing(store: Store,
+                         attester_slashing: AttesterSlashing) -> None:
+    """Mark double/surround voters as equivocating so their latest
+    messages stop counting (fork-choice.md :778-795)."""
+    attestation_1 = attester_slashing.attestation_1
+    attestation_2 = attester_slashing.attestation_2
+    assert is_slashable_attestation_data(attestation_1.data,
+                                         attestation_2.data)
+    state = store.block_states[store.justified_checkpoint.root]
+    assert is_valid_indexed_attestation(state, attestation_1)
+    assert is_valid_indexed_attestation(state, attestation_2)
+
+    indices = set(attestation_1.attesting_indices).intersection(
+        attestation_2.attesting_indices)
+    for index in indices:
+        store.equivocating_indices.add(index)
+
+
+# ---------------------------------------------------------------------------
+# Safe block (fork_choice/safe-block.md)
+# ---------------------------------------------------------------------------
+
+
+def get_safe_beacon_block_root(store: Store) -> Root:
+    # Use most recent justified block as a stopgap
+    return store.justified_checkpoint.root

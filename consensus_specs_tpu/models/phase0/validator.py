@@ -1,0 +1,313 @@
+# Phase 0 -- Honest Validator + p2p pure functions + weak subjectivity
+# (executable spec source).
+#
+# Parity contract: specs/phase0/validator.md (assignments :272, block
+# proposal :423-600, attesting :672, aggregation :717-815),
+# specs/phase0/p2p-interface.md (custom types :195-233, subnet
+# subscription :1315-1333), specs/phase0/weak-subjectivity.md
+# (ws period :94, staleness check :181).
+
+
+# ---------------------------------------------------------------------------
+# Custom types + constants (validator.md :100-103, p2p-interface.md :195-233,
+# weak-subjectivity.md constants table)
+# ---------------------------------------------------------------------------
+
+
+class NodeID(uint256):
+    pass
+
+
+class SubnetID(uint64):
+    pass
+
+
+TARGET_AGGREGATORS_PER_COMMITTEE = uint64(2**4)
+NODE_ID_BITS = 256
+ETH_TO_GWEI = uint64(10**9)
+SAFETY_DECAY = uint64(10)
+
+
+# ---------------------------------------------------------------------------
+# Containers (validator.md :107-131)
+# ---------------------------------------------------------------------------
+
+
+class Eth1Block(Container):
+    timestamp: uint64
+    deposit_root: Root
+    deposit_count: uint64
+    # All other eth1 block fields
+
+
+class AggregateAndProof(Container):
+    aggregator_index: ValidatorIndex
+    aggregate: Attestation
+    selection_proof: BLSSignature
+
+
+class SignedAggregateAndProof(Container):
+    message: AggregateAndProof
+    signature: BLSSignature
+
+
+# ---------------------------------------------------------------------------
+# Assignments (validator.md :253-305)
+# ---------------------------------------------------------------------------
+
+
+def check_if_validator_active(state: BeaconState,
+                              validator_index: ValidatorIndex) -> bool:
+    validator = state.validators[validator_index]
+    return is_active_validator(validator, get_current_epoch(state))
+
+
+def get_committee_assignment(
+        state: BeaconState, epoch: Epoch, validator_index: ValidatorIndex
+) -> Optional[Tuple[Sequence[ValidatorIndex], CommitteeIndex, Slot]]:
+    """(committee, committee index, slot) at which `validator_index`
+    attests in `epoch`, or None; `epoch <= next_epoch`
+    (validator.md :272-296)."""
+    next_epoch = Epoch(get_current_epoch(state) + 1)
+    assert epoch <= next_epoch
+
+    start_slot = compute_start_slot_at_epoch(epoch)
+    committee_count_per_slot = get_committee_count_per_slot(state, epoch)
+    for slot in range(start_slot, start_slot + SLOTS_PER_EPOCH):
+        for index in range(committee_count_per_slot):
+            committee = get_beacon_committee(state, Slot(slot),
+                                             CommitteeIndex(index))
+            if validator_index in committee:
+                return committee, CommitteeIndex(index), Slot(slot)
+    return None
+
+
+def is_proposer(state: BeaconState,
+                validator_index: ValidatorIndex) -> bool:
+    return get_beacon_proposer_index(state) == validator_index
+
+
+# ---------------------------------------------------------------------------
+# Block proposal (validator.md :423-600)
+# ---------------------------------------------------------------------------
+
+
+def get_epoch_signature(state: BeaconState, block: BeaconBlock,
+                        privkey: int) -> BLSSignature:
+    domain = get_domain(state, DOMAIN_RANDAO, compute_epoch_at_slot(block.slot))
+    signing_root = compute_signing_root(compute_epoch_at_slot(block.slot), domain)
+    return bls.Sign(privkey, signing_root)
+
+
+def compute_time_at_slot(state: BeaconState, slot: Slot) -> uint64:
+    return uint64(state.genesis_time + slot * config.SECONDS_PER_SLOT)
+
+
+def voting_period_start_time(state: BeaconState) -> uint64:
+    eth1_voting_period_start_slot = Slot(
+        state.slot
+        - state.slot % (EPOCHS_PER_ETH1_VOTING_PERIOD * SLOTS_PER_EPOCH))
+    return compute_time_at_slot(state, eth1_voting_period_start_slot)
+
+
+def is_candidate_block(block: Eth1Block, period_start: uint64) -> bool:
+    follow_time = config.SECONDS_PER_ETH1_BLOCK * config.ETH1_FOLLOW_DISTANCE
+    return (block.timestamp + follow_time <= period_start
+            and block.timestamp + follow_time * 2 >= period_start)
+
+
+def get_eth1_data(block: Eth1Block) -> Eth1Data:
+    """Stub: real clients read the deposit contract at `block`
+    (the reference's sundry stub, `pysetup/spec_builders/phase0.py:36-44`;
+    tests monkeypatch this)."""
+    return Eth1Data(
+        deposit_root=block.deposit_root,
+        deposit_count=block.deposit_count,
+        block_hash=hash(uint_to_bytes(block.timestamp)),
+    )
+
+
+def get_eth1_vote(state: BeaconState,
+                  eth1_chain: Sequence[Eth1Block]) -> Eth1Data:
+    """Majority vote over candidate-window eth1 blocks, defaulting to the
+    current `state.eth1_data` (validator.md :468-497)."""
+    period_start = voting_period_start_time(state)
+    # eth1_chain: all eth1 blocks, ascending by height
+    votes_to_consider = [
+        get_eth1_data(block) for block in eth1_chain
+        if (is_candidate_block(block, period_start)
+            # Never roll back the deposit contract state
+            and get_eth1_data(block).deposit_count
+            >= state.eth1_data.deposit_count)
+    ]
+
+    # Count in-window votes already cast this voting period
+    valid_votes = [vote for vote in state.eth1_data_votes
+                   if vote in votes_to_consider]
+
+    # Default: the most recent in-window block, else the current eth1_data
+    if any(votes_to_consider):
+        default_vote = votes_to_consider[len(votes_to_consider) - 1]
+    else:
+        default_vote = state.eth1_data
+
+    return max(
+        valid_votes,
+        # Tiebreak by smallest distance to the period start
+        key=lambda v: (valid_votes.count(v), -valid_votes.index(v)),
+        default=default_vote,
+    )
+
+
+def compute_new_state_root(state: BeaconState, block: BeaconBlock) -> Root:
+    """State root for a block under construction: run the transition
+    without signature/root validation (validator.md :574-580)."""
+    temp_state: BeaconState = state.copy()
+    signed_block = SignedBeaconBlock(message=block)
+    state_transition(temp_state, signed_block, validate_result=False)
+    return hash_tree_root(temp_state)
+
+
+def get_block_signature(state: BeaconState, block: BeaconBlock,
+                        privkey: int) -> BLSSignature:
+    domain = get_domain(state, DOMAIN_BEACON_PROPOSER,
+                        compute_epoch_at_slot(block.slot))
+    signing_root = compute_signing_root(block, domain)
+    return bls.Sign(privkey, signing_root)
+
+
+# ---------------------------------------------------------------------------
+# Attesting + aggregation (validator.md :672-815)
+# ---------------------------------------------------------------------------
+
+
+def get_attestation_signature(state: BeaconState,
+                              attestation_data: AttestationData,
+                              privkey: int) -> BLSSignature:
+    domain = get_domain(state, DOMAIN_BEACON_ATTESTER,
+                        attestation_data.target.epoch)
+    signing_root = compute_signing_root(attestation_data, domain)
+    return bls.Sign(privkey, signing_root)
+
+
+def compute_subnet_for_attestation(committees_per_slot: uint64, slot: Slot,
+                                   committee_index: CommitteeIndex) -> SubnetID:
+    """Subnet for an attestation in phase0 (validator.md :693-704)."""
+    slots_since_epoch_start = uint64(slot % SLOTS_PER_EPOCH)
+    committees_since_epoch_start = (committees_per_slot
+                                    * slots_since_epoch_start)
+    return SubnetID((committees_since_epoch_start + committee_index)
+                    % config.ATTESTATION_SUBNET_COUNT)
+
+
+def get_slot_signature(state: BeaconState, slot: Slot,
+                       privkey: int) -> BLSSignature:
+    domain = get_domain(state, DOMAIN_SELECTION_PROOF,
+                        compute_epoch_at_slot(slot))
+    signing_root = compute_signing_root(slot, domain)
+    return bls.Sign(privkey, signing_root)
+
+
+def is_aggregator(state: BeaconState, slot: Slot, index: CommitteeIndex,
+                  slot_signature: BLSSignature) -> bool:
+    committee = get_beacon_committee(state, slot, index)
+    modulo = max(1, len(committee) // TARGET_AGGREGATORS_PER_COMMITTEE)
+    return bytes_to_uint64(hash(slot_signature)[0:8]) % modulo == 0
+
+
+def get_aggregate_signature(
+        attestations: Sequence[Attestation]) -> BLSSignature:
+    signatures = [attestation.signature for attestation in attestations]
+    return bls.Aggregate(signatures)
+
+
+def get_aggregate_and_proof(state: BeaconState,
+                            aggregator_index: ValidatorIndex,
+                            aggregate: Attestation,
+                            privkey: int) -> AggregateAndProof:
+    return AggregateAndProof(
+        aggregator_index=aggregator_index,
+        aggregate=aggregate,
+        selection_proof=get_slot_signature(state, aggregate.data.slot,
+                                           privkey),
+    )
+
+
+def get_aggregate_and_proof_signature(
+        state: BeaconState, aggregate_and_proof: AggregateAndProof,
+        privkey: int) -> BLSSignature:
+    aggregate = aggregate_and_proof.aggregate
+    domain = get_domain(state, DOMAIN_AGGREGATE_AND_PROOF,
+                        compute_epoch_at_slot(aggregate.data.slot))
+    signing_root = compute_signing_root(aggregate_and_proof, domain)
+    return bls.Sign(privkey, signing_root)
+
+
+# ---------------------------------------------------------------------------
+# p2p: long-lived subnet subscription (p2p-interface.md :1315-1333)
+# ---------------------------------------------------------------------------
+
+
+def compute_subscribed_subnet(node_id: NodeID, epoch: Epoch,
+                              index: int) -> SubnetID:
+    node_id_prefix = node_id >> (NODE_ID_BITS
+                                 - config.ATTESTATION_SUBNET_PREFIX_BITS)
+    node_offset = node_id % config.EPOCHS_PER_SUBNET_SUBSCRIPTION
+    permutation_seed = hash(uint_to_bytes(uint64(
+        (epoch + node_offset) // config.EPOCHS_PER_SUBNET_SUBSCRIPTION)))
+    permutated_prefix = compute_shuffled_index(
+        node_id_prefix,
+        1 << config.ATTESTATION_SUBNET_PREFIX_BITS,
+        permutation_seed,
+    )
+    return SubnetID((permutated_prefix + index)
+                    % config.ATTESTATION_SUBNET_COUNT)
+
+
+def compute_subscribed_subnets(node_id: NodeID,
+                               epoch: Epoch) -> Sequence[SubnetID]:
+    return [compute_subscribed_subnet(node_id, epoch, index)
+            for index in range(config.SUBNETS_PER_NODE)]
+
+
+# ---------------------------------------------------------------------------
+# Weak subjectivity (weak-subjectivity.md :94-200)
+# ---------------------------------------------------------------------------
+
+
+def compute_weak_subjectivity_period(state: BeaconState) -> uint64:
+    """Number of recent epochs a WS checkpoint stays safe, accounting for
+    churn (`get_validator_churn_limit` per epoch) and top-ups
+    (`MAX_DEPOSITS * SLOTS_PER_EPOCH` per epoch); uint64-only algebra in
+    Ether to dodge Gwei overflow (weak-subjectivity.md :94-123)."""
+    ws_period = config.MIN_VALIDATOR_WITHDRAWABILITY_DELAY
+    N = len(get_active_validator_indices(state, get_current_epoch(state)))
+    t = get_total_active_balance(state) // N // ETH_TO_GWEI
+    T = MAX_EFFECTIVE_BALANCE // ETH_TO_GWEI
+    delta = get_validator_churn_limit(state)
+    Delta = MAX_DEPOSITS * SLOTS_PER_EPOCH
+    D = SAFETY_DECAY
+
+    if T * (200 + 3 * D) < t * (200 + 12 * D):
+        epochs_for_validator_set_churn = (
+            N * (t * (200 + 12 * D) - T * (200 + 3 * D))
+            // (600 * delta * (2 * t + T)))
+        epochs_for_balance_top_ups = N * (200 + 3 * D) // (600 * Delta)
+        ws_period += max(epochs_for_validator_set_churn,
+                         epochs_for_balance_top_ups)
+    else:
+        ws_period += 3 * N * D * t // (200 * Delta * (T - t))
+
+    return uint64(ws_period)
+
+
+def is_within_weak_subjectivity_period(store: Store, ws_state: BeaconState,
+                                       ws_checkpoint: Checkpoint) -> bool:
+    # Validate the state against the checkpoint
+    assert ws_state.latest_block_header.state_root == ws_checkpoint.root
+    assert compute_epoch_at_slot(ws_state.slot) == ws_checkpoint.epoch
+
+    ws_period = compute_weak_subjectivity_period(ws_state)
+    ws_state_epoch = compute_epoch_at_slot(ws_state.slot)
+    current_epoch = compute_epoch_at_slot(get_current_slot(store))
+    return current_epoch <= ws_state_epoch + ws_period

@@ -11,20 +11,17 @@ all_gather collectives over ICI.
 from __future__ import annotations
 
 import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-jax.config.update("jax_enable_x64", True)
-
-import jax.numpy as jnp  # noqa: E402
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P  # noqa: E402
-
-from .bridge import (  # noqa: E402, F401
+from .bridge import (  # noqa: F401
     pad_pow2,
     participation_from_pending,
     registry_arrays_from_state,
     validator_static_leaf_words,
 )
-from .epoch import EpochParams, EpochScalars, RegistryArrays, epoch_sweep  # noqa: E402, F401
-from .merkle import (  # noqa: E402, F401
+from .epoch import EpochParams, EpochScalars, RegistryArrays, epoch_sweep  # noqa: F401
+from .merkle import (  # noqa: F401
     ValidatorLeaves,
     balances_list_root,
     pack_u64_chunks,
@@ -32,6 +29,19 @@ from .merkle import (  # noqa: E402, F401
     validator_records_root,
     validator_registry_root,
 )
+
+
+def require_x64() -> None:
+    """The sweep/merkle kernels carry Gwei balances and epochs as uint64;
+    without `jax_enable_x64` JAX silently downcasts them to uint32.  The
+    flag is process-wide, so it is set by *entry points* (bench.py,
+    __graft_entry__, tests/conftest.py) — flipping it at import time here
+    would retroactively change dtypes under any host application."""
+    if not jax.config.jax_enable_x64:
+        raise RuntimeError(
+            "consensus_specs_tpu.parallel needs uint64: enable x64 first "
+            '(jax.config.update("jax_enable_x64", True) at process start, '
+            "or JAX_ENABLE_X64=1)")
 
 __all__ = [
     "EpochParams", "EpochScalars", "RegistryArrays", "ValidatorLeaves",
@@ -48,6 +58,10 @@ def make_mesh(n_devices: int | None = None, axis: str = "data") -> Mesh:
     devs = jax.devices()
     if n_devices is not None:
         devs = devs[:n_devices]
+    n = len(devs)
+    assert n & (n - 1) == 0, (
+        f"mesh must be a power of two for the sharded merkle reduction, "
+        f"got {n} devices (pass n_devices=<largest pow2>)")
     return Mesh(np.array(devs), (axis,))
 
 
@@ -65,6 +79,7 @@ def make_epoch_step(params: EpochParams):
     Registry arrays must be pre-padded to a power-of-two length; `length`
     is the true validator count (for the SSZ length mix-in).
     """
+    require_x64()
 
     @jax.jit
     def step(reg: RegistryArrays, sc: EpochScalars, length):
@@ -85,6 +100,7 @@ def make_sharded_epoch_step(mesh: Mesh, params: EpochParams,
     (new_bal, new_eff, balances_root, registry_root) with the roots
     replicated.
     """
+    require_x64()
     from jax import shard_map
 
     def _step(reg: RegistryArrays, sc: EpochScalars, length,

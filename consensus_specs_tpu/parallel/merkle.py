@@ -26,7 +26,7 @@ from jax import lax
 from ..ops.sha256_jax import hash_pairs, sha256_64B_words
 from ..ops.sha256_np import ZERO_HASH_WORDS
 
-# x64 (uint64 packing) is enabled once, in parallel/__init__.
+# uint64 packing needs x64; entry points enable it (see parallel.require_x64)
 
 _ZEROS = jnp.asarray(np.stack(ZERO_HASH_WORDS[:64]))  # (64, 8) uint32
 
@@ -92,6 +92,12 @@ def balances_list_root(balances, length, limit_depth: int = 38,
     """hash_tree_root of `List[uint64, 2**40]` (SSZ packed, limit 2**40
     values -> 2**38 chunks).  `balances` is the (padded, pow2) local shard;
     `length` the true global element count."""
+    if axis_name is not None:
+        # shard boundaries must be 32-byte-chunk-aligned, or pack_u64_chunks
+        # would zero-pad mid-stream and silently corrupt the root
+        assert balances.shape[0] % 4 == 0, (
+            f"sharded balances_list_root needs a chunk-aligned shard "
+            f"(multiple of 4 uint64), got {balances.shape[0]}")
     chunks = pack_u64_chunks(balances)
     if axis_name is None:
         root = subtree_root(chunks, limit_depth)
@@ -111,6 +117,8 @@ def _sharded_list_root(local_chunks, limit_depth: int, axis_name: str):
     local = subtree_root(local_chunks, local_depth)
     roots = lax.all_gather(local, axis_name)  # (n_dev, 8) on every device
     n_dev = roots.shape[0]
+    assert n_dev & (n_dev - 1) == 0, (
+        f"sharded list root needs a power-of-two mesh, got {n_dev} devices")
     shard_depth = (n_dev - 1).bit_length()
     level = roots
     for _ in range(shard_depth):
@@ -161,7 +169,19 @@ def validator_records_root(leaves: ValidatorLeaves, effective_balance,
 def validator_registry_root(record_roots, length, limit_depth: int = 40,
                             axis_name: str | None = None):
     """hash_tree_root of `List[Validator, 2**40]` given the (padded, pow2)
-    local shard of per-record roots."""
+    local shard of per-record roots.
+
+    Pad rows (global index >= `length`) are masked to zero chunks here:
+    SSZ pads the List's leaf level with 32-byte zero chunks, NOT with the
+    record root of an all-zero Validator."""
+    n_local = record_roots.shape[0]
+    idx = jnp.arange(n_local, dtype=jnp.uint64)
+    if axis_name is not None:
+        idx = idx + (lax.axis_index(axis_name).astype(jnp.uint64)
+                     * jnp.uint64(n_local))
+    in_range = idx < jnp.asarray(length, dtype=jnp.uint64)
+    record_roots = jnp.where(in_range[:, None], record_roots,
+                             jnp.zeros_like(record_roots))
     if axis_name is None:
         root = subtree_root(record_roots, limit_depth)
     else:

@@ -1,0 +1,343 @@
+"""Fork-choice test driving: event-sourced store steps (tick / block /
+attestation / attester_slashing) plus the step+check emission used by the
+reference-vector format (`tests/formats/fork_choice/README.md`).
+Mirrors `eth2spec/test/helpers/fork_choice.py:43-556`.
+"""
+
+from __future__ import annotations
+
+from .attestations import (
+    next_epoch_with_attestations,
+    next_slots_with_attestations,
+    state_transition_with_full_block,
+)
+
+
+def encode_hex(value: bytes) -> str:
+    return "0x" + bytes(value).hex()
+
+
+# ---------------------------------------------------------------------------
+# store construction
+# ---------------------------------------------------------------------------
+
+
+def get_anchor_root(spec, state):
+    anchor_block_header = state.latest_block_header.copy()
+    if anchor_block_header.state_root == spec.Bytes32():
+        anchor_block_header.state_root = spec.hash_tree_root(state)
+    return spec.hash_tree_root(anchor_block_header)
+
+
+def get_genesis_forkchoice_store_and_block(spec, genesis_state):
+    assert genesis_state.slot == spec.GENESIS_SLOT
+    genesis_block = spec.BeaconBlock(
+        state_root=spec.hash_tree_root(genesis_state))
+    store = spec.get_forkchoice_store(genesis_state, genesis_block)
+    return store, genesis_block
+
+
+def get_genesis_forkchoice_store(spec, genesis_state):
+    store, _ = get_genesis_forkchoice_store_and_block(spec, genesis_state)
+    return store
+
+
+# ---------------------------------------------------------------------------
+# vector file naming (`helpers/fork_choice.py:224-254`)
+# ---------------------------------------------------------------------------
+
+
+def get_block_file_name(block):
+    from ...utils.ssz.ssz_impl import hash_tree_root
+
+    return f"block_{encode_hex(hash_tree_root(block))}"
+
+
+def get_attestation_file_name(attestation):
+    from ...utils.ssz.ssz_impl import hash_tree_root
+
+    return f"attestation_{encode_hex(hash_tree_root(attestation))}"
+
+
+def get_attester_slashing_file_name(attester_slashing):
+    from ...utils.ssz.ssz_impl import hash_tree_root
+
+    return f"attester_slashing_{encode_hex(hash_tree_root(attester_slashing))}"
+
+
+# ---------------------------------------------------------------------------
+# step runners
+# ---------------------------------------------------------------------------
+
+
+def check_head_against_root(spec, store, root):
+    head = spec.get_head(store)
+    assert head == root
+
+
+def on_tick_and_append_step(spec, store, time, test_steps):
+    assert time >= store.time
+    spec.on_tick(store, time)
+    test_steps.append({"tick": int(time)})
+    output_store_checks(spec, store, test_steps)
+
+
+def run_on_block(spec, store, signed_block, valid=True):
+    if not valid:
+        try:
+            spec.on_block(store, signed_block)
+        except AssertionError:
+            return
+        else:
+            assert False, "on_block unexpectedly accepted the block"
+
+    spec.on_block(store, signed_block)
+    root = spec.hash_tree_root(signed_block.message)
+    assert store.blocks[root] == signed_block.message
+
+
+def add_block(spec, store, signed_block, test_steps, valid=True):
+    """Run on_block (+ the block's attestations and attester slashings,
+    as receiving a block implies receiving its contents); yield the
+    block as a vector part and append the step + store checks."""
+    yield get_block_file_name(signed_block), signed_block
+
+    if not valid:
+        try:
+            run_on_block(spec, store, signed_block, valid=True)
+        except AssertionError:
+            test_steps.append({
+                "block": get_block_file_name(signed_block),
+                "valid": False,
+            })
+            return
+        else:
+            assert False, "on_block unexpectedly accepted the block"
+
+    run_on_block(spec, store, signed_block, valid=True)
+    test_steps.append({"block": get_block_file_name(signed_block),
+                       "valid": True})
+
+    for attestation in signed_block.message.body.attestations:
+        run_on_attestation(spec, store, attestation, is_from_block=True,
+                           valid=True)
+    for attester_slashing in signed_block.message.body.attester_slashings:
+        run_on_attester_slashing(spec, store, attester_slashing, valid=True)
+
+    block_root = spec.hash_tree_root(signed_block.message)
+    assert store.blocks[block_root] == signed_block.message
+    assert (spec.hash_tree_root(store.block_states[block_root])
+            == signed_block.message.state_root)
+    output_store_checks(spec, store, test_steps)
+
+    return store.block_states[block_root]
+
+
+def tick_and_add_block(spec, store, signed_block, test_steps, valid=True):
+    """Advance time slot-by-slot to the block's slot, then add it."""
+    pre_state = store.block_states[signed_block.message.parent_root]
+    block_time = (pre_state.genesis_time
+                  + signed_block.message.slot * spec.config.SECONDS_PER_SLOT)
+    while store.time < block_time:
+        time = (pre_state.genesis_time
+                + (spec.get_current_slot(store) + 1)
+                * spec.config.SECONDS_PER_SLOT)
+        on_tick_and_append_step(spec, store, time, test_steps)
+
+    post_state = yield from add_block(spec, store, signed_block, test_steps,
+                                      valid=valid)
+    return post_state
+
+
+def run_on_attestation(spec, store, attestation, is_from_block=False,
+                       valid=True):
+    if not valid:
+        try:
+            spec.on_attestation(store, attestation,
+                                is_from_block=is_from_block)
+        except AssertionError:
+            return
+        else:
+            assert False, "on_attestation unexpectedly accepted"
+
+    spec.on_attestation(store, attestation, is_from_block=is_from_block)
+
+
+def add_attestation(spec, store, attestation, test_steps,
+                    is_from_block=False, valid=True):
+    run_on_attestation(spec, store, attestation,
+                       is_from_block=is_from_block, valid=valid)
+    yield get_attestation_file_name(attestation), attestation
+    step = {"attestation": get_attestation_file_name(attestation)}
+    if not valid:
+        step["valid"] = False
+    test_steps.append(step)
+
+
+def add_attestations(spec, store, attestations, test_steps,
+                     is_from_block=False):
+    for attestation in attestations:
+        yield from add_attestation(spec, store, attestation, test_steps,
+                                   is_from_block=is_from_block)
+
+
+def tick_and_run_on_attestation(spec, store, attestation, test_steps,
+                                is_from_block=False):
+    # Attestations only count from the slot after their own
+    min_time_to_include = ((attestation.data.slot + 1)
+                           * spec.config.SECONDS_PER_SLOT)
+    if store.time < min_time_to_include:
+        spec.on_tick(store, min_time_to_include)
+        test_steps.append({"tick": int(min_time_to_include)})
+
+    yield from add_attestation(spec, store, attestation, test_steps,
+                               is_from_block)
+
+
+def run_on_attester_slashing(spec, store, attester_slashing, valid=True):
+    if not valid:
+        try:
+            spec.on_attester_slashing(store, attester_slashing)
+        except AssertionError:
+            return
+        else:
+            assert False, "on_attester_slashing unexpectedly accepted"
+
+    spec.on_attester_slashing(store, attester_slashing)
+
+
+def add_attester_slashing(spec, store, attester_slashing, test_steps,
+                          valid=True):
+    slashing_file_name = get_attester_slashing_file_name(attester_slashing)
+    yield slashing_file_name, attester_slashing
+
+    if not valid:
+        try:
+            run_on_attester_slashing(spec, store, attester_slashing)
+        except AssertionError:
+            test_steps.append({"attester_slashing": slashing_file_name,
+                               "valid": False})
+            return
+        else:
+            assert False, "on_attester_slashing unexpectedly accepted"
+
+    run_on_attester_slashing(spec, store, attester_slashing)
+    test_steps.append({"attester_slashing": slashing_file_name})
+
+
+# ---------------------------------------------------------------------------
+# checks output (`helpers/fork_choice.py:406-463`)
+# ---------------------------------------------------------------------------
+
+
+def get_formatted_head_output(spec, store):
+    head = spec.get_head(store)
+    return {"slot": int(store.blocks[head].slot), "root": encode_hex(head)}
+
+
+def output_head_check(spec, store, test_steps):
+    test_steps.append({"checks": {
+        "head": get_formatted_head_output(spec, store),
+    }})
+
+
+def output_store_checks(spec, store, test_steps,
+                        with_viable_for_head_weights=False):
+    checks = {
+        "time": int(store.time),
+        "head": get_formatted_head_output(spec, store),
+        "justified_checkpoint": {
+            "epoch": int(store.justified_checkpoint.epoch),
+            "root": encode_hex(store.justified_checkpoint.root),
+        },
+        "finalized_checkpoint": {
+            "epoch": int(store.finalized_checkpoint.epoch),
+            "root": encode_hex(store.finalized_checkpoint.root),
+        },
+        "proposer_boost_root": encode_hex(store.proposer_boost_root),
+    }
+
+    if with_viable_for_head_weights:
+        filtered_block_roots = spec.get_filtered_block_tree(store).keys()
+        leaves_viable_for_head = [
+            root for root in filtered_block_roots
+            if not any(c for c in filtered_block_roots
+                       if store.blocks[c].parent_root == root)
+        ]
+        checks["viable_for_head_roots_and_weights"] = [
+            {"root": encode_hex(root),
+             "weight": int(spec.get_weight(store, root))}
+            for root in leaves_viable_for_head
+        ]
+
+    test_steps.append({"checks": checks})
+
+
+# ---------------------------------------------------------------------------
+# chain driving (`helpers/fork_choice.py:466-548`)
+# ---------------------------------------------------------------------------
+
+
+def apply_next_epoch_with_attestations(spec, state, store, fill_cur_epoch,
+                                       fill_prev_epoch, participation_fn=None,
+                                       test_steps=None):
+    if test_steps is None:
+        test_steps = []
+
+    _, new_signed_blocks, post_state = next_epoch_with_attestations(
+        spec, state, fill_cur_epoch, fill_prev_epoch,
+        participation_fn=participation_fn)
+    for signed_block in new_signed_blocks:
+        block = signed_block.message
+        yield from tick_and_add_block(spec, store, signed_block, test_steps)
+        block_root = spec.hash_tree_root(block)
+        assert store.blocks[block_root] == block
+        last_signed_block = signed_block
+
+    assert (spec.hash_tree_root(store.block_states[block_root])
+            == spec.hash_tree_root(post_state))
+    return post_state, store, last_signed_block
+
+
+def apply_next_slots_with_attestations(spec, state, store, slots,
+                                       fill_cur_epoch, fill_prev_epoch,
+                                       test_steps, participation_fn=None):
+    _, new_signed_blocks, post_state = next_slots_with_attestations(
+        spec, state, slots, fill_cur_epoch, fill_prev_epoch,
+        participation_fn=participation_fn)
+    for signed_block in new_signed_blocks:
+        block = signed_block.message
+        yield from tick_and_add_block(spec, store, signed_block, test_steps)
+        block_root = spec.hash_tree_root(block)
+        assert store.blocks[block_root] == block
+        last_signed_block = signed_block
+
+    assert (spec.hash_tree_root(store.block_states[block_root])
+            == spec.hash_tree_root(post_state))
+    return post_state, store, last_signed_block
+
+
+def is_ready_to_justify(spec, state):
+    """True if the state justifies a new checkpoint at the epoch
+    boundary."""
+    temp_state = state.copy()
+    spec.process_justification_and_finalization(temp_state)
+    return (temp_state.current_justified_checkpoint.epoch
+            > state.current_justified_checkpoint.epoch)
+
+
+def find_next_justifying_slot(spec, state, fill_cur_epoch, fill_prev_epoch,
+                              participation_fn=None):
+    temp_state = state.copy()
+
+    signed_blocks = []
+    justifying_slot = None
+    while justifying_slot is None:
+        signed_block = state_transition_with_full_block(
+            spec, temp_state, fill_cur_epoch, fill_prev_epoch,
+            participation_fn)
+        signed_blocks.append(signed_block)
+        if is_ready_to_justify(spec, temp_state):
+            justifying_slot = temp_state.slot
+
+    return signed_blocks, justifying_slot

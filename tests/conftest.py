@@ -22,6 +22,8 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+# the parallel kernels carry uint64; entry points own this switch
+jax.config.update("jax_enable_x64", True)
 
 import pytest  # noqa: E402
 

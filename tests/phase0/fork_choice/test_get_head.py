@@ -1,0 +1,348 @@
+"""Fork choice: LMD-GHOST head selection
+(parity: `test/phase0/fork_choice/test_get_head.py`)."""
+
+from consensus_specs_tpu.testlib.context import (
+    spec_state_test,
+    with_all_phases,
+)
+from consensus_specs_tpu.testlib.helpers.attestations import (
+    get_valid_attestation,
+    next_epoch_with_attestations,
+)
+from consensus_specs_tpu.testlib.helpers.attester_slashings import (
+    get_valid_attester_slashing_by_indices,
+)
+from consensus_specs_tpu.testlib.helpers.block import (
+    build_empty_block_for_next_slot,
+)
+from consensus_specs_tpu.testlib.helpers.fork_choice import (
+    add_attestation,
+    add_attester_slashing,
+    add_block,
+    apply_next_epoch_with_attestations,
+    check_head_against_root,
+    get_anchor_root,
+    get_genesis_forkchoice_store,
+    get_genesis_forkchoice_store_and_block,
+    on_tick_and_append_step,
+    output_head_check,
+    tick_and_add_block,
+    tick_and_run_on_attestation,
+)
+from consensus_specs_tpu.testlib.helpers.state import (
+    next_epoch,
+    state_transition_and_sign_block,
+)
+
+
+@with_all_phases
+@spec_state_test
+def test_genesis(spec, state):
+    test_steps = []
+    yield "anchor_state", state
+    anchor_block = spec.BeaconBlock(state_root=spec.hash_tree_root(state))
+    yield "anchor_block", anchor_block
+
+    anchor_root = get_anchor_root(spec, state)
+    store = spec.get_forkchoice_store(state, anchor_block)
+    check_head_against_root(spec, store, anchor_root)
+    output_head_check(spec, store, test_steps)
+
+    yield "steps", test_steps
+
+
+@with_all_phases
+@spec_state_test
+def test_chain_no_attestations(spec, state):
+    test_steps = []
+    yield "anchor_state", state
+    anchor_block = spec.BeaconBlock(state_root=spec.hash_tree_root(state))
+    yield "anchor_block", anchor_block
+
+    anchor_root = get_anchor_root(spec, state)
+    store = spec.get_forkchoice_store(state, anchor_block)
+    check_head_against_root(spec, store, anchor_root)
+
+    # On receiving a block of `GENESIS_SLOT + 1` slot
+    block_1 = build_empty_block_for_next_slot(spec, state)
+    signed_block_1 = state_transition_and_sign_block(spec, state, block_1)
+    yield from tick_and_add_block(spec, store, signed_block_1, test_steps)
+
+    # On receiving a block of next epoch
+    block_2 = build_empty_block_for_next_slot(spec, state)
+    signed_block_2 = state_transition_and_sign_block(spec, state, block_2)
+    yield from tick_and_add_block(spec, store, signed_block_2, test_steps)
+
+    check_head_against_root(spec, store, spec.hash_tree_root(block_2))
+    yield "steps", test_steps
+
+
+@with_all_phases
+@spec_state_test
+def test_split_tie_breaker_no_attestations(spec, state):
+    test_steps = []
+    yield "anchor_state", state
+    anchor_block = spec.BeaconBlock(state_root=spec.hash_tree_root(state))
+    yield "anchor_block", anchor_block
+
+    anchor_root = get_anchor_root(spec, state)
+    store = spec.get_forkchoice_store(state, anchor_block)
+    genesis_state = state.copy()
+    check_head_against_root(spec, store, anchor_root)
+
+    # Two competing blocks at the same slot
+    block_1_state = genesis_state.copy()
+    block_1 = build_empty_block_for_next_slot(spec, block_1_state)
+    signed_block_1 = state_transition_and_sign_block(
+        spec, block_1_state, block_1)
+
+    block_2_state = genesis_state.copy()
+    block_2 = build_empty_block_for_next_slot(spec, block_2_state)
+    block_2.body.graffiti = b"\x42" * 32
+    signed_block_2 = state_transition_and_sign_block(
+        spec, block_2_state, block_2)
+
+    yield from tick_and_add_block(spec, store, signed_block_1, test_steps)
+    yield from tick_and_add_block(spec, store, signed_block_2, test_steps)
+
+    highest_root = max(spec.hash_tree_root(block_1),
+                       spec.hash_tree_root(block_2))
+    check_head_against_root(spec, store, highest_root)
+    yield "steps", test_steps
+
+
+@with_all_phases
+@spec_state_test
+def test_shorter_chain_but_heavier_weight(spec, state):
+    test_steps = []
+    yield "anchor_state", state
+    anchor_block = spec.BeaconBlock(state_root=spec.hash_tree_root(state))
+    yield "anchor_block", anchor_block
+
+    anchor_root = get_anchor_root(spec, state)
+    store = spec.get_forkchoice_store(state, anchor_block)
+    genesis_state = state.copy()
+    check_head_against_root(spec, store, anchor_root)
+
+    # Build a longer chain without attestations
+    long_state = genesis_state.copy()
+    for _ in range(3):
+        long_block = build_empty_block_for_next_slot(spec, long_state)
+        signed_long_block = state_transition_and_sign_block(
+            spec, long_state, long_block)
+        yield from tick_and_add_block(spec, store, signed_long_block,
+                                      test_steps)
+
+    # Build a short chain carrying an attestation
+    short_state = genesis_state.copy()
+    short_block = build_empty_block_for_next_slot(spec, short_state)
+    short_block.body.graffiti = b"\x42" * 32
+    signed_short_block = state_transition_and_sign_block(
+        spec, short_state, short_block)
+    yield from tick_and_add_block(spec, store, signed_short_block, test_steps)
+
+    short_attestation = get_valid_attestation(
+        spec, short_state, short_block.slot, signed=True)
+    yield from tick_and_run_on_attestation(
+        spec, store, short_attestation, test_steps)
+
+    check_head_against_root(spec, store, spec.hash_tree_root(short_block))
+    yield "steps", test_steps
+
+
+@with_all_phases
+@spec_state_test
+def test_filtered_block_tree(spec, state):
+    test_steps = []
+    yield "anchor_state", state
+    anchor_block = spec.BeaconBlock(state_root=spec.hash_tree_root(state))
+    yield "anchor_block", anchor_block
+
+    anchor_root = get_anchor_root(spec, state)
+    store = spec.get_forkchoice_store(state, anchor_block)
+    check_head_against_root(spec, store, anchor_root)
+
+    # Transition through epochs to set up justification
+    for _ in range(3):
+        state, store, _ = yield from apply_next_epoch_with_attestations(
+            spec, state, store, True, False, test_steps=test_steps)
+
+    assert store.justified_checkpoint.epoch > 0
+    # The filtered tree base is the justified root
+    filtered = spec.get_filtered_block_tree(store)
+    assert store.justified_checkpoint.root in filtered
+    # The head is in the filtered tree
+    head = spec.get_head(store)
+    assert head in filtered
+    yield "steps", test_steps
+
+
+@with_all_phases
+@spec_state_test
+def test_proposer_boost_correct_head(spec, state):
+    """The timely (boosted) block outweighs an equal-weight rival even
+    when its root is lexicographically smaller."""
+    test_steps = []
+    yield "anchor_state", state
+    anchor_block = spec.BeaconBlock(state_root=spec.hash_tree_root(state))
+    yield "anchor_block", anchor_block
+
+    store = spec.get_forkchoice_store(state, anchor_block)
+    genesis_state = state.copy()
+
+    # Build block that serves as head before the proposer boost block
+    state_1 = genesis_state.copy()
+    block_1 = build_empty_block_for_next_slot(spec, state_1)
+    block_1.body.graffiti = b"\x42" * 32
+    signed_block_1 = state_transition_and_sign_block(spec, state_1, block_1)
+
+    state_2 = genesis_state.copy()
+    block_2 = build_empty_block_for_next_slot(spec, state_2)
+    signed_block_2 = state_transition_and_sign_block(spec, state_2, block_2)
+
+    root_1 = spec.hash_tree_root(block_1)
+    root_2 = spec.hash_tree_root(block_2)
+    # Ensure the rival (block_1) would win a tie-break without boost
+    if root_1 < root_2:
+        signed_block_1, signed_block_2 = signed_block_2, signed_block_1
+        block_1, block_2 = block_2, block_1
+        state_1, state_2 = state_2, state_1
+        root_1, root_2 = root_2, root_1
+
+    # Tick to block_1's slot and add it late (no boost)
+    time = (store.genesis_time
+            + block_1.slot * spec.config.SECONDS_PER_SLOT
+            + spec.config.SECONDS_PER_SLOT // spec.INTERVALS_PER_SLOT)
+    on_tick_and_append_step(spec, store, time, test_steps)
+    yield from add_block(spec, store, signed_block_1, test_steps)
+    assert store.proposer_boost_root == spec.Root()
+    check_head_against_root(spec, store, root_1)
+
+    # block_2 arrives in a later slot, timely: gets the boost and wins
+    # despite the lexicographically smaller root
+    state_3 = state_2.copy()
+    block_3 = build_empty_block_for_next_slot(spec, state_3)
+    signed_block_3 = state_transition_and_sign_block(spec, state_3, block_3)
+    time = store.genesis_time + block_3.slot * spec.config.SECONDS_PER_SLOT
+    on_tick_and_append_step(spec, store, time, test_steps)
+    yield from add_block(spec, store, signed_block_2, test_steps)
+    yield from add_block(spec, store, signed_block_3, test_steps)
+    assert store.proposer_boost_root == spec.hash_tree_root(block_3)
+    check_head_against_root(spec, store, spec.hash_tree_root(block_3))
+
+    yield "steps", test_steps
+
+
+@with_all_phases
+@spec_state_test
+def test_discard_equivocations_on_attester_slashing(spec, state):
+    """An attester slashing removes the equivocating validators' latest
+    messages from the weight calculation."""
+    test_steps = []
+    yield "anchor_state", state
+    anchor_block = spec.BeaconBlock(state_root=spec.hash_tree_root(state))
+    yield "anchor_block", anchor_block
+
+    store = spec.get_forkchoice_store(state, anchor_block)
+    genesis_state = state.copy()
+
+    # Build block_1 (lexicographically sortable rival pair)
+    state_1 = genesis_state.copy()
+    block_1 = build_empty_block_for_next_slot(spec, state_1)
+    block_1.body.graffiti = b"\x42" * 32
+    signed_block_1 = state_transition_and_sign_block(spec, state_1, block_1)
+
+    state_2 = genesis_state.copy()
+    block_2 = build_empty_block_for_next_slot(spec, state_2)
+    signed_block_2 = state_transition_and_sign_block(spec, state_2, block_2)
+
+    root_1 = spec.hash_tree_root(block_1)
+    root_2 = spec.hash_tree_root(block_2)
+    # Ensure block_2 would lose the tie-break
+    if root_2 > root_1:
+        signed_block_1, signed_block_2 = signed_block_2, signed_block_1
+        block_1, block_2 = block_2, block_1
+        state_1, state_2 = state_2, state_1
+        root_1, root_2 = root_2, root_1
+
+    # Attestation for block_2 from one committee member...
+    attestation = get_valid_attestation(
+        spec, state_2, slot=block_2.slot, signed=True,
+        filter_participant_set=lambda comm: [min(comm)])
+    attester_index = min(spec.get_attesting_indices(state_2, attestation))
+
+    # ...who also signed a conflicting (equivocating) attestation
+    attester_slashing = get_valid_attester_slashing_by_indices(
+        spec, state_2, [attester_index], signed_1=True, signed_2=True)
+
+    yield from tick_and_add_block(spec, store, signed_block_1, test_steps)
+    yield from tick_and_add_block(spec, store, signed_block_2, test_steps)
+    yield from tick_and_run_on_attestation(
+        spec, store, attestation, test_steps)
+    # The attestation makes block_2 the head
+    check_head_against_root(spec, store, root_2)
+
+    # Slashing discards the vote; tie-break restores block_1
+    yield from add_attester_slashing(
+        spec, store, attester_slashing, test_steps)
+    assert attester_index in store.equivocating_indices
+    check_head_against_root(spec, store, root_1)
+
+    yield "steps", test_steps
+
+
+@with_all_phases
+@spec_state_test
+def test_justification_update_at_epoch_boundary(spec, state):
+    """Unrealized justification realizes at the epoch boundary tick."""
+    test_steps = []
+    yield "anchor_state", state
+    anchor_block = spec.BeaconBlock(state_root=spec.hash_tree_root(state))
+    yield "anchor_block", anchor_block
+
+    store = spec.get_forkchoice_store(state, anchor_block)
+
+    # Two full epochs of attestations: justification is reached but,
+    # mid-epoch, only as an *unrealized* checkpoint
+    for _ in range(2):
+        state, store, _ = yield from apply_next_epoch_with_attestations(
+            spec, state, store, True, False, test_steps=test_steps)
+
+    assert store.unrealized_justified_checkpoint.epoch > 0
+    assert (store.unrealized_justified_checkpoint.epoch
+            > store.justified_checkpoint.epoch)
+
+    # Tick into the next epoch: unrealized checkpoints realize
+    next_epoch_time = (store.genesis_time
+                       + (spec.get_current_slot(store) + spec.SLOTS_PER_EPOCH)
+                       * spec.config.SECONDS_PER_SLOT)
+    on_tick_and_append_step(spec, store, next_epoch_time, test_steps)
+    assert (store.justified_checkpoint.epoch
+            == store.unrealized_justified_checkpoint.epoch)
+
+    yield "steps", test_steps
+
+
+@with_all_phases
+@spec_state_test
+def test_proposer_head_simple(spec, state):
+    """get_proposer_head returns the current head when no re-org
+    conditions are met (the common case)."""
+    test_steps = []
+    store, anchor_block = get_genesis_forkchoice_store_and_block(spec, state)
+    yield "anchor_state", state
+    yield "anchor_block", anchor_block
+
+    # A timely block: no re-org, proposer builds on it
+    block = build_empty_block_for_next_slot(spec, state)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    yield from tick_and_add_block(spec, store, signed_block, test_steps)
+    head = spec.get_head(store)
+
+    # After the boost wears off (next slot tick)
+    time = (store.genesis_time
+            + (block.slot + 1) * spec.config.SECONDS_PER_SLOT)
+    on_tick_and_append_step(spec, store, time, test_steps)
+    assert (spec.get_proposer_head(store, head, spec.Slot(block.slot + 1))
+            == head)
+    yield "steps", test_steps

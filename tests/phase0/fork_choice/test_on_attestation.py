@@ -1,0 +1,190 @@
+"""Fork choice: on_attestation handler
+(parity: `test/phase0/fork_choice/test_on_attestation.py`)."""
+
+from consensus_specs_tpu.testlib.context import (
+    spec_state_test,
+    with_all_phases,
+)
+from consensus_specs_tpu.testlib.helpers.attestations import (
+    get_valid_attestation,
+)
+from consensus_specs_tpu.testlib.helpers.block import (
+    build_empty_block_for_next_slot,
+)
+from consensus_specs_tpu.testlib.helpers.fork_choice import (
+    get_genesis_forkchoice_store,
+    run_on_attestation,
+)
+from consensus_specs_tpu.testlib.helpers.state import (
+    next_epoch,
+    next_slots,
+    state_transition_and_sign_block,
+    transition_to,
+)
+
+
+def _apply_block(spec, store, state):
+    block = build_empty_block_for_next_slot(spec, state)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    block_time = (store.genesis_time
+                  + state.slot * spec.config.SECONDS_PER_SLOT)
+    if store.time < block_time:
+        spec.on_tick(store, block_time)
+    spec.on_block(store, signed_block)
+    return block
+
+
+@with_all_phases
+@spec_state_test
+def test_on_attestation_current_epoch(spec, state):
+    store = get_genesis_forkchoice_store(spec, state)
+    spec.on_tick(store, store.time + 2 * spec.config.SECONDS_PER_SLOT)
+    block = _apply_block(spec, store, state)
+
+    attestation = get_valid_attestation(spec, state, slot=block.slot,
+                                        signed=True)
+    assert attestation.data.target.epoch == spec.GENESIS_EPOCH
+    assert spec.get_current_store_epoch(store) == spec.GENESIS_EPOCH
+    run_on_attestation(spec, store, attestation)
+    sample_index = min(spec.get_attesting_indices(state, attestation))
+    assert store.latest_messages[sample_index] == spec.LatestMessage(
+        epoch=attestation.data.target.epoch,
+        root=attestation.data.beacon_block_root,
+    )
+
+
+@with_all_phases
+@spec_state_test
+def test_on_attestation_previous_epoch(spec, state):
+    store = get_genesis_forkchoice_store(spec, state)
+    # Tick a full epoch: the genesis-epoch attestation is previous-epoch
+    spec.on_tick(store, store.time
+                 + spec.config.SECONDS_PER_SLOT * spec.SLOTS_PER_EPOCH)
+    block = _apply_block(spec, store, state)
+
+    attestation = get_valid_attestation(spec, state, slot=block.slot,
+                                        signed=True)
+    assert attestation.data.target.epoch == spec.GENESIS_EPOCH
+    assert spec.get_current_store_epoch(store) == spec.GENESIS_EPOCH + 1
+    run_on_attestation(spec, store, attestation)
+
+
+@with_all_phases
+@spec_state_test
+def test_on_attestation_past_epoch(spec, state):
+    store = get_genesis_forkchoice_store(spec, state)
+    # Move time forward 2 epochs
+    time = (store.time
+            + 2 * spec.config.SECONDS_PER_SLOT * spec.SLOTS_PER_EPOCH)
+    spec.on_tick(store, time)
+
+    # Create an attestation for a block in an epoch two behind
+    block = _apply_block(spec, store, state)
+    attestation = get_valid_attestation(spec, state, slot=block.slot,
+                                        signed=True)
+    assert (attestation.data.target.epoch
+            == spec.GENESIS_EPOCH)
+    assert spec.get_current_store_epoch(store) >= 2
+
+    run_on_attestation(spec, store, attestation, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_on_attestation_mismatched_target_and_slot(spec, state):
+    store = get_genesis_forkchoice_store(spec, state)
+    spec.on_tick(store, store.time
+                 + spec.config.SECONDS_PER_SLOT * spec.SLOTS_PER_EPOCH)
+    block = _apply_block(spec, store, state)
+
+    attestation = get_valid_attestation(spec, state, slot=block.slot)
+    attestation.data.target.epoch += 1  # target inconsistent with slot
+
+    from consensus_specs_tpu.testlib.helpers.attestations import (
+        sign_attestation)
+
+    sign_attestation(spec, state, attestation)
+    run_on_attestation(spec, store, attestation, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_on_attestation_inconsistent_target_and_head(spec, state):
+    """LMD vote on a chain that conflicts with the FFG target root."""
+    store = get_genesis_forkchoice_store(spec, state)
+    spec.on_tick(store, store.time + 2 * spec.config.SECONDS_PER_SLOT)
+
+    genesis_state = state.copy()
+
+    # Chain A: one block
+    state_a = genesis_state.copy()
+    block_a = _apply_block(spec, store, state_a)
+
+    # Chain B: a competing block
+    state_b = genesis_state.copy()
+    block_b = build_empty_block_for_next_slot(spec, state_b)
+    block_b.body.graffiti = b"\x77" * 32
+    signed_block_b = state_transition_and_sign_block(spec, state_b, block_b)
+    spec.on_block(store, signed_block_b)
+
+    # Attestation votes head=A but target root=B (inconsistent)
+    attestation = get_valid_attestation(spec, state_a, slot=block_a.slot)
+    attestation.data.beacon_block_root = spec.hash_tree_root(block_a)
+    attestation.data.target.root = spec.hash_tree_root(block_b)
+
+    from consensus_specs_tpu.testlib.helpers.attestations import (
+        sign_attestation)
+
+    sign_attestation(spec, state_a, attestation)
+    spec.on_tick(store, store.time + spec.config.SECONDS_PER_SLOT)
+    run_on_attestation(spec, store, attestation, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_on_attestation_future_block(spec, state):
+    """Attestation whose LMD vote is newer than its own slot."""
+    store = get_genesis_forkchoice_store(spec, state)
+    spec.on_tick(store, store.time + 5 * spec.config.SECONDS_PER_SLOT)
+    block = _apply_block(spec, store, state)
+
+    # Attestation for a slot *before* the block it votes for
+    attestation = get_valid_attestation(spec, state, slot=block.slot,
+                                        signed=False)
+    attestation.data.slot = block.slot - 1
+
+    from consensus_specs_tpu.testlib.helpers.attestations import (
+        sign_attestation)
+
+    sign_attestation(spec, state, attestation)
+    run_on_attestation(spec, store, attestation, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_on_attestation_same_slot(spec, state):
+    """Attestations only count from the slot after their own."""
+    store = get_genesis_forkchoice_store(spec, state)
+    spec.on_tick(store, store.time + spec.config.SECONDS_PER_SLOT)
+    block = _apply_block(spec, store, state)
+
+    attestation = get_valid_attestation(spec, state, slot=block.slot,
+                                        signed=True)
+    # No tick past the attestation slot: rejected
+    run_on_attestation(spec, store, attestation, valid=False)
+
+
+@with_all_phases
+@spec_state_test
+def test_on_attestation_invalid_attestation(spec, state):
+    """Indexed-attestation validation failure (bad signature bits)."""
+    store = get_genesis_forkchoice_store(spec, state)
+    spec.on_tick(store, store.time + 3 * spec.config.SECONDS_PER_SLOT)
+    block = _apply_block(spec, store, state)
+
+    attestation = get_valid_attestation(spec, state, slot=block.slot,
+                                        signed=True)
+    # Corrupt: point the attestation at an unknown block
+    attestation.data.beacon_block_root = b"\x69" * 32
+    spec.on_tick(store, store.time + spec.config.SECONDS_PER_SLOT)
+    run_on_attestation(spec, store, attestation, valid=False)

@@ -1,0 +1,201 @@
+"""Fork choice: on_block handler
+(parity: `test/phase0/fork_choice/test_on_block.py`)."""
+
+from consensus_specs_tpu.testlib.context import (
+    spec_state_test,
+    with_all_phases,
+)
+from consensus_specs_tpu.testlib.helpers.block import (
+    build_empty_block_for_next_slot,
+)
+from consensus_specs_tpu.testlib.helpers.fork_choice import (
+    add_block,
+    apply_next_epoch_with_attestations,
+    check_head_against_root,
+    get_genesis_forkchoice_store_and_block,
+    on_tick_and_append_step,
+    tick_and_add_block,
+)
+from consensus_specs_tpu.testlib.helpers.state import (
+    next_epoch,
+    state_transition_and_sign_block,
+    transition_to,
+)
+
+
+@with_all_phases
+@spec_state_test
+def test_basic(spec, state):
+    test_steps = []
+    store, anchor_block = get_genesis_forkchoice_store_and_block(spec, state)
+    yield "anchor_state", state
+    yield "anchor_block", anchor_block
+    current_time = (state.slot * spec.config.SECONDS_PER_SLOT
+                    + store.genesis_time)
+    on_tick_and_append_step(spec, store, current_time, test_steps)
+    assert store.time == current_time
+
+    # On receiving a block of `GENESIS_SLOT + 1` slot
+    block = build_empty_block_for_next_slot(spec, state)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    yield from tick_and_add_block(spec, store, signed_block, test_steps)
+    check_head_against_root(spec, store, spec.hash_tree_root(block))
+
+    # On receiving a block of next epoch
+    store.time = (current_time
+                  + spec.config.SECONDS_PER_SLOT * spec.SLOTS_PER_EPOCH)
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.graffiti = b"\x12" * 32
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    yield from tick_and_add_block(spec, store, signed_block, test_steps)
+    check_head_against_root(spec, store, spec.hash_tree_root(block))
+
+    yield "steps", test_steps
+
+
+@with_all_phases
+@spec_state_test
+def test_on_block_future_block(spec, state):
+    test_steps = []
+    store, anchor_block = get_genesis_forkchoice_store_and_block(spec, state)
+    yield "anchor_state", state
+    yield "anchor_block", anchor_block
+
+    # Do NOT tick to the block's slot: the block is from the future
+    block = build_empty_block_for_next_slot(spec, state)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    yield from add_block(spec, store, signed_block, test_steps, valid=False)
+
+    yield "steps", test_steps
+
+
+@with_all_phases
+@spec_state_test
+def test_on_block_bad_parent_root(spec, state):
+    test_steps = []
+    store, anchor_block = get_genesis_forkchoice_store_and_block(spec, state)
+    yield "anchor_state", state
+    yield "anchor_block", anchor_block
+    current_time = (state.slot * spec.config.SECONDS_PER_SLOT
+                    + store.genesis_time)
+    on_tick_and_append_step(spec, store, current_time, test_steps)
+
+    block = build_empty_block_for_next_slot(spec, state)
+    transition_unsigned = state.copy()
+    spec.process_slots(transition_unsigned, block.slot)
+    block.state_root = spec.hash_tree_root(transition_unsigned)
+
+    block.parent_root = b"\x45" * 32  # unknown parent
+
+    from consensus_specs_tpu.testlib.helpers.block import sign_block
+
+    signed_block = sign_block(spec, state, block)
+    yield from add_block(spec, store, signed_block, test_steps, valid=False)
+
+    yield "steps", test_steps
+
+
+@with_all_phases
+@spec_state_test
+def test_on_block_before_finalized(spec, state):
+    test_steps = []
+    store, anchor_block = get_genesis_forkchoice_store_and_block(spec, state)
+    yield "anchor_state", state
+    yield "anchor_block", anchor_block
+
+    # Fork from the pre-finalization state
+    fork_state = state.copy()
+
+    # Justify + finalize some epochs
+    for _ in range(4):
+        state, store, _ = yield from apply_next_epoch_with_attestations(
+            spec, state, store, True, True, test_steps=test_steps)
+    assert store.finalized_checkpoint.epoch > 0
+
+    # A block behind the finalized slot is rejected
+    block = build_empty_block_for_next_slot(spec, fork_state)
+    signed_block = state_transition_and_sign_block(spec, fork_state, block)
+    yield from add_block(spec, store, signed_block, test_steps, valid=False)
+
+    yield "steps", test_steps
+
+
+@with_all_phases
+@spec_state_test
+def test_proposer_boost(spec, state):
+    test_steps = []
+    store, anchor_block = get_genesis_forkchoice_store_and_block(spec, state)
+    yield "anchor_state", state
+    yield "anchor_block", anchor_block
+
+    block = build_empty_block_for_next_slot(spec, state)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+
+    # Timely arrival (start of the block's slot): boost applies
+    time = (store.genesis_time
+            + block.slot * spec.config.SECONDS_PER_SLOT)
+    on_tick_and_append_step(spec, store, time, test_steps)
+    yield from add_block(spec, store, signed_block, test_steps)
+    assert store.proposer_boost_root == spec.hash_tree_root(block)
+    assert spec.get_weight(store, spec.hash_tree_root(block)) > 0
+
+    # Next slot: boost expires, weight (no attestations) drops to zero
+    time = (store.genesis_time
+            + (block.slot + 1) * spec.config.SECONDS_PER_SLOT)
+    on_tick_and_append_step(spec, store, time, test_steps)
+    assert store.proposer_boost_root == spec.Root()
+    assert spec.get_weight(store, spec.hash_tree_root(block)) == 0
+
+    yield "steps", test_steps
+
+
+@with_all_phases
+@spec_state_test
+def test_proposer_boost_not_first_block(spec, state):
+    """Only the first timely block of a slot gets the boost."""
+    test_steps = []
+    store, anchor_block = get_genesis_forkchoice_store_and_block(spec, state)
+    yield "anchor_state", state
+    yield "anchor_block", anchor_block
+    genesis_state = state.copy()
+
+    state_1 = genesis_state.copy()
+    block_1 = build_empty_block_for_next_slot(spec, state_1)
+    signed_block_1 = state_transition_and_sign_block(spec, state_1, block_1)
+
+    state_2 = genesis_state.copy()
+    block_2 = build_empty_block_for_next_slot(spec, state_2)
+    block_2.body.graffiti = b"\x34" * 32
+    signed_block_2 = state_transition_and_sign_block(spec, state_2, block_2)
+
+    time = store.genesis_time + block_1.slot * spec.config.SECONDS_PER_SLOT
+    on_tick_and_append_step(spec, store, time, test_steps)
+    yield from add_block(spec, store, signed_block_1, test_steps)
+    assert store.proposer_boost_root == spec.hash_tree_root(block_1)
+
+    # Second timely block of the same slot: boost stays with the first
+    yield from add_block(spec, store, signed_block_2, test_steps)
+    assert store.proposer_boost_root == spec.hash_tree_root(block_1)
+
+    yield "steps", test_steps
+
+
+@with_all_phases
+@spec_state_test
+def test_on_block_checkpoints(spec, state):
+    """on_block realizes justified/finalized checkpoint updates carried
+    by the block's post-state."""
+    test_steps = []
+    store, anchor_block = get_genesis_forkchoice_store_and_block(spec, state)
+    yield "anchor_state", state
+    yield "anchor_block", anchor_block
+
+    for _ in range(3):
+        state, store, _ = yield from apply_next_epoch_with_attestations(
+            spec, state, store, True, False, test_steps=test_steps)
+
+    assert store.justified_checkpoint.epoch > 0
+    assert (store.justified_checkpoint
+            == state.current_justified_checkpoint)
+
+    yield "steps", test_steps

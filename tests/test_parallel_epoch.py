@@ -105,3 +105,46 @@ def test_balances_root_matches_ssz(spec):
     want = hash_tree_root(spec_state.balances)
     got = np.asarray(root).astype(">u4").tobytes()
     assert got == bytes(want)
+
+
+def test_registry_root_matches_ssz_non_pow2(spec):
+    """Padded registries (any non-power-of-two count) must merkleize like
+    SSZ: pad rows are zero *chunks*, not zero-Validator record roots."""
+    from consensus_specs_tpu.parallel import (
+        ValidatorLeaves,
+        validator_records_root,
+        validator_registry_root,
+        validator_static_leaf_words,
+    )
+
+    state = create_genesis_state(
+        spec, [spec.MAX_EFFECTIVE_BALANCE] * 48,
+        default_activation_threshold(spec))
+    n = len(state.validators)
+    assert n & (n - 1) != 0  # genuinely exercises the padding path
+
+    pk_root, cred = validator_static_leaf_words(spec, state)
+    arrs = {
+        "effective_balance": [int(v.effective_balance)
+                              for v in state.validators],
+        "slashed": [bool(v.slashed) for v in state.validators],
+        "activation_eligibility_epoch": [
+            int(v.activation_eligibility_epoch) for v in state.validators],
+        "activation_epoch": [int(v.activation_epoch)
+                             for v in state.validators],
+        "exit_epoch": [int(v.exit_epoch) for v in state.validators],
+        "withdrawable_epoch": [int(v.withdrawable_epoch)
+                               for v in state.validators],
+    }
+    pad = {k: pad_pow2(np.asarray(v, dtype=np.uint64))
+           for k, v in arrs.items()}
+    rec = validator_records_root(
+        ValidatorLeaves(pad_pow2(np.asarray(pk_root)),
+                        pad_pow2(np.asarray(cred))),
+        pad["effective_balance"], pad["slashed"],
+        pad["activation_eligibility_epoch"], pad["activation_epoch"],
+        pad["exit_epoch"], pad["withdrawable_epoch"])
+    root = validator_registry_root(rec, np.uint64(n))
+    got = np.asarray(root).astype(">u4").tobytes()
+    want = bytes(hash_tree_root(state.validators))
+    assert got == want
