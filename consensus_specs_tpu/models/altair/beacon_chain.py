@@ -1,0 +1,568 @@
+# Altair -- The Beacon Chain (executable spec source, delta over phase0).
+#
+# Executed into the namespace AFTER the phase0 sources: class/function
+# definitions here override the phase0 bindings, and phase0 functions that
+# call overridden names pick up the new versions through the shared
+# namespace (the reference's generated-module override semantics).
+# Parity contract: specs/altair/beacon-chain.md (constants :70-137,
+# containers :139-210, helpers :263-447, block processing :486-606,
+# epoch processing :608-745) and specs/altair/bls.md (:29-67).
+
+# ---------------------------------------------------------------------------
+# Custom types + constants (beacon-chain.md :64-105)
+# ---------------------------------------------------------------------------
+
+
+class ParticipationFlags(uint8):
+    pass
+
+
+TIMELY_SOURCE_FLAG_INDEX = 0
+TIMELY_TARGET_FLAG_INDEX = 1
+TIMELY_HEAD_FLAG_INDEX = 2
+
+TIMELY_SOURCE_WEIGHT = uint64(14)
+TIMELY_TARGET_WEIGHT = uint64(26)
+TIMELY_HEAD_WEIGHT = uint64(14)
+SYNC_REWARD_WEIGHT = uint64(2)
+PROPOSER_WEIGHT = uint64(8)
+WEIGHT_DENOMINATOR = uint64(64)
+
+DOMAIN_SYNC_COMMITTEE = DomainType("0x07000000")
+DOMAIN_SYNC_COMMITTEE_SELECTION_PROOF = DomainType("0x08000000")
+DOMAIN_CONTRIBUTION_AND_PROOF = DomainType("0x09000000")
+
+PARTICIPATION_FLAG_WEIGHTS = [TIMELY_SOURCE_WEIGHT, TIMELY_TARGET_WEIGHT,
+                              TIMELY_HEAD_WEIGHT]
+
+G2_POINT_AT_INFINITY = BLSSignature(b"\xc0" + b"\x00" * 95)
+
+
+# ---------------------------------------------------------------------------
+# Containers (beacon-chain.md :139-210)
+# ---------------------------------------------------------------------------
+
+
+class SyncAggregate(Container):
+    sync_committee_bits: Bitvector[SYNC_COMMITTEE_SIZE]
+    sync_committee_signature: BLSSignature
+
+
+class SyncCommittee(Container):
+    pubkeys: Vector[BLSPubkey, SYNC_COMMITTEE_SIZE]
+    aggregate_pubkey: BLSPubkey
+
+
+class BeaconBlockBody(Container):
+    randao_reveal: BLSSignature
+    eth1_data: Eth1Data
+    graffiti: Bytes32
+    proposer_slashings: List[ProposerSlashing, MAX_PROPOSER_SLASHINGS]
+    attester_slashings: List[AttesterSlashing, MAX_ATTESTER_SLASHINGS]
+    attestations: List[Attestation, MAX_ATTESTATIONS]
+    deposits: List[Deposit, MAX_DEPOSITS]
+    voluntary_exits: List[SignedVoluntaryExit, MAX_VOLUNTARY_EXITS]
+    # [New in Altair]
+    sync_aggregate: SyncAggregate
+
+
+class BeaconBlock(Container):
+    slot: Slot
+    proposer_index: ValidatorIndex
+    parent_root: Root
+    state_root: Root
+    body: BeaconBlockBody
+
+
+class SignedBeaconBlock(Container):
+    message: BeaconBlock
+    signature: BLSSignature
+
+
+class BeaconState(Container):
+    genesis_time: uint64
+    genesis_validators_root: Root
+    slot: Slot
+    fork: Fork
+    latest_block_header: BeaconBlockHeader
+    block_roots: Vector[Root, SLOTS_PER_HISTORICAL_ROOT]
+    state_roots: Vector[Root, SLOTS_PER_HISTORICAL_ROOT]
+    historical_roots: List[Root, HISTORICAL_ROOTS_LIMIT]
+    eth1_data: Eth1Data
+    eth1_data_votes: List[Eth1Data, EPOCHS_PER_ETH1_VOTING_PERIOD * SLOTS_PER_EPOCH]
+    eth1_deposit_index: uint64
+    validators: List[Validator, VALIDATOR_REGISTRY_LIMIT]
+    balances: List[Gwei, VALIDATOR_REGISTRY_LIMIT]
+    randao_mixes: Vector[Bytes32, EPOCHS_PER_HISTORICAL_VECTOR]
+    slashings: Vector[Gwei, EPOCHS_PER_SLASHINGS_VECTOR]
+    # [Modified in Altair]
+    previous_epoch_participation: List[ParticipationFlags, VALIDATOR_REGISTRY_LIMIT]
+    # [Modified in Altair]
+    current_epoch_participation: List[ParticipationFlags, VALIDATOR_REGISTRY_LIMIT]
+    justification_bits: Bitvector[JUSTIFICATION_BITS_LENGTH]
+    previous_justified_checkpoint: Checkpoint
+    current_justified_checkpoint: Checkpoint
+    finalized_checkpoint: Checkpoint
+    # [New in Altair]
+    inactivity_scores: List[uint64, VALIDATOR_REGISTRY_LIMIT]
+    # [New in Altair]
+    current_sync_committee: SyncCommittee
+    # [New in Altair]
+    next_sync_committee: SyncCommittee
+
+
+# ---------------------------------------------------------------------------
+# Crypto extensions (altair/bls.md :29-67)
+# ---------------------------------------------------------------------------
+
+
+def eth_aggregate_pubkeys(pubkeys: Sequence[BLSPubkey]) -> BLSPubkey:
+    """EC point sum of the input pubkeys (altair/bls.md :36-53)."""
+    assert len(pubkeys) > 0
+    assert all(bls.KeyValidate(pubkey) for pubkey in pubkeys)
+    return BLSPubkey(bls.AggregatePKs(pubkeys))
+
+
+def eth_fast_aggregate_verify(pubkeys: Sequence[BLSPubkey], message: Bytes32,
+                              signature: BLSSignature) -> bool:
+    """FastAggregateVerify that also accepts an empty committee signing
+    the infinity point (altair/bls.md :55-67)."""
+    if len(pubkeys) == 0 and signature == G2_POINT_AT_INFINITY:
+        return True
+    return bls.FastAggregateVerify(pubkeys, message, signature)
+
+
+# ---------------------------------------------------------------------------
+# Misc helpers (beacon-chain.md :224-261)
+# ---------------------------------------------------------------------------
+
+
+def add_flag(flags: ParticipationFlags, flag_index: int) -> ParticipationFlags:
+    """Return a new ``ParticipationFlags`` adding ``flag_index``."""
+    flag = ParticipationFlags(2**flag_index)
+    return flags | flag
+
+
+def has_flag(flags: ParticipationFlags, flag_index: int) -> bool:
+    """Return whether ``flags`` has ``flag_index`` set."""
+    flag = ParticipationFlags(2**flag_index)
+    return flags & flag == flag
+
+
+def get_index_for_new_validator(state: BeaconState) -> ValidatorIndex:
+    return ValidatorIndex(len(state.validators))
+
+
+def set_or_append_list(list, index: ValidatorIndex, value) -> None:
+    if index == len(list):
+        list.append(value)
+    else:
+        list[index] = value
+
+
+# ---------------------------------------------------------------------------
+# Beacon state accessors (beacon-chain.md :263-447)
+# ---------------------------------------------------------------------------
+
+
+def get_next_sync_committee_indices(state: BeaconState) -> Sequence[ValidatorIndex]:
+    """Sync committee indices (with possible duplicates) for the NEXT
+    period: effective-balance-weighted sampling over the shuffled active
+    set (beacon-chain.md :268-291)."""
+    epoch = Epoch(get_current_epoch(state) + 1)
+
+    MAX_RANDOM_BYTE = 2**8 - 1
+    active_validator_indices = get_active_validator_indices(state, epoch)
+    active_validator_count = uint64(len(active_validator_indices))
+    seed = get_seed(state, epoch, DOMAIN_SYNC_COMMITTEE)
+    i = 0
+    sync_committee_indices = []
+    while len(sync_committee_indices) < SYNC_COMMITTEE_SIZE:
+        shuffled_index = compute_shuffled_index(
+            uint64(i % active_validator_count), active_validator_count, seed)
+        candidate_index = active_validator_indices[shuffled_index]
+        random_byte = hash(seed + uint_to_bytes(uint64(i // 32)))[i % 32]
+        effective_balance = state.validators[candidate_index].effective_balance
+        if (effective_balance * MAX_RANDOM_BYTE
+                >= MAX_EFFECTIVE_BALANCE * random_byte):
+            sync_committee_indices.append(candidate_index)
+        i += 1
+    return sync_committee_indices
+
+
+def get_next_sync_committee(state: BeaconState) -> SyncCommittee:
+    """Next sync committee, with possible pubkey duplicates; only call at
+    period boundaries / the altair upgrade (beacon-chain.md :300-307)."""
+    indices = get_next_sync_committee_indices(state)
+    pubkeys = [state.validators[index].pubkey for index in indices]
+    aggregate_pubkey = eth_aggregate_pubkeys(pubkeys)
+    return SyncCommittee(pubkeys=pubkeys, aggregate_pubkey=aggregate_pubkey)
+
+
+def get_base_reward_per_increment(state: BeaconState) -> Gwei:
+    return Gwei(EFFECTIVE_BALANCE_INCREMENT * BASE_REWARD_FACTOR
+                // integer_squareroot(get_total_active_balance(state)))
+
+
+def get_base_reward(state: BeaconState, index: ValidatorIndex) -> Gwei:
+    """Increment-based base reward (replaces phase0's
+    BASE_REWARDS_PER_EPOCH accounting)."""
+    increments = (state.validators[index].effective_balance
+                  // EFFECTIVE_BALANCE_INCREMENT)
+    return Gwei(increments * get_base_reward_per_increment(state))
+
+
+def get_unslashed_participating_indices(state: BeaconState, flag_index: int,
+                                        epoch: Epoch) -> Set[ValidatorIndex]:
+    """Active, unslashed validators with `flag_index` set for `epoch`."""
+    assert epoch in (get_previous_epoch(state), get_current_epoch(state))
+    if epoch == get_current_epoch(state):
+        epoch_participation = state.current_epoch_participation
+    else:
+        epoch_participation = state.previous_epoch_participation
+    active_validator_indices = get_active_validator_indices(state, epoch)
+    participating_indices = [
+        i for i in active_validator_indices
+        if has_flag(epoch_participation[i], flag_index)
+    ]
+    return set(filter(lambda index: not state.validators[index].slashed,
+                      participating_indices))
+
+
+def get_attestation_participation_flag_indices(
+        state: BeaconState, data: AttestationData,
+        inclusion_delay: uint64) -> Sequence[int]:
+    """Flag indices an attestation satisfies: source/target/head matches
+    gated by inclusion-delay timeliness (beacon-chain.md :362-391)."""
+    if data.target.epoch == get_current_epoch(state):
+        justified_checkpoint = state.current_justified_checkpoint
+    else:
+        justified_checkpoint = state.previous_justified_checkpoint
+
+    # Matching roots
+    is_matching_source = data.source == justified_checkpoint
+    is_matching_target = (is_matching_source
+                          and data.target.root
+                          == get_block_root(state, data.target.epoch))
+    is_matching_head = (is_matching_target
+                        and data.beacon_block_root
+                        == get_block_root_at_slot(state, data.slot))
+    assert is_matching_source
+
+    participation_flag_indices = []
+    if (is_matching_source
+            and inclusion_delay <= integer_squareroot(SLOTS_PER_EPOCH)):
+        participation_flag_indices.append(TIMELY_SOURCE_FLAG_INDEX)
+    if is_matching_target and inclusion_delay <= SLOTS_PER_EPOCH:
+        participation_flag_indices.append(TIMELY_TARGET_FLAG_INDEX)
+    if is_matching_head and inclusion_delay == MIN_ATTESTATION_INCLUSION_DELAY:
+        participation_flag_indices.append(TIMELY_HEAD_FLAG_INDEX)
+
+    return participation_flag_indices
+
+
+def get_flag_index_deltas(state: BeaconState, flag_index: int):
+    """Per-validator (rewards, penalties) for one participation flag
+    (beacon-chain.md :397-423)."""
+    rewards = [Gwei(0)] * len(state.validators)
+    penalties = [Gwei(0)] * len(state.validators)
+    previous_epoch = get_previous_epoch(state)
+    unslashed_participating_indices = get_unslashed_participating_indices(
+        state, flag_index, previous_epoch)
+    weight = PARTICIPATION_FLAG_WEIGHTS[flag_index]
+    unslashed_participating_balance = get_total_balance(
+        state, unslashed_participating_indices)
+    unslashed_participating_increments = (
+        unslashed_participating_balance // EFFECTIVE_BALANCE_INCREMENT)
+    active_increments = (get_total_active_balance(state)
+                         // EFFECTIVE_BALANCE_INCREMENT)
+    for index in get_eligible_validator_indices(state):
+        base_reward = get_base_reward(state, index)
+        if index in unslashed_participating_indices:
+            if not is_in_inactivity_leak(state):
+                reward_numerator = (base_reward * weight
+                                    * unslashed_participating_increments)
+                rewards[index] += Gwei(
+                    reward_numerator
+                    // (active_increments * WEIGHT_DENOMINATOR))
+        elif flag_index != TIMELY_HEAD_FLAG_INDEX:
+            penalties[index] += Gwei(base_reward * weight
+                                     // WEIGHT_DENOMINATOR)
+    return rewards, penalties
+
+
+def get_inactivity_penalty_deltas(state: BeaconState):
+    """Inactivity penalties from inactivity scores (quadratic leak);
+    no rewards (beacon-chain.md :429-446)."""
+    rewards = [Gwei(0) for _ in range(len(state.validators))]
+    penalties = [Gwei(0) for _ in range(len(state.validators))]
+    previous_epoch = get_previous_epoch(state)
+    matching_target_indices = get_unslashed_participating_indices(
+        state, TIMELY_TARGET_FLAG_INDEX, previous_epoch)
+    for index in get_eligible_validator_indices(state):
+        if index not in matching_target_indices:
+            penalty_numerator = (state.validators[index].effective_balance
+                                 * state.inactivity_scores[index])
+            penalty_denominator = (config.INACTIVITY_SCORE_BIAS
+                                   * INACTIVITY_PENALTY_QUOTIENT_ALTAIR)
+            penalties[index] += Gwei(penalty_numerator // penalty_denominator)
+    return rewards, penalties
+
+
+# ---------------------------------------------------------------------------
+# Beacon state mutators (beacon-chain.md :451-483)
+# ---------------------------------------------------------------------------
+
+
+def slash_validator(state: BeaconState, slashed_index: ValidatorIndex,
+                    whistleblower_index: ValidatorIndex = None) -> None:
+    """Slash with the altair penalty quotient and proposer-weighted
+    whistleblower split."""
+    epoch = get_current_epoch(state)
+    initiate_validator_exit(state, slashed_index)
+    validator = state.validators[slashed_index]
+    validator.slashed = True
+    validator.withdrawable_epoch = max(
+        validator.withdrawable_epoch, Epoch(epoch + EPOCHS_PER_SLASHINGS_VECTOR))
+    state.slashings[epoch % EPOCHS_PER_SLASHINGS_VECTOR] += validator.effective_balance
+    decrease_balance(state, slashed_index,
+                     validator.effective_balance
+                     // MIN_SLASHING_PENALTY_QUOTIENT_ALTAIR)
+
+    # Apply proposer and whistleblower rewards
+    proposer_index = get_beacon_proposer_index(state)
+    if whistleblower_index is None:
+        whistleblower_index = proposer_index
+    whistleblower_reward = Gwei(validator.effective_balance
+                                // WHISTLEBLOWER_REWARD_QUOTIENT)
+    proposer_reward = Gwei(whistleblower_reward * PROPOSER_WEIGHT
+                           // WEIGHT_DENOMINATOR)
+    increase_balance(state, proposer_index, proposer_reward)
+    increase_balance(state, whistleblower_index,
+                     Gwei(whistleblower_reward - proposer_reward))
+
+
+# ---------------------------------------------------------------------------
+# Block processing (beacon-chain.md :486-606)
+# ---------------------------------------------------------------------------
+
+
+def process_block(state: BeaconState, block: BeaconBlock) -> None:
+    process_block_header(state, block)
+    process_randao(state, block.body)
+    process_eth1_data(state, block.body)
+    process_operations(state, block.body)
+    # [New in Altair]
+    process_sync_aggregate(state, block.body.sync_aggregate)
+
+
+def process_attestation(state: BeaconState, attestation: Attestation) -> None:
+    """Participation-flag incentive accounting (beacon-chain.md :503-541)."""
+    data = attestation.data
+    assert data.target.epoch in (get_previous_epoch(state),
+                                 get_current_epoch(state))
+    assert data.target.epoch == compute_epoch_at_slot(data.slot)
+    assert (data.slot + MIN_ATTESTATION_INCLUSION_DELAY
+            <= state.slot
+            <= data.slot + SLOTS_PER_EPOCH)
+    assert data.index < get_committee_count_per_slot(state, data.target.epoch)
+
+    committee = get_beacon_committee(state, data.slot, data.index)
+    assert len(attestation.aggregation_bits) == len(committee)
+
+    # Participation flag indices
+    participation_flag_indices = get_attestation_participation_flag_indices(
+        state, data, state.slot - data.slot)
+
+    # Verify signature
+    assert is_valid_indexed_attestation(
+        state, get_indexed_attestation(state, attestation))
+
+    # Update epoch participation flags
+    if data.target.epoch == get_current_epoch(state):
+        epoch_participation = state.current_epoch_participation
+    else:
+        epoch_participation = state.previous_epoch_participation
+
+    proposer_reward_numerator = 0
+    for index in get_attesting_indices(state, attestation):
+        for flag_index, weight in enumerate(PARTICIPATION_FLAG_WEIGHTS):
+            if (flag_index in participation_flag_indices
+                    and not has_flag(epoch_participation[index], flag_index)):
+                epoch_participation[index] = add_flag(
+                    epoch_participation[index], flag_index)
+                proposer_reward_numerator += get_base_reward(state, index) * weight
+
+    # Reward proposer
+    proposer_reward_denominator = ((WEIGHT_DENOMINATOR - PROPOSER_WEIGHT)
+                                   * WEIGHT_DENOMINATOR // PROPOSER_WEIGHT)
+    proposer_reward = Gwei(proposer_reward_numerator
+                           // proposer_reward_denominator)
+    increase_balance(state, get_beacon_proposer_index(state), proposer_reward)
+
+
+def add_validator_to_registry(state: BeaconState, pubkey: BLSPubkey,
+                              withdrawal_credentials: Bytes32,
+                              amount: uint64) -> None:
+    """Also initialize participation flags + inactivity score."""
+    index = get_index_for_new_validator(state)
+    validator = get_validator_from_deposit(pubkey, withdrawal_credentials,
+                                           amount)
+    set_or_append_list(state.validators, index, validator)
+    set_or_append_list(state.balances, index, amount)
+    # [New in Altair]
+    set_or_append_list(state.previous_epoch_participation, index,
+                       ParticipationFlags(0b0000_0000))
+    set_or_append_list(state.current_epoch_participation, index,
+                       ParticipationFlags(0b0000_0000))
+    set_or_append_list(state.inactivity_scores, index, uint64(0))
+
+
+def process_sync_aggregate(state: BeaconState,
+                           sync_aggregate: SyncAggregate) -> None:
+    """Verify the committee signature over the previous slot's block root
+    and settle participant/proposer rewards (beacon-chain.md :569-606)."""
+    # Verify sync committee aggregate signature signing over the previous slot block root
+    committee_pubkeys = state.current_sync_committee.pubkeys
+    participant_pubkeys = [
+        pubkey for pubkey, bit
+        in zip(committee_pubkeys, sync_aggregate.sync_committee_bits) if bit
+    ]
+    previous_slot = max(state.slot, Slot(1)) - Slot(1)
+    domain = get_domain(state, DOMAIN_SYNC_COMMITTEE,
+                        compute_epoch_at_slot(previous_slot))
+    signing_root = compute_signing_root(
+        get_block_root_at_slot(state, previous_slot), domain)
+    assert eth_fast_aggregate_verify(
+        participant_pubkeys, signing_root,
+        sync_aggregate.sync_committee_signature)
+
+    # Compute participant and proposer rewards
+    total_active_increments = (get_total_active_balance(state)
+                               // EFFECTIVE_BALANCE_INCREMENT)
+    total_base_rewards = Gwei(get_base_reward_per_increment(state)
+                              * total_active_increments)
+    max_participant_rewards = Gwei(total_base_rewards * SYNC_REWARD_WEIGHT
+                                   // WEIGHT_DENOMINATOR // SLOTS_PER_EPOCH)
+    participant_reward = Gwei(max_participant_rewards // SYNC_COMMITTEE_SIZE)
+    proposer_reward = Gwei(participant_reward * PROPOSER_WEIGHT
+                           // (WEIGHT_DENOMINATOR - PROPOSER_WEIGHT))
+
+    # Apply participant and proposer rewards
+    all_pubkeys = [v.pubkey for v in state.validators]
+    committee_indices = [
+        ValidatorIndex(all_pubkeys.index(pubkey))
+        for pubkey in state.current_sync_committee.pubkeys
+    ]
+    for participant_index, participation_bit in zip(
+            committee_indices, sync_aggregate.sync_committee_bits):
+        if participation_bit:
+            increase_balance(state, participant_index, participant_reward)
+            increase_balance(state, get_beacon_proposer_index(state),
+                             proposer_reward)
+        else:
+            decrease_balance(state, participant_index, participant_reward)
+
+
+# ---------------------------------------------------------------------------
+# Epoch processing (beacon-chain.md :608-745)
+# ---------------------------------------------------------------------------
+
+
+def process_epoch(state: BeaconState) -> None:
+    process_justification_and_finalization(state)  # [Modified in Altair]
+    process_inactivity_updates(state)  # [New in Altair]
+    process_rewards_and_penalties(state)  # [Modified in Altair]
+    process_registry_updates(state)
+    process_slashings(state)  # [Modified in Altair]
+    process_eth1_data_reset(state)
+    process_effective_balance_updates(state)
+    process_slashings_reset(state)
+    process_randao_mixes_reset(state)
+    process_historical_roots_update(state)
+    process_participation_flag_updates(state)  # [New in Altair]
+    process_sync_committee_updates(state)  # [New in Altair]
+
+
+def process_justification_and_finalization(state: BeaconState) -> None:
+    # Skip FFG updates in the first two epochs (stub-root corner cases)
+    if get_current_epoch(state) <= GENESIS_EPOCH + 1:
+        return
+    previous_indices = get_unslashed_participating_indices(
+        state, TIMELY_TARGET_FLAG_INDEX, get_previous_epoch(state))
+    current_indices = get_unslashed_participating_indices(
+        state, TIMELY_TARGET_FLAG_INDEX, get_current_epoch(state))
+    total_active_balance = get_total_active_balance(state)
+    previous_target_balance = get_total_balance(state, previous_indices)
+    current_target_balance = get_total_balance(state, current_indices)
+    weigh_justification_and_finalization(
+        state, total_active_balance, previous_target_balance,
+        current_target_balance)
+
+
+def process_inactivity_updates(state: BeaconState) -> None:
+    """Score up inactive validators, score everyone down in leak-free
+    epochs (beacon-chain.md :656-673)."""
+    # Score updates are based on previous-epoch participation
+    if get_current_epoch(state) == GENESIS_EPOCH:
+        return
+
+    for index in get_eligible_validator_indices(state):
+        if index in get_unslashed_participating_indices(
+                state, TIMELY_TARGET_FLAG_INDEX, get_previous_epoch(state)):
+            state.inactivity_scores[index] -= min(
+                1, state.inactivity_scores[index])
+        else:
+            state.inactivity_scores[index] += config.INACTIVITY_SCORE_BIAS
+        if not is_in_inactivity_leak(state):
+            state.inactivity_scores[index] -= min(
+                config.INACTIVITY_SCORE_RECOVERY_RATE,
+                state.inactivity_scores[index])
+
+
+def process_rewards_and_penalties(state: BeaconState) -> None:
+    # No work was done in the epoch before genesis
+    if get_current_epoch(state) == GENESIS_EPOCH:
+        return
+
+    flag_deltas = [
+        get_flag_index_deltas(state, flag_index)
+        for flag_index in range(len(PARTICIPATION_FLAG_WEIGHTS))
+    ]
+    deltas = flag_deltas + [get_inactivity_penalty_deltas(state)]
+    for rewards, penalties in deltas:
+        for index in range(len(state.validators)):
+            increase_balance(state, ValidatorIndex(index), rewards[index])
+            decrease_balance(state, ValidatorIndex(index), penalties[index])
+
+
+def process_slashings(state: BeaconState) -> None:
+    epoch = get_current_epoch(state)
+    total_balance = get_total_active_balance(state)
+    adjusted_total_slashing_balance = min(
+        sum(state.slashings) * PROPORTIONAL_SLASHING_MULTIPLIER_ALTAIR,
+        total_balance)
+    for index, validator in enumerate(state.validators):
+        if (validator.slashed
+                and epoch + EPOCHS_PER_SLASHINGS_VECTOR // 2
+                == validator.withdrawable_epoch):
+            # Factor out the increment to avoid uint64 overflow
+            increment = EFFECTIVE_BALANCE_INCREMENT
+            penalty_numerator = (validator.effective_balance // increment
+                                 * adjusted_total_slashing_balance)
+            penalty = penalty_numerator // total_balance * increment
+            decrease_balance(state, ValidatorIndex(index), penalty)
+
+
+def process_participation_flag_updates(state: BeaconState) -> None:
+    state.previous_epoch_participation = state.current_epoch_participation
+    state.current_epoch_participation = [
+        ParticipationFlags(0b0000_0000) for _ in range(len(state.validators))
+    ]
+
+
+def process_sync_committee_updates(state: BeaconState) -> None:
+    next_epoch = get_current_epoch(state) + Epoch(1)
+    if next_epoch % EPOCHS_PER_SYNC_COMMITTEE_PERIOD == 0:
+        state.current_sync_committee = state.next_sync_committee
+        state.next_sync_committee = get_next_sync_committee(state)

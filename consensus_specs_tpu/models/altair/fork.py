@@ -1,0 +1,77 @@
+# Altair -- Fork Logic (executable spec source).
+#
+# Parity contract: specs/altair/fork.md (:34-130).  `upgrade_to_altair`
+# consumes a *phase0* BeaconState instance (built by the phase0 spec) and
+# returns this fork's BeaconState; fields are copied attribute-wise, so no
+# cross-module type reference is needed.
+
+
+def compute_fork_version(epoch: Epoch) -> Version:
+    """Fork version at `epoch`."""
+    if epoch >= config.ALTAIR_FORK_EPOCH:
+        return config.ALTAIR_FORK_VERSION
+    return config.GENESIS_FORK_VERSION
+
+
+def translate_participation(state: BeaconState,
+                            pending_attestations) -> None:
+    """Convert phase0 PendingAttestations into previous-epoch
+    participation flags (fork.md `translate_participation`)."""
+    for attestation in pending_attestations:
+        data = attestation.data
+        inclusion_delay = attestation.inclusion_delay
+        # Translate attestation inclusion info to flag indices
+        participation_flag_indices = get_attestation_participation_flag_indices(
+            state, data, inclusion_delay)
+
+        # Apply flags to all attesting validators
+        epoch_participation = state.previous_epoch_participation
+        for index in get_attesting_indices(state, attestation):
+            for flag_index in participation_flag_indices:
+                epoch_participation[index] = add_flag(
+                    epoch_participation[index], flag_index)
+
+
+def upgrade_to_altair(pre) -> BeaconState:
+    """phase0 -> altair state upgrade (fork.md `upgrade_to_altair`)."""
+    epoch = compute_epoch_at_slot(pre.slot)
+    post = BeaconState(
+        genesis_time=pre.genesis_time,
+        genesis_validators_root=pre.genesis_validators_root,
+        slot=pre.slot,
+        fork=Fork(
+            previous_version=pre.fork.current_version,
+            current_version=config.ALTAIR_FORK_VERSION,
+            epoch=epoch,
+        ),
+        latest_block_header=pre.latest_block_header,
+        block_roots=pre.block_roots,
+        state_roots=pre.state_roots,
+        historical_roots=pre.historical_roots,
+        eth1_data=pre.eth1_data,
+        eth1_data_votes=pre.eth1_data_votes,
+        eth1_deposit_index=pre.eth1_deposit_index,
+        validators=pre.validators,
+        balances=pre.balances,
+        randao_mixes=pre.randao_mixes,
+        slashings=pre.slashings,
+        previous_epoch_participation=[
+            ParticipationFlags(0b0000_0000) for _ in range(len(pre.validators))
+        ],
+        current_epoch_participation=[
+            ParticipationFlags(0b0000_0000) for _ in range(len(pre.validators))
+        ],
+        justification_bits=pre.justification_bits,
+        previous_justified_checkpoint=pre.previous_justified_checkpoint,
+        current_justified_checkpoint=pre.current_justified_checkpoint,
+        finalized_checkpoint=pre.finalized_checkpoint,
+        inactivity_scores=[uint64(0) for _ in range(len(pre.validators))],
+    )
+    # Fill in previous epoch participation from pending attestations
+    translate_participation(post, pre.previous_epoch_attestations)
+
+    # Fill in sync committees
+    # Note: A duplicate committee is assigned at the fork boundary
+    post.current_sync_committee = get_next_sync_committee(post)
+    post.next_sync_committee = get_next_sync_committee(post)
+    return post

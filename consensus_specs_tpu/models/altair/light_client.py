@@ -1,0 +1,575 @@
+# Altair -- Light Client (sync protocol + full-node data derivation).
+#
+# Parity contract: specs/altair/light-client/sync-protocol.md
+# (containers :85-171, helpers :173-320, init :322-354, updates :356-590)
+# and specs/altair/light-client/full-node.md (:37-221).
+
+# ---------------------------------------------------------------------------
+# Constants (sync-protocol.md :68-74) — computed, then pinned by assert
+# ---------------------------------------------------------------------------
+
+FINALIZED_ROOT_GINDEX = get_generalized_index(
+    BeaconState, "finalized_checkpoint", "root")
+CURRENT_SYNC_COMMITTEE_GINDEX = get_generalized_index(
+    BeaconState, "current_sync_committee")
+NEXT_SYNC_COMMITTEE_GINDEX = get_generalized_index(
+    BeaconState, "next_sync_committee")
+
+assert FINALIZED_ROOT_GINDEX == 105, FINALIZED_ROOT_GINDEX
+assert CURRENT_SYNC_COMMITTEE_GINDEX == 54, CURRENT_SYNC_COMMITTEE_GINDEX
+assert NEXT_SYNC_COMMITTEE_GINDEX == 55, NEXT_SYNC_COMMITTEE_GINDEX
+
+FinalityBranch = Vector[Bytes32, floorlog2(FINALIZED_ROOT_GINDEX)]
+CurrentSyncCommitteeBranch = Vector[
+    Bytes32, floorlog2(CURRENT_SYNC_COMMITTEE_GINDEX)]
+NextSyncCommitteeBranch = Vector[
+    Bytes32, floorlog2(NEXT_SYNC_COMMITTEE_GINDEX)]
+
+
+# ---------------------------------------------------------------------------
+# Containers (sync-protocol.md :85-171)
+# ---------------------------------------------------------------------------
+
+
+class LightClientHeader(Container):
+    beacon: BeaconBlockHeader
+
+
+class LightClientBootstrap(Container):
+    # Header matching the requested beacon block root
+    header: LightClientHeader
+    # Current sync committee corresponding to `header.beacon.state_root`
+    current_sync_committee: SyncCommittee
+    current_sync_committee_branch: CurrentSyncCommitteeBranch
+
+
+class LightClientUpdate(Container):
+    # Header attested to by the sync committee
+    attested_header: LightClientHeader
+    # Next sync committee corresponding to `attested_header.beacon.state_root`
+    next_sync_committee: SyncCommittee
+    next_sync_committee_branch: NextSyncCommitteeBranch
+    # Finalized header corresponding to `attested_header.beacon.state_root`
+    finalized_header: LightClientHeader
+    finality_branch: FinalityBranch
+    # Sync committee aggregate signature
+    sync_aggregate: SyncAggregate
+    # Slot at which the aggregate signature was created (untrusted)
+    signature_slot: Slot
+
+
+class LightClientFinalityUpdate(Container):
+    attested_header: LightClientHeader
+    finalized_header: LightClientHeader
+    finality_branch: FinalityBranch
+    sync_aggregate: SyncAggregate
+    signature_slot: Slot
+
+
+class LightClientOptimisticUpdate(Container):
+    attested_header: LightClientHeader
+    sync_aggregate: SyncAggregate
+    signature_slot: Slot
+
+
+@dataclass
+class LightClientStore(object):
+    # Header that is finalized
+    finalized_header: LightClientHeader
+    # Sync committees corresponding to the finalized header
+    current_sync_committee: SyncCommittee
+    next_sync_committee: SyncCommittee
+    # Best available header to switch finalized head to
+    best_valid_update: Optional[LightClientUpdate]
+    # Most recent available reasonably-safe header
+    optimistic_header: LightClientHeader
+    # Max committee participation seen (for the safety threshold)
+    previous_max_active_participants: uint64
+    current_max_active_participants: uint64
+
+
+# ---------------------------------------------------------------------------
+# Helpers (sync-protocol.md :173-320)
+# ---------------------------------------------------------------------------
+
+
+def finalized_root_gindex_at_slot(_slot: Slot):
+    return FINALIZED_ROOT_GINDEX
+
+
+def current_sync_committee_gindex_at_slot(_slot: Slot):
+    return CURRENT_SYNC_COMMITTEE_GINDEX
+
+
+def next_sync_committee_gindex_at_slot(_slot: Slot):
+    return NEXT_SYNC_COMMITTEE_GINDEX
+
+
+def is_valid_light_client_header(_header: LightClientHeader) -> bool:
+    return True
+
+
+def is_sync_committee_update(update: LightClientUpdate) -> bool:
+    return update.next_sync_committee_branch != NextSyncCommitteeBranch()
+
+
+def is_finality_update(update: LightClientUpdate) -> bool:
+    return update.finality_branch != FinalityBranch()
+
+
+def is_better_update(new_update: LightClientUpdate,
+                     old_update: LightClientUpdate) -> bool:
+    """Update ranking (sync-protocol.md :220-270): supermajority first,
+    then relevant-committee presence, finality, committee finality,
+    participation, and age tiebreakers."""
+    # Compare supermajority (> 2/3) sync committee participation
+    max_active_participants = len(new_update.sync_aggregate.sync_committee_bits)
+    new_num_active_participants = sum(
+        new_update.sync_aggregate.sync_committee_bits)
+    old_num_active_participants = sum(
+        old_update.sync_aggregate.sync_committee_bits)
+    new_has_supermajority = (new_num_active_participants * 3
+                             >= max_active_participants * 2)
+    old_has_supermajority = (old_num_active_participants * 3
+                             >= max_active_participants * 2)
+    if new_has_supermajority != old_has_supermajority:
+        return new_has_supermajority
+    if (not new_has_supermajority
+            and new_num_active_participants != old_num_active_participants):
+        return new_num_active_participants > old_num_active_participants
+
+    # Compare presence of relevant sync committee
+    new_has_relevant_sync_committee = is_sync_committee_update(new_update) and (
+        compute_sync_committee_period_at_slot(
+            new_update.attested_header.beacon.slot)
+        == compute_sync_committee_period_at_slot(new_update.signature_slot))
+    old_has_relevant_sync_committee = is_sync_committee_update(old_update) and (
+        compute_sync_committee_period_at_slot(
+            old_update.attested_header.beacon.slot)
+        == compute_sync_committee_period_at_slot(old_update.signature_slot))
+    if new_has_relevant_sync_committee != old_has_relevant_sync_committee:
+        return new_has_relevant_sync_committee
+
+    # Compare indication of any finality
+    new_has_finality = is_finality_update(new_update)
+    old_has_finality = is_finality_update(old_update)
+    if new_has_finality != old_has_finality:
+        return new_has_finality
+
+    # Compare sync committee finality
+    if new_has_finality:
+        new_has_sync_committee_finality = (
+            compute_sync_committee_period_at_slot(
+                new_update.finalized_header.beacon.slot)
+            == compute_sync_committee_period_at_slot(
+                new_update.attested_header.beacon.slot))
+        old_has_sync_committee_finality = (
+            compute_sync_committee_period_at_slot(
+                old_update.finalized_header.beacon.slot)
+            == compute_sync_committee_period_at_slot(
+                old_update.attested_header.beacon.slot))
+        if (new_has_sync_committee_finality
+                != old_has_sync_committee_finality):
+            return new_has_sync_committee_finality
+
+    # Tiebreaker 1: Sync committee participation beyond supermajority
+    if new_num_active_participants != old_num_active_participants:
+        return new_num_active_participants > old_num_active_participants
+
+    # Tiebreaker 2: Prefer older data (fewer changes to best)
+    if (new_update.attested_header.beacon.slot
+            != old_update.attested_header.beacon.slot):
+        return (new_update.attested_header.beacon.slot
+                < old_update.attested_header.beacon.slot)
+
+    # Tiebreaker 3: Prefer updates with earlier signature slots
+    return new_update.signature_slot < old_update.signature_slot
+
+
+def is_next_sync_committee_known(store: LightClientStore) -> bool:
+    return store.next_sync_committee != SyncCommittee()
+
+
+def get_safety_threshold(store: LightClientStore) -> uint64:
+    return max(store.previous_max_active_participants,
+               store.current_max_active_participants) // 2
+
+
+def get_subtree_index(generalized_index) -> uint64:
+    return uint64(generalized_index % 2**(floorlog2(generalized_index)))
+
+
+def is_valid_normalized_merkle_branch(leaf: Bytes32, branch,
+                                      gindex, root: Root) -> bool:
+    """Branch check tolerating zero-padded extra nodes in front (future
+    forks deepen the state tree; branches are normalized to max depth)."""
+    depth = floorlog2(gindex)
+    index = get_subtree_index(gindex)
+    num_extra = len(branch) - depth
+    for i in range(num_extra):
+        if branch[i] != Bytes32():
+            return False
+    return is_valid_merkle_branch(leaf, branch[num_extra:], depth, index, root)
+
+
+def compute_sync_committee_period_at_slot(slot: Slot) -> uint64:
+    return compute_sync_committee_period(compute_epoch_at_slot(slot))
+
+
+# ---------------------------------------------------------------------------
+# Initialization (sync-protocol.md :322-354)
+# ---------------------------------------------------------------------------
+
+
+def initialize_light_client_store(
+        trusted_block_root: Root,
+        bootstrap: LightClientBootstrap) -> LightClientStore:
+    assert is_valid_light_client_header(bootstrap.header)
+    assert hash_tree_root(bootstrap.header.beacon) == trusted_block_root
+
+    assert is_valid_normalized_merkle_branch(
+        leaf=hash_tree_root(bootstrap.current_sync_committee),
+        branch=bootstrap.current_sync_committee_branch,
+        gindex=current_sync_committee_gindex_at_slot(
+            bootstrap.header.beacon.slot),
+        root=bootstrap.header.beacon.state_root,
+    )
+
+    return LightClientStore(
+        finalized_header=bootstrap.header,
+        current_sync_committee=bootstrap.current_sync_committee,
+        next_sync_committee=SyncCommittee(),
+        best_valid_update=None,
+        optimistic_header=bootstrap.header,
+        previous_max_active_participants=0,
+        current_max_active_participants=0,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Update processing (sync-protocol.md :356-590)
+# ---------------------------------------------------------------------------
+
+
+def validate_light_client_update(store: LightClientStore,
+                                 update: LightClientUpdate,
+                                 current_slot: Slot,
+                                 genesis_validators_root: Root) -> None:
+    # Verify sync committee has sufficient participants
+    sync_aggregate = update.sync_aggregate
+    assert (sum(sync_aggregate.sync_committee_bits)
+            >= MIN_SYNC_COMMITTEE_PARTICIPANTS)
+
+    # Verify update does not skip a sync committee period
+    assert is_valid_light_client_header(update.attested_header)
+    update_attested_slot = update.attested_header.beacon.slot
+    update_finalized_slot = update.finalized_header.beacon.slot
+    assert (current_slot >= update.signature_slot
+            > update_attested_slot >= update_finalized_slot)
+    store_period = compute_sync_committee_period_at_slot(
+        store.finalized_header.beacon.slot)
+    update_signature_period = compute_sync_committee_period_at_slot(
+        update.signature_slot)
+    if is_next_sync_committee_known(store):
+        assert update_signature_period in (store_period, store_period + 1)
+    else:
+        assert update_signature_period == store_period
+
+    # Verify update is relevant
+    update_attested_period = compute_sync_committee_period_at_slot(
+        update_attested_slot)
+    update_has_next_sync_committee = (
+        not is_next_sync_committee_known(store)
+        and is_sync_committee_update(update)
+        and update_attested_period == store_period)
+    assert (update_attested_slot > store.finalized_header.beacon.slot
+            or update_has_next_sync_committee)
+
+    # Verify the finality branch confirms finalized_header to match the
+    # finalized checkpoint root of the attested state (genesis finalized
+    # root is the zero hash)
+    if not is_finality_update(update):
+        assert update.finalized_header == LightClientHeader()
+    else:
+        if update_finalized_slot == GENESIS_SLOT:
+            assert update.finalized_header == LightClientHeader()
+            finalized_root = Bytes32()
+        else:
+            assert is_valid_light_client_header(update.finalized_header)
+            finalized_root = hash_tree_root(update.finalized_header.beacon)
+        assert is_valid_normalized_merkle_branch(
+            leaf=finalized_root,
+            branch=update.finality_branch,
+            gindex=finalized_root_gindex_at_slot(
+                update.attested_header.beacon.slot),
+            root=update.attested_header.beacon.state_root,
+        )
+
+    # Verify the next_sync_committee is the one saved in the attested state
+    if not is_sync_committee_update(update):
+        assert update.next_sync_committee == SyncCommittee()
+    else:
+        if (update_attested_period == store_period
+                and is_next_sync_committee_known(store)):
+            assert update.next_sync_committee == store.next_sync_committee
+        assert is_valid_normalized_merkle_branch(
+            leaf=hash_tree_root(update.next_sync_committee),
+            branch=update.next_sync_committee_branch,
+            gindex=next_sync_committee_gindex_at_slot(
+                update.attested_header.beacon.slot),
+            root=update.attested_header.beacon.state_root,
+        )
+
+    # Verify sync committee aggregate signature
+    if update_signature_period == store_period:
+        sync_committee = store.current_sync_committee
+    else:
+        sync_committee = store.next_sync_committee
+    participant_pubkeys = [
+        pubkey for (bit, pubkey)
+        in zip(sync_aggregate.sync_committee_bits, sync_committee.pubkeys)
+        if bit
+    ]
+    fork_version_slot = max(update.signature_slot, Slot(1)) - Slot(1)
+    fork_version = compute_fork_version(
+        compute_epoch_at_slot(fork_version_slot))
+    domain = compute_domain(DOMAIN_SYNC_COMMITTEE, fork_version,
+                            genesis_validators_root)
+    signing_root = compute_signing_root(update.attested_header.beacon, domain)
+    assert bls.FastAggregateVerify(
+        participant_pubkeys, signing_root,
+        sync_aggregate.sync_committee_signature)
+
+
+def apply_light_client_update(store: LightClientStore,
+                              update: LightClientUpdate) -> None:
+    store_period = compute_sync_committee_period_at_slot(
+        store.finalized_header.beacon.slot)
+    update_finalized_period = compute_sync_committee_period_at_slot(
+        update.finalized_header.beacon.slot)
+    if not is_next_sync_committee_known(store):
+        assert update_finalized_period == store_period
+        store.next_sync_committee = update.next_sync_committee
+    elif update_finalized_period == store_period + 1:
+        store.current_sync_committee = store.next_sync_committee
+        store.next_sync_committee = update.next_sync_committee
+        store.previous_max_active_participants = (
+            store.current_max_active_participants)
+        store.current_max_active_participants = 0
+    if (update.finalized_header.beacon.slot
+            > store.finalized_header.beacon.slot):
+        store.finalized_header = update.finalized_header
+        if (store.finalized_header.beacon.slot
+                > store.optimistic_header.beacon.slot):
+            store.optimistic_header = store.finalized_header
+
+
+def process_light_client_store_force_update(store: LightClientStore,
+                                            current_slot: Slot) -> None:
+    """Forced best update after UPDATE_TIMEOUT: treats the attested
+    header as finalized to guarantee period progression during extended
+    non-finality (sync-protocol.md :483-499)."""
+    if (current_slot > store.finalized_header.beacon.slot + UPDATE_TIMEOUT
+            and store.best_valid_update is not None):
+        if (store.best_valid_update.finalized_header.beacon.slot
+                <= store.finalized_header.beacon.slot):
+            store.best_valid_update.finalized_header = (
+                store.best_valid_update.attested_header)
+        apply_light_client_update(store, store.best_valid_update)
+        store.best_valid_update = None
+
+
+def process_light_client_update(store: LightClientStore,
+                                update: LightClientUpdate,
+                                current_slot: Slot,
+                                genesis_validators_root: Root) -> None:
+    validate_light_client_update(store, update, current_slot,
+                                 genesis_validators_root)
+
+    sync_committee_bits = update.sync_aggregate.sync_committee_bits
+
+    # Track the best update for a potential forced update
+    if (store.best_valid_update is None
+            or is_better_update(update, store.best_valid_update)):
+        store.best_valid_update = update
+
+    # Track the maximum number of active participants
+    store.current_max_active_participants = max(
+        store.current_max_active_participants, sum(sync_committee_bits))
+
+    # Update the optimistic header
+    if (sum(sync_committee_bits) > get_safety_threshold(store)
+            and update.attested_header.beacon.slot
+            > store.optimistic_header.beacon.slot):
+        store.optimistic_header = update.attested_header
+
+    # Update finalized header
+    update_has_finalized_next_sync_committee = (
+        not is_next_sync_committee_known(store)
+        and is_sync_committee_update(update)
+        and is_finality_update(update)
+        and (compute_sync_committee_period_at_slot(
+                update.finalized_header.beacon.slot)
+             == compute_sync_committee_period_at_slot(
+                update.attested_header.beacon.slot)))
+    if (sum(sync_committee_bits) * 3 >= len(sync_committee_bits) * 2
+            and (update.finalized_header.beacon.slot
+                 > store.finalized_header.beacon.slot
+                 or update_has_finalized_next_sync_committee)):
+        # Normal update through 2/3 threshold
+        apply_light_client_update(store, update)
+        store.best_valid_update = None
+
+
+def process_light_client_finality_update(
+        store: LightClientStore,
+        finality_update: LightClientFinalityUpdate,
+        current_slot: Slot, genesis_validators_root: Root) -> None:
+    update = LightClientUpdate(
+        attested_header=finality_update.attested_header,
+        next_sync_committee=SyncCommittee(),
+        next_sync_committee_branch=NextSyncCommitteeBranch(),
+        finalized_header=finality_update.finalized_header,
+        finality_branch=finality_update.finality_branch,
+        sync_aggregate=finality_update.sync_aggregate,
+        signature_slot=finality_update.signature_slot,
+    )
+    process_light_client_update(store, update, current_slot,
+                                genesis_validators_root)
+
+
+def process_light_client_optimistic_update(
+        store: LightClientStore,
+        optimistic_update: LightClientOptimisticUpdate,
+        current_slot: Slot, genesis_validators_root: Root) -> None:
+    update = LightClientUpdate(
+        attested_header=optimistic_update.attested_header,
+        next_sync_committee=SyncCommittee(),
+        next_sync_committee_branch=NextSyncCommitteeBranch(),
+        finalized_header=LightClientHeader(),
+        finality_branch=FinalityBranch(),
+        sync_aggregate=optimistic_update.sync_aggregate,
+        signature_slot=optimistic_update.signature_slot,
+    )
+    process_light_client_update(store, update, current_slot,
+                                genesis_validators_root)
+
+
+# ---------------------------------------------------------------------------
+# Full node: deriving light client data (full-node.md :37-221)
+# ---------------------------------------------------------------------------
+
+
+def compute_merkle_proof(object, index):
+    """Branch for gindex `index` of an SSZ object (full-node.md :31)."""
+    return compute_merkle_proof_backing(object, index)
+
+
+def block_to_light_client_header(block: SignedBeaconBlock) -> LightClientHeader:
+    return LightClientHeader(
+        beacon=BeaconBlockHeader(
+            slot=block.message.slot,
+            proposer_index=block.message.proposer_index,
+            parent_root=block.message.parent_root,
+            state_root=block.message.state_root,
+            body_root=hash_tree_root(block.message.body),
+        ),
+    )
+
+
+def create_light_client_bootstrap(
+        state: BeaconState,
+        block: SignedBeaconBlock) -> LightClientBootstrap:
+    assert compute_epoch_at_slot(state.slot) >= config.ALTAIR_FORK_EPOCH
+
+    assert state.slot == state.latest_block_header.slot
+    header = state.latest_block_header.copy()
+    header.state_root = hash_tree_root(state)
+    assert hash_tree_root(header) == hash_tree_root(block.message)
+
+    return LightClientBootstrap(
+        header=block_to_light_client_header(block),
+        current_sync_committee=state.current_sync_committee,
+        current_sync_committee_branch=CurrentSyncCommitteeBranch(
+            compute_merkle_proof(
+                state, current_sync_committee_gindex_at_slot(state.slot))),
+    )
+
+
+def create_light_client_update(state: BeaconState, block: SignedBeaconBlock,
+                               attested_state: BeaconState,
+                               attested_block: SignedBeaconBlock,
+                               finalized_block) -> LightClientUpdate:
+    """Derive the period's LightClientUpdate from a block whose
+    sync_aggregate attests its parent (full-node.md :109-168)."""
+    assert (compute_epoch_at_slot(attested_state.slot)
+            >= config.ALTAIR_FORK_EPOCH)
+    assert (sum(block.message.body.sync_aggregate.sync_committee_bits)
+            >= MIN_SYNC_COMMITTEE_PARTICIPANTS)
+
+    assert state.slot == state.latest_block_header.slot
+    header = state.latest_block_header.copy()
+    header.state_root = hash_tree_root(state)
+    assert hash_tree_root(header) == hash_tree_root(block.message)
+    update_signature_period = compute_sync_committee_period_at_slot(
+        block.message.slot)
+
+    assert attested_state.slot == attested_state.latest_block_header.slot
+    attested_header = attested_state.latest_block_header.copy()
+    attested_header.state_root = hash_tree_root(attested_state)
+    assert (hash_tree_root(attested_header)
+            == hash_tree_root(attested_block.message)
+            == block.message.parent_root)
+    update_attested_period = compute_sync_committee_period_at_slot(
+        attested_block.message.slot)
+
+    update = LightClientUpdate()
+
+    update.attested_header = block_to_light_client_header(attested_block)
+
+    # next_sync_committee is only useful if signed by the current committee
+    if update_attested_period == update_signature_period:
+        update.next_sync_committee = attested_state.next_sync_committee
+        update.next_sync_committee_branch = NextSyncCommitteeBranch(
+            compute_merkle_proof(
+                attested_state,
+                next_sync_committee_gindex_at_slot(attested_state.slot)))
+
+    # Indicate finality whenever possible
+    if finalized_block is not None:
+        if finalized_block.message.slot != GENESIS_SLOT:
+            update.finalized_header = block_to_light_client_header(
+                finalized_block)
+            assert (hash_tree_root(update.finalized_header.beacon)
+                    == attested_state.finalized_checkpoint.root)
+        else:
+            assert attested_state.finalized_checkpoint.root == Bytes32()
+        update.finality_branch = FinalityBranch(
+            compute_merkle_proof(
+                attested_state,
+                finalized_root_gindex_at_slot(attested_state.slot)))
+
+    update.sync_aggregate = block.message.body.sync_aggregate
+    update.signature_slot = block.message.slot
+
+    return update
+
+
+def create_light_client_finality_update(
+        update: LightClientUpdate) -> LightClientFinalityUpdate:
+    return LightClientFinalityUpdate(
+        attested_header=update.attested_header,
+        finalized_header=update.finalized_header,
+        finality_branch=update.finality_branch,
+        sync_aggregate=update.sync_aggregate,
+        signature_slot=update.signature_slot,
+    )
+
+
+def create_light_client_optimistic_update(
+        update: LightClientUpdate) -> LightClientOptimisticUpdate:
+    return LightClientOptimisticUpdate(
+        attested_header=update.attested_header,
+        sync_aggregate=update.sync_aggregate,
+        signature_slot=update.signature_slot,
+    )

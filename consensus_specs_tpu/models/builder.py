@@ -231,9 +231,20 @@ def build_spec(fork: str, preset_name: str) -> Spec:
     return spec
 
 
+_OVERRIDE_SPEC_CACHE: dict[tuple, Spec] = {}
+
+
 def spec_with_config(spec: Spec, overrides: dict[str, Any]) -> Spec:
     """Fresh spec instance with config overrides (the reference's
-    `with_config_overrides` re-import, `test/context.py:663-734`)."""
+    `with_config_overrides` re-import, `test/context.py:663-734`).
+    Cached per (fork, preset, overrides) — rebuilding the namespace means
+    re-executing every spec source file."""
+    fp = tuple(sorted(
+        (k, bytes(v) if isinstance(v, bytes) else v)
+        for k, v in overrides.items()))
+    key = (spec.fork, spec.preset_name, fp)
+    if key in _OVERRIDE_SPEC_CACHE:
+        return _OVERRIDE_SPEC_CACHE[key]
     ns = _preamble_namespace()
     ns.update(load_preset(spec.preset_name, spec.fork))
     cfg = load_config(spec.preset_name)
@@ -242,4 +253,5 @@ def spec_with_config(spec: Spec, overrides: dict[str, Any]) -> Spec:
     _exec_sources(spec.fork, ns)
     fresh = Spec(spec.fork, spec.preset_name, ns)
     ns["spec"] = fresh
+    _OVERRIDE_SPEC_CACHE[key] = fresh
     return fresh

@@ -55,7 +55,13 @@ _GENESIS_CACHE: dict = {}
 def _cached_genesis(spec, balances_fn, threshold_fn):
     from .helpers.genesis import create_genesis_state
 
-    key = (spec.fork, spec.preset_name, spec.config.CONFIG_NAME,
+    # genesis content depends on config (fork versions), so fingerprint the
+    # whole config — override-carrying specs must not share a cache entry
+    # with the base config
+    cfg_fp = tuple(sorted(
+        (k, bytes(v) if isinstance(v, bytes) else v)
+        for k, v in spec.config.to_dict().items()))
+    key = (spec.fork, spec.preset_name, cfg_fp,
            balances_fn.__name__, threshold_fn.__name__)
     if key not in _GENESIS_CACHE:
         balances = balances_fn(spec)
@@ -229,6 +235,28 @@ def spec_configured_state_test(config_overrides, balances_fn=default_balances,
 
 def with_custom_state(balances_fn, threshold_fn):
     return lambda fn: with_state(balances_fn, threshold_fn)(fn)
+
+
+def spec_state_test_with_matching_config(fn):
+    """spec_state_test whose config declares every fork up to the tested
+    one active from genesis (`config_fork_epoch_overrides` +
+    `spec_state_test_with_matching_config`, `test/context.py:340-366`) —
+    needed by code that reads `config.<FORK>_FORK_EPOCH`, e.g. the light
+    client protocol."""
+    from ..models.builder import fork_chain
+
+    @functools.wraps(fn)
+    def wrapper(*args, spec, generator_mode=False, **kwargs):
+        overrides = {}
+        for f in fork_chain(spec.fork):
+            if f != "phase0":
+                overrides[f.upper() + "_FORK_EPOCH"] = 0
+        overridden = spec_with_config(spec, overrides) if overrides else spec
+        inner = with_state()(fn)
+        return vector_test(inner)(*args, spec=overridden,
+                                  generator_mode=generator_mode, **kwargs)
+
+    return wrapper
 
 
 def _bls_switch(value):

@@ -20,19 +20,34 @@ def run_attestation_processing(spec, state, attestation, valid=True):
         yield "post", None
         return
 
-    if attestation.data.target.epoch == spec.get_current_epoch(state):
-        current_epoch_count = len(state.current_epoch_attestations)
-    else:
-        previous_epoch_count = len(state.previous_epoch_attestations)
+    from .forks import is_post_altair
+
+    is_current = (attestation.data.target.epoch
+                  == spec.get_current_epoch(state))
+    if not is_post_altair(spec):
+        # phase0 appends a PendingAttestation to the epoch's list
+        if is_current:
+            pre_count = len(state.current_epoch_attestations)
+        else:
+            pre_count = len(state.previous_epoch_attestations)
 
     spec.process_attestation(state, attestation)
 
-    if attestation.data.target.epoch == spec.get_current_epoch(state):
-        assert (len(state.current_epoch_attestations)
-                == current_epoch_count + 1)
+    if not is_post_altair(spec):
+        if is_current:
+            assert len(state.current_epoch_attestations) == pre_count + 1
+        else:
+            assert len(state.previous_epoch_attestations) == pre_count + 1
     else:
-        assert (len(state.previous_epoch_attestations)
-                == previous_epoch_count + 1)
+        # altair+ sets participation flags for the attesting indices
+        participation = (state.current_epoch_participation if is_current
+                         else state.previous_epoch_participation)
+        flag_indices = spec.get_attestation_participation_flag_indices(
+            state, attestation.data,
+            state.slot - attestation.data.slot)
+        for index in spec.get_attesting_indices(state, attestation):
+            for flag_index in flag_indices:
+                assert spec.has_flag(participation[index], flag_index)
 
     yield "post", state
 

@@ -42,6 +42,13 @@ def build_empty_block(spec, state, slot=None, proposer_index=None):
             ),
         ),
     )
+    from .forks import is_post_altair
+
+    if is_post_altair(spec):
+        # An empty sync aggregate (no participants) carries the point at
+        # infinity, which eth_fast_aggregate_verify accepts
+        block.body.sync_aggregate.sync_committee_signature = (
+            spec.G2_POINT_AT_INFINITY)
     return block
 
 

@@ -1,0 +1,70 @@
+"""Epoch-processing slicing: run the epoch pipeline up to / through one
+sub-transition (mirrors `test/helpers/epoch_processing.py:7-104`)."""
+
+from __future__ import annotations
+
+from .forks import is_post_altair
+
+
+def get_process_calls(spec):
+    """Ordered sub-transition names of `process_epoch` for this fork."""
+    if is_post_altair(spec):
+        return [
+            "process_justification_and_finalization",
+            "process_inactivity_updates",
+            "process_rewards_and_penalties",
+            "process_registry_updates",
+            "process_slashings",
+            "process_eth1_data_reset",
+            "process_effective_balance_updates",
+            "process_slashings_reset",
+            "process_randao_mixes_reset",
+            "process_historical_roots_update",
+            "process_participation_flag_updates",
+            "process_sync_committee_updates",
+        ]
+    return [
+        "process_justification_and_finalization",
+        "process_rewards_and_penalties",
+        "process_registry_updates",
+        "process_slashings",
+        "process_eth1_data_reset",
+        "process_effective_balance_updates",
+        "process_slashings_reset",
+        "process_randao_mixes_reset",
+        "process_historical_roots_update",
+        "process_participation_record_updates",
+    ]
+
+
+def run_epoch_processing_to(spec, state, process_name: str):
+    """Advance to the last slot of the epoch and run the pipeline UP TO
+    (not including) `process_name`."""
+    slot = state.slot + (spec.SLOTS_PER_EPOCH
+                         - state.slot % spec.SLOTS_PER_EPOCH)
+    # transition to the last slot of the epoch
+    if state.slot < slot - 1:
+        spec.process_slots(state, slot - 1)
+    # start the epoch transition, stopping before `process_name`
+    for name in get_process_calls(spec):
+        if name == process_name:
+            break
+        getattr(spec, name)(state)
+
+
+def run_epoch_processing_with(spec, state, process_name: str):
+    """Yield-protocol: pre -> run `process_name` -> post."""
+    run_epoch_processing_to(spec, state, process_name)
+    yield "pre", state
+    getattr(spec, process_name)(state)
+    yield "post", state
+
+
+def run_epoch_processing_from(spec, state, process_name: str):
+    """Run the pipeline FROM `process_name` (inclusive) to the end."""
+    hit = False
+    for name in get_process_calls(spec):
+        if name == process_name:
+            hit = True
+        if hit:
+            getattr(spec, name)(state)

@@ -1,0 +1,51 @@
+"""Fork predicates (mirrors `test/helpers/forks.py`)."""
+
+from __future__ import annotations
+
+from ...models.builder import ALL_FORKS, PREVIOUS_FORK_OF
+
+
+def is_post_fork(a: str, b: str) -> bool:
+    """True if fork `a` is `b` or later."""
+    f: str | None = a
+    while f is not None:
+        if f == b:
+            return True
+        f = PREVIOUS_FORK_OF.get(f)
+    return False
+
+
+def is_post_altair(spec) -> bool:
+    return is_post_fork(spec.fork, "altair")
+
+
+def is_post_bellatrix(spec) -> bool:
+    return is_post_fork(spec.fork, "bellatrix")
+
+
+def is_post_capella(spec) -> bool:
+    return is_post_fork(spec.fork, "capella")
+
+
+def is_post_deneb(spec) -> bool:
+    return is_post_fork(spec.fork, "deneb")
+
+
+def is_post_electra(spec) -> bool:
+    return is_post_fork(spec.fork, "electra")
+
+
+def is_post_fulu(spec) -> bool:
+    return is_post_fork(spec.fork, "fulu")
+
+
+def get_spec_for_fork_version(spec, fork_version):
+    """Name of the fork whose version equals `fork_version` in config."""
+    for fork in ALL_FORKS:
+        if fork == "phase0":
+            key = "GENESIS_FORK_VERSION"
+        else:
+            key = f"{fork.upper()}_FORK_VERSION"
+        if getattr(spec.config, key, None) == fork_version:
+            return fork
+    raise ValueError(f"unknown fork version {fork_version!r}")

@@ -6,7 +6,27 @@ deposit path is exercised by the genesis initialization tests instead.
 
 from __future__ import annotations
 
+from .forks import is_post_altair
 from .keys import pubkey
+
+
+def _fork_version_of(spec):
+    """(previous_version, current_version) for a genesis state of this
+    spec's fork (the reference sets versions per fork in
+    `helpers/genesis.py create_genesis_state`)."""
+    cfg = spec.config
+    if spec.fork == "phase0":
+        return cfg.GENESIS_FORK_VERSION, cfg.GENESIS_FORK_VERSION
+    chain = []
+    from ...models.builder import fork_chain
+
+    names = fork_chain(spec.fork)
+    for name in names:
+        if name == "phase0":
+            chain.append(cfg.GENESIS_FORK_VERSION)
+        else:
+            chain.append(getattr(cfg, f"{name.upper()}_FORK_VERSION"))
+    return chain[-2], chain[-1]
 
 
 def build_mock_validator(spec, i: int, balance: int,
@@ -30,6 +50,7 @@ def build_mock_validator(spec, i: int, balance: int,
 def create_genesis_state(spec, validator_balances, activation_threshold):
     deposit_root = b"\x42" * 32
     eth1_block_hash = b"\xda" * 32
+    previous_version, current_version = _fork_version_of(spec)
     state = spec.BeaconState(
         genesis_time=0,
         eth1_deposit_index=len(validator_balances),
@@ -39,8 +60,8 @@ def create_genesis_state(spec, validator_balances, activation_threshold):
             block_hash=eth1_block_hash,
         ),
         fork=spec.Fork(
-            previous_version=spec.config.GENESIS_FORK_VERSION,
-            current_version=spec.config.GENESIS_FORK_VERSION,
+            previous_version=previous_version,
+            current_version=current_version,
             epoch=spec.GENESIS_EPOCH,
         ),
         latest_block_header=spec.BeaconBlockHeader(
@@ -56,7 +77,18 @@ def create_genesis_state(spec, validator_balances, activation_threshold):
             v.activation_epoch = spec.GENESIS_EPOCH
         state.validators.append(v)
         state.balances.append(balance)
+        if is_post_altair(spec):
+            state.previous_epoch_participation.append(
+                spec.ParticipationFlags(0))
+            state.current_epoch_participation.append(
+                spec.ParticipationFlags(0))
+            state.inactivity_scores.append(spec.uint64(0))
 
     state.genesis_validators_root = spec.hash_tree_root(state.validators)
+
+    if is_post_altair(spec):
+        # Fill in sync committees (duplicate committee at genesis)
+        state.current_sync_committee = spec.get_next_sync_committee(state)
+        state.next_sync_committee = spec.get_next_sync_committee(state)
 
     return state

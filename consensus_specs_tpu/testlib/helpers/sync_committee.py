@@ -1,0 +1,156 @@
+"""Sync-committee test helpers (mirrors `test/helpers/sync_committee.py`)."""
+
+from __future__ import annotations
+
+from ...ops import bls
+from ..utils import expect_assertion_error
+from .block import build_empty_block_for_next_slot
+from .keys import privkeys, pubkey_to_privkey
+
+
+def compute_sync_committee_signature(spec, state, slot, privkey_int,
+                                     block_root=None, domain_type=None):
+    """One member's signature over the block root at `slot`."""
+    domain = spec.get_domain(state, domain_type or spec.DOMAIN_SYNC_COMMITTEE,
+                             spec.compute_epoch_at_slot(slot))
+    if block_root is None:
+        block_root = spec.get_block_root_at_slot(state, slot)
+    signing_root = spec.compute_signing_root(block_root, domain)
+    return bls.Sign(privkey_int, signing_root)
+
+
+def compute_aggregate_sync_committee_signature(spec, state, slot,
+                                               participants,
+                                               block_root=None):
+    """Aggregate signature of `participants` (validator indices) over the
+    block root at `slot`."""
+    if len(participants) == 0:
+        return spec.G2_POINT_AT_INFINITY
+
+    signatures = []
+    for validator_index in participants:
+        privkey_int = privkeys[validator_index]
+        signatures.append(compute_sync_committee_signature(
+            spec, state, slot, privkey_int, block_root=block_root))
+    return bls.Aggregate(signatures)
+
+
+def compute_committee_indices(state, committee=None):
+    """Validator registry indices of the sync committee members."""
+    if committee is None:
+        committee = state.current_sync_committee
+    all_pubkeys = [v.pubkey for v in state.validators]
+    return [all_pubkeys.index(pubkey) for pubkey in committee.pubkeys]
+
+
+def get_sync_aggregate(spec, state, num_participants=None, signature_slot=None):
+    """A valid SyncAggregate for the *current* state slot (signing the
+    previous slot's block root), with the first `num_participants`
+    members participating."""
+    if signature_slot is None:
+        signature_slot = state.slot
+    previous_slot = max(int(signature_slot), 1) - 1
+    committee_indices = compute_committee_indices(state)
+    if num_participants is None:
+        num_participants = len(committee_indices)
+    assert 0 <= num_participants <= len(committee_indices)
+
+    participants = committee_indices[:num_participants]
+    bits = [i < num_participants for i in range(len(committee_indices))]
+    signature = compute_aggregate_sync_committee_signature(
+        spec, state, spec.Slot(previous_slot), participants,
+        block_root=spec.get_block_root_at_slot(state, previous_slot))
+    return spec.SyncAggregate(
+        sync_committee_bits=bits,
+        sync_committee_signature=signature,
+    )
+
+
+def run_sync_committee_processing(spec, state, block, expect_exception=False):
+    """Process the block's sync aggregate; yields the operation-test
+    vector parts."""
+    pre_state = state.copy()
+    yield "pre", state
+    yield "sync_aggregate", block.body.sync_aggregate
+    if expect_exception:
+        expect_assertion_error(
+            lambda: spec.process_sync_aggregate(
+                state, block.body.sync_aggregate))
+        yield "post", None
+    else:
+        spec.process_sync_aggregate(state, block.body.sync_aggregate)
+        yield "post", state
+        validate_sync_committee_rewards(
+            spec, pre_state, state,
+            committee_indices=compute_committee_indices(pre_state),
+            committee_bits=block.body.sync_aggregate.sync_committee_bits,
+            proposer_index=spec.get_beacon_proposer_index(state))
+
+
+def compute_sync_committee_participant_reward_and_penalty(
+        spec, state, participant_index, committee_indices, committee_bits):
+    """(reward, penalty) a member accrues in one process_sync_aggregate
+    (mirrors `helpers/sync_committee.py` reward math)."""
+    total_active_increments = (spec.get_total_active_balance(state)
+                               // spec.EFFECTIVE_BALANCE_INCREMENT)
+    total_base_rewards = (spec.get_base_reward_per_increment(state)
+                          * total_active_increments)
+    max_participant_rewards = (total_base_rewards * spec.SYNC_REWARD_WEIGHT
+                               // spec.WEIGHT_DENOMINATOR
+                               // spec.SLOTS_PER_EPOCH)
+    participant_reward = max_participant_rewards // spec.SYNC_COMMITTEE_SIZE
+
+    included = sum(1 for i, bit in zip(committee_indices, committee_bits)
+                   if bit and i == participant_index)
+    excluded = sum(1 for i, bit in zip(committee_indices, committee_bits)
+                   if not bit and i == participant_index)
+    return (spec.Gwei(included * participant_reward),
+            spec.Gwei(excluded * participant_reward))
+
+
+def compute_sync_committee_proposer_reward(spec, state, committee_indices,
+                                           committee_bits):
+    total_active_increments = (spec.get_total_active_balance(state)
+                               // spec.EFFECTIVE_BALANCE_INCREMENT)
+    total_base_rewards = (spec.get_base_reward_per_increment(state)
+                          * total_active_increments)
+    max_participant_rewards = (total_base_rewards * spec.SYNC_REWARD_WEIGHT
+                               // spec.WEIGHT_DENOMINATOR
+                               // spec.SLOTS_PER_EPOCH)
+    participant_reward = max_participant_rewards // spec.SYNC_COMMITTEE_SIZE
+    proposer_reward = (participant_reward * spec.PROPOSER_WEIGHT
+                       // (spec.WEIGHT_DENOMINATOR - spec.PROPOSER_WEIGHT))
+    return spec.Gwei(sum(bool(b) for b in committee_bits) * proposer_reward)
+
+
+def validate_sync_committee_rewards(spec, pre_state, post_state,
+                                    committee_indices, committee_bits,
+                                    proposer_index):
+    for index in range(len(post_state.validators)):
+        reward = spec.Gwei(0)
+        penalty = spec.Gwei(0)
+        if index in committee_indices:
+            r, p = compute_sync_committee_participant_reward_and_penalty(
+                spec, pre_state, index, committee_indices, committee_bits)
+            reward += r
+            penalty += p
+        if proposer_index == index:
+            reward += compute_sync_committee_proposer_reward(
+                spec, pre_state, committee_indices, committee_bits)
+        assert (post_state.balances[index]
+                == pre_state.balances[index] + reward - penalty)
+
+
+def run_successful_sync_committee_test(spec, state, committee_indices,
+                                       committee_bits):
+    block = build_empty_block_for_next_slot(spec, state)
+    # advance first: the committee signs the block root at `slot - 1`,
+    # which is only in `state.block_roots` once the state is at `slot`
+    spec.process_slots(state, block.slot)
+    block.body.sync_aggregate = spec.SyncAggregate(
+        sync_committee_bits=committee_bits,
+        sync_committee_signature=compute_aggregate_sync_committee_signature(
+            spec, state, block.slot - 1,
+            [i for i, bit in zip(committee_indices, committee_bits) if bit]),
+    )
+    yield from run_sync_committee_processing(spec, state, block)

@@ -98,8 +98,20 @@ class View:
             if isinstance(value, cls):
                 return value  # subclass instance (custom-type alias), keep
             if not isinstance(value, (int, bytes)):
+                # Same-named foreign type: each fork's spec is built in its
+                # own namespace, so e.g. a phase0 BeaconBlockHeader is a
+                # different class from altair's.  Cross-fork upgrade
+                # functions hand such values over; round-trip through the
+                # wire format.  Differently-named types stay a TypeError —
+                # a byte-compatible reinterpretation would be corruption.
+                if type(value).__name__ == cls.__name__:
+                    try:
+                        return cls.decode_bytes(value.encode_bytes())
+                    except Exception:
+                        pass
                 raise TypeError(
-                    f"cannot coerce {type(value).__name__} to {cls.__name__}")
+                    f"cannot coerce {type(value).__name__} "
+                    f"to {cls.__name__}")
         return cls(value)  # type: ignore[call-arg]
 
 
@@ -142,9 +154,13 @@ class MutableView(View):
         raise NotImplementedError
 
     def __eq__(self, other):
+        # Compare by class *name*, not identity: every fork's spec builds
+        # its containers in a separate namespace, and cross-fork code
+        # (upgrade fns, transition tests) must see e.g. a phase0
+        # Checkpoint(1, r) as equal to an altair Checkpoint(1, r).
         return (
             isinstance(other, View)
-            and type(other) is type(self)
+            and type(other).__name__ == type(self).__name__
             and other.hash_tree_root() == self.hash_tree_root()
         )
 

@@ -1,0 +1,114 @@
+"""Altair: process_sync_aggregate
+(parity: `test/altair/block_processing/sync_aggregate/*`)."""
+
+from consensus_specs_tpu.testlib.context import (
+    ALTAIR,
+    always_bls,
+    spec_state_test,
+    with_all_phases_from,
+)
+from consensus_specs_tpu.testlib.helpers.block import (
+    build_empty_block_for_next_slot,
+)
+from consensus_specs_tpu.testlib.helpers.sync_committee import (
+    compute_aggregate_sync_committee_signature,
+    compute_committee_indices,
+    run_sync_committee_processing,
+    run_successful_sync_committee_test,
+)
+
+with_altair_and_later = with_all_phases_from(ALTAIR)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_sync_committee_rewards_full_participation(spec, state):
+    committee_indices = compute_committee_indices(state)
+    committee_bits = [True] * len(committee_indices)
+    yield from run_successful_sync_committee_test(
+        spec, state, committee_indices, committee_bits)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_sync_committee_rewards_empty_participants(spec, state):
+    committee_indices = compute_committee_indices(state)
+    committee_bits = [False] * len(committee_indices)
+    yield from run_successful_sync_committee_test(
+        spec, state, committee_indices, committee_bits)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_sync_committee_rewards_half_participation(spec, state):
+    committee_indices = compute_committee_indices(state)
+    size = len(committee_indices)
+    committee_bits = [i < size // 2 for i in range(size)]
+    yield from run_successful_sync_committee_test(
+        spec, state, committee_indices, committee_bits)
+
+
+@with_altair_and_later
+@spec_state_test
+@always_bls
+def test_invalid_signature_bad_domain(spec, state):
+    committee_indices = compute_committee_indices(state)
+
+    block = build_empty_block_for_next_slot(spec, state)
+    spec.process_slots(state, block.slot)
+    block.body.sync_aggregate = spec.SyncAggregate(
+        sync_committee_bits=[True] * len(committee_indices),
+        sync_committee_signature=compute_aggregate_sync_committee_signature(
+            spec, state, block.slot - 1, committee_indices,
+            block_root=spec.Root(b"\x12" * 32)),  # wrong message
+    )
+    yield from run_sync_committee_processing(spec, state, block,
+                                             expect_exception=True)
+
+
+@with_altair_and_later
+@spec_state_test
+@always_bls
+def test_invalid_signature_missing_participant(spec, state):
+    committee_indices = compute_committee_indices(state)
+
+    block = build_empty_block_for_next_slot(spec, state)
+    spec.process_slots(state, block.slot)
+    # Bits claim full participation but one member did not sign
+    block.body.sync_aggregate = spec.SyncAggregate(
+        sync_committee_bits=[True] * len(committee_indices),
+        sync_committee_signature=compute_aggregate_sync_committee_signature(
+            spec, state, block.slot - 1, committee_indices[1:]),
+    )
+    yield from run_sync_committee_processing(spec, state, block,
+                                             expect_exception=True)
+
+
+@with_altair_and_later
+@spec_state_test
+@always_bls
+def test_invalid_signature_extra_participant(spec, state):
+    committee_indices = compute_committee_indices(state)
+
+    block = build_empty_block_for_next_slot(spec, state)
+    spec.process_slots(state, block.slot)
+    # One member signed but is not in the bits
+    block.body.sync_aggregate = spec.SyncAggregate(
+        sync_committee_bits=[False] + [True] * (len(committee_indices) - 1),
+        sync_committee_signature=compute_aggregate_sync_committee_signature(
+            spec, state, block.slot - 1, committee_indices),
+    )
+    yield from run_sync_committee_processing(spec, state, block,
+                                             expect_exception=True)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_proposer_in_committee_without_participation(spec, state):
+    """The proposer may be a committee member; rewards must still settle
+    per the pre-state committee."""
+    committee_indices = compute_committee_indices(state)
+    size = len(committee_indices)
+    committee_bits = [i % 2 == 0 for i in range(size)]
+    yield from run_successful_sync_committee_test(
+        spec, state, committee_indices, committee_bits)

@@ -1,0 +1,86 @@
+"""Altair: process_inactivity_updates
+(parity: `test/altair/epoch_processing/test_process_inactivity_updates.py`)."""
+
+from consensus_specs_tpu.testlib.context import (
+    ALTAIR,
+    spec_state_test,
+    with_all_phases_from,
+)
+from consensus_specs_tpu.testlib.helpers.attestations import (
+    next_epoch_with_attestations,
+)
+from consensus_specs_tpu.testlib.helpers.epoch_processing import (
+    run_epoch_processing_with,
+)
+from consensus_specs_tpu.testlib.helpers.state import (
+    next_epoch,
+    next_epoch_via_block,
+)
+
+with_altair_and_later = with_all_phases_from(ALTAIR)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_genesis_epoch_no_updates(spec, state):
+    assert spec.get_current_epoch(state) == spec.GENESIS_EPOCH
+    pre_scores = list(state.inactivity_scores)
+    yield from run_epoch_processing_with(
+        spec, state, "process_inactivity_updates")
+    assert list(state.inactivity_scores) == pre_scores
+
+
+@with_altair_and_later
+@spec_state_test
+def test_all_zero_inactivity_scores_full_participation(spec, state):
+    # A full epoch of attestations, then the next epoch's update
+    _, _, state = next_epoch_with_attestations(spec, state, True, False)
+    state.inactivity_scores = [0] * len(state.validators)
+    yield from run_epoch_processing_with(
+        spec, state, "process_inactivity_updates")
+    assert all(score == 0 for score in state.inactivity_scores)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_all_zero_inactivity_scores_empty_participation(spec, state):
+    # Advance without any attestations: everyone is inactive
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    state.inactivity_scores = [0] * len(state.validators)
+    # not in leak yet (only 2 epochs since finality): bias up then
+    # recovery down nets to zero... unless leaking
+    leaking = spec.is_in_inactivity_leak(state)
+    yield from run_epoch_processing_with(
+        spec, state, "process_inactivity_updates")
+    expected = int(spec.config.INACTIVITY_SCORE_BIAS)
+    if not leaking:
+        expected -= min(int(spec.config.INACTIVITY_SCORE_RECOVERY_RATE),
+                        expected)
+    for index in spec.get_eligible_validator_indices(state):
+        assert state.inactivity_scores[index] == expected
+
+
+@with_altair_and_later
+@spec_state_test
+def test_random_inactivity_scores_leaking(spec, state):
+    # Go deep into an inactivity leak
+    for _ in range(int(spec.MIN_EPOCHS_TO_INACTIVITY_PENALTY) + 3):
+        next_epoch(spec, state)
+    assert spec.is_in_inactivity_leak(state)
+
+    import random
+
+    rng = random.Random(10101)
+    state.inactivity_scores = [rng.randint(0, 100)
+                               for _ in range(len(state.validators))]
+    pre_scores = list(state.inactivity_scores)
+
+    yield from run_epoch_processing_with(
+        spec, state, "process_inactivity_updates")
+
+    # Nobody participated: each eligible validator's score rises by BIAS
+    # with no recovery (leak active)
+    for index in spec.get_eligible_validator_indices(state):
+        assert (state.inactivity_scores[index]
+                == pre_scores[index] + int(spec.config.INACTIVITY_SCORE_BIAS))

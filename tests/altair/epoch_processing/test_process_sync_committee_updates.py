@@ -1,0 +1,48 @@
+"""Altair: process_sync_committee_updates
+(parity: `test/altair/epoch_processing/test_process_sync_committee_updates.py`)."""
+
+from consensus_specs_tpu.testlib.context import (
+    ALTAIR,
+    spec_state_test,
+    with_all_phases_from,
+)
+from consensus_specs_tpu.testlib.helpers.epoch_processing import (
+    run_epoch_processing_with,
+)
+from consensus_specs_tpu.testlib.helpers.state import next_epoch
+
+with_altair_and_later = with_all_phases_from(ALTAIR)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_sync_committees_progress_not_at_period_boundary(spec, state):
+    assert spec.EPOCHS_PER_SYNC_COMMITTEE_PERIOD > 1
+    first_sync_committee = state.current_sync_committee.copy()
+    next_sync_committee = state.next_sync_committee.copy()
+
+    yield from run_epoch_processing_with(
+        spec, state, "process_sync_committee_updates")
+
+    # Not a boundary: committees unchanged
+    assert state.current_sync_committee == first_sync_committee
+    assert state.next_sync_committee == next_sync_committee
+
+
+@with_altair_and_later
+@spec_state_test
+def test_sync_committees_progress_at_period_boundary(spec, state):
+    first_sync_committee = state.current_sync_committee.copy()
+    next_sync_committee = state.next_sync_committee.copy()
+
+    # Advance to the last epoch of the period
+    for _ in range(int(spec.EPOCHS_PER_SYNC_COMMITTEE_PERIOD) - 1):
+        next_epoch(spec, state)
+
+    yield from run_epoch_processing_with(
+        spec, state, "process_sync_committee_updates")
+
+    # Rotation happened
+    assert state.current_sync_committee == next_sync_committee
+    expected_next = spec.get_next_sync_committee(state)
+    assert state.next_sync_committee == expected_next

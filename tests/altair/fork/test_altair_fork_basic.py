@@ -1,0 +1,83 @@
+"""Altair fork upgrade: phase0 state -> altair state
+(parity: `test/altair/fork/test_altair_fork_basic.py`)."""
+
+from consensus_specs_tpu.models.builder import build_spec
+from consensus_specs_tpu.testlib.context import (
+    ALTAIR,
+    spec_state_test,
+    with_phases,
+)
+from consensus_specs_tpu.testlib.helpers.attestations import (
+    next_epoch_with_attestations,
+)
+from consensus_specs_tpu.testlib.helpers.state import next_epoch
+
+
+def _phase0_state_for(spec, state):
+    """Rebuild this (altair-typed) genesis state as a phase0 state."""
+    phase0_spec = build_spec("phase0", spec.preset_name)
+    from consensus_specs_tpu.testlib.helpers.genesis import (
+        create_genesis_state)
+
+    balances = [int(b) for b in state.balances]
+    return phase0_spec, create_genesis_state(
+        phase0_spec, balances, phase0_spec.MAX_EFFECTIVE_BALANCE)
+
+
+def _check_upgrade(spec, pre_spec, pre, post):
+    # Immutable identity carried over
+    assert post.genesis_time == pre.genesis_time
+    assert post.genesis_validators_root == pre.genesis_validators_root
+    assert post.slot == pre.slot
+    assert post.fork.previous_version == pre.fork.current_version
+    assert post.fork.current_version == spec.config.ALTAIR_FORK_VERSION
+    assert len(post.validators) == len(pre.validators)
+    assert [bytes(v.pubkey) for v in post.validators] == \
+        [bytes(v.pubkey) for v in pre.validators]
+    assert list(post.balances) == list(pre.balances)
+    # Fresh altair-only state
+    assert len(post.inactivity_scores) == len(post.validators)
+    assert all(score == 0 for score in post.inactivity_scores)
+    assert len(post.previous_epoch_participation) == len(post.validators)
+    assert len(post.current_epoch_participation) == len(post.validators)
+    assert all(f == 0 for f in post.current_epoch_participation)
+    # Sync committees filled (duplicate committee at the boundary)
+    assert post.current_sync_committee == post.next_sync_committee
+
+
+@with_phases([ALTAIR])
+@spec_state_test
+def test_fork_base_state(spec, state):
+    pre_spec, pre = _phase0_state_for(spec, state)
+    yield "pre", pre
+    post = spec.upgrade_to_altair(pre)
+    yield "post", post
+    _check_upgrade(spec, pre_spec, pre, post)
+
+
+@with_phases([ALTAIR])
+@spec_state_test
+def test_fork_next_epoch(spec, state):
+    pre_spec, pre = _phase0_state_for(spec, state)
+    next_epoch(pre_spec, pre)
+    yield "pre", pre
+    post = spec.upgrade_to_altair(pre)
+    yield "post", post
+    _check_upgrade(spec, pre_spec, pre, post)
+
+
+@with_phases([ALTAIR])
+@spec_state_test
+def test_fork_with_attestations_translates_participation(spec, state):
+    """Pending phase0 attestations become previous-epoch participation
+    flags in the upgraded state."""
+    pre_spec, pre = _phase0_state_for(spec, state)
+    _, _, pre = next_epoch_with_attestations(pre_spec, pre, True, False)
+    assert len(pre.previous_epoch_attestations) > 0
+
+    yield "pre", pre
+    post = spec.upgrade_to_altair(pre)
+    yield "post", post
+    _check_upgrade(spec, pre_spec, pre, post)
+    # Some validators got their flags translated
+    assert any(int(f) != 0 for f in post.previous_epoch_participation)

@@ -1,0 +1,115 @@
+"""Altair light client: bootstrap + update processing
+(parity: `test/altair/light_client/test_sync_protocol.py`)."""
+
+from consensus_specs_tpu.testlib.context import (
+    ALTAIR,
+    spec_state_test_with_matching_config,
+    with_all_phases_from,
+)
+from consensus_specs_tpu.testlib.helpers.block import (
+    build_empty_block_for_next_slot,
+)
+from consensus_specs_tpu.testlib.helpers.state import (
+    next_slots,
+    state_transition_and_sign_block,
+)
+from consensus_specs_tpu.testlib.helpers.sync_committee import (
+    compute_aggregate_sync_committee_signature,
+    compute_committee_indices,
+)
+
+with_altair_and_later = with_all_phases_from(ALTAIR)
+
+
+def _genesis_block(spec, state):
+    return spec.SignedBeaconBlock(
+        message=spec.BeaconBlock(state_root=spec.hash_tree_root(state)))
+
+
+def _bootstrap_store(spec, state):
+    block = _genesis_block(spec, state)
+    bootstrap = spec.create_light_client_bootstrap(state.copy(), block)
+    trusted_root = spec.hash_tree_root(block.message)
+    return spec.initialize_light_client_store(trusted_root, bootstrap), block
+
+
+def _apply_block_with_sync_aggregate(spec, state):
+    """Apply one block whose sync_aggregate attests the previous block."""
+    block = build_empty_block_for_next_slot(spec, state)
+    signing_state = state.copy()
+    spec.process_slots(signing_state, block.slot)
+    committee_indices = compute_committee_indices(signing_state)
+    block.body.sync_aggregate = spec.SyncAggregate(
+        sync_committee_bits=[True] * len(committee_indices),
+        sync_committee_signature=compute_aggregate_sync_committee_signature(
+            spec, signing_state, block.slot - 1, committee_indices),
+    )
+    return state_transition_and_sign_block(spec, state, block)
+
+
+@with_altair_and_later
+@spec_state_test_with_matching_config
+def test_light_client_bootstrap(spec, state):
+    store, block = _bootstrap_store(spec, state)
+    yield "bootstrap_state", state
+    assert store.finalized_header.beacon.slot == state.slot
+    assert store.current_sync_committee == state.current_sync_committee
+    # next committee unknown from a bootstrap
+    assert not spec.is_next_sync_committee_known(store)
+    assert store.best_valid_update is None
+
+
+@with_altair_and_later
+@spec_state_test_with_matching_config
+def test_light_client_optimistic_progression(spec, state):
+    store, _ = _bootstrap_store(spec, state)
+    yield "bootstrap_state", state
+
+    # attested block then signature block
+    signed_attested = _apply_block_with_sync_aggregate(spec, state)
+    attested_state = state.copy()
+    signed_sig_block = _apply_block_with_sync_aggregate(spec, state)
+
+    update = spec.create_light_client_update(
+        state, signed_sig_block, attested_state, signed_attested, None)
+
+    current_slot = state.slot
+    spec.process_light_client_update(
+        store, update, current_slot, state.genesis_validators_root)
+
+    # Full participation: the optimistic header advances to the attested
+    assert (store.optimistic_header.beacon.slot
+            == signed_attested.message.slot)
+    # No finality proof: finalized header stays at bootstrap
+    assert store.finalized_header.beacon.slot == spec.GENESIS_SLOT
+    assert store.best_valid_update == update
+    # Without a finality proof the update is not applied, so the next
+    # committee is only staged in best_valid_update, not yet adopted
+    assert not spec.is_next_sync_committee_known(store)
+
+
+@with_altair_and_later
+@spec_state_test_with_matching_config
+def test_light_client_force_update(spec, state):
+    store, _ = _bootstrap_store(spec, state)
+    yield "bootstrap_state", state
+
+    signed_attested = _apply_block_with_sync_aggregate(spec, state)
+    attested_state = state.copy()
+    signed_sig_block = _apply_block_with_sync_aggregate(spec, state)
+
+    update = spec.create_light_client_update(
+        state, signed_sig_block, attested_state, signed_attested, None)
+    spec.process_light_client_update(
+        store, update, state.slot, state.genesis_validators_root)
+    assert store.finalized_header.beacon.slot == spec.GENESIS_SLOT
+    assert store.best_valid_update is not None
+
+    # After UPDATE_TIMEOUT the best update is force-applied
+    timeout_slot = spec.Slot(
+        int(store.finalized_header.beacon.slot)
+        + int(spec.UPDATE_TIMEOUT) + 1)
+    spec.process_light_client_store_force_update(store, timeout_slot)
+    assert store.best_valid_update is None
+    assert (store.finalized_header.beacon.slot
+            == signed_attested.message.slot)

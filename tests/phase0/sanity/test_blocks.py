@@ -138,25 +138,42 @@ def test_attestation(spec, state):
     attestation = get_valid_attestation(
         spec, state, slot=state.slot, signed=True)
 
+    from consensus_specs_tpu.testlib.helpers.forks import is_post_altair
+
     # Add to state via block transition
-    pre_current_attestations_len = len(state.current_epoch_attestations)
+    if not is_post_altair(spec):
+        pre_current_attestations_len = len(state.current_epoch_attestations)
     block = build_empty_block(
         spec, state, state.slot + spec.MIN_ATTESTATION_INCLUSION_DELAY)
     block.body.attestations.append(attestation)
     signed_block = state_transition_and_sign_block(spec, state, block)
 
-    assert (len(state.current_epoch_attestations)
-            == pre_current_attestations_len + 1)
-
-    # Epoch transition should move to previous_epoch_attestations
-    pre_current_attestations_root = spec.hash_tree_root(
-        state.current_epoch_attestations)
     from consensus_specs_tpu.testlib.helpers.state import next_epoch as ne
-    ne(spec, state)
 
-    assert len(state.current_epoch_attestations) == 0
-    assert (spec.hash_tree_root(state.previous_epoch_attestations)
-            == pre_current_attestations_root)
+    if not is_post_altair(spec):
+        assert (len(state.current_epoch_attestations)
+                == pre_current_attestations_len + 1)
+        # Epoch transition should move to previous_epoch_attestations
+        pre_current_attestations_root = spec.hash_tree_root(
+            state.current_epoch_attestations)
+        ne(spec, state)
+        assert len(state.current_epoch_attestations) == 0
+        assert (spec.hash_tree_root(state.previous_epoch_attestations)
+                == pre_current_attestations_root)
+    else:
+        # altair+: flags are set for the attesting indices
+        attesting = spec.get_attesting_indices(state, attestation)
+        assert len(attesting) > 0
+        for index in attesting:
+            assert spec.has_flag(state.current_epoch_participation[index],
+                                 spec.TIMELY_SOURCE_FLAG_INDEX)
+        pre_participation_root = spec.hash_tree_root(
+            state.current_epoch_participation)
+        ne(spec, state)
+        # flags rotated into the previous-epoch list, current zeroed
+        assert (spec.hash_tree_root(state.previous_epoch_participation)
+                == pre_participation_root)
+        assert all(int(f) == 0 for f in state.current_epoch_participation)
 
     yield "blocks", [signed_block]
     yield "post", state
@@ -176,7 +193,16 @@ def test_duplicate_attestation_same_block(spec, state):
     signed_block = state_transition_and_sign_block(spec, state, block)
     yield "blocks", [signed_block]
     yield "post", state
-    assert len(state.current_epoch_attestations) == 2
+
+    from consensus_specs_tpu.testlib.helpers.forks import is_post_altair
+
+    if not is_post_altair(spec):
+        assert len(state.current_epoch_attestations) == 2
+    else:
+        # the duplicate sets no new flags; every attester has the flags
+        for index in spec.get_attesting_indices(state, attestation):
+            assert spec.has_flag(state.current_epoch_participation[index],
+                                 spec.TIMELY_SOURCE_FLAG_INDEX)
 
 
 @with_all_phases
