@@ -1,0 +1,368 @@
+# Bellatrix -- The Beacon Chain (executable spec source, delta over altair).
+#
+# The Merge: execution payloads enter the beacon block, the ExecutionEngine
+# protocol abstracts the EL, and penalty parameters reach their final
+# values.  Parity contract: specs/bellatrix/beacon-chain.md
+# (types :53-60, containers :97-197, predicates :203-222, engine :291-360,
+# block processing :362-417, epoch processing :419-440); the
+# NoopExecutionEngine mirrors the reference's build-time stub
+# (`pysetup/spec_builders/bellatrix.py` execution_engine_cls).
+
+# ---------------------------------------------------------------------------
+# Custom types (beacon-chain.md :53-60, fork-choice.md :30-34)
+# ---------------------------------------------------------------------------
+
+Transaction = ByteList[MAX_BYTES_PER_TRANSACTION]
+
+
+class ExecutionAddress(Bytes20):
+    pass
+
+
+class PayloadId(Bytes8):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Containers (beacon-chain.md :97-197)
+# ---------------------------------------------------------------------------
+
+
+class ExecutionPayload(Container):
+    parent_hash: Hash32
+    fee_recipient: ExecutionAddress
+    state_root: Bytes32
+    receipts_root: Bytes32
+    logs_bloom: ByteVector[BYTES_PER_LOGS_BLOOM]
+    prev_randao: Bytes32
+    block_number: uint64
+    gas_limit: uint64
+    gas_used: uint64
+    timestamp: uint64
+    extra_data: ByteList[MAX_EXTRA_DATA_BYTES]
+    base_fee_per_gas: uint256
+    block_hash: Hash32
+    transactions: List[Transaction, MAX_TRANSACTIONS_PER_PAYLOAD]
+
+
+class ExecutionPayloadHeader(Container):
+    parent_hash: Hash32
+    fee_recipient: ExecutionAddress
+    state_root: Bytes32
+    receipts_root: Bytes32
+    logs_bloom: ByteVector[BYTES_PER_LOGS_BLOOM]
+    prev_randao: Bytes32
+    block_number: uint64
+    gas_limit: uint64
+    gas_used: uint64
+    timestamp: uint64
+    extra_data: ByteList[MAX_EXTRA_DATA_BYTES]
+    base_fee_per_gas: uint256
+    block_hash: Hash32
+    transactions_root: Root
+
+
+class BeaconBlockBody(Container):
+    randao_reveal: BLSSignature
+    eth1_data: Eth1Data
+    graffiti: Bytes32
+    proposer_slashings: List[ProposerSlashing, MAX_PROPOSER_SLASHINGS]
+    attester_slashings: List[AttesterSlashing, MAX_ATTESTER_SLASHINGS]
+    attestations: List[Attestation, MAX_ATTESTATIONS]
+    deposits: List[Deposit, MAX_DEPOSITS]
+    voluntary_exits: List[SignedVoluntaryExit, MAX_VOLUNTARY_EXITS]
+    sync_aggregate: SyncAggregate
+    # [New in Bellatrix]
+    execution_payload: ExecutionPayload
+
+
+class BeaconBlock(Container):
+    slot: Slot
+    proposer_index: ValidatorIndex
+    parent_root: Root
+    state_root: Root
+    body: BeaconBlockBody
+
+
+class SignedBeaconBlock(Container):
+    message: BeaconBlock
+    signature: BLSSignature
+
+
+class BeaconState(Container):
+    genesis_time: uint64
+    genesis_validators_root: Root
+    slot: Slot
+    fork: Fork
+    latest_block_header: BeaconBlockHeader
+    block_roots: Vector[Root, SLOTS_PER_HISTORICAL_ROOT]
+    state_roots: Vector[Root, SLOTS_PER_HISTORICAL_ROOT]
+    historical_roots: List[Root, HISTORICAL_ROOTS_LIMIT]
+    eth1_data: Eth1Data
+    eth1_data_votes: List[Eth1Data, EPOCHS_PER_ETH1_VOTING_PERIOD * SLOTS_PER_EPOCH]
+    eth1_deposit_index: uint64
+    validators: List[Validator, VALIDATOR_REGISTRY_LIMIT]
+    balances: List[Gwei, VALIDATOR_REGISTRY_LIMIT]
+    randao_mixes: Vector[Bytes32, EPOCHS_PER_HISTORICAL_VECTOR]
+    slashings: Vector[Gwei, EPOCHS_PER_SLASHINGS_VECTOR]
+    previous_epoch_participation: List[ParticipationFlags, VALIDATOR_REGISTRY_LIMIT]
+    current_epoch_participation: List[ParticipationFlags, VALIDATOR_REGISTRY_LIMIT]
+    justification_bits: Bitvector[JUSTIFICATION_BITS_LENGTH]
+    previous_justified_checkpoint: Checkpoint
+    current_justified_checkpoint: Checkpoint
+    finalized_checkpoint: Checkpoint
+    inactivity_scores: List[uint64, VALIDATOR_REGISTRY_LIMIT]
+    current_sync_committee: SyncCommittee
+    next_sync_committee: SyncCommittee
+    # [New in Bellatrix]
+    latest_execution_payload_header: ExecutionPayloadHeader
+
+
+# ---------------------------------------------------------------------------
+# Predicates (beacon-chain.md :203-222)
+# ---------------------------------------------------------------------------
+
+
+def is_merge_transition_complete(state: BeaconState) -> bool:
+    return state.latest_execution_payload_header != ExecutionPayloadHeader()
+
+
+def is_merge_transition_block(state: BeaconState,
+                              body: BeaconBlockBody) -> bool:
+    return (not is_merge_transition_complete(state)
+            and body.execution_payload != ExecutionPayload())
+
+
+def is_execution_enabled(state: BeaconState, body: BeaconBlockBody) -> bool:
+    return (is_merge_transition_block(state, body)
+            or is_merge_transition_complete(state))
+
+
+# ---------------------------------------------------------------------------
+# Modified accessors / mutators (beacon-chain.md :226-287)
+# ---------------------------------------------------------------------------
+
+
+def get_inactivity_penalty_deltas(state: BeaconState):
+    """Inactivity penalties with the final (bellatrix) quotient."""
+    rewards = [Gwei(0) for _ in range(len(state.validators))]
+    penalties = [Gwei(0) for _ in range(len(state.validators))]
+    previous_epoch = get_previous_epoch(state)
+    matching_target_indices = get_unslashed_participating_indices(
+        state, TIMELY_TARGET_FLAG_INDEX, previous_epoch)
+    for index in get_eligible_validator_indices(state):
+        if index not in matching_target_indices:
+            penalty_numerator = (state.validators[index].effective_balance
+                                 * state.inactivity_scores[index])
+            # [Modified in Bellatrix]
+            penalty_denominator = (config.INACTIVITY_SCORE_BIAS
+                                   * INACTIVITY_PENALTY_QUOTIENT_BELLATRIX)
+            penalties[index] += Gwei(penalty_numerator // penalty_denominator)
+    return rewards, penalties
+
+
+def slash_validator(state: BeaconState, slashed_index: ValidatorIndex,
+                    whistleblower_index: ValidatorIndex = None) -> None:
+    """Slash with the final (bellatrix) penalty quotient."""
+    epoch = get_current_epoch(state)
+    initiate_validator_exit(state, slashed_index)
+    validator = state.validators[slashed_index]
+    validator.slashed = True
+    validator.withdrawable_epoch = max(
+        validator.withdrawable_epoch,
+        Epoch(epoch + EPOCHS_PER_SLASHINGS_VECTOR))
+    state.slashings[epoch % EPOCHS_PER_SLASHINGS_VECTOR] += validator.effective_balance
+    # [Modified in Bellatrix]
+    slashing_penalty = (validator.effective_balance
+                        // MIN_SLASHING_PENALTY_QUOTIENT_BELLATRIX)
+    decrease_balance(state, slashed_index, slashing_penalty)
+
+    # Apply proposer and whistleblower rewards
+    proposer_index = get_beacon_proposer_index(state)
+    if whistleblower_index is None:
+        whistleblower_index = proposer_index
+    whistleblower_reward = Gwei(validator.effective_balance
+                                // WHISTLEBLOWER_REWARD_QUOTIENT)
+    proposer_reward = Gwei(whistleblower_reward * PROPOSER_WEIGHT
+                           // WEIGHT_DENOMINATOR)
+    increase_balance(state, proposer_index, proposer_reward)
+    increase_balance(state, whistleblower_index,
+                     Gwei(whistleblower_reward - proposer_reward))
+
+
+# ---------------------------------------------------------------------------
+# Execution engine (beacon-chain.md :291-360)
+# ---------------------------------------------------------------------------
+
+ExecutionState = Any
+
+
+@dataclass
+class NewPayloadRequest(object):
+    execution_payload: ExecutionPayload
+
+
+@dataclass
+class PayloadAttributes(object):
+    timestamp: uint64
+    prev_randao: Bytes32
+    suggested_fee_recipient: ExecutionAddress
+
+
+@dataclass
+class GetPayloadResponse(object):
+    execution_payload: ExecutionPayload
+
+
+class ExecutionEngine:
+    """Implementation-dependent EL protocol; the spec only pins the method
+    contracts (beacon-chain.md :303-360, fork-choice.md :38-92,
+    validator.md :96-110)."""
+
+    def notify_new_payload(self, execution_payload: ExecutionPayload) -> bool:
+        """True iff `execution_payload` is valid wrt the execution state."""
+        raise NotImplementedError
+
+    def is_valid_block_hash(self, execution_payload: ExecutionPayload) -> bool:
+        """True iff `execution_payload.block_hash` is computed correctly."""
+        raise NotImplementedError
+
+    def verify_and_notify_new_payload(
+            self, new_payload_request: NewPayloadRequest) -> bool:
+        execution_payload = new_payload_request.execution_payload
+
+        if b"" in execution_payload.transactions:
+            return False
+
+        if not self.is_valid_block_hash(execution_payload):
+            return False
+
+        if not self.notify_new_payload(execution_payload):
+            return False
+
+        return True
+
+    def notify_forkchoice_updated(self, head_block_hash: Hash32,
+                                  safe_block_hash: Hash32,
+                                  finalized_block_hash: Hash32,
+                                  payload_attributes):
+        raise NotImplementedError
+
+    def get_payload(self, payload_id: PayloadId) -> GetPayloadResponse:
+        raise NotImplementedError
+
+
+class NoopExecutionEngine(ExecutionEngine):
+    """Build-time stub standing in for a real EL
+    (`pysetup/spec_builders/bellatrix.py:39-65`); accepts everything."""
+
+    def notify_new_payload(self, execution_payload: ExecutionPayload) -> bool:
+        return True
+
+    def notify_forkchoice_updated(self, head_block_hash: Hash32,
+                                  safe_block_hash: Hash32,
+                                  finalized_block_hash: Hash32,
+                                  payload_attributes):
+        pass
+
+    def get_payload(self, payload_id: PayloadId) -> GetPayloadResponse:
+        raise NotImplementedError("no default block production")
+
+    def is_valid_block_hash(self, execution_payload: ExecutionPayload) -> bool:
+        return True
+
+    def verify_and_notify_new_payload(
+            self, new_payload_request: NewPayloadRequest) -> bool:
+        return True
+
+
+EXECUTION_ENGINE = NoopExecutionEngine()
+
+
+# ---------------------------------------------------------------------------
+# Block processing (beacon-chain.md :362-417)
+# ---------------------------------------------------------------------------
+
+
+def process_block(state: BeaconState, block: BeaconBlock) -> None:
+    process_block_header(state, block)
+    # payload before randao: it consumes the PREVIOUS block's randao mix
+    if is_execution_enabled(state, block.body):
+        process_execution_payload(state, block.body, EXECUTION_ENGINE)  # [New in Bellatrix]
+    process_randao(state, block.body)
+    process_eth1_data(state, block.body)
+    process_operations(state, block.body)
+    process_sync_aggregate(state, block.body.sync_aggregate)
+
+
+def process_execution_payload(state: BeaconState, body: BeaconBlockBody,
+                              execution_engine: ExecutionEngine) -> None:
+    payload = body.execution_payload
+
+    # Verify consistency with the previous execution payload header
+    if is_merge_transition_complete(state):
+        assert payload.parent_hash == state.latest_execution_payload_header.block_hash
+    # Verify prev_randao
+    assert payload.prev_randao == get_randao_mix(state, get_current_epoch(state))
+    # Verify timestamp
+    assert payload.timestamp == compute_time_at_slot(state, state.slot)
+    # Verify the execution payload is valid
+    assert execution_engine.verify_and_notify_new_payload(
+        NewPayloadRequest(execution_payload=payload))
+    # Cache execution payload header
+    state.latest_execution_payload_header = ExecutionPayloadHeader(
+        parent_hash=payload.parent_hash,
+        fee_recipient=payload.fee_recipient,
+        state_root=payload.state_root,
+        receipts_root=payload.receipts_root,
+        logs_bloom=payload.logs_bloom,
+        prev_randao=payload.prev_randao,
+        block_number=payload.block_number,
+        gas_limit=payload.gas_limit,
+        gas_used=payload.gas_used,
+        timestamp=payload.timestamp,
+        extra_data=payload.extra_data,
+        base_fee_per_gas=payload.base_fee_per_gas,
+        block_hash=payload.block_hash,
+        transactions_root=hash_tree_root(payload.transactions),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Epoch processing (beacon-chain.md :419-440)
+# ---------------------------------------------------------------------------
+
+
+def process_slashings(state: BeaconState) -> None:
+    epoch = get_current_epoch(state)
+    total_balance = get_total_active_balance(state)
+    adjusted_total_slashing_balance = min(
+        sum(state.slashings) * PROPORTIONAL_SLASHING_MULTIPLIER_BELLATRIX,
+        total_balance)
+    for index, validator in enumerate(state.validators):
+        if (validator.slashed
+                and epoch + EPOCHS_PER_SLASHINGS_VECTOR // 2
+                == validator.withdrawable_epoch):
+            # Factor out the increment to avoid uint64 overflow
+            increment = EFFECTIVE_BALANCE_INCREMENT
+            penalty_numerator = (validator.effective_balance // increment
+                                 * adjusted_total_slashing_balance)
+            penalty = penalty_numerator // total_balance * increment
+            decrease_balance(state, ValidatorIndex(index), penalty)
+
+
+# ---------------------------------------------------------------------------
+# Sundry EL-facing stubs (`pysetup/spec_builders/bellatrix.py:17-36`)
+# ---------------------------------------------------------------------------
+
+
+def get_execution_state(_execution_state_root: Bytes32) -> ExecutionState:
+    pass
+
+
+def get_pow_chain_head():
+    pass
+
+
+def validator_is_connected(validator_index: ValidatorIndex) -> bool:
+    return True

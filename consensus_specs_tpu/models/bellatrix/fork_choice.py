@@ -1,0 +1,151 @@
+# Bellatrix -- Fork Choice (executable spec source, delta over phase0).
+#
+# Adds merge-transition validation to `on_block` and the PoW terminal
+# block machinery.  Parity contract: specs/bellatrix/fork-choice.md
+# (PowBlock :207, is_valid_terminal_pow_block :227,
+#  validate_merge_block :236, on_block :268-330,
+#  should_override_forkchoice_update :114) and
+# fork_choice/safe-block.md `get_safe_execution_block_hash`.
+
+
+class PowBlock(Container):
+    block_hash: Hash32
+    parent_hash: Hash32
+    total_difficulty: uint256
+
+
+def get_pow_block(hash: Bytes32):
+    """Stub: real clients query the EL via eth_getBlockByHash
+    (`pysetup/spec_builders/bellatrix.py:22-23`); tests monkeypatch."""
+    return PowBlock(block_hash=hash, parent_hash=Bytes32(),
+                    total_difficulty=uint256(0))
+
+
+def is_valid_terminal_pow_block(block: PowBlock, parent: PowBlock) -> bool:
+    is_total_difficulty_reached = (block.total_difficulty
+                                   >= config.TERMINAL_TOTAL_DIFFICULTY)
+    is_parent_total_difficulty_valid = (parent.total_difficulty
+                                        < config.TERMINAL_TOTAL_DIFFICULTY)
+    return is_total_difficulty_reached and is_parent_total_difficulty_valid
+
+
+def validate_merge_block(block: BeaconBlock) -> None:
+    """Check the payload's parent is a valid terminal PoW block.
+    Unavailable PoW blocks may become available later; callers MAY delay
+    (fork-choice.md :236-261)."""
+    if config.TERMINAL_BLOCK_HASH != Hash32():
+        # Terminal-hash override: the activation epoch must be reached
+        assert (compute_epoch_at_slot(block.slot)
+                >= config.TERMINAL_BLOCK_HASH_ACTIVATION_EPOCH)
+        assert (block.body.execution_payload.parent_hash
+                == config.TERMINAL_BLOCK_HASH)
+        return
+
+    pow_block = get_pow_block(block.body.execution_payload.parent_hash)
+    assert pow_block is not None
+    pow_parent = get_pow_block(pow_block.parent_hash)
+    assert pow_parent is not None
+    assert is_valid_terminal_pow_block(pow_block, pow_parent)
+
+
+def on_block(store: Store, signed_block: SignedBeaconBlock) -> None:
+    """phase0 on_block + merge-transition validation
+    (fork-choice.md :268-330)."""
+    block = signed_block.message
+    # Parent must be known
+    assert block.parent_root in store.block_states
+    pre_state = copy(store.block_states[block.parent_root])
+    # Future blocks wait until their slot arrives
+    assert get_current_slot(store) >= block.slot
+
+    # Must descend from (and be after) the finalized checkpoint
+    finalized_slot = compute_start_slot_at_epoch(
+        store.finalized_checkpoint.epoch)
+    assert block.slot > finalized_slot
+    finalized_checkpoint_block = get_checkpoint_block(
+        store, block.parent_root, store.finalized_checkpoint.epoch)
+    assert store.finalized_checkpoint.root == finalized_checkpoint_block
+
+    # Full state transition (asserts internally on invalid blocks)
+    state = pre_state.copy()
+    block_root = hash_tree_root(block)
+    state_transition(state, signed_block, True)
+
+    # [New in Bellatrix]
+    if is_merge_transition_block(pre_state, block.body):
+        validate_merge_block(block)
+
+    store.blocks[block_root] = block
+    store.block_states[block_root] = state
+
+    # Timeliness: arrived in its own slot, before the attesting interval
+    time_into_slot = ((store.time - store.genesis_time)
+                      % config.SECONDS_PER_SLOT)
+    is_before_attesting_interval = (
+        time_into_slot < config.SECONDS_PER_SLOT // INTERVALS_PER_SLOT)
+    is_timely = (get_current_slot(store) == block.slot
+                 and is_before_attesting_interval)
+    store.block_timeliness[block_root] = is_timely
+
+    # Boost the first timely block of the slot
+    if is_timely and store.proposer_boost_root == Root():
+        store.proposer_boost_root = block_root
+
+    update_checkpoints(store, state.current_justified_checkpoint,
+                       state.finalized_checkpoint)
+    compute_pulled_up_tip(store, block_root)
+
+
+def should_override_forkchoice_update(store: Store, head_root: Root) -> bool:
+    """Whether a proposing node should withhold the fcU for a weak head
+    it intends to re-org (fork-choice.md :114-186)."""
+    head_block = store.blocks[head_root]
+    parent_root = head_block.parent_root
+    parent_block = store.blocks[parent_root]
+    current_slot = get_current_slot(store)
+    proposal_slot = head_block.slot + Slot(1)
+
+    head_late = is_head_late(store, head_root)
+    shuffling_stable = is_shuffling_stable(proposal_slot)
+    ffg_competitive = is_ffg_competitive(store, head_root, parent_root)
+    finalization_ok = is_finalization_ok(store, proposal_slot)
+
+    # Only suppress the fork choice update if we are confident that we
+    # will propose the next block
+    parent_state_advanced = store.block_states[parent_root].copy()
+    process_slots(parent_state_advanced, proposal_slot)
+    proposer_index = get_beacon_proposer_index(parent_state_advanced)
+    proposing_reorg_slot = validator_is_connected(proposer_index)
+
+    # Single-slot re-org
+    parent_slot_ok = parent_block.slot + 1 == head_block.slot
+    proposing_on_time = is_proposing_on_time(store)
+
+    # Note that this condition is different from `get_proposer_head`
+    current_time_ok = head_block.slot == current_slot or (
+        proposal_slot == current_slot and is_proposing_on_time(store))
+    single_slot_reorg = parent_slot_ok and current_time_ok
+
+    # Check the head weight only if the attestations from the head slot
+    # have already been applied
+    if current_slot > head_block.slot:
+        head_weak = is_head_weak(store, head_root)
+        parent_strong = is_parent_strong(store, parent_root)
+    else:
+        head_weak = True
+        parent_strong = True
+
+    return all([head_late, shuffling_stable, ffg_competitive,
+                finalization_ok, proposing_reorg_slot, single_slot_reorg,
+                head_weak, parent_strong])
+
+
+def get_safe_execution_block_hash(store: Store) -> Hash32:
+    """Execution block hash of the safe beacon block
+    (fork_choice/safe-block.md)."""
+    safe_block_root = get_safe_beacon_block_root(store)
+    safe_block = store.blocks[safe_block_root]
+    # Return Hash32() if no payload is yet available (pre-merge)
+    if compute_epoch_at_slot(safe_block.slot) >= config.BELLATRIX_FORK_EPOCH:
+        return safe_block.body.execution_payload.block_hash
+    return Hash32()

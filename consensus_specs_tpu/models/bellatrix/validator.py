@@ -1,0 +1,81 @@
+# Bellatrix -- Honest Validator (executable spec source, delta).
+# Parity contract: specs/bellatrix/validator.md (:44-215).
+
+
+def get_pow_block_at_terminal_total_difficulty(pow_chain):
+    """First PoW block crossing TTD whose parent has not
+    (validator.md :51-67)."""
+    # pow_chain abstractly represents all blocks in the PoW chain
+    for block in pow_chain.values():
+        block_reached_ttd = (block.total_difficulty
+                             >= config.TERMINAL_TOTAL_DIFFICULTY)
+        if block_reached_ttd:
+            # Genesis block: reaching TTD alone qualifies
+            if block.parent_hash == Hash32():
+                return block
+            parent = pow_chain[block.parent_hash]
+            parent_reached_ttd = (parent.total_difficulty
+                                  >= config.TERMINAL_TOTAL_DIFFICULTY)
+            if not parent_reached_ttd:
+                return block
+
+    return None
+
+
+def get_terminal_pow_block(pow_chain):
+    if config.TERMINAL_BLOCK_HASH != Hash32():
+        # Terminal block hash override takes precedence over TTD
+        if config.TERMINAL_BLOCK_HASH in pow_chain:
+            return pow_chain[config.TERMINAL_BLOCK_HASH]
+        return None
+
+    return get_pow_block_at_terminal_total_difficulty(pow_chain)
+
+
+def prepare_execution_payload(state: BeaconState, safe_block_hash: Hash32,
+                              finalized_block_hash: Hash32,
+                              suggested_fee_recipient: ExecutionAddress,
+                              execution_engine: ExecutionEngine,
+                              pow_chain=None):
+    """Kick off payload building via fcU; returns the PayloadId or None
+    pre-merge (validator.md :145-186)."""
+    if not is_merge_transition_complete(state):
+        assert pow_chain is not None
+        is_terminal_block_hash_set = config.TERMINAL_BLOCK_HASH != Hash32()
+        is_activation_epoch_reached = (
+            get_current_epoch(state)
+            >= config.TERMINAL_BLOCK_HASH_ACTIVATION_EPOCH)
+        if is_terminal_block_hash_set and not is_activation_epoch_reached:
+            # Terminal hash override set but not yet active
+            return None
+
+        terminal_pow_block = get_terminal_pow_block(pow_chain)
+        if terminal_pow_block is None:
+            # Pre-merge, no prepare payload call is needed
+            return None
+        # Signify merge via producing on top of the terminal PoW block
+        parent_hash = terminal_pow_block.block_hash
+    else:
+        # Post-merge, normal payload
+        parent_hash = state.latest_execution_payload_header.block_hash
+
+    # Set the forkchoice head and initiate the payload build process
+    payload_attributes = PayloadAttributes(
+        timestamp=compute_time_at_slot(state, state.slot),
+        prev_randao=get_randao_mix(state, get_current_epoch(state)),
+        suggested_fee_recipient=suggested_fee_recipient,
+    )
+    return execution_engine.notify_forkchoice_updated(
+        head_block_hash=parent_hash,
+        safe_block_hash=safe_block_hash,
+        finalized_block_hash=finalized_block_hash,
+        payload_attributes=payload_attributes,
+    )
+
+
+def get_execution_payload(payload_id,
+                          execution_engine: ExecutionEngine) -> ExecutionPayload:
+    if payload_id is None:
+        # Pre-merge, empty payload
+        return ExecutionPayload()
+    return execution_engine.get_payload(payload_id).execution_payload

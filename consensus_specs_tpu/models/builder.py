@@ -65,13 +65,15 @@ SPEC_SOURCES: dict[str, list[str]] = {
                "genesis.py"],
     "altair": ["beacon_chain.py", "fork.py", "light_client.py",
                "validator.py"],
-    "bellatrix": ["beacon_chain.py", "fork.py", "fork_choice.py"],
+    "bellatrix": ["beacon_chain.py", "fork.py", "fork_choice.py",
+                  "validator.py"],
     "capella": ["beacon_chain.py", "fork.py"],
     "deneb": ["polynomial_commitments.py", "beacon_chain.py", "fork.py",
-              "fork_choice.py", "validator.py"],
-    "electra": ["beacon_chain.py", "fork.py"],
+              "fork_choice.py", "p2p.py", "validator.py"],
+    "electra": ["beacon_chain.py", "fork.py", "validator.py"],
     "fulu": ["polynomial_commitments_sampling.py", "das_core.py",
-             "beacon_chain.py", "fork.py"],
+             "beacon_chain.py", "fork.py", "fork_choice.py", "p2p.py",
+             "validator.py"],
 }
 
 
@@ -132,7 +134,8 @@ def _preamble_namespace() -> dict[str, Any]:
         concat_generalized_indices,
         get_generalized_index,
     )
-    from ..utils.ssz.ssz_impl import copy, hash_tree_root, serialize, uint_to_bytes
+    from ..utils.ssz.ssz_impl import (
+        copy, deserialize, hash_tree_root, serialize, uint_to_bytes)
 
     ns: dict[str, Any] = {
         # ssz types
@@ -146,6 +149,8 @@ def _preamble_namespace() -> dict[str, Any]:
         # ssz functions
         "hash_tree_root": hash_tree_root,
         "serialize": serialize,
+        "ssz_serialize": serialize,
+        "ssz_deserialize": deserialize,
         "uint_to_bytes": uint_to_bytes,
         "copy": copy,
         "get_generalized_index": get_generalized_index,
@@ -223,6 +228,8 @@ def build_spec(fork: str, preset_name: str) -> Spec:
     ns = _preamble_namespace()
     ns.update(load_preset(preset_name, fork))
     ns["config"] = Configuration(**load_config(preset_name))
+    ns["TRUSTED_SETUPS_DIR"] = str(
+        PKG_ROOT / "presets" / preset_name / "trusted_setups")
     _exec_sources(fork, ns)
     # bind functions' globals: they already close over `ns` via exec globals
     spec = Spec(fork, preset_name, ns)
@@ -239,9 +246,16 @@ def spec_with_config(spec: Spec, overrides: dict[str, Any]) -> Spec:
     `with_config_overrides` re-import, `test/context.py:663-734`).
     Cached per (fork, preset, overrides) — rebuilding the namespace means
     re-executing every spec source file."""
-    fp = tuple(sorted(
-        (k, bytes(v) if isinstance(v, bytes) else v)
-        for k, v in overrides.items()))
+    def _hashable(v):
+        if isinstance(v, bytes):
+            return bytes(v)
+        if isinstance(v, (list, tuple)):
+            return tuple(_hashable(x) for x in v)
+        if isinstance(v, dict):
+            return tuple(sorted((k, _hashable(x)) for k, x in v.items()))
+        return v
+
+    fp = tuple(sorted((k, _hashable(v)) for k, v in overrides.items()))
     key = (spec.fork, spec.preset_name, fp)
     if key in _OVERRIDE_SPEC_CACHE:
         return _OVERRIDE_SPEC_CACHE[key]
@@ -250,6 +264,8 @@ def spec_with_config(spec: Spec, overrides: dict[str, Any]) -> Spec:
     cfg = load_config(spec.preset_name)
     cfg.update(overrides)
     ns["config"] = Configuration(**{k: _parse_value(v) for k, v in cfg.items()})
+    ns["TRUSTED_SETUPS_DIR"] = str(
+        PKG_ROOT / "presets" / spec.preset_name / "trusted_setups")
     _exec_sources(spec.fork, ns)
     fresh = Spec(spec.fork, spec.preset_name, ns)
     ns["spec"] = fresh

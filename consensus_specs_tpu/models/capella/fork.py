@@ -1,0 +1,74 @@
+# Capella -- Fork Logic (executable spec source).
+# Parity contract: specs/capella/fork.md.
+
+
+def compute_fork_version(epoch: Epoch) -> Version:
+    """Fork version at `epoch`."""
+    if epoch >= config.CAPELLA_FORK_EPOCH:
+        return config.CAPELLA_FORK_VERSION
+    if epoch >= config.BELLATRIX_FORK_EPOCH:
+        return config.BELLATRIX_FORK_VERSION
+    if epoch >= config.ALTAIR_FORK_EPOCH:
+        return config.ALTAIR_FORK_VERSION
+    return config.GENESIS_FORK_VERSION
+
+
+def upgrade_to_capella(pre) -> BeaconState:
+    """bellatrix -> capella state upgrade (fork.md `upgrade_to_capella`)."""
+    epoch = compute_epoch_at_slot(pre.slot)
+    pre_header = pre.latest_execution_payload_header
+    latest_execution_payload_header = ExecutionPayloadHeader(
+        parent_hash=pre_header.parent_hash,
+        fee_recipient=pre_header.fee_recipient,
+        state_root=pre_header.state_root,
+        receipts_root=pre_header.receipts_root,
+        logs_bloom=pre_header.logs_bloom,
+        prev_randao=pre_header.prev_randao,
+        block_number=pre_header.block_number,
+        gas_limit=pre_header.gas_limit,
+        gas_used=pre_header.gas_used,
+        timestamp=pre_header.timestamp,
+        extra_data=pre_header.extra_data,
+        base_fee_per_gas=pre_header.base_fee_per_gas,
+        block_hash=pre_header.block_hash,
+        transactions_root=pre_header.transactions_root,
+        # [New in Capella]
+        withdrawals_root=Root(),
+    )
+    post = BeaconState(
+        genesis_time=pre.genesis_time,
+        genesis_validators_root=pre.genesis_validators_root,
+        slot=pre.slot,
+        fork=Fork(
+            previous_version=pre.fork.current_version,
+            current_version=config.CAPELLA_FORK_VERSION,
+            epoch=epoch,
+        ),
+        latest_block_header=pre.latest_block_header,
+        block_roots=pre.block_roots,
+        state_roots=pre.state_roots,
+        historical_roots=pre.historical_roots,
+        eth1_data=pre.eth1_data,
+        eth1_data_votes=pre.eth1_data_votes,
+        eth1_deposit_index=pre.eth1_deposit_index,
+        validators=pre.validators,
+        balances=pre.balances,
+        randao_mixes=pre.randao_mixes,
+        slashings=pre.slashings,
+        previous_epoch_participation=pre.previous_epoch_participation,
+        current_epoch_participation=pre.current_epoch_participation,
+        justification_bits=pre.justification_bits,
+        previous_justified_checkpoint=pre.previous_justified_checkpoint,
+        current_justified_checkpoint=pre.current_justified_checkpoint,
+        finalized_checkpoint=pre.finalized_checkpoint,
+        inactivity_scores=pre.inactivity_scores,
+        current_sync_committee=pre.current_sync_committee,
+        next_sync_committee=pre.next_sync_committee,
+        latest_execution_payload_header=latest_execution_payload_header,
+        # [New in Capella]
+        next_withdrawal_index=WithdrawalIndex(0),
+        next_withdrawal_validator_index=ValidatorIndex(0),
+        historical_summaries=List[HistoricalSummary, HISTORICAL_ROOTS_LIMIT]([]),
+    )
+
+    return post

@@ -1,0 +1,422 @@
+# Deneb -- The Beacon Chain (executable spec source, delta over capella).
+#
+# EIP-4844 blobs (KZG commitments in the block body, versioned hashes to
+# the EL), EIP-4788 (parent beacon root to the EL), EIP-7044 (fixed exit
+# domain), EIP-7045 (extended attestation inclusion), EIP-7514
+# (activation churn cap).  Parity contract: specs/deneb/beacon-chain.md
+# (types :59-72, containers :101-210, helpers :212-274, engine :276-366,
+#  block processing :368-507, epoch processing :509-545).
+
+# ---------------------------------------------------------------------------
+# Custom types + constants (beacon-chain.md :59-72)
+# ---------------------------------------------------------------------------
+
+
+class VersionedHash(Bytes32):
+    pass
+
+
+class BlobIndex(uint64):
+    pass
+
+
+VERSIONED_HASH_VERSION_KZG = Bytes1("0x01")
+
+
+# ---------------------------------------------------------------------------
+# Containers (beacon-chain.md :101-210)
+# ---------------------------------------------------------------------------
+
+
+class ExecutionPayload(Container):
+    parent_hash: Hash32
+    fee_recipient: ExecutionAddress
+    state_root: Bytes32
+    receipts_root: Bytes32
+    logs_bloom: ByteVector[BYTES_PER_LOGS_BLOOM]
+    prev_randao: Bytes32
+    block_number: uint64
+    gas_limit: uint64
+    gas_used: uint64
+    timestamp: uint64
+    extra_data: ByteList[MAX_EXTRA_DATA_BYTES]
+    base_fee_per_gas: uint256
+    block_hash: Hash32
+    transactions: List[Transaction, MAX_TRANSACTIONS_PER_PAYLOAD]
+    withdrawals: List[Withdrawal, MAX_WITHDRAWALS_PER_PAYLOAD]
+    # [New in Deneb:EIP4844]
+    blob_gas_used: uint64
+    # [New in Deneb:EIP4844]
+    excess_blob_gas: uint64
+
+
+class ExecutionPayloadHeader(Container):
+    parent_hash: Hash32
+    fee_recipient: ExecutionAddress
+    state_root: Bytes32
+    receipts_root: Bytes32
+    logs_bloom: ByteVector[BYTES_PER_LOGS_BLOOM]
+    prev_randao: Bytes32
+    block_number: uint64
+    gas_limit: uint64
+    gas_used: uint64
+    timestamp: uint64
+    extra_data: ByteList[MAX_EXTRA_DATA_BYTES]
+    base_fee_per_gas: uint256
+    block_hash: Hash32
+    transactions_root: Root
+    withdrawals_root: Root
+    # [New in Deneb:EIP4844]
+    blob_gas_used: uint64
+    # [New in Deneb:EIP4844]
+    excess_blob_gas: uint64
+
+
+class BeaconBlockBody(Container):
+    randao_reveal: BLSSignature
+    eth1_data: Eth1Data
+    graffiti: Bytes32
+    proposer_slashings: List[ProposerSlashing, MAX_PROPOSER_SLASHINGS]
+    attester_slashings: List[AttesterSlashing, MAX_ATTESTER_SLASHINGS]
+    attestations: List[Attestation, MAX_ATTESTATIONS]
+    deposits: List[Deposit, MAX_DEPOSITS]
+    voluntary_exits: List[SignedVoluntaryExit, MAX_VOLUNTARY_EXITS]
+    sync_aggregate: SyncAggregate
+    # [Modified in Deneb:EIP4844]
+    execution_payload: ExecutionPayload
+    bls_to_execution_changes: List[SignedBLSToExecutionChange, MAX_BLS_TO_EXECUTION_CHANGES]
+    # [New in Deneb:EIP4844]
+    blob_kzg_commitments: List[KZGCommitment, MAX_BLOB_COMMITMENTS_PER_BLOCK]
+
+
+class BeaconBlock(Container):
+    slot: Slot
+    proposer_index: ValidatorIndex
+    parent_root: Root
+    state_root: Root
+    body: BeaconBlockBody
+
+
+class SignedBeaconBlock(Container):
+    message: BeaconBlock
+    signature: BLSSignature
+
+
+class BeaconState(Container):
+    genesis_time: uint64
+    genesis_validators_root: Root
+    slot: Slot
+    fork: Fork
+    latest_block_header: BeaconBlockHeader
+    block_roots: Vector[Root, SLOTS_PER_HISTORICAL_ROOT]
+    state_roots: Vector[Root, SLOTS_PER_HISTORICAL_ROOT]
+    historical_roots: List[Root, HISTORICAL_ROOTS_LIMIT]
+    eth1_data: Eth1Data
+    eth1_data_votes: List[Eth1Data, EPOCHS_PER_ETH1_VOTING_PERIOD * SLOTS_PER_EPOCH]
+    eth1_deposit_index: uint64
+    validators: List[Validator, VALIDATOR_REGISTRY_LIMIT]
+    balances: List[Gwei, VALIDATOR_REGISTRY_LIMIT]
+    randao_mixes: Vector[Bytes32, EPOCHS_PER_HISTORICAL_VECTOR]
+    slashings: Vector[Gwei, EPOCHS_PER_SLASHINGS_VECTOR]
+    previous_epoch_participation: List[ParticipationFlags, VALIDATOR_REGISTRY_LIMIT]
+    current_epoch_participation: List[ParticipationFlags, VALIDATOR_REGISTRY_LIMIT]
+    justification_bits: Bitvector[JUSTIFICATION_BITS_LENGTH]
+    previous_justified_checkpoint: Checkpoint
+    current_justified_checkpoint: Checkpoint
+    finalized_checkpoint: Checkpoint
+    inactivity_scores: List[uint64, VALIDATOR_REGISTRY_LIMIT]
+    current_sync_committee: SyncCommittee
+    next_sync_committee: SyncCommittee
+    # [Modified in Deneb:EIP4844]
+    latest_execution_payload_header: ExecutionPayloadHeader
+    next_withdrawal_index: WithdrawalIndex
+    next_withdrawal_validator_index: ValidatorIndex
+    historical_summaries: List[HistoricalSummary, HISTORICAL_ROOTS_LIMIT]
+
+
+# ---------------------------------------------------------------------------
+# Helpers (beacon-chain.md :212-274)
+# ---------------------------------------------------------------------------
+
+
+def kzg_commitment_to_versioned_hash(
+        kzg_commitment: KZGCommitment) -> VersionedHash:
+    return VERSIONED_HASH_VERSION_KZG + hash(kzg_commitment)[1:]
+
+
+def get_attestation_participation_flag_indices(
+        state: BeaconState, data: AttestationData,
+        inclusion_delay: uint64) -> Sequence[int]:
+    """Flag indices an attestation satisfies; the target flag no longer
+    depends on inclusion delay (EIP-7045)."""
+    if data.target.epoch == get_current_epoch(state):
+        justified_checkpoint = state.current_justified_checkpoint
+    else:
+        justified_checkpoint = state.previous_justified_checkpoint
+
+    # Matching roots
+    is_matching_source = data.source == justified_checkpoint
+    is_matching_target = (is_matching_source
+                          and data.target.root
+                          == get_block_root(state, data.target.epoch))
+    is_matching_head = (is_matching_target
+                        and data.beacon_block_root
+                        == get_block_root_at_slot(state, data.slot))
+    assert is_matching_source
+
+    participation_flag_indices = []
+    if (is_matching_source
+            and inclusion_delay <= integer_squareroot(SLOTS_PER_EPOCH)):
+        participation_flag_indices.append(TIMELY_SOURCE_FLAG_INDEX)
+    if is_matching_target:  # [Modified in Deneb:EIP7045]
+        participation_flag_indices.append(TIMELY_TARGET_FLAG_INDEX)
+    if is_matching_head and inclusion_delay == MIN_ATTESTATION_INCLUSION_DELAY:
+        participation_flag_indices.append(TIMELY_HEAD_FLAG_INDEX)
+
+    return participation_flag_indices
+
+
+def get_validator_activation_churn_limit(state: BeaconState) -> uint64:
+    """Activation churn limit, capped by EIP-7514."""
+    return min(config.MAX_PER_EPOCH_ACTIVATION_CHURN_LIMIT,
+               get_validator_churn_limit(state))
+
+
+# ---------------------------------------------------------------------------
+# Execution engine (beacon-chain.md :276-366)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class NewPayloadRequest(object):
+    execution_payload: ExecutionPayload
+    versioned_hashes: Sequence[VersionedHash]
+    parent_beacon_block_root: Root
+
+
+class ExecutionEngine:
+    """EL protocol, extended with versioned-hash and parent-root checks
+    (EIP-4844/4788)."""
+
+    def notify_new_payload(self, execution_payload: ExecutionPayload,
+                           parent_beacon_block_root: Root) -> bool:
+        raise NotImplementedError
+
+    def is_valid_block_hash(self, execution_payload: ExecutionPayload,
+                            parent_beacon_block_root: Root) -> bool:
+        raise NotImplementedError
+
+    def is_valid_versioned_hashes(self, new_payload_request) -> bool:
+        raise NotImplementedError
+
+    def verify_and_notify_new_payload(self, new_payload_request) -> bool:
+        execution_payload = new_payload_request.execution_payload
+        # [New in Deneb:EIP4788]
+        parent_beacon_block_root = new_payload_request.parent_beacon_block_root
+
+        if b"" in execution_payload.transactions:
+            return False
+
+        # [Modified in Deneb:EIP4788]
+        if not self.is_valid_block_hash(execution_payload,
+                                        parent_beacon_block_root):
+            return False
+
+        # [New in Deneb:EIP4844]
+        if not self.is_valid_versioned_hashes(new_payload_request):
+            return False
+
+        # [Modified in Deneb:EIP4788]
+        if not self.notify_new_payload(execution_payload,
+                                       parent_beacon_block_root):
+            return False
+
+        return True
+
+    def notify_forkchoice_updated(self, head_block_hash, safe_block_hash,
+                                  finalized_block_hash, payload_attributes):
+        raise NotImplementedError
+
+    def get_payload(self, payload_id):
+        raise NotImplementedError
+
+
+class NoopExecutionEngine(ExecutionEngine):
+    """Accept-everything EL stub (`pysetup/spec_builders/deneb.py:46-79`)."""
+
+    def notify_new_payload(self, execution_payload,
+                           parent_beacon_block_root) -> bool:
+        return True
+
+    def notify_forkchoice_updated(self, head_block_hash, safe_block_hash,
+                                  finalized_block_hash, payload_attributes):
+        pass
+
+    def get_payload(self, payload_id):
+        raise NotImplementedError("no default block production")
+
+    def is_valid_block_hash(self, execution_payload,
+                            parent_beacon_block_root) -> bool:
+        return True
+
+    def is_valid_versioned_hashes(self, new_payload_request) -> bool:
+        return True
+
+    def verify_and_notify_new_payload(self, new_payload_request) -> bool:
+        return True
+
+
+EXECUTION_ENGINE = NoopExecutionEngine()
+
+
+# ---------------------------------------------------------------------------
+# Block processing (beacon-chain.md :368-507)
+# ---------------------------------------------------------------------------
+
+
+def process_attestation(state: BeaconState, attestation: Attestation) -> None:
+    """Valid inclusion now extends through target.epoch + 1 (EIP-7045)."""
+    data = attestation.data
+    assert data.target.epoch in (get_previous_epoch(state),
+                                 get_current_epoch(state))
+    assert data.target.epoch == compute_epoch_at_slot(data.slot)
+    # [Modified in Deneb:EIP7045] no upper bound on inclusion slot
+    assert data.slot + MIN_ATTESTATION_INCLUSION_DELAY <= state.slot
+    assert data.index < get_committee_count_per_slot(state, data.target.epoch)
+
+    committee = get_beacon_committee(state, data.slot, data.index)
+    assert len(attestation.aggregation_bits) == len(committee)
+
+    # Participation flag indices
+    participation_flag_indices = get_attestation_participation_flag_indices(
+        state, data, state.slot - data.slot)
+
+    # Verify signature
+    assert is_valid_indexed_attestation(
+        state, get_indexed_attestation(state, attestation))
+
+    # Update epoch participation flags
+    if data.target.epoch == get_current_epoch(state):
+        epoch_participation = state.current_epoch_participation
+    else:
+        epoch_participation = state.previous_epoch_participation
+
+    proposer_reward_numerator = 0
+    for index in get_attesting_indices(state, attestation):
+        for flag_index, weight in enumerate(PARTICIPATION_FLAG_WEIGHTS):
+            if (flag_index in participation_flag_indices
+                    and not has_flag(epoch_participation[index], flag_index)):
+                epoch_participation[index] = add_flag(
+                    epoch_participation[index], flag_index)
+                proposer_reward_numerator += get_base_reward(state, index) * weight
+
+    # Reward proposer
+    proposer_reward_denominator = ((WEIGHT_DENOMINATOR - PROPOSER_WEIGHT)
+                                   * WEIGHT_DENOMINATOR // PROPOSER_WEIGHT)
+    proposer_reward = Gwei(proposer_reward_numerator
+                           // proposer_reward_denominator)
+    increase_balance(state, get_beacon_proposer_index(state), proposer_reward)
+
+
+def process_execution_payload(state: BeaconState, body: BeaconBlockBody,
+                              execution_engine: ExecutionEngine) -> None:
+    payload = body.execution_payload
+
+    # Verify consistency with the previous execution payload header
+    assert payload.parent_hash == state.latest_execution_payload_header.block_hash
+    # Verify prev_randao
+    assert payload.prev_randao == get_randao_mix(state, get_current_epoch(state))
+    # Verify timestamp
+    assert payload.timestamp == compute_time_at_slot(state, state.slot)
+
+    # [New in Deneb:EIP4844] Verify commitments are under limit
+    assert len(body.blob_kzg_commitments) <= config.MAX_BLOBS_PER_BLOCK
+
+    # Verify the execution payload is valid
+    # [Modified in Deneb:EIP4844+EIP4788]
+    versioned_hashes = [kzg_commitment_to_versioned_hash(commitment)
+                        for commitment in body.blob_kzg_commitments]
+    assert execution_engine.verify_and_notify_new_payload(
+        NewPayloadRequest(
+            execution_payload=payload,
+            versioned_hashes=versioned_hashes,
+            parent_beacon_block_root=state.latest_block_header.parent_root,
+        ))
+
+    # Cache execution payload header
+    state.latest_execution_payload_header = ExecutionPayloadHeader(
+        parent_hash=payload.parent_hash,
+        fee_recipient=payload.fee_recipient,
+        state_root=payload.state_root,
+        receipts_root=payload.receipts_root,
+        logs_bloom=payload.logs_bloom,
+        prev_randao=payload.prev_randao,
+        block_number=payload.block_number,
+        gas_limit=payload.gas_limit,
+        gas_used=payload.gas_used,
+        timestamp=payload.timestamp,
+        extra_data=payload.extra_data,
+        base_fee_per_gas=payload.base_fee_per_gas,
+        block_hash=payload.block_hash,
+        transactions_root=hash_tree_root(payload.transactions),
+        withdrawals_root=hash_tree_root(payload.withdrawals),
+        blob_gas_used=payload.blob_gas_used,  # [New in Deneb:EIP4844]
+        excess_blob_gas=payload.excess_blob_gas,  # [New in Deneb:EIP4844]
+    )
+
+
+def process_voluntary_exit(state: BeaconState,
+                           signed_voluntary_exit: SignedVoluntaryExit) -> None:
+    """Exit signatures are locked to CAPELLA_FORK_VERSION (EIP-7044)."""
+    voluntary_exit = signed_voluntary_exit.message
+    validator = state.validators[voluntary_exit.validator_index]
+    # Verify the validator is active
+    assert is_active_validator(validator, get_current_epoch(state))
+    # Verify exit has not been initiated
+    assert validator.exit_epoch == FAR_FUTURE_EPOCH
+    # Exits are not valid before their epoch
+    assert get_current_epoch(state) >= voluntary_exit.epoch
+    # Verify the validator has been active long enough
+    assert (get_current_epoch(state)
+            >= validator.activation_epoch + config.SHARD_COMMITTEE_PERIOD)
+    # Verify signature
+    # [Modified in Deneb:EIP7044]
+    domain = compute_domain(DOMAIN_VOLUNTARY_EXIT,
+                            config.CAPELLA_FORK_VERSION,
+                            state.genesis_validators_root)
+    signing_root = compute_signing_root(voluntary_exit, domain)
+    assert bls.Verify(validator.pubkey, signing_root,
+                      signed_voluntary_exit.signature)
+    # Initiate exit
+    initiate_validator_exit(state, voluntary_exit.validator_index)
+
+
+# ---------------------------------------------------------------------------
+# Epoch processing (beacon-chain.md :509-545)
+# ---------------------------------------------------------------------------
+
+
+def process_registry_updates(state: BeaconState) -> None:
+    """Activations rate-limited by the EIP-7514 churn cap."""
+    # Process activation eligibility and ejections
+    for index, validator in enumerate(state.validators):
+        if is_eligible_for_activation_queue(validator):
+            validator.activation_eligibility_epoch = get_current_epoch(state) + 1
+
+        if (is_active_validator(validator, get_current_epoch(state))
+                and validator.effective_balance <= config.EJECTION_BALANCE):
+            initiate_validator_exit(state, ValidatorIndex(index))
+
+    # Queue validators eligible for activation, ordered by eligibility
+    activation_queue = sorted(
+        [index for index, validator in enumerate(state.validators)
+         if is_eligible_for_activation(state, validator)],
+        key=lambda index: (
+            state.validators[index].activation_eligibility_epoch, index),
+    )
+    # Dequeue up to the activation churn limit
+    # [Modified in Deneb:EIP7514]
+    for index in activation_queue[:get_validator_activation_churn_limit(state)]:
+        validator = state.validators[index]
+        validator.activation_epoch = compute_activation_exit_epoch(
+            get_current_epoch(state))

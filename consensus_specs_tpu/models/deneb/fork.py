@@ -1,0 +1,79 @@
+# Deneb -- Fork Logic (executable spec source).
+# Parity contract: specs/deneb/fork.md.
+
+
+def compute_fork_version(epoch: Epoch) -> Version:
+    """Fork version at `epoch`."""
+    if epoch >= config.DENEB_FORK_EPOCH:
+        return config.DENEB_FORK_VERSION
+    if epoch >= config.CAPELLA_FORK_EPOCH:
+        return config.CAPELLA_FORK_VERSION
+    if epoch >= config.BELLATRIX_FORK_EPOCH:
+        return config.BELLATRIX_FORK_VERSION
+    if epoch >= config.ALTAIR_FORK_EPOCH:
+        return config.ALTAIR_FORK_VERSION
+    return config.GENESIS_FORK_VERSION
+
+
+def upgrade_to_deneb(pre) -> BeaconState:
+    """capella -> deneb state upgrade (fork.md `upgrade_to_deneb`)."""
+    epoch = compute_epoch_at_slot(pre.slot)
+    h = pre.latest_execution_payload_header
+    latest_execution_payload_header = ExecutionPayloadHeader(
+        parent_hash=h.parent_hash,
+        fee_recipient=h.fee_recipient,
+        state_root=h.state_root,
+        receipts_root=h.receipts_root,
+        logs_bloom=h.logs_bloom,
+        prev_randao=h.prev_randao,
+        block_number=h.block_number,
+        gas_limit=h.gas_limit,
+        gas_used=h.gas_used,
+        timestamp=h.timestamp,
+        extra_data=h.extra_data,
+        base_fee_per_gas=h.base_fee_per_gas,
+        block_hash=h.block_hash,
+        transactions_root=h.transactions_root,
+        withdrawals_root=h.withdrawals_root,
+        # [New in Deneb:EIP4844]
+        blob_gas_used=uint64(0),
+        excess_blob_gas=uint64(0),
+    )
+    post = BeaconState(
+        genesis_time=pre.genesis_time,
+        genesis_validators_root=pre.genesis_validators_root,
+        slot=pre.slot,
+        fork=Fork(
+            previous_version=pre.fork.current_version,
+            # [Modified in Deneb]
+            current_version=config.DENEB_FORK_VERSION,
+            epoch=epoch,
+        ),
+        latest_block_header=pre.latest_block_header,
+        block_roots=pre.block_roots,
+        state_roots=pre.state_roots,
+        historical_roots=pre.historical_roots,
+        eth1_data=pre.eth1_data,
+        eth1_data_votes=pre.eth1_data_votes,
+        eth1_deposit_index=pre.eth1_deposit_index,
+        validators=pre.validators,
+        balances=pre.balances,
+        randao_mixes=pre.randao_mixes,
+        slashings=pre.slashings,
+        previous_epoch_participation=pre.previous_epoch_participation,
+        current_epoch_participation=pre.current_epoch_participation,
+        justification_bits=pre.justification_bits,
+        previous_justified_checkpoint=pre.previous_justified_checkpoint,
+        current_justified_checkpoint=pre.current_justified_checkpoint,
+        finalized_checkpoint=pre.finalized_checkpoint,
+        inactivity_scores=pre.inactivity_scores,
+        current_sync_committee=pre.current_sync_committee,
+        next_sync_committee=pre.next_sync_committee,
+        # [Modified in Deneb:EIP4844]
+        latest_execution_payload_header=latest_execution_payload_header,
+        next_withdrawal_index=pre.next_withdrawal_index,
+        next_withdrawal_validator_index=pre.next_withdrawal_validator_index,
+        historical_summaries=pre.historical_summaries,
+    )
+
+    return post

@@ -1,0 +1,80 @@
+# Deneb -- Fork Choice (executable spec source, delta over bellatrix).
+#
+# Adds the blob data-availability gate to on_block.  Parity contract:
+# specs/deneb/fork-choice.md (:25-140); `retrieve_blobs_and_proofs` is the
+# build-time stub the tests monkeypatch
+# (`pysetup/spec_builders/deneb.py:39-42`,
+#  `test/helpers/fork_choice.py:55-115`).
+
+
+@dataclass
+class PayloadAttributes(object):
+    timestamp: uint64
+    prev_randao: Bytes32
+    suggested_fee_recipient: ExecutionAddress
+    withdrawals: Sequence[Withdrawal]
+    parent_beacon_block_root: Root  # [New in Deneb:EIP4788]
+
+
+def retrieve_blobs_and_proofs(beacon_block_root: Root):
+    """Stub: implementation/context dependent; returns all blobs+proofs
+    for the block, raising if unavailable."""
+    return [], []
+
+
+def is_data_available(beacon_block_root: Root,
+                      blob_kzg_commitments) -> bool:
+    """Initial DA check: fetch every blob+proof and batch-verify
+    (fork-choice.md :56-68); later upgrades replace this with sampling."""
+    blobs, proofs = retrieve_blobs_and_proofs(beacon_block_root)
+
+    return verify_blob_kzg_proof_batch(blobs, blob_kzg_commitments, proofs)
+
+
+def on_block(store: Store, signed_block: SignedBeaconBlock) -> None:
+    """bellatrix on_block + the data-availability gate
+    (fork-choice.md :76-140).  Note: the merge-transition validation
+    became vacuous post-capella and is dropped upstream too."""
+    block = signed_block.message
+    # Parent must be known
+    assert block.parent_root in store.block_states
+    # Future blocks wait until their slot arrives
+    assert get_current_slot(store) >= block.slot
+
+    # Must descend from (and be after) the finalized checkpoint
+    finalized_slot = compute_start_slot_at_epoch(
+        store.finalized_checkpoint.epoch)
+    assert block.slot > finalized_slot
+    finalized_checkpoint_block = get_checkpoint_block(
+        store, block.parent_root, store.finalized_checkpoint.epoch)
+    assert store.finalized_checkpoint.root == finalized_checkpoint_block
+
+    # [New in Deneb:EIP4844] blob availability; unavailable blocks MAY be
+    # queued and retried once their blob data arrives
+    assert is_data_available(hash_tree_root(block),
+                             block.body.blob_kzg_commitments)
+
+    # Full state transition (asserts internally on invalid blocks)
+    state = copy(store.block_states[block.parent_root])
+    block_root = hash_tree_root(block)
+    state_transition(state, signed_block, True)
+
+    store.blocks[block_root] = block
+    store.block_states[block_root] = state
+
+    # Timeliness: arrived in its own slot, before the attesting interval
+    time_into_slot = ((store.time - store.genesis_time)
+                      % config.SECONDS_PER_SLOT)
+    is_before_attesting_interval = (
+        time_into_slot < config.SECONDS_PER_SLOT // INTERVALS_PER_SLOT)
+    is_timely = (get_current_slot(store) == block.slot
+                 and is_before_attesting_interval)
+    store.block_timeliness[block_root] = is_timely
+
+    # Boost the first timely block of the slot
+    if is_timely and store.proposer_boost_root == Root():
+        store.proposer_boost_root = block_root
+
+    update_checkpoints(store, state.current_justified_checkpoint,
+                       state.finalized_checkpoint)
+    compute_pulled_up_tip(store, block_root)

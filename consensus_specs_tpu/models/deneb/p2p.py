@@ -1,0 +1,29 @@
+# Deneb -- p2p pure functions: blob sidecars.
+# Parity contract: specs/deneb/p2p-interface.md (:70-135).
+
+
+class BlobSidecar(Container):
+    index: BlobIndex
+    blob: Blob
+    kzg_commitment: KZGCommitment
+    kzg_proof: KZGProof
+    signed_block_header: SignedBeaconBlockHeader
+    kzg_commitment_inclusion_proof: Vector[Bytes32, KZG_COMMITMENT_INCLUSION_PROOF_DEPTH]
+
+
+class BlobIdentifier(Container):
+    block_root: Root
+    index: BlobIndex
+
+
+def verify_blob_sidecar_inclusion_proof(blob_sidecar: BlobSidecar) -> bool:
+    """Merkle proof of the commitment's membership in the block body."""
+    gindex = get_subtree_index(get_generalized_index(
+        BeaconBlockBody, "blob_kzg_commitments", int(blob_sidecar.index)))
+    return is_valid_merkle_branch(
+        leaf=hash_tree_root(blob_sidecar.kzg_commitment),
+        branch=blob_sidecar.kzg_commitment_inclusion_proof,
+        depth=KZG_COMMITMENT_INCLUSION_PROOF_DEPTH,
+        index=gindex,
+        root=blob_sidecar.signed_block_header.message.body_root,
+    )

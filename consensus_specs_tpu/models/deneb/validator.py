@@ -1,0 +1,82 @@
+# Deneb -- Honest Validator (executable spec source, delta).
+# Parity contract: specs/deneb/validator.md (:40-230).
+
+
+@dataclass
+class BlobsBundle(object):
+    commitments: Any
+    proofs: Any
+    blobs: Any
+
+
+@dataclass
+class GetPayloadResponse(object):
+    execution_payload: ExecutionPayload
+    block_value: uint256
+    blobs_bundle: BlobsBundle  # [New in Deneb:EIP4844]
+
+
+def compute_signed_block_header(
+        signed_block: SignedBeaconBlock) -> SignedBeaconBlockHeader:
+    block = signed_block.message
+    block_header = BeaconBlockHeader(
+        slot=block.slot,
+        proposer_index=block.proposer_index,
+        parent_root=block.parent_root,
+        state_root=block.state_root,
+        body_root=hash_tree_root(block.body),
+    )
+    return SignedBeaconBlockHeader(message=block_header,
+                                   signature=signed_block.signature)
+
+
+def prepare_execution_payload(state: BeaconState, safe_block_hash: Hash32,
+                              finalized_block_hash: Hash32,
+                              suggested_fee_recipient: ExecutionAddress,
+                              execution_engine: ExecutionEngine):
+    """fcU with the parent beacon block root attribute (EIP-4788)."""
+    # Verify consistency with the previous execution payload header
+    parent_hash = state.latest_execution_payload_header.block_hash
+
+    # Set the forkchoice head and initiate the payload build process
+    payload_attributes = PayloadAttributes(
+        timestamp=compute_time_at_slot(state, state.slot),
+        prev_randao=get_randao_mix(state, get_current_epoch(state)),
+        suggested_fee_recipient=suggested_fee_recipient,
+        withdrawals=get_expected_withdrawals(state),
+        # [New in Deneb:EIP4788]
+        parent_beacon_block_root=hash_tree_root(state.latest_block_header),
+    )
+    return execution_engine.notify_forkchoice_updated(
+        head_block_hash=parent_hash,
+        safe_block_hash=safe_block_hash,
+        finalized_block_hash=finalized_block_hash,
+        payload_attributes=payload_attributes,
+    )
+
+
+def get_blob_sidecars(signed_block: SignedBeaconBlock, blobs,
+                      blob_kzg_proofs):
+    """Package a block's blobs into gossip sidecars with inclusion
+    proofs (validator.md :170-192)."""
+    block = signed_block.message
+    signed_block_header = compute_signed_block_header(signed_block)
+    return [
+        BlobSidecar(
+            index=index,
+            blob=blob,
+            kzg_commitment=block.body.blob_kzg_commitments[index],
+            kzg_proof=blob_kzg_proofs[index],
+            signed_block_header=signed_block_header,
+            kzg_commitment_inclusion_proof=compute_merkle_proof_backing(
+                block.body,
+                get_generalized_index(BeaconBlockBody,
+                                      "blob_kzg_commitments", index),
+            ),
+        )
+        for index, blob in enumerate(blobs)
+    ]
+
+
+def compute_subnet_for_blob_sidecar(blob_index: BlobIndex) -> SubnetID:
+    return SubnetID(blob_index % config.BLOB_SIDECAR_SUBNET_COUNT)

@@ -1,0 +1,1324 @@
+# Electra -- The Beacon Chain (executable spec source, delta over deneb).
+#
+# EIP-7251 (maxEB: compounding credentials, balance-denominated churn,
+# pending deposits/withdrawals/consolidations), EIP-6110 (EL-triggered
+# deposits), EIP-7002 (EL-triggered withdrawals), EIP-7549 (committee-bits
+# attestations), EIP-7691 (blob throughput).  Parity contract:
+# specs/electra/beacon-chain.md (constants :126-216, containers :218-421,
+# helpers :423-830, epoch :833-1069, engine :1071-1163,
+# block :1165-1860).
+
+# ---------------------------------------------------------------------------
+# Constants (beacon-chain.md :126-150)
+# ---------------------------------------------------------------------------
+
+UNSET_DEPOSIT_REQUESTS_START_INDEX = uint64(2**64 - 1)
+FULL_EXIT_REQUEST_AMOUNT = uint64(0)
+COMPOUNDING_WITHDRAWAL_PREFIX = Bytes1("0x02")
+DEPOSIT_REQUEST_TYPE = Bytes1("0x00")
+WITHDRAWAL_REQUEST_TYPE = Bytes1("0x01")
+CONSOLIDATION_REQUEST_TYPE = Bytes1("0x02")
+
+
+# ---------------------------------------------------------------------------
+# New containers (beacon-chain.md :220-311)
+# ---------------------------------------------------------------------------
+
+
+class PendingDeposit(Container):
+    pubkey: BLSPubkey
+    withdrawal_credentials: Bytes32
+    amount: Gwei
+    signature: BLSSignature
+    slot: Slot
+
+
+class PendingPartialWithdrawal(Container):
+    validator_index: ValidatorIndex
+    amount: Gwei
+    withdrawable_epoch: Epoch
+
+
+class PendingConsolidation(Container):
+    source_index: ValidatorIndex
+    target_index: ValidatorIndex
+
+
+class DepositRequest(Container):
+    pubkey: BLSPubkey
+    withdrawal_credentials: Bytes32
+    amount: Gwei
+    signature: BLSSignature
+    index: uint64
+
+
+class WithdrawalRequest(Container):
+    source_address: ExecutionAddress
+    validator_pubkey: BLSPubkey
+    amount: Gwei
+
+
+class ConsolidationRequest(Container):
+    source_address: ExecutionAddress
+    source_pubkey: BLSPubkey
+    target_pubkey: BLSPubkey
+
+
+class ExecutionRequests(Container):
+    # [New in Electra:EIP6110]
+    deposits: List[DepositRequest, MAX_DEPOSIT_REQUESTS_PER_PAYLOAD]
+    # [New in Electra:EIP7002:EIP7251]
+    withdrawals: List[WithdrawalRequest, MAX_WITHDRAWAL_REQUESTS_PER_PAYLOAD]
+    # [New in Electra:EIP7251]
+    consolidations: List[ConsolidationRequest, MAX_CONSOLIDATION_REQUESTS_PER_PAYLOAD]
+
+
+class SingleAttestation(Container):
+    committee_index: CommitteeIndex
+    attester_index: ValidatorIndex
+    data: AttestationData
+    signature: BLSSignature
+
+
+# ---------------------------------------------------------------------------
+# Modified containers (beacon-chain.md :313-421)
+# ---------------------------------------------------------------------------
+
+
+class Attestation(Container):
+    # [Modified in Electra:EIP7549]
+    aggregation_bits: Bitlist[MAX_VALIDATORS_PER_COMMITTEE * MAX_COMMITTEES_PER_SLOT]
+    data: AttestationData
+    signature: BLSSignature
+    # [New in Electra:EIP7549]
+    committee_bits: Bitvector[MAX_COMMITTEES_PER_SLOT]
+
+
+class IndexedAttestation(Container):
+    # [Modified in Electra:EIP7549]
+    attesting_indices: List[ValidatorIndex, MAX_VALIDATORS_PER_COMMITTEE * MAX_COMMITTEES_PER_SLOT]
+    data: AttestationData
+    signature: BLSSignature
+
+
+class AttesterSlashing(Container):
+    # [Modified in Electra:EIP7549]
+    attestation_1: IndexedAttestation
+    attestation_2: IndexedAttestation
+
+
+class BeaconBlockBody(Container):
+    randao_reveal: BLSSignature
+    eth1_data: Eth1Data
+    graffiti: Bytes32
+    proposer_slashings: List[ProposerSlashing, MAX_PROPOSER_SLASHINGS]
+    # [Modified in Electra:EIP7549]
+    attester_slashings: List[AttesterSlashing, MAX_ATTESTER_SLASHINGS_ELECTRA]
+    # [Modified in Electra:EIP7549]
+    attestations: List[Attestation, MAX_ATTESTATIONS_ELECTRA]
+    deposits: List[Deposit, MAX_DEPOSITS]
+    voluntary_exits: List[SignedVoluntaryExit, MAX_VOLUNTARY_EXITS]
+    sync_aggregate: SyncAggregate
+    execution_payload: ExecutionPayload
+    bls_to_execution_changes: List[SignedBLSToExecutionChange, MAX_BLS_TO_EXECUTION_CHANGES]
+    blob_kzg_commitments: List[KZGCommitment, MAX_BLOB_COMMITMENTS_PER_BLOCK]
+    # [New in Electra]
+    execution_requests: ExecutionRequests
+
+
+class BeaconBlock(Container):
+    slot: Slot
+    proposer_index: ValidatorIndex
+    parent_root: Root
+    state_root: Root
+    body: BeaconBlockBody
+
+
+class SignedBeaconBlock(Container):
+    message: BeaconBlock
+    signature: BLSSignature
+
+
+class BeaconState(Container):
+    genesis_time: uint64
+    genesis_validators_root: Root
+    slot: Slot
+    fork: Fork
+    latest_block_header: BeaconBlockHeader
+    block_roots: Vector[Root, SLOTS_PER_HISTORICAL_ROOT]
+    state_roots: Vector[Root, SLOTS_PER_HISTORICAL_ROOT]
+    historical_roots: List[Root, HISTORICAL_ROOTS_LIMIT]
+    eth1_data: Eth1Data
+    eth1_data_votes: List[Eth1Data, EPOCHS_PER_ETH1_VOTING_PERIOD * SLOTS_PER_EPOCH]
+    eth1_deposit_index: uint64
+    validators: List[Validator, VALIDATOR_REGISTRY_LIMIT]
+    balances: List[Gwei, VALIDATOR_REGISTRY_LIMIT]
+    randao_mixes: Vector[Bytes32, EPOCHS_PER_HISTORICAL_VECTOR]
+    slashings: Vector[Gwei, EPOCHS_PER_SLASHINGS_VECTOR]
+    previous_epoch_participation: List[ParticipationFlags, VALIDATOR_REGISTRY_LIMIT]
+    current_epoch_participation: List[ParticipationFlags, VALIDATOR_REGISTRY_LIMIT]
+    justification_bits: Bitvector[JUSTIFICATION_BITS_LENGTH]
+    previous_justified_checkpoint: Checkpoint
+    current_justified_checkpoint: Checkpoint
+    finalized_checkpoint: Checkpoint
+    inactivity_scores: List[uint64, VALIDATOR_REGISTRY_LIMIT]
+    current_sync_committee: SyncCommittee
+    next_sync_committee: SyncCommittee
+    latest_execution_payload_header: ExecutionPayloadHeader
+    next_withdrawal_index: WithdrawalIndex
+    next_withdrawal_validator_index: ValidatorIndex
+    historical_summaries: List[HistoricalSummary, HISTORICAL_ROOTS_LIMIT]
+    # [New in Electra:EIP6110]
+    deposit_requests_start_index: uint64
+    # [New in Electra:EIP7251]
+    deposit_balance_to_consume: Gwei
+    exit_balance_to_consume: Gwei
+    earliest_exit_epoch: Epoch
+    consolidation_balance_to_consume: Gwei
+    earliest_consolidation_epoch: Epoch
+    pending_deposits: List[PendingDeposit, PENDING_DEPOSITS_LIMIT]
+    pending_partial_withdrawals: List[PendingPartialWithdrawal, PENDING_PARTIAL_WITHDRAWALS_LIMIT]
+    pending_consolidations: List[PendingConsolidation, PENDING_CONSOLIDATIONS_LIMIT]
+
+
+# ---------------------------------------------------------------------------
+# Predicates (beacon-chain.md :425-546)
+# ---------------------------------------------------------------------------
+
+
+def compute_proposer_index(state: BeaconState, indices, seed: Bytes32) -> ValidatorIndex:
+    """Effective-balance-weighted sampling with a 16-bit random value and
+    the electra max effective balance."""
+    assert len(indices) > 0
+    MAX_RANDOM_VALUE = 2**16 - 1  # [Modified in Electra]
+    i = uint64(0)
+    total = uint64(len(indices))
+    while True:
+        candidate_index = indices[compute_shuffled_index(i % total, total, seed)]
+        # [Modified in Electra]
+        random_bytes = hash(seed + uint_to_bytes(uint64(i // 16)))
+        offset = i % 16 * 2
+        random_value = bytes_to_uint64(random_bytes[offset:offset + 2])
+        effective_balance = state.validators[candidate_index].effective_balance
+        # [Modified in Electra:EIP7251]
+        if (effective_balance * MAX_RANDOM_VALUE
+                >= MAX_EFFECTIVE_BALANCE_ELECTRA * random_value):
+            return candidate_index
+        i += 1
+
+
+def is_eligible_for_activation_queue(validator: Validator) -> bool:
+    """Eligible for the activation queue (EIP-7251 threshold)."""
+    return (
+        validator.activation_eligibility_epoch == FAR_FUTURE_EPOCH
+        # [Modified in Electra:EIP7251]
+        and validator.effective_balance >= MIN_ACTIVATION_BALANCE
+    )
+
+
+def is_compounding_withdrawal_credential(withdrawal_credentials: Bytes32) -> bool:
+    return withdrawal_credentials[:1] == COMPOUNDING_WITHDRAWAL_PREFIX
+
+
+def has_compounding_withdrawal_credential(validator: Validator) -> bool:
+    """0x02-prefixed ("compounding") withdrawal credential?"""
+    return is_compounding_withdrawal_credential(validator.withdrawal_credentials)
+
+
+def has_execution_withdrawal_credential(validator: Validator) -> bool:
+    """0x01 or 0x02 prefixed withdrawal credential?"""
+    return (has_eth1_withdrawal_credential(validator)
+            or has_compounding_withdrawal_credential(validator))
+
+
+def is_fully_withdrawable_validator(validator: Validator, balance: Gwei,
+                                    epoch: Epoch) -> bool:
+    return (
+        # [Modified in Electra:EIP7251]
+        has_execution_withdrawal_credential(validator)
+        and validator.withdrawable_epoch <= epoch
+        and balance > 0
+    )
+
+
+def is_partially_withdrawable_validator(validator: Validator,
+                                        balance: Gwei) -> bool:
+    max_effective_balance = get_max_effective_balance(validator)
+    # [Modified in Electra:EIP7251]
+    has_max_effective_balance = (validator.effective_balance
+                                 == max_effective_balance)
+    has_excess_balance = balance > max_effective_balance
+    return (
+        has_execution_withdrawal_credential(validator)
+        and has_max_effective_balance
+        and has_excess_balance
+    )
+
+
+# ---------------------------------------------------------------------------
+# Misc + accessors (beacon-chain.md :548-673)
+# ---------------------------------------------------------------------------
+
+
+def get_committee_indices(committee_bits) -> Sequence[CommitteeIndex]:
+    return [CommitteeIndex(index) for index, bit in enumerate(committee_bits)
+            if bit]
+
+
+def get_max_effective_balance(validator: Validator) -> Gwei:
+    """Max effective balance by credential type."""
+    if has_compounding_withdrawal_credential(validator):
+        return MAX_EFFECTIVE_BALANCE_ELECTRA
+    else:
+        return MIN_ACTIVATION_BALANCE
+
+
+def get_balance_churn_limit(state: BeaconState) -> Gwei:
+    """Balance-denominated churn limit for the current epoch."""
+    churn = max(config.MIN_PER_EPOCH_CHURN_LIMIT_ELECTRA,
+                get_total_active_balance(state) // config.CHURN_LIMIT_QUOTIENT)
+    return churn - churn % EFFECTIVE_BALANCE_INCREMENT
+
+
+def get_activation_exit_churn_limit(state: BeaconState) -> Gwei:
+    """Churn limit dedicated to activations and exits."""
+    return min(config.MAX_PER_EPOCH_ACTIVATION_EXIT_CHURN_LIMIT,
+               get_balance_churn_limit(state))
+
+
+def get_consolidation_churn_limit(state: BeaconState) -> Gwei:
+    return get_balance_churn_limit(state) - get_activation_exit_churn_limit(state)
+
+
+def get_pending_balance_to_withdraw(state: BeaconState,
+                                    validator_index: ValidatorIndex) -> Gwei:
+    return sum(
+        withdrawal.amount for withdrawal in state.pending_partial_withdrawals
+        if withdrawal.validator_index == validator_index
+    )
+
+
+def get_attesting_indices(state: BeaconState,
+                          attestation: Attestation) -> Set[ValidatorIndex]:
+    """Attesting indices from aggregation_bits + committee_bits
+    (EIP-7549)."""
+    output: Set[ValidatorIndex] = set()
+    committee_indices = get_committee_indices(attestation.committee_bits)
+    committee_offset = 0
+    for committee_index in committee_indices:
+        committee = get_beacon_committee(state, attestation.data.slot,
+                                         committee_index)
+        committee_attesters = set(
+            attester_index for i, attester_index in enumerate(committee)
+            if attestation.aggregation_bits[committee_offset + i])
+        output = output.union(committee_attesters)
+
+        committee_offset += len(committee)
+
+    return output
+
+
+def get_next_sync_committee_indices(state: BeaconState) -> Sequence[ValidatorIndex]:
+    """Sampling with a 16-bit random value and the electra max effective
+    balance."""
+    epoch = Epoch(get_current_epoch(state) + 1)
+
+    MAX_RANDOM_VALUE = 2**16 - 1  # [Modified in Electra]
+    active_validator_indices = get_active_validator_indices(state, epoch)
+    active_validator_count = uint64(len(active_validator_indices))
+    seed = get_seed(state, epoch, DOMAIN_SYNC_COMMITTEE)
+    i = uint64(0)
+    sync_committee_indices = []
+    while len(sync_committee_indices) < SYNC_COMMITTEE_SIZE:
+        shuffled_index = compute_shuffled_index(
+            uint64(i % active_validator_count), active_validator_count, seed)
+        candidate_index = active_validator_indices[shuffled_index]
+        # [Modified in Electra]
+        random_bytes = hash(seed + uint_to_bytes(uint64(i // 16)))
+        offset = i % 16 * 2
+        random_value = bytes_to_uint64(random_bytes[offset:offset + 2])
+        effective_balance = state.validators[candidate_index].effective_balance
+        # [Modified in Electra:EIP7251]
+        if (effective_balance * MAX_RANDOM_VALUE
+                >= MAX_EFFECTIVE_BALANCE_ELECTRA * random_value):
+            sync_committee_indices.append(candidate_index)
+        i += 1
+    return sync_committee_indices
+
+
+# ---------------------------------------------------------------------------
+# Mutators (beacon-chain.md :675-830)
+# ---------------------------------------------------------------------------
+
+
+def initiate_validator_exit(state: BeaconState, index: ValidatorIndex) -> None:
+    """Exit via the balance-churn queue (EIP-7251)."""
+    # Return if validator already initiated exit
+    validator = state.validators[index]
+    if validator.exit_epoch != FAR_FUTURE_EPOCH:
+        return
+
+    # Compute exit queue epoch [Modified in Electra:EIP7251]
+    exit_queue_epoch = compute_exit_epoch_and_update_churn(
+        state, validator.effective_balance)
+
+    # Set validator exit epoch and withdrawable epoch
+    validator.exit_epoch = exit_queue_epoch
+    validator.withdrawable_epoch = Epoch(
+        validator.exit_epoch + config.MIN_VALIDATOR_WITHDRAWABILITY_DELAY)
+
+
+def switch_to_compounding_validator(state: BeaconState,
+                                    index: ValidatorIndex) -> None:
+    validator = state.validators[index]
+    validator.withdrawal_credentials = (
+        COMPOUNDING_WITHDRAWAL_PREFIX + validator.withdrawal_credentials[1:])
+    queue_excess_active_balance(state, index)
+
+
+def queue_excess_active_balance(state: BeaconState,
+                                index: ValidatorIndex) -> None:
+    balance = state.balances[index]
+    if balance > MIN_ACTIVATION_BALANCE:
+        excess_balance = balance - MIN_ACTIVATION_BALANCE
+        state.balances[index] = MIN_ACTIVATION_BALANCE
+        validator = state.validators[index]
+        # G2 infinity signature + GENESIS_SLOT distinguish this from a
+        # pending deposit request
+        state.pending_deposits.append(PendingDeposit(
+            pubkey=validator.pubkey,
+            withdrawal_credentials=validator.withdrawal_credentials,
+            amount=excess_balance,
+            signature=G2_POINT_AT_INFINITY,
+            slot=GENESIS_SLOT,
+        ))
+
+
+def compute_exit_epoch_and_update_churn(state: BeaconState,
+                                        exit_balance: Gwei) -> Epoch:
+    """Allocate `exit_balance` into the earliest epoch(s) with spare exit
+    churn (beacon-chain.md :733-759)."""
+    earliest_exit_epoch = max(
+        state.earliest_exit_epoch,
+        compute_activation_exit_epoch(get_current_epoch(state)))
+    per_epoch_churn = get_activation_exit_churn_limit(state)
+    # New epoch for exits
+    if state.earliest_exit_epoch < earliest_exit_epoch:
+        exit_balance_to_consume = per_epoch_churn
+    else:
+        exit_balance_to_consume = state.exit_balance_to_consume
+
+    # Exit doesn't fit in the current earliest epoch
+    if exit_balance > exit_balance_to_consume:
+        balance_to_process = exit_balance - exit_balance_to_consume
+        additional_epochs = (balance_to_process - 1) // per_epoch_churn + 1
+        earliest_exit_epoch += additional_epochs
+        exit_balance_to_consume += additional_epochs * per_epoch_churn
+
+    # Consume the balance and update state variables
+    state.exit_balance_to_consume = exit_balance_to_consume - exit_balance
+    state.earliest_exit_epoch = earliest_exit_epoch
+
+    return state.earliest_exit_epoch
+
+
+def compute_consolidation_epoch_and_update_churn(
+        state: BeaconState, consolidation_balance: Gwei) -> Epoch:
+    """Same allocation scheme over the consolidation churn."""
+    earliest_consolidation_epoch = max(
+        state.earliest_consolidation_epoch,
+        compute_activation_exit_epoch(get_current_epoch(state)))
+    per_epoch_consolidation_churn = get_consolidation_churn_limit(state)
+    # New epoch for consolidations
+    if state.earliest_consolidation_epoch < earliest_consolidation_epoch:
+        consolidation_balance_to_consume = per_epoch_consolidation_churn
+    else:
+        consolidation_balance_to_consume = state.consolidation_balance_to_consume
+
+    # Consolidation doesn't fit in the current earliest epoch
+    if consolidation_balance > consolidation_balance_to_consume:
+        balance_to_process = (consolidation_balance
+                              - consolidation_balance_to_consume)
+        additional_epochs = ((balance_to_process - 1)
+                             // per_epoch_consolidation_churn + 1)
+        earliest_consolidation_epoch += additional_epochs
+        consolidation_balance_to_consume += (additional_epochs
+                                             * per_epoch_consolidation_churn)
+
+    # Consume the balance and update state variables
+    state.consolidation_balance_to_consume = (
+        consolidation_balance_to_consume - consolidation_balance)
+    state.earliest_consolidation_epoch = earliest_consolidation_epoch
+
+    return state.earliest_consolidation_epoch
+
+
+def slash_validator(state: BeaconState, slashed_index: ValidatorIndex,
+                    whistleblower_index: ValidatorIndex = None) -> None:
+    """EIP-7251 slashing penalty and whistleblower quotients."""
+    epoch = get_current_epoch(state)
+    initiate_validator_exit(state, slashed_index)
+    validator = state.validators[slashed_index]
+    validator.slashed = True
+    validator.withdrawable_epoch = max(
+        validator.withdrawable_epoch,
+        Epoch(epoch + EPOCHS_PER_SLASHINGS_VECTOR))
+    state.slashings[epoch % EPOCHS_PER_SLASHINGS_VECTOR] += validator.effective_balance
+    # [Modified in Electra:EIP7251]
+    slashing_penalty = (validator.effective_balance
+                        // MIN_SLASHING_PENALTY_QUOTIENT_ELECTRA)
+    decrease_balance(state, slashed_index, slashing_penalty)
+
+    # Apply proposer and whistleblower rewards
+    proposer_index = get_beacon_proposer_index(state)
+    if whistleblower_index is None:
+        whistleblower_index = proposer_index
+    # [Modified in Electra:EIP7251]
+    whistleblower_reward = Gwei(validator.effective_balance
+                                // WHISTLEBLOWER_REWARD_QUOTIENT_ELECTRA)
+    proposer_reward = Gwei(whistleblower_reward * PROPOSER_WEIGHT
+                           // WEIGHT_DENOMINATOR)
+    increase_balance(state, proposer_index, proposer_reward)
+    increase_balance(state, whistleblower_index,
+                     Gwei(whistleblower_reward - proposer_reward))
+
+
+# ---------------------------------------------------------------------------
+# Epoch processing (beacon-chain.md :833-1069)
+# ---------------------------------------------------------------------------
+
+
+def process_epoch(state: BeaconState) -> None:
+    process_justification_and_finalization(state)
+    process_inactivity_updates(state)
+    process_rewards_and_penalties(state)
+    process_registry_updates(state)  # [Modified in Electra:EIP7251]
+    process_slashings(state)  # [Modified in Electra:EIP7251]
+    process_eth1_data_reset(state)
+    process_pending_deposits(state)  # [New in Electra:EIP7251]
+    process_pending_consolidations(state)  # [New in Electra:EIP7251]
+    process_effective_balance_updates(state)  # [Modified in Electra:EIP7251]
+    process_slashings_reset(state)
+    process_randao_mixes_reset(state)
+    process_historical_summaries_update(state)
+    process_participation_flag_updates(state)
+    process_sync_committee_updates(state)
+
+
+def process_registry_updates(state: BeaconState) -> None:
+    """Eligibility, ejections, and activations in a single sweep."""
+    current_epoch = get_current_epoch(state)
+    activation_epoch = compute_activation_exit_epoch(current_epoch)
+
+    for index, validator in enumerate(state.validators):
+        if is_eligible_for_activation_queue(validator):  # [Modified in Electra:EIP7251]
+            validator.activation_eligibility_epoch = current_epoch + 1
+        elif (is_active_validator(validator, current_epoch)
+                and validator.effective_balance <= config.EJECTION_BALANCE):
+            initiate_validator_exit(state, ValidatorIndex(index))  # [Modified in Electra:EIP7251]
+        elif is_eligible_for_activation(state, validator):
+            validator.activation_epoch = activation_epoch
+
+
+def process_slashings(state: BeaconState) -> None:
+    """Per-increment correlation penalty (EIP-7251)."""
+    epoch = get_current_epoch(state)
+    total_balance = get_total_active_balance(state)
+    adjusted_total_slashing_balance = min(
+        sum(state.slashings) * PROPORTIONAL_SLASHING_MULTIPLIER_BELLATRIX,
+        total_balance)
+    # Factored out from total balance to avoid uint64 overflow
+    increment = EFFECTIVE_BALANCE_INCREMENT
+    penalty_per_effective_balance_increment = (
+        adjusted_total_slashing_balance // (total_balance // increment))
+    for index, validator in enumerate(state.validators):
+        if (validator.slashed
+                and epoch + EPOCHS_PER_SLASHINGS_VECTOR // 2
+                == validator.withdrawable_epoch):
+            effective_balance_increments = (validator.effective_balance
+                                            // increment)
+            # [Modified in Electra:EIP7251]
+            penalty = (penalty_per_effective_balance_increment
+                       * effective_balance_increments)
+            decrease_balance(state, ValidatorIndex(index), penalty)
+
+
+def apply_pending_deposit(state: BeaconState, deposit: PendingDeposit) -> None:
+    """Apply `deposit` to the state (new validator or top-up)."""
+    validator_pubkeys = [v.pubkey for v in state.validators]
+    if deposit.pubkey not in validator_pubkeys:
+        # Verify the proof of possession (not checked by the contract)
+        if is_valid_deposit_signature(deposit.pubkey,
+                                      deposit.withdrawal_credentials,
+                                      deposit.amount, deposit.signature):
+            add_validator_to_registry(state, deposit.pubkey,
+                                      deposit.withdrawal_credentials,
+                                      deposit.amount)
+    else:
+        validator_index = ValidatorIndex(
+            validator_pubkeys.index(deposit.pubkey))
+        increase_balance(state, validator_index, deposit.amount)
+
+
+def process_pending_deposits(state: BeaconState) -> None:
+    """Drain the pending-deposit queue subject to: Eth1-bridge ordering,
+    finality of the deposit's slot, the per-epoch count limit, and the
+    activation churn (beacon-chain.md :940-1017)."""
+    next_epoch = Epoch(get_current_epoch(state) + 1)
+    available_for_processing = (state.deposit_balance_to_consume
+                                + get_activation_exit_churn_limit(state))
+    processed_amount = 0
+    next_deposit_index = 0
+    deposits_to_postpone = []
+    is_churn_limit_reached = False
+    finalized_slot = compute_start_slot_at_epoch(
+        state.finalized_checkpoint.epoch)
+
+    for deposit in state.pending_deposits:
+        # Deposit requests wait until all Eth1 bridge deposits apply
+        if (deposit.slot > GENESIS_SLOT
+                and state.eth1_deposit_index
+                < state.deposit_requests_start_index):
+            break
+
+        # Stop once deposits are no longer finalized
+        if deposit.slot > finalized_slot:
+            break
+
+        # Stop at the per-epoch processing limit
+        if next_deposit_index >= MAX_PENDING_DEPOSITS_PER_EPOCH:
+            break
+
+        # Read validator state
+        is_validator_exited = False
+        is_validator_withdrawn = False
+        validator_pubkeys = [v.pubkey for v in state.validators]
+        if deposit.pubkey in validator_pubkeys:
+            validator = state.validators[
+                ValidatorIndex(validator_pubkeys.index(deposit.pubkey))]
+            is_validator_exited = validator.exit_epoch < FAR_FUTURE_EPOCH
+            is_validator_withdrawn = validator.withdrawable_epoch < next_epoch
+
+        if is_validator_withdrawn:
+            # Balance can never activate: credit without consuming churn
+            apply_pending_deposit(state, deposit)
+        elif is_validator_exited:
+            # Exiting: postpone until after the withdrawable epoch
+            deposits_to_postpone.append(deposit)
+        else:
+            # Stop at the churn limit
+            is_churn_limit_reached = (processed_amount + deposit.amount
+                                      > available_for_processing)
+            if is_churn_limit_reached:
+                break
+
+            # Consume churn and apply deposit
+            processed_amount += deposit.amount
+            apply_pending_deposit(state, deposit)
+
+        # However handled, move on in the queue
+        next_deposit_index += 1
+
+    state.pending_deposits = (list(state.pending_deposits)[next_deposit_index:]
+                              + deposits_to_postpone)
+
+    # Accumulate churn only if the limit was hit
+    if is_churn_limit_reached:
+        state.deposit_balance_to_consume = (available_for_processing
+                                            - processed_amount)
+    else:
+        state.deposit_balance_to_consume = Gwei(0)
+
+
+def process_pending_consolidations(state: BeaconState) -> None:
+    next_epoch = Epoch(get_current_epoch(state) + 1)
+    next_pending_consolidation = 0
+    for pending_consolidation in state.pending_consolidations:
+        source_validator = state.validators[pending_consolidation.source_index]
+        if source_validator.slashed:
+            next_pending_consolidation += 1
+            continue
+        if source_validator.withdrawable_epoch > next_epoch:
+            break
+
+        # Consolidated balance = min(balance, effective balance)
+        source_effective_balance = min(
+            state.balances[pending_consolidation.source_index],
+            source_validator.effective_balance)
+
+        # Move active balance to target; excess stays withdrawable
+        decrease_balance(state, pending_consolidation.source_index,
+                         source_effective_balance)
+        increase_balance(state, pending_consolidation.target_index,
+                         source_effective_balance)
+        next_pending_consolidation += 1
+
+    state.pending_consolidations = list(
+        state.pending_consolidations)[next_pending_consolidation:]
+
+
+def process_effective_balance_updates(state: BeaconState) -> None:
+    """Hysteresis update against the per-validator max effective
+    balance (EIP-7251)."""
+    for index, validator in enumerate(state.validators):
+        balance = state.balances[index]
+        HYSTERESIS_INCREMENT = uint64(EFFECTIVE_BALANCE_INCREMENT
+                                      // HYSTERESIS_QUOTIENT)
+        DOWNWARD_THRESHOLD = (HYSTERESIS_INCREMENT
+                              * HYSTERESIS_DOWNWARD_MULTIPLIER)
+        UPWARD_THRESHOLD = HYSTERESIS_INCREMENT * HYSTERESIS_UPWARD_MULTIPLIER
+        # [Modified in Electra:EIP7251]
+        max_effective_balance = get_max_effective_balance(validator)
+
+        if (balance + DOWNWARD_THRESHOLD < validator.effective_balance
+                or validator.effective_balance + UPWARD_THRESHOLD < balance):
+            validator.effective_balance = min(
+                balance - balance % EFFECTIVE_BALANCE_INCREMENT,
+                max_effective_balance)
+
+
+# ---------------------------------------------------------------------------
+# Execution engine (beacon-chain.md :1071-1163)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class NewPayloadRequest(object):
+    execution_payload: ExecutionPayload
+    versioned_hashes: Sequence[VersionedHash]
+    parent_beacon_block_root: Root
+    # [New in Electra]
+    execution_requests: ExecutionRequests
+
+
+def get_execution_requests_list(
+        execution_requests: ExecutionRequests) -> Sequence[bytes]:
+    """EIP-7685 encoding: type byte + SSZ of each non-empty list."""
+    requests = [
+        (DEPOSIT_REQUEST_TYPE, execution_requests.deposits),
+        (WITHDRAWAL_REQUEST_TYPE, execution_requests.withdrawals),
+        (CONSOLIDATION_REQUEST_TYPE, execution_requests.consolidations),
+    ]
+
+    return [
+        request_type + serialize(request_data)
+        for request_type, request_data in requests
+        if len(request_data) != 0
+    ]
+
+
+class ExecutionEngine:
+    """EL protocol; notify/is_valid_block_hash carry the EIP-7685
+    requests list in Electra."""
+
+    def notify_new_payload(self, execution_payload, parent_beacon_block_root,
+                           execution_requests_list) -> bool:
+        raise NotImplementedError
+
+    def is_valid_block_hash(self, execution_payload,
+                            parent_beacon_block_root,
+                            execution_requests_list) -> bool:
+        raise NotImplementedError
+
+    def is_valid_versioned_hashes(self, new_payload_request) -> bool:
+        raise NotImplementedError
+
+    def verify_and_notify_new_payload(self, new_payload_request) -> bool:
+        execution_payload = new_payload_request.execution_payload
+        parent_beacon_block_root = new_payload_request.parent_beacon_block_root
+        # [New in Electra]
+        execution_requests_list = get_execution_requests_list(
+            new_payload_request.execution_requests)
+
+        if b"" in execution_payload.transactions:
+            return False
+
+        # [Modified in Electra]
+        if not self.is_valid_block_hash(execution_payload,
+                                        parent_beacon_block_root,
+                                        execution_requests_list):
+            return False
+
+        if not self.is_valid_versioned_hashes(new_payload_request):
+            return False
+
+        # [Modified in Electra]
+        if not self.notify_new_payload(execution_payload,
+                                       parent_beacon_block_root,
+                                       execution_requests_list):
+            return False
+
+        return True
+
+    def notify_forkchoice_updated(self, head_block_hash, safe_block_hash,
+                                  finalized_block_hash, payload_attributes):
+        raise NotImplementedError
+
+    def get_payload(self, payload_id):
+        raise NotImplementedError
+
+
+class NoopExecutionEngine(ExecutionEngine):
+    """Accept-everything EL stub
+    (`pysetup/spec_builders/electra.py` execution_engine_cls)."""
+
+    def notify_new_payload(self, execution_payload, parent_beacon_block_root,
+                           execution_requests_list) -> bool:
+        return True
+
+    def notify_forkchoice_updated(self, head_block_hash, safe_block_hash,
+                                  finalized_block_hash, payload_attributes):
+        pass
+
+    def get_payload(self, payload_id):
+        raise NotImplementedError("no default block production")
+
+    def is_valid_block_hash(self, execution_payload,
+                            parent_beacon_block_root,
+                            execution_requests_list) -> bool:
+        return True
+
+    def is_valid_versioned_hashes(self, new_payload_request) -> bool:
+        return True
+
+    def verify_and_notify_new_payload(self, new_payload_request) -> bool:
+        return True
+
+
+EXECUTION_ENGINE = NoopExecutionEngine()
+
+
+# ---------------------------------------------------------------------------
+# Block processing (beacon-chain.md :1165-1860)
+# ---------------------------------------------------------------------------
+
+
+def process_block(state: BeaconState, block: BeaconBlock) -> None:
+    process_block_header(state, block)
+    process_withdrawals(state, block.body.execution_payload)  # [Modified in Electra:EIP7251]
+    process_execution_payload(state, block.body, EXECUTION_ENGINE)  # [Modified in Electra:EIP6110]
+    process_randao(state, block.body)
+    process_eth1_data(state, block.body)
+    process_operations(state, block.body)  # [Modified in Electra]
+    process_sync_aggregate(state, block.body.sync_aggregate)
+
+
+def get_expected_withdrawals(state: BeaconState):
+    """Pending partial withdrawals first (EIP-7251), then the sweep;
+    returns (withdrawals, processed_partial_withdrawals_count)."""
+    epoch = get_current_epoch(state)
+    withdrawal_index = state.next_withdrawal_index
+    validator_index = state.next_withdrawal_validator_index
+    withdrawals = []
+    processed_partial_withdrawals_count = 0
+
+    # [New in Electra:EIP7251] Consume pending partial withdrawals
+    for withdrawal in state.pending_partial_withdrawals:
+        if (withdrawal.withdrawable_epoch > epoch
+                or len(withdrawals)
+                == MAX_PENDING_PARTIALS_PER_WITHDRAWALS_SWEEP):
+            break
+
+        validator = state.validators[withdrawal.validator_index]
+        has_sufficient_effective_balance = (
+            validator.effective_balance >= MIN_ACTIVATION_BALANCE)
+        total_withdrawn = sum(
+            w.amount for w in withdrawals
+            if w.validator_index == withdrawal.validator_index)
+        balance = state.balances[withdrawal.validator_index] - total_withdrawn
+        has_excess_balance = balance > MIN_ACTIVATION_BALANCE
+        if (validator.exit_epoch == FAR_FUTURE_EPOCH
+                and has_sufficient_effective_balance
+                and has_excess_balance):
+            withdrawable_balance = min(balance - MIN_ACTIVATION_BALANCE,
+                                       withdrawal.amount)
+            withdrawals.append(Withdrawal(
+                index=withdrawal_index,
+                validator_index=withdrawal.validator_index,
+                address=ExecutionAddress(validator.withdrawal_credentials[12:]),
+                amount=withdrawable_balance,
+            ))
+            withdrawal_index += WithdrawalIndex(1)
+
+        processed_partial_withdrawals_count += 1
+
+    # Sweep for remaining
+    bound = min(len(state.validators), MAX_VALIDATORS_PER_WITHDRAWALS_SWEEP)
+    for _ in range(bound):
+        validator = state.validators[validator_index]
+        # [Modified in Electra:EIP7251]
+        total_withdrawn = sum(w.amount for w in withdrawals
+                              if w.validator_index == validator_index)
+        balance = state.balances[validator_index] - total_withdrawn
+        if is_fully_withdrawable_validator(validator, balance, epoch):
+            withdrawals.append(Withdrawal(
+                index=withdrawal_index,
+                validator_index=validator_index,
+                address=ExecutionAddress(validator.withdrawal_credentials[12:]),
+                amount=balance,
+            ))
+            withdrawal_index += WithdrawalIndex(1)
+        elif is_partially_withdrawable_validator(validator, balance):
+            withdrawals.append(Withdrawal(
+                index=withdrawal_index,
+                validator_index=validator_index,
+                address=ExecutionAddress(validator.withdrawal_credentials[12:]),
+                # [Modified in Electra:EIP7251]
+                amount=balance - get_max_effective_balance(validator),
+            ))
+            withdrawal_index += WithdrawalIndex(1)
+        if len(withdrawals) == MAX_WITHDRAWALS_PER_PAYLOAD:
+            break
+        validator_index = ValidatorIndex(
+            (validator_index + 1) % len(state.validators))
+    return withdrawals, processed_partial_withdrawals_count
+
+
+def process_withdrawals(state: BeaconState,
+                        payload: ExecutionPayload) -> None:
+    # [Modified in Electra:EIP7251]
+    expected_withdrawals, processed_partial_withdrawals_count = (
+        get_expected_withdrawals(state))
+
+    assert payload.withdrawals == expected_withdrawals
+
+    for withdrawal in expected_withdrawals:
+        decrease_balance(state, withdrawal.validator_index, withdrawal.amount)
+
+    # [New in Electra:EIP7251] Update pending partial withdrawals
+    state.pending_partial_withdrawals = list(
+        state.pending_partial_withdrawals)[processed_partial_withdrawals_count:]
+
+    # Update the next withdrawal index if this block contained withdrawals
+    if len(expected_withdrawals) != 0:
+        latest_withdrawal = expected_withdrawals[-1]
+        state.next_withdrawal_index = WithdrawalIndex(
+            latest_withdrawal.index + 1)
+
+    # Update the next validator index for the next sweep
+    if len(expected_withdrawals) == MAX_WITHDRAWALS_PER_PAYLOAD:
+        next_validator_index = ValidatorIndex(
+            (expected_withdrawals[-1].validator_index + 1)
+            % len(state.validators))
+        state.next_withdrawal_validator_index = next_validator_index
+    else:
+        next_index = (state.next_withdrawal_validator_index
+                      + MAX_VALIDATORS_PER_WITHDRAWALS_SWEEP)
+        next_validator_index = ValidatorIndex(
+            next_index % len(state.validators))
+        state.next_withdrawal_validator_index = next_validator_index
+
+
+def process_execution_payload(state: BeaconState, body: BeaconBlockBody,
+                              execution_engine: ExecutionEngine) -> None:
+    payload = body.execution_payload
+
+    # Verify consistency with the previous execution payload header
+    assert payload.parent_hash == state.latest_execution_payload_header.block_hash
+    # Verify prev_randao
+    assert payload.prev_randao == get_randao_mix(state, get_current_epoch(state))
+    # Verify timestamp
+    assert payload.timestamp == compute_time_at_slot(state, state.slot)
+    # [Modified in Electra:EIP7691] Verify commitments are under limit
+    assert (len(body.blob_kzg_commitments)
+            <= config.MAX_BLOBS_PER_BLOCK_ELECTRA)
+    # Verify the execution payload is valid
+    versioned_hashes = [kzg_commitment_to_versioned_hash(commitment)
+                        for commitment in body.blob_kzg_commitments]
+    assert execution_engine.verify_and_notify_new_payload(
+        NewPayloadRequest(
+            execution_payload=payload,
+            versioned_hashes=versioned_hashes,
+            parent_beacon_block_root=state.latest_block_header.parent_root,
+            # [New in Electra]
+            execution_requests=body.execution_requests,
+        ))
+    # Cache execution payload header
+    state.latest_execution_payload_header = ExecutionPayloadHeader(
+        parent_hash=payload.parent_hash,
+        fee_recipient=payload.fee_recipient,
+        state_root=payload.state_root,
+        receipts_root=payload.receipts_root,
+        logs_bloom=payload.logs_bloom,
+        prev_randao=payload.prev_randao,
+        block_number=payload.block_number,
+        gas_limit=payload.gas_limit,
+        gas_used=payload.gas_used,
+        timestamp=payload.timestamp,
+        extra_data=payload.extra_data,
+        base_fee_per_gas=payload.base_fee_per_gas,
+        block_hash=payload.block_hash,
+        transactions_root=hash_tree_root(payload.transactions),
+        withdrawals_root=hash_tree_root(payload.withdrawals),
+        blob_gas_used=payload.blob_gas_used,
+        excess_blob_gas=payload.excess_blob_gas,
+    )
+
+
+def process_operations(state: BeaconState, body: BeaconBlockBody) -> None:
+    # [Modified in Electra:EIP6110]
+    # Disable the former deposit mechanism once all prior deposits apply
+    eth1_deposit_index_limit = min(state.eth1_data.deposit_count,
+                                   state.deposit_requests_start_index)
+    if state.eth1_deposit_index < eth1_deposit_index_limit:
+        assert len(body.deposits) == min(
+            MAX_DEPOSITS,
+            eth1_deposit_index_limit - state.eth1_deposit_index)
+    else:
+        assert len(body.deposits) == 0
+
+    def for_ops(operations, fn):
+        for operation in operations:
+            fn(state, operation)
+
+    for_ops(body.proposer_slashings, process_proposer_slashing)
+    for_ops(body.attester_slashings, process_attester_slashing)
+    for_ops(body.attestations, process_attestation)  # [Modified in Electra:EIP7549]
+    for_ops(body.deposits, process_deposit)
+    for_ops(body.voluntary_exits, process_voluntary_exit)  # [Modified in Electra:EIP7251]
+    for_ops(body.bls_to_execution_changes, process_bls_to_execution_change)
+    for_ops(body.execution_requests.deposits, process_deposit_request)  # [New in Electra:EIP6110]
+    for_ops(body.execution_requests.withdrawals, process_withdrawal_request)  # [New in Electra:EIP7002:EIP7251]
+    for_ops(body.execution_requests.consolidations, process_consolidation_request)  # [New in Electra:EIP7251]
+
+
+def process_attestation(state: BeaconState, attestation: Attestation) -> None:
+    """Committee-bits attestation processing (EIP-7549)."""
+    data = attestation.data
+    assert data.target.epoch in (get_previous_epoch(state),
+                                 get_current_epoch(state))
+    assert data.target.epoch == compute_epoch_at_slot(data.slot)
+    assert data.slot + MIN_ATTESTATION_INCLUSION_DELAY <= state.slot
+
+    # [Modified in Electra:EIP7549]
+    assert data.index == 0
+    committee_indices = get_committee_indices(attestation.committee_bits)
+    committee_offset = 0
+    for committee_index in committee_indices:
+        assert committee_index < get_committee_count_per_slot(
+            state, data.target.epoch)
+        committee = get_beacon_committee(state, data.slot, committee_index)
+        committee_attesters = set(
+            attester_index for i, attester_index in enumerate(committee)
+            if attestation.aggregation_bits[committee_offset + i])
+        assert len(committee_attesters) > 0
+        committee_offset += len(committee)
+
+    # Bitfield length matches total number of participants
+    assert len(attestation.aggregation_bits) == committee_offset
+
+    # Participation flag indices
+    participation_flag_indices = get_attestation_participation_flag_indices(
+        state, data, state.slot - data.slot)
+
+    # Verify signature
+    assert is_valid_indexed_attestation(
+        state, get_indexed_attestation(state, attestation))
+
+    # Update epoch participation flags
+    if data.target.epoch == get_current_epoch(state):
+        epoch_participation = state.current_epoch_participation
+    else:
+        epoch_participation = state.previous_epoch_participation
+
+    proposer_reward_numerator = 0
+    for index in get_attesting_indices(state, attestation):
+        for flag_index, weight in enumerate(PARTICIPATION_FLAG_WEIGHTS):
+            if (flag_index in participation_flag_indices
+                    and not has_flag(epoch_participation[index], flag_index)):
+                epoch_participation[index] = add_flag(
+                    epoch_participation[index], flag_index)
+                proposer_reward_numerator += get_base_reward(state, index) * weight
+
+    # Reward proposer
+    proposer_reward_denominator = ((WEIGHT_DENOMINATOR - PROPOSER_WEIGHT)
+                                   * WEIGHT_DENOMINATOR // PROPOSER_WEIGHT)
+    proposer_reward = Gwei(proposer_reward_numerator
+                           // proposer_reward_denominator)
+    increase_balance(state, get_beacon_proposer_index(state), proposer_reward)
+
+
+def get_validator_from_deposit(pubkey: BLSPubkey,
+                               withdrawal_credentials: Bytes32,
+                               amount: uint64) -> Validator:
+    """Effective balance capped per credential type (EIP-7251)."""
+    validator = Validator(
+        pubkey=pubkey,
+        withdrawal_credentials=withdrawal_credentials,
+        effective_balance=Gwei(0),
+        slashed=False,
+        activation_eligibility_epoch=FAR_FUTURE_EPOCH,
+        activation_epoch=FAR_FUTURE_EPOCH,
+        exit_epoch=FAR_FUTURE_EPOCH,
+        withdrawable_epoch=FAR_FUTURE_EPOCH,
+    )
+
+    # [Modified in Electra:EIP7251]
+    max_effective_balance = get_max_effective_balance(validator)
+    validator.effective_balance = min(
+        amount - amount % EFFECTIVE_BALANCE_INCREMENT, max_effective_balance)
+
+    return validator
+
+
+def apply_deposit(state: BeaconState, pubkey: BLSPubkey,
+                  withdrawal_credentials: Bytes32, amount: uint64,
+                  signature: BLSSignature) -> None:
+    """Register the validator with zero balance and queue the amount as
+    a pending deposit (EIP-7251)."""
+    validator_pubkeys = [v.pubkey for v in state.validators]
+    if pubkey not in validator_pubkeys:
+        # Verify the proof of possession (not checked by the contract)
+        if is_valid_deposit_signature(pubkey, withdrawal_credentials,
+                                      amount, signature):
+            # [Modified in Electra:EIP7251]
+            add_validator_to_registry(state, pubkey, withdrawal_credentials,
+                                      Gwei(0))
+        else:
+            return
+
+    # [Modified in Electra:EIP7251] queue the balance
+    state.pending_deposits.append(PendingDeposit(
+        pubkey=pubkey,
+        withdrawal_credentials=withdrawal_credentials,
+        amount=amount,
+        signature=signature,
+        # GENESIS_SLOT distinguishes from a pending deposit request
+        slot=GENESIS_SLOT,
+    ))
+
+
+def process_voluntary_exit(state: BeaconState,
+                           signed_voluntary_exit: SignedVoluntaryExit) -> None:
+    """Additionally requires an empty pending-withdrawal queue for the
+    validator (EIP-7251)."""
+    voluntary_exit = signed_voluntary_exit.message
+    validator = state.validators[voluntary_exit.validator_index]
+    # Verify the validator is active
+    assert is_active_validator(validator, get_current_epoch(state))
+    # Verify exit has not been initiated
+    assert validator.exit_epoch == FAR_FUTURE_EPOCH
+    # Exits are not valid before their epoch
+    assert get_current_epoch(state) >= voluntary_exit.epoch
+    # Verify the validator has been active long enough
+    assert (get_current_epoch(state)
+            >= validator.activation_epoch + config.SHARD_COMMITTEE_PERIOD)
+    # [New in Electra:EIP7251] no pending withdrawals in the queue
+    assert get_pending_balance_to_withdraw(
+        state, voluntary_exit.validator_index) == 0
+    # Verify signature
+    domain = compute_domain(DOMAIN_VOLUNTARY_EXIT,
+                            config.CAPELLA_FORK_VERSION,
+                            state.genesis_validators_root)
+    signing_root = compute_signing_root(voluntary_exit, domain)
+    assert bls.Verify(validator.pubkey, signing_root,
+                      signed_voluntary_exit.signature)
+    # Initiate exit
+    initiate_validator_exit(state, voluntary_exit.validator_index)
+
+
+def process_withdrawal_request(
+        state: BeaconState, withdrawal_request: WithdrawalRequest) -> None:
+    """EL-triggered exit / partial withdrawal (EIP-7002/EIP-7251);
+    invalid requests are ignored, not asserted."""
+    amount = withdrawal_request.amount
+    is_full_exit_request = amount == FULL_EXIT_REQUEST_AMOUNT
+
+    # If the partial queue is full, only full exits are processed
+    if (len(state.pending_partial_withdrawals)
+            == PENDING_PARTIAL_WITHDRAWALS_LIMIT
+            and not is_full_exit_request):
+        return
+
+    validator_pubkeys = [v.pubkey for v in state.validators]
+    # Verify pubkey exists
+    request_pubkey = withdrawal_request.validator_pubkey
+    if request_pubkey not in validator_pubkeys:
+        return
+    index = ValidatorIndex(validator_pubkeys.index(request_pubkey))
+    validator = state.validators[index]
+
+    # Verify withdrawal credentials
+    has_correct_credential = has_execution_withdrawal_credential(validator)
+    is_correct_source_address = (
+        validator.withdrawal_credentials[12:]
+        == withdrawal_request.source_address)
+    if not (has_correct_credential and is_correct_source_address):
+        return
+    # Verify the validator is active
+    if not is_active_validator(validator, get_current_epoch(state)):
+        return
+    # Verify exit has not been initiated
+    if validator.exit_epoch != FAR_FUTURE_EPOCH:
+        return
+    # Verify the validator has been active long enough
+    if (get_current_epoch(state)
+            < validator.activation_epoch + config.SHARD_COMMITTEE_PERIOD):
+        return
+
+    pending_balance_to_withdraw = get_pending_balance_to_withdraw(state, index)
+
+    if is_full_exit_request:
+        # Only exit if the queue holds nothing for this validator
+        if pending_balance_to_withdraw == 0:
+            initiate_validator_exit(state, index)
+        return
+
+    has_sufficient_effective_balance = (
+        validator.effective_balance >= MIN_ACTIVATION_BALANCE)
+    has_excess_balance = (
+        state.balances[index]
+        > MIN_ACTIVATION_BALANCE + pending_balance_to_withdraw)
+
+    # Partial withdrawals need compounding credentials
+    if (has_compounding_withdrawal_credential(validator)
+            and has_sufficient_effective_balance
+            and has_excess_balance):
+        to_withdraw = min(
+            state.balances[index] - MIN_ACTIVATION_BALANCE
+            - pending_balance_to_withdraw,
+            amount)
+        exit_queue_epoch = compute_exit_epoch_and_update_churn(state,
+                                                               to_withdraw)
+        withdrawable_epoch = Epoch(
+            exit_queue_epoch + config.MIN_VALIDATOR_WITHDRAWABILITY_DELAY)
+        state.pending_partial_withdrawals.append(PendingPartialWithdrawal(
+            validator_index=index,
+            amount=to_withdraw,
+            withdrawable_epoch=withdrawable_epoch,
+        ))
+
+
+def process_deposit_request(state: BeaconState,
+                            deposit_request: DepositRequest) -> None:
+    """EL-triggered deposit (EIP-6110)."""
+    # Set deposit request start index
+    if state.deposit_requests_start_index == UNSET_DEPOSIT_REQUESTS_START_INDEX:
+        state.deposit_requests_start_index = deposit_request.index
+
+    # Create pending deposit
+    state.pending_deposits.append(PendingDeposit(
+        pubkey=deposit_request.pubkey,
+        withdrawal_credentials=deposit_request.withdrawal_credentials,
+        amount=deposit_request.amount,
+        signature=deposit_request.signature,
+        slot=state.slot,
+    ))
+
+
+def is_valid_switch_to_compounding_request(
+        state: BeaconState,
+        consolidation_request: ConsolidationRequest) -> bool:
+    # Switch to compounding requires source == target
+    if (consolidation_request.source_pubkey
+            != consolidation_request.target_pubkey):
+        return False
+
+    # Verify pubkey exists
+    source_pubkey = consolidation_request.source_pubkey
+    validator_pubkeys = [v.pubkey for v in state.validators]
+    if source_pubkey not in validator_pubkeys:
+        return False
+
+    source_validator = state.validators[
+        ValidatorIndex(validator_pubkeys.index(source_pubkey))]
+
+    # Verify request has been authorized
+    if (source_validator.withdrawal_credentials[12:]
+            != consolidation_request.source_address):
+        return False
+
+    # Verify source withdrawal credentials
+    if not has_eth1_withdrawal_credential(source_validator):
+        return False
+
+    # Verify the source is active
+    current_epoch = get_current_epoch(state)
+    if not is_active_validator(source_validator, current_epoch):
+        return False
+
+    # Verify exit for source has not been initiated
+    if source_validator.exit_epoch != FAR_FUTURE_EPOCH:
+        return False
+
+    return True
+
+
+def process_consolidation_request(
+        state: BeaconState,
+        consolidation_request: ConsolidationRequest) -> None:
+    """EL-triggered consolidation / switch-to-compounding (EIP-7251)."""
+    if is_valid_switch_to_compounding_request(state, consolidation_request):
+        validator_pubkeys = [v.pubkey for v in state.validators]
+        request_source_pubkey = consolidation_request.source_pubkey
+        source_index = ValidatorIndex(
+            validator_pubkeys.index(request_source_pubkey))
+        switch_to_compounding_validator(state, source_index)
+        return
+
+    # source != target, so a consolidation cannot be used as an exit
+    if (consolidation_request.source_pubkey
+            == consolidation_request.target_pubkey):
+        return
+    # A full pending queue ignores consolidation requests
+    if len(state.pending_consolidations) == PENDING_CONSOLIDATIONS_LIMIT:
+        return
+    # Too little consolidation churn also ignores them
+    if get_consolidation_churn_limit(state) <= MIN_ACTIVATION_BALANCE:
+        return
+
+    validator_pubkeys = [v.pubkey for v in state.validators]
+    # Verify pubkeys exist
+    request_source_pubkey = consolidation_request.source_pubkey
+    request_target_pubkey = consolidation_request.target_pubkey
+    if request_source_pubkey not in validator_pubkeys:
+        return
+    if request_target_pubkey not in validator_pubkeys:
+        return
+    source_index = ValidatorIndex(
+        validator_pubkeys.index(request_source_pubkey))
+    target_index = ValidatorIndex(
+        validator_pubkeys.index(request_target_pubkey))
+    source_validator = state.validators[source_index]
+    target_validator = state.validators[target_index]
+
+    # Verify source withdrawal credentials
+    has_correct_credential = has_execution_withdrawal_credential(
+        source_validator)
+    is_correct_source_address = (
+        source_validator.withdrawal_credentials[12:]
+        == consolidation_request.source_address)
+    if not (has_correct_credential and is_correct_source_address):
+        return
+
+    # Target must have compounding credentials
+    if not has_compounding_withdrawal_credential(target_validator):
+        return
+
+    # Both must be active with no exit initiated
+    current_epoch = get_current_epoch(state)
+    if not is_active_validator(source_validator, current_epoch):
+        return
+    if not is_active_validator(target_validator, current_epoch):
+        return
+    if source_validator.exit_epoch != FAR_FUTURE_EPOCH:
+        return
+    if target_validator.exit_epoch != FAR_FUTURE_EPOCH:
+        return
+    # Source must have been active long enough
+    if (current_epoch
+            < source_validator.activation_epoch
+            + config.SHARD_COMMITTEE_PERIOD):
+        return
+    # Source must have no pending withdrawals in the queue
+    if get_pending_balance_to_withdraw(state, source_index) > 0:
+        return
+
+    # Initiate source exit and append the pending consolidation
+    source_validator.exit_epoch = compute_consolidation_epoch_and_update_churn(
+        state, source_validator.effective_balance)
+    source_validator.withdrawable_epoch = Epoch(
+        source_validator.exit_epoch
+        + config.MIN_VALIDATOR_WITHDRAWABILITY_DELAY)
+    state.pending_consolidations.append(PendingConsolidation(
+        source_index=source_index, target_index=target_index))

@@ -1,0 +1,106 @@
+# Electra -- Fork Logic (executable spec source).
+# Parity contract: specs/electra/fork.md.
+
+
+def compute_fork_version(epoch: Epoch) -> Version:
+    """Fork version at `epoch`."""
+    if epoch >= config.ELECTRA_FORK_EPOCH:
+        return config.ELECTRA_FORK_VERSION
+    if epoch >= config.DENEB_FORK_EPOCH:
+        return config.DENEB_FORK_VERSION
+    if epoch >= config.CAPELLA_FORK_EPOCH:
+        return config.CAPELLA_FORK_VERSION
+    if epoch >= config.BELLATRIX_FORK_EPOCH:
+        return config.BELLATRIX_FORK_VERSION
+    if epoch >= config.ALTAIR_FORK_EPOCH:
+        return config.ALTAIR_FORK_VERSION
+    return config.GENESIS_FORK_VERSION
+
+
+def upgrade_to_electra(pre) -> BeaconState:
+    """deneb -> electra state upgrade: initialize churn accounting and
+    re-queue not-yet-active balances as pending deposits
+    (fork.md `upgrade_to_electra`)."""
+    epoch = compute_epoch_at_slot(pre.slot)
+
+    earliest_exit_epoch = compute_activation_exit_epoch(epoch)
+    for validator in pre.validators:
+        if validator.exit_epoch != FAR_FUTURE_EPOCH:
+            if validator.exit_epoch > earliest_exit_epoch:
+                earliest_exit_epoch = validator.exit_epoch
+    earliest_exit_epoch += Epoch(1)
+
+    post = BeaconState(
+        genesis_time=pre.genesis_time,
+        genesis_validators_root=pre.genesis_validators_root,
+        slot=pre.slot,
+        fork=Fork(
+            previous_version=pre.fork.current_version,
+            # [Modified in Electra]
+            current_version=config.ELECTRA_FORK_VERSION,
+            epoch=epoch,
+        ),
+        latest_block_header=pre.latest_block_header,
+        block_roots=pre.block_roots,
+        state_roots=pre.state_roots,
+        historical_roots=pre.historical_roots,
+        eth1_data=pre.eth1_data,
+        eth1_data_votes=pre.eth1_data_votes,
+        eth1_deposit_index=pre.eth1_deposit_index,
+        validators=pre.validators,
+        balances=pre.balances,
+        randao_mixes=pre.randao_mixes,
+        slashings=pre.slashings,
+        previous_epoch_participation=pre.previous_epoch_participation,
+        current_epoch_participation=pre.current_epoch_participation,
+        justification_bits=pre.justification_bits,
+        previous_justified_checkpoint=pre.previous_justified_checkpoint,
+        current_justified_checkpoint=pre.current_justified_checkpoint,
+        finalized_checkpoint=pre.finalized_checkpoint,
+        inactivity_scores=pre.inactivity_scores,
+        current_sync_committee=pre.current_sync_committee,
+        next_sync_committee=pre.next_sync_committee,
+        latest_execution_payload_header=pre.latest_execution_payload_header,
+        next_withdrawal_index=pre.next_withdrawal_index,
+        next_withdrawal_validator_index=pre.next_withdrawal_validator_index,
+        historical_summaries=pre.historical_summaries,
+        # [New in Electra:EIP6110]
+        deposit_requests_start_index=UNSET_DEPOSIT_REQUESTS_START_INDEX,
+        # [New in Electra:EIP7251]
+        deposit_balance_to_consume=0,
+        exit_balance_to_consume=0,
+        earliest_exit_epoch=earliest_exit_epoch,
+        consolidation_balance_to_consume=0,
+        earliest_consolidation_epoch=compute_activation_exit_epoch(epoch),
+        pending_deposits=[],
+        pending_partial_withdrawals=[],
+        pending_consolidations=[],
+    )
+
+    post.exit_balance_to_consume = get_activation_exit_churn_limit(post)
+    post.consolidation_balance_to_consume = get_consolidation_churn_limit(post)
+
+    # [New in Electra:EIP7251] re-queue not-yet-active balances
+    pre_activation = sorted(
+        [index for index, validator in enumerate(post.validators)
+         if validator.activation_epoch == FAR_FUTURE_EPOCH],
+        key=lambda index: (
+            post.validators[index].activation_eligibility_epoch, index),
+    )
+
+    for index in pre_activation:
+        balance = post.balances[index]
+        post.balances[index] = 0
+        validator = post.validators[index]
+        validator.effective_balance = 0
+        validator.activation_eligibility_epoch = FAR_FUTURE_EPOCH
+        # G2 infinity signature + GENESIS_SLOT mark a non-request deposit
+        post.pending_deposits.append(PendingDeposit(
+            pubkey=validator.pubkey,
+            withdrawal_credentials=validator.withdrawal_credentials,
+            amount=balance,
+            signature=G2_POINT_AT_INFINITY,
+            slot=GENESIS_SLOT,
+        ))
+
+    return post

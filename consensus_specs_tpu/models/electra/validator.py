@@ -1,0 +1,165 @@
+# Electra -- Honest Validator (executable spec source, delta).
+# Parity contract: specs/electra/validator.md (:50-330).
+
+
+@dataclass
+class GetPayloadResponse(object):
+    execution_payload: ExecutionPayload
+    block_value: uint256
+    blobs_bundle: Any
+    execution_requests: Sequence[bytes]  # [New in Electra]
+
+
+class AggregateAndProof(Container):
+    aggregator_index: ValidatorIndex
+    # [Modified in Electra:EIP7549]
+    aggregate: Attestation
+    selection_proof: BLSSignature
+
+
+class SignedAggregateAndProof(Container):
+    message: AggregateAndProof
+    signature: BLSSignature
+
+
+def compute_on_chain_aggregate(network_aggregates) -> Attestation:
+    """Consolidate per-committee aggregates with equal AttestationData
+    into one on-chain Attestation (EIP-7549)."""
+    aggregates = sorted(
+        network_aggregates,
+        key=lambda a: get_committee_indices(a.committee_bits)[0])
+
+    data = aggregates[0].data
+    aggregation_bits = Bitlist[MAX_VALIDATORS_PER_COMMITTEE
+                               * MAX_COMMITTEES_PER_SLOT]()
+    for a in aggregates:
+        for b in a.aggregation_bits:
+            aggregation_bits.append(b)
+
+    signature = bls.Aggregate([a.signature for a in aggregates])
+
+    committee_indices = [get_committee_indices(a.committee_bits)[0]
+                         for a in aggregates]
+    committee_flags = [(index in committee_indices)
+                       for index in range(0, MAX_COMMITTEES_PER_SLOT)]
+    committee_bits = Bitvector[MAX_COMMITTEES_PER_SLOT](committee_flags)
+
+    return Attestation(
+        aggregation_bits=aggregation_bits,
+        data=data,
+        committee_bits=committee_bits,
+        signature=signature,
+    )
+
+
+def get_eth1_pending_deposit_count(state: BeaconState) -> uint64:
+    eth1_deposit_index_limit = min(state.eth1_data.deposit_count,
+                                   state.deposit_requests_start_index)
+    if state.eth1_deposit_index < eth1_deposit_index_limit:
+        return min(MAX_DEPOSITS,
+                   eth1_deposit_index_limit - state.eth1_deposit_index)
+    else:
+        return uint64(0)
+
+
+def get_eth1_vote(state: BeaconState, eth1_chain):
+    # [New in Electra:EIP6110] no more polling once requests take over
+    if state.eth1_deposit_index == state.deposit_requests_start_index:
+        return state.eth1_data
+
+    period_start = voting_period_start_time(state)
+    votes_to_consider = [
+        get_eth1_data(block) for block in eth1_chain
+        if (is_candidate_block(block, period_start)
+            and get_eth1_data(block).deposit_count
+            >= state.eth1_data.deposit_count)
+    ]
+
+    valid_votes = [vote for vote in state.eth1_data_votes
+                   if vote in votes_to_consider]
+
+    if any(votes_to_consider):
+        default_vote = votes_to_consider[len(votes_to_consider) - 1]
+    else:
+        default_vote = state.eth1_data
+
+    return max(
+        valid_votes,
+        key=lambda v: (valid_votes.count(v), -valid_votes.index(v)),
+        default=default_vote,
+    )
+
+
+def prepare_execution_payload(state: BeaconState, safe_block_hash: Hash32,
+                              finalized_block_hash: Hash32,
+                              suggested_fee_recipient: ExecutionAddress,
+                              execution_engine: ExecutionEngine):
+    """Only change: the tuple-returning get_expected_withdrawals."""
+    parent_hash = state.latest_execution_payload_header.block_hash
+
+    withdrawals, _ = get_expected_withdrawals(state)  # [Modified in EIP-7251]
+
+    payload_attributes = PayloadAttributes(
+        timestamp=compute_time_at_slot(state, state.slot),
+        prev_randao=get_randao_mix(state, get_current_epoch(state)),
+        suggested_fee_recipient=suggested_fee_recipient,
+        withdrawals=withdrawals,
+        parent_beacon_block_root=hash_tree_root(state.latest_block_header),
+    )
+    return execution_engine.notify_forkchoice_updated(
+        head_block_hash=parent_hash,
+        safe_block_hash=safe_block_hash,
+        finalized_block_hash=finalized_block_hash,
+        payload_attributes=payload_attributes,
+    )
+
+
+def get_execution_requests(execution_requests_list) -> ExecutionRequests:
+    """Decode the EIP-7685 requests list (strictly ascending types, no
+    empties, at most one of each)."""
+    deposits = []
+    withdrawals = []
+    consolidations = []
+
+    request_types = [
+        DEPOSIT_REQUEST_TYPE,
+        WITHDRAWAL_REQUEST_TYPE,
+        CONSOLIDATION_REQUEST_TYPE,
+    ]
+
+    prev_request_type = None
+    for request in execution_requests_list:
+        request_type, request_data = request[0:1], request[1:]
+
+        # The request type must be known
+        assert request_type in request_types
+        # The request data must not be empty
+        assert len(request_data) != 0
+        # Strictly ascending order, no duplicates
+        assert prev_request_type is None or prev_request_type < request_type
+        prev_request_type = request_type
+
+        if request_type == DEPOSIT_REQUEST_TYPE:
+            deposits = ssz_deserialize(
+                List[DepositRequest, MAX_DEPOSIT_REQUESTS_PER_PAYLOAD],
+                request_data)
+        elif request_type == WITHDRAWAL_REQUEST_TYPE:
+            withdrawals = ssz_deserialize(
+                List[WithdrawalRequest, MAX_WITHDRAWAL_REQUESTS_PER_PAYLOAD],
+                request_data)
+        elif request_type == CONSOLIDATION_REQUEST_TYPE:
+            consolidations = ssz_deserialize(
+                List[ConsolidationRequest,
+                     MAX_CONSOLIDATION_REQUESTS_PER_PAYLOAD],
+                request_data)
+
+    return ExecutionRequests(
+        deposits=deposits,
+        withdrawals=withdrawals,
+        consolidations=consolidations,
+    )
+
+
+def compute_subnet_for_blob_sidecar(blob_index: BlobIndex) -> SubnetID:
+    # [Modified in Electra:EIP7691]
+    return SubnetID(blob_index % config.BLOB_SIDECAR_SUBNET_COUNT_ELECTRA)

@@ -1,0 +1,187 @@
+# Fulu -- The Beacon Chain (executable spec source, delta over electra).
+#
+# EIP-7892 (blob-parameters-only forks via BLOB_SCHEDULE), EIP-7917
+# (pre-computed proposer lookahead), EIP-7594 DAS plumbing.
+# Parity contract: specs/fulu/beacon-chain.md.
+
+
+class BeaconState(Container):
+    genesis_time: uint64
+    genesis_validators_root: Root
+    slot: Slot
+    fork: Fork
+    latest_block_header: BeaconBlockHeader
+    block_roots: Vector[Root, SLOTS_PER_HISTORICAL_ROOT]
+    state_roots: Vector[Root, SLOTS_PER_HISTORICAL_ROOT]
+    historical_roots: List[Root, HISTORICAL_ROOTS_LIMIT]
+    eth1_data: Eth1Data
+    eth1_data_votes: List[Eth1Data, EPOCHS_PER_ETH1_VOTING_PERIOD * SLOTS_PER_EPOCH]
+    eth1_deposit_index: uint64
+    validators: List[Validator, VALIDATOR_REGISTRY_LIMIT]
+    balances: List[Gwei, VALIDATOR_REGISTRY_LIMIT]
+    randao_mixes: Vector[Bytes32, EPOCHS_PER_HISTORICAL_VECTOR]
+    slashings: Vector[Gwei, EPOCHS_PER_SLASHINGS_VECTOR]
+    previous_epoch_participation: List[ParticipationFlags, VALIDATOR_REGISTRY_LIMIT]
+    current_epoch_participation: List[ParticipationFlags, VALIDATOR_REGISTRY_LIMIT]
+    justification_bits: Bitvector[JUSTIFICATION_BITS_LENGTH]
+    previous_justified_checkpoint: Checkpoint
+    current_justified_checkpoint: Checkpoint
+    finalized_checkpoint: Checkpoint
+    inactivity_scores: List[uint64, VALIDATOR_REGISTRY_LIMIT]
+    current_sync_committee: SyncCommittee
+    next_sync_committee: SyncCommittee
+    latest_execution_payload_header: ExecutionPayloadHeader
+    next_withdrawal_index: WithdrawalIndex
+    next_withdrawal_validator_index: ValidatorIndex
+    historical_summaries: List[HistoricalSummary, HISTORICAL_ROOTS_LIMIT]
+    deposit_requests_start_index: uint64
+    deposit_balance_to_consume: Gwei
+    exit_balance_to_consume: Gwei
+    earliest_exit_epoch: Epoch
+    consolidation_balance_to_consume: Gwei
+    earliest_consolidation_epoch: Epoch
+    pending_deposits: List[PendingDeposit, PENDING_DEPOSITS_LIMIT]
+    pending_partial_withdrawals: List[PendingPartialWithdrawal, PENDING_PARTIAL_WITHDRAWALS_LIMIT]
+    pending_consolidations: List[PendingConsolidation, PENDING_CONSOLIDATIONS_LIMIT]
+    # [New in Fulu:EIP7917]
+    proposer_lookahead: Vector[ValidatorIndex, (MIN_SEED_LOOKAHEAD + 1) * SLOTS_PER_EPOCH]
+
+
+# ---------------------------------------------------------------------------
+# Misc helpers (beacon-chain.md :174-278)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class BlobParameters:
+    epoch: Epoch
+    max_blobs_per_block: uint64
+
+
+def get_blob_parameters(epoch: Epoch) -> BlobParameters:
+    """Blob parameters at `epoch` from the BPO schedule, defaulting to
+    the electra values (EIP-7892)."""
+    for entry in sorted(config.BLOB_SCHEDULE,
+                        key=lambda e: e["EPOCH"], reverse=True):
+        if epoch >= entry["EPOCH"]:
+            return BlobParameters(entry["EPOCH"],
+                                  entry["MAX_BLOBS_PER_BLOCK"])
+    return BlobParameters(config.ELECTRA_FORK_EPOCH,
+                          config.MAX_BLOBS_PER_BLOCK_ELECTRA)
+
+
+def compute_fork_digest(genesis_validators_root: Root,
+                        epoch: Epoch) -> ForkDigest:
+    """Fork digest XOR'd with the blob-parameters hash so BPO-only forks
+    separate on the p2p layer (EIP-7892)."""
+    fork_version = compute_fork_version(epoch)
+    base_digest = compute_fork_data_root(fork_version,
+                                         genesis_validators_root)
+    blob_parameters = get_blob_parameters(epoch)
+
+    mask = hash(uint_to_bytes(uint64(blob_parameters.epoch))
+                + uint_to_bytes(uint64(blob_parameters.max_blobs_per_block)))
+    return ForkDigest(bytes(a ^ b for a, b in
+                            zip(base_digest, mask))[:4])
+
+
+def compute_proposer_indices(state: BeaconState, epoch: Epoch,
+                             seed: Bytes32, indices):
+    """Proposer indices for every slot of `epoch`."""
+    start_slot = compute_start_slot_at_epoch(epoch)
+    seeds = [hash(seed + uint_to_bytes(Slot(start_slot + i)))
+             for i in range(SLOTS_PER_EPOCH)]
+    return [compute_proposer_index(state, indices, s) for s in seeds]
+
+
+def get_beacon_proposer_index(state: BeaconState) -> ValidatorIndex:
+    """Proposer at the current slot, from the pre-computed lookahead."""
+    return state.proposer_lookahead[state.slot % SLOTS_PER_EPOCH]
+
+
+def get_beacon_proposer_indices(state: BeaconState, epoch: Epoch):
+    """Proposer indices for the given `epoch`."""
+    indices = get_active_validator_indices(state, epoch)
+    seed = get_seed(state, epoch, DOMAIN_BEACON_PROPOSER)
+    return compute_proposer_indices(state, epoch, seed, indices)
+
+
+# ---------------------------------------------------------------------------
+# Block processing (beacon-chain.md :56-113)
+# ---------------------------------------------------------------------------
+
+
+def process_execution_payload(state: BeaconState, body: BeaconBlockBody,
+                              execution_engine: ExecutionEngine) -> None:
+    payload = body.execution_payload
+
+    assert payload.parent_hash == state.latest_execution_payload_header.block_hash
+    assert payload.prev_randao == get_randao_mix(state, get_current_epoch(state))
+    assert payload.timestamp == compute_time_at_slot(state, state.slot)
+    # [Modified in Fulu:EIP7892] limit from the blob schedule
+    assert (len(body.blob_kzg_commitments)
+            <= get_blob_parameters(get_current_epoch(state)).max_blobs_per_block)
+    versioned_hashes = [kzg_commitment_to_versioned_hash(commitment)
+                        for commitment in body.blob_kzg_commitments]
+    assert execution_engine.verify_and_notify_new_payload(
+        NewPayloadRequest(
+            execution_payload=payload,
+            versioned_hashes=versioned_hashes,
+            parent_beacon_block_root=state.latest_block_header.parent_root,
+            execution_requests=body.execution_requests,
+        ))
+    state.latest_execution_payload_header = ExecutionPayloadHeader(
+        parent_hash=payload.parent_hash,
+        fee_recipient=payload.fee_recipient,
+        state_root=payload.state_root,
+        receipts_root=payload.receipts_root,
+        logs_bloom=payload.logs_bloom,
+        prev_randao=payload.prev_randao,
+        block_number=payload.block_number,
+        gas_limit=payload.gas_limit,
+        gas_used=payload.gas_used,
+        timestamp=payload.timestamp,
+        extra_data=payload.extra_data,
+        base_fee_per_gas=payload.base_fee_per_gas,
+        block_hash=payload.block_hash,
+        transactions_root=hash_tree_root(payload.transactions),
+        withdrawals_root=hash_tree_root(payload.withdrawals),
+        blob_gas_used=payload.blob_gas_used,
+        excess_blob_gas=payload.excess_blob_gas,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Epoch processing (beacon-chain.md :279-330)
+# ---------------------------------------------------------------------------
+
+
+def process_epoch(state: BeaconState) -> None:
+    process_justification_and_finalization(state)
+    process_inactivity_updates(state)
+    process_rewards_and_penalties(state)
+    process_registry_updates(state)
+    process_slashings(state)
+    process_eth1_data_reset(state)
+    process_pending_deposits(state)
+    process_pending_consolidations(state)
+    process_effective_balance_updates(state)
+    process_slashings_reset(state)
+    process_randao_mixes_reset(state)
+    process_historical_summaries_update(state)
+    process_participation_flag_updates(state)
+    process_sync_committee_updates(state)
+    process_proposer_lookahead(state)  # [New in Fulu:EIP7917]
+
+
+def process_proposer_lookahead(state: BeaconState) -> None:
+    """Shift the lookahead one epoch and append the newly-computable
+    epoch's proposers (EIP-7917)."""
+    last_epoch_start = len(state.proposer_lookahead) - SLOTS_PER_EPOCH
+    # Shift out proposers in the first epoch
+    state.proposer_lookahead[:last_epoch_start] = list(
+        state.proposer_lookahead[SLOTS_PER_EPOCH:])
+    # Fill in the last epoch with new proposer indices
+    last_epoch_proposers = get_beacon_proposer_indices(
+        state, Epoch(get_current_epoch(state) + MIN_SEED_LOOKAHEAD + 1))
+    state.proposer_lookahead[last_epoch_start:] = last_epoch_proposers

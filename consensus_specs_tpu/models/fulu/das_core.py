@@ -1,0 +1,105 @@
+# Fulu -- Data Availability Sampling Core (PeerDAS).
+# Parity contract: specs/fulu/das-core.md (types :48-58, containers
+# :73-94, custody :100-133, matrix :135-185).
+
+UINT256_MAX = uint256(2**256 - 1)
+
+
+class RowIndex(uint64):
+    pass
+
+
+class ColumnIndex(uint64):
+    pass
+
+
+class CustodyIndex(uint64):
+    pass
+
+
+class DataColumnSidecar(Container):
+    index: ColumnIndex
+    column: List[Cell, MAX_BLOB_COMMITMENTS_PER_BLOCK]
+    kzg_commitments: List[KZGCommitment, MAX_BLOB_COMMITMENTS_PER_BLOCK]
+    kzg_proofs: List[KZGProof, MAX_BLOB_COMMITMENTS_PER_BLOCK]
+    signed_block_header: SignedBeaconBlockHeader
+    kzg_commitments_inclusion_proof: Vector[Bytes32, KZG_COMMITMENTS_INCLUSION_PROOF_DEPTH]
+
+
+class MatrixEntry(Container):
+    cell: Cell
+    kzg_proof: KZGProof
+    column_index: ColumnIndex
+    row_index: RowIndex
+
+
+def get_custody_groups(node_id: NodeID,
+                       custody_group_count: uint64) -> Sequence[CustodyIndex]:
+    """Deterministic public custody-group selection by node id; extending
+    `custody_group_count` extends (not reshuffles) the list."""
+    assert custody_group_count <= config.NUMBER_OF_CUSTODY_GROUPS
+
+    current_id = uint256(node_id)
+    custody_groups = []
+    while len(custody_groups) < custody_group_count:
+        custody_group = CustodyIndex(
+            bytes_to_uint64(hash(uint_to_bytes(current_id))[0:8])
+            % config.NUMBER_OF_CUSTODY_GROUPS)
+        if custody_group not in custody_groups:
+            custody_groups.append(custody_group)
+        if current_id == UINT256_MAX:
+            # Overflow prevention
+            current_id = uint256(0)
+        else:
+            current_id = uint256(current_id + 1)
+
+    assert len(custody_groups) == len(set(custody_groups))
+    return sorted(custody_groups)
+
+
+def compute_columns_for_custody_group(
+        custody_group: CustodyIndex) -> Sequence[ColumnIndex]:
+    assert custody_group < config.NUMBER_OF_CUSTODY_GROUPS
+    columns_per_group = (config.NUMBER_OF_COLUMNS
+                         // config.NUMBER_OF_CUSTODY_GROUPS)
+    return [
+        ColumnIndex(config.NUMBER_OF_CUSTODY_GROUPS * i + custody_group)
+        for i in range(columns_per_group)
+    ]
+
+
+def compute_matrix(blobs) -> Sequence[MatrixEntry]:
+    """Full flattened matrix of cells/proofs (rows = blobs, columns =
+    cells of the extension)."""
+    matrix = []
+    for blob_index, blob in enumerate(blobs):
+        cells, proofs = compute_cells_and_kzg_proofs(blob)
+        for cell_index, (cell, proof) in enumerate(zip(cells, proofs)):
+            matrix.append(MatrixEntry(
+                cell=cell,
+                kzg_proof=proof,
+                row_index=blob_index,
+                column_index=cell_index,
+            ))
+    return matrix
+
+
+def recover_matrix(partial_matrix, blob_count: uint64) -> Sequence[MatrixEntry]:
+    """Recover the full matrix from >= 50% of each row's cells."""
+    matrix = []
+    for blob_index in range(blob_count):
+        cell_indices = [e.column_index for e in partial_matrix
+                        if e.row_index == blob_index]
+        cells = [e.cell for e in partial_matrix
+                 if e.row_index == blob_index]
+        recovered_cells, recovered_proofs = recover_cells_and_kzg_proofs(
+            cell_indices, cells)
+        for cell_index, (cell, proof) in enumerate(
+                zip(recovered_cells, recovered_proofs)):
+            matrix.append(MatrixEntry(
+                cell=cell,
+                kzg_proof=proof,
+                row_index=blob_index,
+                column_index=cell_index,
+            ))
+    return matrix

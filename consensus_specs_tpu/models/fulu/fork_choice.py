@@ -1,0 +1,66 @@
+# Fulu -- Fork Choice (executable spec source, delta over deneb).
+# DA check via column sidecars (EIP-7594).
+# Parity contract: specs/fulu/fork-choice.md.
+
+
+def retrieve_column_sidecars(beacon_block_root: Root):
+    """Stub replacing `retrieve_blobs_and_proofs`; tests monkeypatch
+    (`pysetup/spec_builders/fulu.py` sundry)."""
+    return []
+
+
+def is_data_available(beacon_block_root: Root) -> bool:
+    """Sample custody columns for the block; True iff every retrieved
+    sidecar is structurally valid with correct KZG proofs."""
+    column_sidecars = retrieve_column_sidecars(beacon_block_root)
+    return all(
+        verify_data_column_sidecar(column_sidecar)
+        and verify_data_column_sidecar_kzg_proofs(column_sidecar)
+        for column_sidecar in column_sidecars
+    )
+
+
+def on_block(store: Store, signed_block: SignedBeaconBlock) -> None:
+    """deneb on_block with the column-sampling DA gate
+    (fork-choice.md :46-97)."""
+    block = signed_block.message
+    # Parent must be known
+    assert block.parent_root in store.block_states
+    state = copy(store.block_states[block.parent_root])
+    # Future blocks wait until their slot arrives
+    assert get_current_slot(store) >= block.slot
+
+    # Must descend from (and be after) the finalized checkpoint
+    finalized_slot = compute_start_slot_at_epoch(
+        store.finalized_checkpoint.epoch)
+    assert block.slot > finalized_slot
+    finalized_checkpoint_block = get_checkpoint_block(
+        store, block.parent_root, store.finalized_checkpoint.epoch)
+    assert store.finalized_checkpoint.root == finalized_checkpoint_block
+
+    # [Modified in Fulu:EIP7594]
+    assert is_data_available(hash_tree_root(block))
+
+    # Full state transition (asserts internally on invalid blocks)
+    block_root = hash_tree_root(block)
+    state_transition(state, signed_block, True)
+
+    store.blocks[block_root] = block
+    store.block_states[block_root] = state
+
+    # Timeliness: arrived in its own slot, before the attesting interval
+    time_into_slot = ((store.time - store.genesis_time)
+                      % config.SECONDS_PER_SLOT)
+    is_before_attesting_interval = (
+        time_into_slot < config.SECONDS_PER_SLOT // INTERVALS_PER_SLOT)
+    is_timely = (get_current_slot(store) == block.slot
+                 and is_before_attesting_interval)
+    store.block_timeliness[block_root] = is_timely
+
+    # Boost the first timely block of the slot
+    if is_timely and store.proposer_boost_root == Root():
+        store.proposer_boost_root = block_root
+
+    update_checkpoints(store, state.current_justified_checkpoint,
+                       state.finalized_checkpoint)
+    compute_pulled_up_tip(store, block_root)

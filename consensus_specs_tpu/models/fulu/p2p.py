@@ -1,0 +1,61 @@
+# Fulu -- p2p pure functions: data-column sidecar validation.
+# Parity contract: specs/fulu/p2p-interface.md (:75-150).
+
+
+NUMBER_OF_COLUMNS_LIMIT = int(config.NUMBER_OF_COLUMNS)
+
+
+class DataColumnsByRootIdentifier(Container):
+    block_root: Root
+    columns: List[ColumnIndex, NUMBER_OF_COLUMNS_LIMIT]
+
+
+def verify_data_column_sidecar(sidecar: DataColumnSidecar) -> bool:
+    """Structural validity of a column sidecar."""
+    # The sidecar index must be within the valid range
+    if sidecar.index >= config.NUMBER_OF_COLUMNS:
+        return False
+
+    # A sidecar for zero blobs is invalid
+    if len(sidecar.kzg_commitments) == 0:
+        return False
+
+    # Column length must equal the number of commitments/proofs
+    if (len(sidecar.column) != len(sidecar.kzg_commitments)
+            or len(sidecar.column) != len(sidecar.kzg_proofs)):
+        return False
+
+    return True
+
+
+def verify_data_column_sidecar_kzg_proofs(sidecar: DataColumnSidecar) -> bool:
+    """Batch-verify the column's cells against their commitments."""
+    # The column index is also the cell index within each row
+    cell_indices = [CellIndex(sidecar.index)] * len(sidecar.column)
+
+    return verify_cell_kzg_proof_batch(
+        commitments_bytes=sidecar.kzg_commitments,
+        cell_indices=cell_indices,
+        cells=sidecar.column,
+        proofs_bytes=sidecar.kzg_proofs,
+    )
+
+
+def verify_data_column_sidecar_inclusion_proof(
+        sidecar: DataColumnSidecar) -> bool:
+    """Merkle proof that the commitment list is in the block body."""
+    gindex = get_subtree_index(get_generalized_index(
+        BeaconBlockBody, "blob_kzg_commitments"))
+    return is_valid_merkle_branch(
+        leaf=hash_tree_root(sidecar.kzg_commitments),
+        branch=sidecar.kzg_commitments_inclusion_proof,
+        depth=KZG_COMMITMENTS_INCLUSION_PROOF_DEPTH,
+        index=gindex,
+        root=sidecar.signed_block_header.message.body_root,
+    )
+
+
+def compute_subnet_for_data_column_sidecar(
+        column_index: ColumnIndex) -> SubnetID:
+    return SubnetID(column_index
+                    % config.DATA_COLUMN_SIDECAR_SUBNET_COUNT)

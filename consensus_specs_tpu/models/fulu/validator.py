@@ -1,0 +1,76 @@
+# Fulu -- Honest Validator (executable spec source, delta).
+# Parity contract: specs/fulu/validator.md (:60-300).
+
+
+@dataclass
+class BlobsBundle(object):
+    commitments: Any
+    proofs: Any  # cell proofs, CELLS_PER_EXT_BLOB per blob
+    blobs: Any
+
+
+@dataclass
+class GetPayloadResponse(object):
+    execution_payload: ExecutionPayload
+    block_value: uint256
+    blobs_bundle: BlobsBundle
+    execution_requests: Sequence[bytes]
+
+
+def get_validators_custody_requirement(state: BeaconState,
+                                       validator_indices) -> uint64:
+    """Custody-group requirement for a node by attached stake."""
+    total_node_balance = sum(
+        state.validators[index].effective_balance
+        for index in validator_indices)
+    count = total_node_balance // config.BALANCE_PER_ADDITIONAL_CUSTODY_GROUP
+    return min(max(count, config.VALIDATOR_CUSTODY_REQUIREMENT),
+               config.NUMBER_OF_CUSTODY_GROUPS)
+
+
+def get_data_column_sidecars(signed_block_header, kzg_commitments,
+                             kzg_commitments_inclusion_proof,
+                             cells_and_kzg_proofs):
+    """Assemble the per-column sidecars from each blob's cells/proofs."""
+    assert len(cells_and_kzg_proofs) == len(kzg_commitments)
+
+    sidecars = []
+    for column_index in range(config.NUMBER_OF_COLUMNS):
+        column_cells, column_proofs = [], []
+        for cells, proofs in cells_and_kzg_proofs:
+            column_cells.append(cells[column_index])
+            column_proofs.append(proofs[column_index])
+        sidecars.append(DataColumnSidecar(
+            index=column_index,
+            column=column_cells,
+            kzg_commitments=kzg_commitments,
+            kzg_proofs=column_proofs,
+            signed_block_header=signed_block_header,
+            kzg_commitments_inclusion_proof=kzg_commitments_inclusion_proof,
+        ))
+    return sidecars
+
+
+def get_data_column_sidecars_from_block(signed_block, cells_and_kzg_proofs):
+    """Sidecars straight from a signed block."""
+    blob_kzg_commitments = signed_block.message.body.blob_kzg_commitments
+    signed_block_header = compute_signed_block_header(signed_block)
+    kzg_commitments_inclusion_proof = compute_merkle_proof_backing(
+        signed_block.message.body,
+        get_generalized_index(BeaconBlockBody, "blob_kzg_commitments"))
+    return get_data_column_sidecars(
+        signed_block_header, blob_kzg_commitments,
+        kzg_commitments_inclusion_proof, cells_and_kzg_proofs)
+
+
+def get_data_column_sidecars_from_column_sidecar(sidecar,
+                                                 cells_and_kzg_proofs):
+    """All sidecars from one received sidecar + recovered cells/proofs
+    (distributed blob publishing)."""
+    assert len(cells_and_kzg_proofs) == len(sidecar.kzg_commitments)
+
+    return get_data_column_sidecars(
+        sidecar.signed_block_header,
+        sidecar.kzg_commitments,
+        sidecar.kzg_commitments_inclusion_proof,
+        cells_and_kzg_proofs)

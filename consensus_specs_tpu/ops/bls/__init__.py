@@ -12,6 +12,7 @@ Backends:
 """
 
 from . import ciphersuite as _py
+from . import fields as _fields
 
 bls_active = True
 _backend_name = "py"
@@ -108,3 +109,45 @@ def pairing_check(values):
     if not bls_active:
         return True
     return _py.pairing_check(values)
+
+
+class Scalar(int):
+    """BLS12-381 scalar-field element (mod r), the arithmetic the KZG
+    library runs on (the reference wraps arkworks' Scalar,
+    `utils/bls.py`; deneb's BLSFieldElement subclasses it)."""
+
+    _R = _fields.R
+
+    def __new__(cls, value=0):
+        return super().__new__(cls, int(value) % cls._R)
+
+    def __add__(self, other):
+        return type(self)((int(self) + int(other)) % self._R)
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return type(self)((int(self) - int(other)) % self._R)
+
+    def __rsub__(self, other):
+        return type(self)((int(other) - int(self)) % self._R)
+
+    def __mul__(self, other):
+        return type(self)((int(self) * int(other)) % self._R)
+
+    __rmul__ = __mul__
+
+    def __neg__(self):
+        return type(self)(-int(self) % self._R)
+
+    def __truediv__(self, other):
+        return self * type(self)(int(other)).inverse()
+
+    def __rtruediv__(self, other):
+        return type(self)(int(other)) / self
+
+    def inverse(self):
+        return type(self)(pow(int(self), -1, self._R))
+
+    def pow(self, exp):
+        return type(self)(pow(int(self), int(exp), self._R))

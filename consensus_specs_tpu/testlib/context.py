@@ -49,6 +49,16 @@ def _implemented_forks() -> list[str]:
 # `test/context.py:71-93`)
 # ---------------------------------------------------------------------------
 
+def _hashable(v):
+    if isinstance(v, bytes):
+        return bytes(v)
+    if isinstance(v, list):
+        return tuple(_hashable(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _hashable(x)) for k, x in v.items()))
+    return v
+
+
 _GENESIS_CACHE: dict = {}
 
 
@@ -59,8 +69,7 @@ def _cached_genesis(spec, balances_fn, threshold_fn):
     # whole config — override-carrying specs must not share a cache entry
     # with the base config
     cfg_fp = tuple(sorted(
-        (k, bytes(v) if isinstance(v, bytes) else v)
-        for k, v in spec.config.to_dict().items()))
+        (k, _hashable(v)) for k, v in spec.config.to_dict().items()))
     key = (spec.fork, spec.preset_name, cfg_fp,
            balances_fn.__name__, threshold_fn.__name__)
     if key not in _GENESIS_CACHE:
@@ -83,6 +92,17 @@ def scaled_churn_balances_min_churn_limit(spec):
     num_validators = (spec.config.CHURN_LIMIT_QUOTIENT
                       * spec.config.MIN_PER_EPOCH_CHURN_LIMIT)
     return [spec.MAX_EFFECTIVE_BALANCE] * num_validators
+
+
+def scaled_churn_balances_exceed_activation_exit_churn_limit(spec):
+    """Enough stake that the balance churn exceeds the activation/exit
+    cap, leaving real consolidation churn
+    (`test/context.py scaled_churn_balances_...`)."""
+    num_validators = (
+        2 * spec.config.CHURN_LIMIT_QUOTIENT
+        * spec.config.MAX_PER_EPOCH_ACTIVATION_EXIT_CHURN_LIMIT
+        // spec.MIN_ACTIVATION_BALANCE)
+    return [spec.MIN_ACTIVATION_BALANCE] * num_validators
 
 
 def low_balances(spec):

@@ -76,9 +76,12 @@ def build_attestation_data(spec, state, slot, index):
     else:
         source_checkpoint = state.current_justified_checkpoint
 
+    from .forks import is_post_electra
+
     return spec.AttestationData(
         slot=slot,
-        index=index,
+        # [EIP-7549] the committee index moves to committee_bits
+        index=0 if is_post_electra(spec) else index,
         beacon_block_root=block_root,
         source=spec.Checkpoint(epoch=source_checkpoint.epoch,
                                root=source_checkpoint.root),
@@ -97,32 +100,62 @@ def get_valid_attestation(spec, state, slot=None, index=None,
 
     attestation_data = build_attestation_data(spec, state, slot=slot,
                                               index=index)
-    beacon_committee = spec.get_beacon_committee(
-        state, attestation_data.slot, attestation_data.index)
-
-    committee_size = len(beacon_committee)
-    aggregation_bits = spec.Bitlist[spec.MAX_VALIDATORS_PER_COMMITTEE](
-        [False] * committee_size)
-    attestation = spec.Attestation(
-        aggregation_bits=aggregation_bits,
-        data=attestation_data,
-    )
+    attestation = spec.Attestation(data=attestation_data)
     # fill the attestation with participants
     fill_aggregate_attestation(
-        spec, state, attestation, signed=signed,
+        spec, state, attestation, committee_index=index, signed=signed,
         filter_participant_set=filter_participant_set)
     return attestation
 
 
-def fill_aggregate_attestation(spec, state, attestation, signed=False,
-                               filter_participant_set=None):
+def get_eip7549_aggregation_bits_offset(spec, state, slot, committee_bits,
+                                        committee_index):
+    """Bit offset of `committee_index`'s members within the combined
+    aggregation bitlist (EIP-7549)."""
+    offset = 0
+    for index in spec.get_committee_indices(committee_bits):
+        if index == committee_index:
+            break
+        offset += len(spec.get_beacon_committee(state, slot, index))
+    return offset
+
+
+def fill_aggregate_attestation(spec, state, attestation, committee_index=None,
+                               signed=False, filter_participant_set=None):
+    from .forks import is_post_electra
+
+    if committee_index is None:
+        committee_index = (0 if is_post_electra(spec)
+                           else attestation.data.index)
     beacon_committee = spec.get_beacon_committee(
-        state, attestation.data.slot, attestation.data.index)
+        state, attestation.data.slot, committee_index)
     participants = set(beacon_committee)
     if filter_participant_set is not None:
         participants = filter_participant_set(participants)
-    for i in range(len(beacon_committee)):
-        attestation.aggregation_bits[i] = beacon_committee[i] in participants
+
+    if is_post_electra(spec):
+        attestation.committee_bits[committee_index] = True
+        # total bitlist length spans every committee set in committee_bits
+        total = sum(
+            len(spec.get_beacon_committee(state, attestation.data.slot, i))
+            for i in spec.get_committee_indices(attestation.committee_bits))
+        attestation.aggregation_bits = spec.Bitlist[
+            spec.MAX_VALIDATORS_PER_COMMITTEE
+            * spec.MAX_COMMITTEES_PER_SLOT]([False] * total)
+        offset = get_eip7549_aggregation_bits_offset(
+            spec, state, attestation.data.slot, attestation.committee_bits,
+            committee_index)
+        for i in range(len(beacon_committee)):
+            attestation.aggregation_bits[offset + i] = (
+                beacon_committee[i] in participants)
+    else:
+        attestation.aggregation_bits = spec.Bitlist[
+            spec.MAX_VALIDATORS_PER_COMMITTEE](
+                [False] * len(beacon_committee))
+        for i in range(len(beacon_committee)):
+            attestation.aggregation_bits[i] = (
+                beacon_committee[i] in participants)
+
     if signed and len(participants) > 0:
         sign_attestation(spec, state, attestation)
 

@@ -1,0 +1,63 @@
+"""Blob test helpers (mirrors `test/helpers/blob.py`)."""
+
+from __future__ import annotations
+
+import random
+
+
+def get_sample_blob(spec, rng=None, is_valid_blob=True):
+    """Random blob; each 32-byte chunk is a canonical field element when
+    `is_valid_blob` (top byte zeroed keeps it < BLS_MODULUS)."""
+    if rng is None:
+        rng = random.Random(5566)
+
+    values = [
+        rng.randrange(0, spec.BLS_MODULUS) if is_valid_blob
+        else spec.BLS_MODULUS + 1
+        for _ in range(spec.FIELD_ELEMENTS_PER_BLOB)
+    ]
+
+    b = b"".join([
+        v.to_bytes(32, spec.KZG_ENDIANNESS) for v in values
+    ])
+    return spec.Blob(b)
+
+
+def get_sample_blob_tx(spec, blob_count=1, rng=None, is_valid_blob=True):
+    """(blobs, commitments, proofs) for `blob_count` sample blobs."""
+    if rng is None:
+        # share one stream across the loop, or every blob is identical
+        rng = random.Random(5566)
+    blobs = []
+    blob_kzg_commitments = []
+    blob_kzg_proofs = []
+    for _ in range(blob_count):
+        blob = get_sample_blob(spec, rng, is_valid_blob=is_valid_blob)
+        if is_valid_blob:
+            blob_commitment = spec.KZGCommitment(
+                spec.blob_to_kzg_commitment(blob))
+            blob_kzg_proof = spec.compute_blob_kzg_proof(blob,
+                                                         blob_commitment)
+        else:
+            blob_commitment = spec.KZGCommitment()
+            blob_kzg_proof = spec.KZGProof()
+        blobs.append(blob)
+        blob_kzg_commitments.append(blob_commitment)
+        blob_kzg_proofs.append(blob_kzg_proof)
+    return blobs, blob_kzg_commitments, blob_kzg_proofs
+
+
+def get_max_blobs_per_block(spec):
+    from .forks import is_post_electra
+
+    if is_post_electra(spec):
+        return int(spec.config.MAX_BLOBS_PER_BLOCK_ELECTRA)
+    return int(spec.config.MAX_BLOBS_PER_BLOCK)
+
+
+def get_blob_sidecar_subnet_count(spec):
+    from .forks import is_post_electra
+
+    if is_post_electra(spec):
+        return int(spec.config.BLOB_SIDECAR_SUBNET_COUNT_ELECTRA)
+    return int(spec.config.BLOB_SIDECAR_SUBNET_COUNT)

@@ -42,13 +42,18 @@ def build_empty_block(spec, state, slot=None, proposer_index=None):
             ),
         ),
     )
-    from .forks import is_post_altair
+    from .forks import is_post_altair, is_post_bellatrix
 
     if is_post_altair(spec):
         # An empty sync aggregate (no participants) carries the point at
         # infinity, which eth_fast_aggregate_verify accepts
         block.body.sync_aggregate.sync_committee_signature = (
             spec.G2_POINT_AT_INFINITY)
+    if is_post_bellatrix(spec):
+        from .execution_payload import build_empty_execution_payload
+
+        block.body.execution_payload = build_empty_execution_payload(
+            spec, state_at)
     return block
 
 

@@ -101,11 +101,37 @@ def run_deposit_processing(spec, state, deposit, validator_index,
         yield "post", None
         return
 
+    from .forks import is_post_electra
+
+    pre_pending = (len(state.pending_deposits) if is_post_electra(spec)
+                   else 0)
+
     spec.process_deposit(state, deposit)
 
     yield "post", state
 
-    if not effective or not bls.KeyValidate(deposit.data.pubkey):
+    if is_post_electra(spec):
+        # electra queues the balance as a pending deposit; it is applied
+        # at the epoch transition, not here
+        if not effective or not bls.KeyValidate(deposit.data.pubkey):
+            assert len(state.validators) == pre_validator_count
+            assert len(state.pending_deposits) == pre_pending
+        else:
+            if is_top_up:
+                assert len(state.validators) == pre_validator_count
+            else:
+                # new validator joins with zero balance
+                assert len(state.validators) == pre_validator_count + 1
+                assert state.balances[validator_index] == 0
+                assert (state.validators[validator_index].effective_balance
+                        == 0)
+            assert len(state.pending_deposits) == pre_pending + 1
+            pd = state.pending_deposits[pre_pending]
+            assert pd.amount == deposit.data.amount
+            assert pd.pubkey == deposit.data.pubkey
+        if is_top_up:
+            assert state.balances[validator_index] == pre_balance
+    elif not effective or not bls.KeyValidate(deposit.data.pubkey):
         assert len(state.validators) == pre_validator_count
         assert len(state.balances) == pre_validator_count
         if is_top_up:

@@ -1,0 +1,132 @@
+"""Execution-payload construction for tests
+(mirrors `test/helpers/execution_payload.py`).
+
+Block hashes: the reference computes the real RLP header hash via an MPT
+(`compute_el_header_block_hash`).  The spec itself never recomputes the
+hash (`is_valid_block_hash` is a Noop stub), so this build derives a
+deterministic placeholder hash from the header contents; swap in an RLP
+encoder when emitting cross-client vectors.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from .forks import is_post_capella, is_post_deneb
+
+
+def compute_el_header_hash_stub(spec, payload_header):
+    """Deterministic stand-in for the EL block hash: sha256 over the SSZ
+    of the header with a zeroed block_hash field.  Single definition —
+    genesis and block construction must agree on the scheme."""
+    from ...utils.ssz.ssz_impl import serialize
+
+    stub = payload_header.copy()
+    stub.block_hash = spec.Hash32()
+    return spec.Hash32(hashlib.sha256(b"el-block-hash:"
+                                      + serialize(stub)).digest())
+
+
+def compute_el_block_hash(spec, payload, pre_state=None):
+    header = get_execution_payload_header(spec, pre_state, payload)
+    return compute_el_header_hash_stub(spec, header)
+
+
+def get_execution_payload_header(spec, state, execution_payload):
+    payload_header = spec.ExecutionPayloadHeader(
+        parent_hash=execution_payload.parent_hash,
+        fee_recipient=execution_payload.fee_recipient,
+        state_root=execution_payload.state_root,
+        receipts_root=execution_payload.receipts_root,
+        logs_bloom=execution_payload.logs_bloom,
+        prev_randao=execution_payload.prev_randao,
+        block_number=execution_payload.block_number,
+        gas_limit=execution_payload.gas_limit,
+        gas_used=execution_payload.gas_used,
+        timestamp=execution_payload.timestamp,
+        extra_data=execution_payload.extra_data,
+        base_fee_per_gas=execution_payload.base_fee_per_gas,
+        block_hash=execution_payload.block_hash,
+        transactions_root=spec.hash_tree_root(execution_payload.transactions),
+    )
+    if is_post_capella(spec):
+        payload_header.withdrawals_root = spec.hash_tree_root(
+            execution_payload.withdrawals)
+    if is_post_deneb(spec):
+        payload_header.blob_gas_used = execution_payload.blob_gas_used
+        payload_header.excess_blob_gas = execution_payload.excess_blob_gas
+    return payload_header
+
+
+def build_empty_execution_payload(spec, state, randao_mix=None):
+    """Valid empty-transactions payload for a pre-state of the same
+    slot."""
+    latest = state.latest_execution_payload_header
+    timestamp = spec.compute_time_at_slot(state, state.slot)
+    empty_txs = spec.List[spec.Transaction,
+                          spec.MAX_TRANSACTIONS_PER_PAYLOAD]()
+
+    if randao_mix is None:
+        randao_mix = spec.get_randao_mix(state, spec.get_current_epoch(state))
+
+    payload = spec.ExecutionPayload(
+        parent_hash=latest.block_hash,
+        fee_recipient=spec.ExecutionAddress(),
+        receipts_root=spec.Bytes32(bytes.fromhex(
+            "1dcc4de8dec75d7aab85b567b6ccd41ad312451b948a7413f0a142fd40d49347")),
+        logs_bloom=spec.ByteVector[spec.BYTES_PER_LOGS_BLOOM](),
+        prev_randao=randao_mix,
+        gas_used=0,
+        gas_limit=latest.gas_limit,
+        timestamp=timestamp,
+        extra_data=spec.ByteList[spec.MAX_EXTRA_DATA_BYTES](),
+        transactions=empty_txs,
+    )
+    payload.state_root = latest.state_root  # no changes to the state
+    payload.block_number = latest.block_number + 1
+    payload.base_fee_per_gas = latest.base_fee_per_gas
+    if is_post_capella(spec):
+        from .forks import is_post_electra
+
+        if is_post_electra(spec):
+            # electra returns (withdrawals, processed_partials_count)
+            payload.withdrawals, _ = spec.get_expected_withdrawals(state)
+        else:
+            payload.withdrawals = spec.get_expected_withdrawals(state)
+    if is_post_deneb(spec):
+        payload.blob_gas_used = 0
+        payload.excess_blob_gas = 0
+
+    payload.block_hash = compute_el_block_hash(spec, payload, state)
+
+    return payload
+
+
+def build_state_with_incomplete_transition(spec, state):
+    """State whose EL transition has not happened (empty header)."""
+    return build_state_with_execution_payload_header(
+        spec, state, spec.ExecutionPayloadHeader())
+
+
+def build_state_with_complete_transition(spec, state):
+    """State already past the merge (pre-populated sample header)."""
+    from .genesis import get_sample_genesis_execution_payload_header
+
+    pre_state_payload = get_sample_genesis_execution_payload_header(spec)
+    return build_state_with_execution_payload_header(
+        spec, state, pre_state_payload)
+
+
+def build_state_with_execution_payload_header(spec, state,
+                                              execution_payload_header):
+    pre_state = state.copy()
+    pre_state.latest_execution_payload_header = execution_payload_header
+    return pre_state
+
+
+def get_random_tx(rng):
+    return spec_random_bytes(rng, rng.randint(1, 1000))
+
+
+def spec_random_bytes(rng, length):
+    return bytes(rng.randint(0, 255) for _ in range(length))

@@ -6,8 +6,35 @@ deposit path is exercised by the genesis initialization tests instead.
 
 from __future__ import annotations
 
-from .forks import is_post_altair
+from .forks import is_post_altair, is_post_bellatrix
 from .keys import pubkey
+
+
+def get_sample_genesis_execution_payload_header(spec, eth1_block_hash=None):
+    """Mock post-merge EL header for genesis states
+    (`helpers/genesis.py get_sample_genesis_execution_payload_header`;
+    block_hash is this build's deterministic stand-in, see
+    helpers/execution_payload.py)."""
+    if eth1_block_hash is None:
+        eth1_block_hash = b"\x55" * 32
+    payload_header = spec.ExecutionPayloadHeader(
+        parent_hash=b"\x30" * 32,
+        fee_recipient=b"\x42" * 20,
+        state_root=b"\x20" * 32,
+        receipts_root=b"\x20" * 32,
+        logs_bloom=b"\x35" * int(spec.BYTES_PER_LOGS_BLOOM),
+        prev_randao=eth1_block_hash,
+        block_number=0,
+        gas_limit=30000000,
+        base_fee_per_gas=1000000000,
+        block_hash=eth1_block_hash,
+        transactions_root=spec.Root(b"\x56" * 32),
+    )
+    from .execution_payload import compute_el_header_hash_stub
+
+    payload_header.block_hash = compute_el_header_hash_stub(
+        spec, payload_header)
+    return payload_header
 
 
 def _fork_version_of(spec):
@@ -31,11 +58,25 @@ def _fork_version_of(spec):
 
 def build_mock_validator(spec, i: int, balance: int,
                          activation_threshold: int):
+    from .forks import is_post_electra
+
     pk = pubkey(i)
-    withdrawal_credentials = (
-        bytes(spec.BLS_WITHDRAWAL_PREFIX) + spec.hash(pk)[1:])
+    if is_post_electra(spec):
+        if balance > spec.MIN_ACTIVATION_BALANCE:
+            # compounding credentials above the activation minimum
+            withdrawal_credentials = (
+                bytes(spec.COMPOUNDING_WITHDRAWAL_PREFIX) + b"\x00" * 11
+                + spec.hash(pk)[12:])
+        else:
+            withdrawal_credentials = (
+                bytes(spec.BLS_WITHDRAWAL_PREFIX) + spec.hash(pk)[1:])
+        max_effective_balance = spec.MAX_EFFECTIVE_BALANCE_ELECTRA
+    else:
+        withdrawal_credentials = (
+            bytes(spec.BLS_WITHDRAWAL_PREFIX) + spec.hash(pk)[1:])
+        max_effective_balance = spec.MAX_EFFECTIVE_BALANCE
     effective = min(balance - balance % spec.EFFECTIVE_BALANCE_INCREMENT,
-                    spec.MAX_EFFECTIVE_BALANCE)
+                    max_effective_balance)
     return spec.Validator(
         pubkey=pk,
         withdrawal_credentials=withdrawal_credentials,
@@ -90,5 +131,24 @@ def create_genesis_state(spec, validator_balances, activation_threshold):
         # Fill in sync committees (duplicate committee at genesis)
         state.current_sync_committee = spec.get_next_sync_committee(state)
         state.next_sync_committee = spec.get_next_sync_committee(state)
+
+    if is_post_bellatrix(spec):
+        # Genesis is post-merge: install a sample EL header so
+        # `is_merge_transition_complete` holds from the start
+        state.latest_execution_payload_header = (
+            get_sample_genesis_execution_payload_header(
+                spec, eth1_block_hash=eth1_block_hash))
+
+    from .forks import is_post_electra, is_post_fulu
+
+    if is_post_electra(spec):
+        state.deposit_requests_start_index = (
+            spec.UNSET_DEPOSIT_REQUESTS_START_INDEX)
+        state.earliest_exit_epoch = spec.GENESIS_EPOCH
+        state.earliest_consolidation_epoch = 0
+
+    if is_post_fulu(spec):
+        # pre-computed proposer lookahead (EIP-7917)
+        state.proposer_lookahead = spec.initialize_proposer_lookahead(state)
 
     return state

@@ -1,0 +1,95 @@
+"""Withdrawal test helpers (mirrors `test/helpers/withdrawals.py`)."""
+
+from __future__ import annotations
+
+
+def get_expected_withdrawals(spec, state):
+    """Fork-agnostic accessor: electra returns (withdrawals, count)."""
+    from .forks import is_post_electra
+
+    if is_post_electra(spec):
+        withdrawals, _ = spec.get_expected_withdrawals(state)
+        return withdrawals
+    return spec.get_expected_withdrawals(state)
+
+
+def set_validator_fully_withdrawable(spec, state, index,
+                                     withdrawable_epoch=None):
+    if withdrawable_epoch is None:
+        withdrawable_epoch = spec.get_current_epoch(state)
+
+    validator = state.validators[index]
+    validator.withdrawable_epoch = withdrawable_epoch
+    # eth1 credentials are required for withdrawals
+    if not spec.has_eth1_withdrawal_credential(validator):
+        validator.withdrawal_credentials = (
+            spec.ETH1_ADDRESS_WITHDRAWAL_PREFIX + b"\x00" * 11
+            + bytes(validator.withdrawal_credentials[12:]))
+
+    assert spec.is_fully_withdrawable_validator(
+        validator, state.balances[index], withdrawable_epoch)
+
+
+def set_validator_partially_withdrawable(spec, state, index,
+                                         excess_balance=1000000000):
+    validator = state.validators[index]
+    validator.effective_balance = spec.MAX_EFFECTIVE_BALANCE
+    state.balances[index] = spec.MAX_EFFECTIVE_BALANCE + excess_balance
+    if not spec.has_eth1_withdrawal_credential(validator):
+        validator.withdrawal_credentials = (
+            spec.ETH1_ADDRESS_WITHDRAWAL_PREFIX + b"\x00" * 11
+            + bytes(validator.withdrawal_credentials[12:]))
+
+    assert spec.is_partially_withdrawable_validator(
+        validator, state.balances[index])
+
+
+def prepare_expected_withdrawals(spec, state, rng,
+                                 num_full_withdrawals=0,
+                                 num_partial_withdrawals=0):
+    bound = min(len(state.validators),
+                spec.MAX_VALIDATORS_PER_WITHDRAWALS_SWEEP)
+    assert num_full_withdrawals + num_partial_withdrawals <= bound
+    eligible = list(range(bound))
+    rng.shuffle(eligible)
+    fully_withdrawable_indices = eligible[:num_full_withdrawals]
+    partial_withdrawals_indices = eligible[
+        num_full_withdrawals:num_full_withdrawals + num_partial_withdrawals]
+
+    for index in fully_withdrawable_indices:
+        set_validator_fully_withdrawable(spec, state, index)
+    for index in partial_withdrawals_indices:
+        set_validator_partially_withdrawable(spec, state, index)
+
+    return fully_withdrawable_indices, partial_withdrawals_indices
+
+
+def run_withdrawals_processing(spec, state, execution_payload, valid=True):
+    """Yield pre/execution_payload/post; run process_withdrawals."""
+    from ..utils import expect_assertion_error
+
+    expected_withdrawals = (get_expected_withdrawals(spec, state)
+                            if valid else None)
+    pre_state = state.copy()
+
+    yield "pre", state
+    yield "execution_payload", execution_payload
+
+    if not valid:
+        expect_assertion_error(
+            lambda: spec.process_withdrawals(state, execution_payload))
+        yield "post", None
+        return
+
+    spec.process_withdrawals(state, execution_payload)
+
+    yield "post", state
+
+    for withdrawal in expected_withdrawals:
+        assert (state.balances[withdrawal.validator_index]
+                == pre_state.balances[withdrawal.validator_index]
+                - withdrawal.amount)
+
+    if len(expected_withdrawals) != 0:
+        assert (state.next_withdrawal_index
+                == expected_withdrawals[-1].index + 1)

@@ -667,6 +667,18 @@ class _SequenceBase(MutableView):
 
     # -- sequence protocol --
 
+    def __eq__(self, other):
+        # Spec code compares SSZ lists against plain Python sequences
+        # (e.g. `payload.withdrawals == expected_withdrawals` where the
+        # right side is a list) — compare element-wise then
+        if isinstance(other, (list, tuple)):
+            return (len(self) == len(other)
+                    and all(a == b for a, b in zip(self, other)))
+        return MutableView.__eq__(self, other)
+
+    def __hash__(self):
+        return MutableView.__hash__(self)
+
     def __len__(self):
         return self._len if self._np_dtype() is not None else len(self._data)
 
@@ -688,6 +700,18 @@ class _SequenceBase(MutableView):
         return self._data[i]
 
     def __setitem__(self, i, value):
+        if isinstance(i, slice):
+            # length-preserving slice assignment (the spec shifts the
+            # fulu proposer lookahead this way)
+            indices = range(*i.indices(len(self)))
+            values = list(value)
+            if len(values) != len(indices):
+                raise ValueError(
+                    f"slice assignment length mismatch: "
+                    f"{len(indices)} slots, {len(values)} values")
+            for j, v in zip(indices, values):
+                self[j] = v
+            return
         i = int(i)
         n = len(self)
         if i < 0 or i >= n:

@@ -1,0 +1,139 @@
+"""Bellatrix: process_execution_payload
+(parity: `test/bellatrix/block_processing/test_process_execution_payload.py`)."""
+
+from consensus_specs_tpu.testlib.context import (
+    BELLATRIX,
+    spec_state_test,
+    with_all_phases_from,
+    with_phases,
+)
+from consensus_specs_tpu.testlib.helpers.execution_payload import (
+    build_empty_execution_payload,
+    build_state_with_complete_transition,
+    build_state_with_incomplete_transition,
+)
+from consensus_specs_tpu.testlib.helpers.state import next_slot
+from consensus_specs_tpu.testlib.utils import expect_assertion_error
+
+with_bellatrix_and_later = with_all_phases_from(BELLATRIX)
+
+
+def run_execution_payload_processing(spec, state, execution_payload,
+                                     valid=True, execution_valid=True):
+    """Yield pre/execution.yml/body/post; process the payload
+    (mirrors the reference runner)."""
+    body = spec.BeaconBlockBody(execution_payload=execution_payload)
+
+    yield "pre", state
+    yield "execution", {"execution_valid": execution_valid}
+    yield "body", body
+
+    called_new_block = False
+
+    class TestEngine(spec.NoopExecutionEngine):
+        def verify_and_notify_new_payload(self, new_payload_request) -> bool:
+            nonlocal called_new_block
+            called_new_block = True
+            assert (new_payload_request.execution_payload
+                    == body.execution_payload)
+            return execution_valid
+
+    if not valid:
+        expect_assertion_error(
+            lambda: spec.process_execution_payload(state, body, TestEngine()))
+        yield "post", None
+        return
+
+    spec.process_execution_payload(state, body, TestEngine())
+
+    # Make sure we called the engine
+    assert called_new_block
+
+    yield "post", state
+
+    from consensus_specs_tpu.testlib.helpers.execution_payload import (
+        get_execution_payload_header)
+
+    assert (state.latest_execution_payload_header
+            == get_execution_payload_header(spec, state, execution_payload))
+
+
+@with_bellatrix_and_later
+@spec_state_test
+def test_success_first_payload(spec, state):
+    state = build_state_with_incomplete_transition(spec, state)
+    next_slot(spec, state)
+    execution_payload = build_empty_execution_payload(spec, state)
+    yield from run_execution_payload_processing(spec, state,
+                                                execution_payload)
+
+
+@with_bellatrix_and_later
+@spec_state_test
+def test_success_regular_payload(spec, state):
+    state = build_state_with_complete_transition(spec, state)
+    next_slot(spec, state)
+    execution_payload = build_empty_execution_payload(spec, state)
+    yield from run_execution_payload_processing(spec, state,
+                                                execution_payload)
+
+
+@with_bellatrix_and_later
+@spec_state_test
+def test_invalid_bad_execution_regular_payload(spec, state):
+    state = build_state_with_complete_transition(spec, state)
+    next_slot(spec, state)
+    execution_payload = build_empty_execution_payload(spec, state)
+    yield from run_execution_payload_processing(
+        spec, state, execution_payload, valid=False, execution_valid=False)
+
+
+@with_bellatrix_and_later
+@spec_state_test
+def test_invalid_bad_parent_hash_regular_payload(spec, state):
+    state = build_state_with_complete_transition(spec, state)
+    next_slot(spec, state)
+    execution_payload = build_empty_execution_payload(spec, state)
+    execution_payload.parent_hash = spec.Hash32(b"\x55" * 32)
+
+    yield from run_execution_payload_processing(
+        spec, state, execution_payload, valid=False)
+
+
+@with_bellatrix_and_later
+@spec_state_test
+def test_invalid_bad_prev_randao_regular_payload(spec, state):
+    state = build_state_with_complete_transition(spec, state)
+    next_slot(spec, state)
+    execution_payload = build_empty_execution_payload(spec, state)
+    execution_payload.prev_randao = b"\x42" * 32
+
+    yield from run_execution_payload_processing(
+        spec, state, execution_payload, valid=False)
+
+
+@with_bellatrix_and_later
+@spec_state_test
+def test_invalid_future_timestamp_regular_payload(spec, state):
+    state = build_state_with_complete_transition(spec, state)
+    next_slot(spec, state)
+    execution_payload = build_empty_execution_payload(spec, state)
+    execution_payload.timestamp += 1
+
+    yield from run_execution_payload_processing(
+        spec, state, execution_payload, valid=False)
+
+
+@with_phases([BELLATRIX])
+@spec_state_test
+def test_bad_parent_hash_first_payload(spec, state):
+    """Pre-transition the parent-hash link is not yet enforced
+    (capella+ checks it unconditionally, so bellatrix only)."""
+    state = build_state_with_incomplete_transition(spec, state)
+    next_slot(spec, state)
+
+    execution_payload = build_empty_execution_payload(spec, state)
+    execution_payload.parent_hash = b"\x55" * 32
+
+    yield from run_execution_payload_processing(spec, state,
+                                                execution_payload)

@@ -1,0 +1,52 @@
+"""Bellatrix fork upgrade: altair state -> bellatrix state
+(parity: `test/bellatrix/fork/test_bellatrix_fork_basic.py`)."""
+
+from consensus_specs_tpu.models.builder import build_spec
+from consensus_specs_tpu.testlib.context import (
+    BELLATRIX,
+    spec_state_test,
+    with_phases,
+)
+from consensus_specs_tpu.testlib.helpers.genesis import create_genesis_state
+from consensus_specs_tpu.testlib.helpers.state import next_epoch
+
+
+def _altair_state_for(spec, state):
+    altair_spec = build_spec("altair", spec.preset_name)
+    balances = [int(b) for b in state.balances]
+    return altair_spec, create_genesis_state(
+        altair_spec, balances, altair_spec.MAX_EFFECTIVE_BALANCE)
+
+
+def _check_upgrade(spec, pre, post):
+    assert post.fork.previous_version == pre.fork.current_version
+    assert post.fork.current_version == spec.config.BELLATRIX_FORK_VERSION
+    assert post.slot == pre.slot
+    assert [bytes(v.pubkey) for v in post.validators] == \
+        [bytes(v.pubkey) for v in pre.validators]
+    assert list(post.inactivity_scores) == list(pre.inactivity_scores)
+    assert post.current_sync_committee == pre.current_sync_committee
+    assert post.next_sync_committee == pre.next_sync_committee
+    # The EL header starts empty: the merge has not happened yet
+    assert not spec.is_merge_transition_complete(post)
+
+
+@with_phases([BELLATRIX])
+@spec_state_test
+def test_fork_base_state(spec, state):
+    altair_spec, pre = _altair_state_for(spec, state)
+    yield "pre", pre
+    post = spec.upgrade_to_bellatrix(pre)
+    yield "post", post
+    _check_upgrade(spec, pre, post)
+
+
+@with_phases([BELLATRIX])
+@spec_state_test
+def test_fork_next_epoch(spec, state):
+    altair_spec, pre = _altair_state_for(spec, state)
+    next_epoch(altair_spec, pre)
+    yield "pre", pre
+    post = spec.upgrade_to_bellatrix(pre)
+    yield "post", post
+    _check_upgrade(spec, pre, post)

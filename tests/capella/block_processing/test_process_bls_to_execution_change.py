@@ -1,0 +1,109 @@
+"""Capella: process_bls_to_execution_change
+(parity: `test/capella/block_processing/test_process_bls_to_execution_change.py`)."""
+
+from consensus_specs_tpu.testlib.context import (
+    CAPELLA,
+    always_bls,
+    spec_state_test,
+    with_all_phases_from,
+)
+from consensus_specs_tpu.testlib.helpers.bls_to_execution_changes import (
+    get_signed_address_change,
+)
+from consensus_specs_tpu.testlib.utils import expect_assertion_error
+
+with_capella_and_later = with_all_phases_from(CAPELLA)
+
+
+def run_bls_to_execution_change_processing(spec, state,
+                                           signed_address_change,
+                                           valid=True):
+    yield "pre", state
+    yield "address_change", signed_address_change
+
+    if not valid:
+        expect_assertion_error(
+            lambda: spec.process_bls_to_execution_change(
+                state, signed_address_change))
+        yield "post", None
+        return
+
+    spec.process_bls_to_execution_change(state, signed_address_change)
+
+    validator_index = signed_address_change.message.validator_index
+    validator = state.validators[validator_index]
+    assert (validator.withdrawal_credentials[:1]
+            == spec.ETH1_ADDRESS_WITHDRAWAL_PREFIX)
+    assert (validator.withdrawal_credentials[12:]
+            == signed_address_change.message.to_execution_address)
+
+    yield "post", state
+
+
+@with_capella_and_later
+@spec_state_test
+def test_success(spec, state):
+    signed_address_change = get_signed_address_change(spec, state)
+    yield from run_bls_to_execution_change_processing(
+        spec, state, signed_address_change)
+
+
+@with_capella_and_later
+@spec_state_test
+def test_success_not_activated(spec, state):
+    validator_index = 3
+    validator = state.validators[validator_index]
+    validator.activation_eligibility_epoch += 4
+    validator.activation_epoch = spec.FAR_FUTURE_EPOCH
+    assert not spec.is_active_validator(validator,
+                                        spec.get_current_epoch(state))
+    signed_address_change = get_signed_address_change(
+        spec, state, validator_index=validator_index)
+    yield from run_bls_to_execution_change_processing(
+        spec, state, signed_address_change)
+
+
+@with_capella_and_later
+@spec_state_test
+def test_invalid_val_index_out_of_range(spec, state):
+    signed_address_change = get_signed_address_change(
+        spec, state, validator_index=len(state.validators))
+    yield from run_bls_to_execution_change_processing(
+        spec, state, signed_address_change, valid=False)
+
+
+@with_capella_and_later
+@spec_state_test
+def test_invalid_already_0x01(spec, state):
+    validator_index = 3
+    validator = state.validators[validator_index]
+    validator.withdrawal_credentials = (
+        spec.ETH1_ADDRESS_WITHDRAWAL_PREFIX + b"\x00" * 11 + b"\x23" * 20)
+    signed_address_change = get_signed_address_change(
+        spec, state, validator_index=validator_index)
+    yield from run_bls_to_execution_change_processing(
+        spec, state, signed_address_change, valid=False)
+
+
+@with_capella_and_later
+@spec_state_test
+def test_invalid_incorrect_from_bls_pubkey(spec, state):
+    from consensus_specs_tpu.testlib.helpers.keys import pubkeys
+
+    validator_index = 2
+    signed_address_change = get_signed_address_change(
+        spec, state, validator_index=validator_index,
+        withdrawal_pubkey=pubkeys[validator_index + 5])
+    yield from run_bls_to_execution_change_processing(
+        spec, state, signed_address_change, valid=False)
+
+
+@with_capella_and_later
+@spec_state_test
+@always_bls
+def test_invalid_bad_signature(spec, state):
+    signed_address_change = get_signed_address_change(spec, state)
+    # Mutate the signature
+    signed_address_change.signature = spec.BLSSignature(b"\x42" * 96)
+    yield from run_bls_to_execution_change_processing(
+        spec, state, signed_address_change, valid=False)

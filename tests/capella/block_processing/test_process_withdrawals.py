@@ -1,0 +1,139 @@
+"""Capella: process_withdrawals
+(parity: `test/capella/block_processing/test_process_withdrawals.py`)."""
+
+import random
+
+from consensus_specs_tpu.testlib.context import (
+    CAPELLA,
+    spec_state_test,
+    with_all_phases_from,
+)
+from consensus_specs_tpu.testlib.helpers.execution_payload import (
+    build_empty_execution_payload,
+)
+from consensus_specs_tpu.testlib.helpers.state import next_slot
+from consensus_specs_tpu.testlib.helpers.withdrawals import (
+    get_expected_withdrawals,
+    prepare_expected_withdrawals,
+    run_withdrawals_processing,
+    set_validator_fully_withdrawable,
+    set_validator_partially_withdrawable,
+)
+
+with_capella_and_later = with_all_phases_from(CAPELLA)
+
+
+@with_capella_and_later
+@spec_state_test
+def test_success_zero_expected_withdrawals(spec, state):
+    assert len(get_expected_withdrawals(spec, state)) == 0
+    next_slot(spec, state)
+    execution_payload = build_empty_execution_payload(spec, state)
+    yield from run_withdrawals_processing(spec, state, execution_payload)
+
+
+@with_capella_and_later
+@spec_state_test
+def test_success_one_full_withdrawal(spec, state):
+    fully_withdrawable_indices, _ = prepare_expected_withdrawals(
+        spec, state, random.Random(42), num_full_withdrawals=1)
+    assert len(get_expected_withdrawals(spec, state)) == 1
+
+    next_slot(spec, state)
+    execution_payload = build_empty_execution_payload(spec, state)
+    yield from run_withdrawals_processing(spec, state, execution_payload)
+
+    # Fully withdrawn: balance zeroed
+    for index in fully_withdrawable_indices:
+        assert state.balances[index] == 0
+
+
+@with_capella_and_later
+@spec_state_test
+def test_success_one_partial_withdrawal(spec, state):
+    _, partial_indices = prepare_expected_withdrawals(
+        spec, state, random.Random(42), num_partial_withdrawals=1)
+    assert len(get_expected_withdrawals(spec, state)) == 1
+
+    next_slot(spec, state)
+    execution_payload = build_empty_execution_payload(spec, state)
+    yield from run_withdrawals_processing(spec, state, execution_payload)
+
+    # Partially withdrawn: excess removed
+    for index in partial_indices:
+        assert state.balances[index] == spec.MAX_EFFECTIVE_BALANCE
+
+
+@with_capella_and_later
+@spec_state_test
+def test_success_max_per_slot(spec, state):
+    num_full = spec.MAX_WITHDRAWALS_PER_PAYLOAD // 2
+    num_partial = spec.MAX_WITHDRAWALS_PER_PAYLOAD - num_full
+    prepare_expected_withdrawals(
+        spec, state, random.Random(42),
+        num_full_withdrawals=num_full, num_partial_withdrawals=num_partial)
+
+    next_slot(spec, state)
+    execution_payload = build_empty_execution_payload(spec, state)
+    yield from run_withdrawals_processing(spec, state, execution_payload)
+
+
+@with_capella_and_later
+@spec_state_test
+def test_invalid_non_withdrawable_non_empty_withdrawals(spec, state):
+    next_slot(spec, state)
+    execution_payload = build_empty_execution_payload(spec, state)
+    withdrawal = spec.Withdrawal(
+        index=0, validator_index=0,
+        address=b"\x30" * 20,
+        amount=420,
+    )
+    execution_payload.withdrawals.append(withdrawal)
+    yield from run_withdrawals_processing(spec, state, execution_payload,
+                                          valid=False)
+
+
+@with_capella_and_later
+@spec_state_test
+def test_invalid_one_expected_full_withdrawal_and_none_in_withdrawals(spec, state):
+    set_validator_fully_withdrawable(spec, state, 0)
+    next_slot(spec, state)
+    execution_payload = build_empty_execution_payload(spec, state)
+    execution_payload.withdrawals = []
+    yield from run_withdrawals_processing(spec, state, execution_payload,
+                                          valid=False)
+
+
+@with_capella_and_later
+@spec_state_test
+def test_invalid_incorrect_withdrawal_index(spec, state):
+    set_validator_fully_withdrawable(spec, state, 0)
+    next_slot(spec, state)
+    execution_payload = build_empty_execution_payload(spec, state)
+    execution_payload.withdrawals[0].index += 1
+    yield from run_withdrawals_processing(spec, state, execution_payload,
+                                          valid=False)
+
+
+@with_capella_and_later
+@spec_state_test
+def test_invalid_incorrect_amount(spec, state):
+    set_validator_partially_withdrawable(spec, state, 0)
+    next_slot(spec, state)
+    execution_payload = build_empty_execution_payload(spec, state)
+    execution_payload.withdrawals[0].amount += 1
+    yield from run_withdrawals_processing(spec, state, execution_payload,
+                                          valid=False)
+
+
+@with_capella_and_later
+@spec_state_test
+def test_withdrawal_sweep_advances(spec, state):
+    """The sweep cursor advances even with no withdrawals."""
+    pre_index = state.next_withdrawal_validator_index
+    next_slot(spec, state)
+    execution_payload = build_empty_execution_payload(spec, state)
+    yield from run_withdrawals_processing(spec, state, execution_payload)
+    expected = (int(pre_index) + int(spec.MAX_VALIDATORS_PER_WITHDRAWALS_SWEEP)) \
+        % len(state.validators)
+    assert int(state.next_withdrawal_validator_index) == expected

@@ -28,6 +28,11 @@ jax.config.update("jax_enable_x64", True)
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running (real crypto) test")
+
+
 def pytest_addoption(parser):
     parser.addoption(
         "--preset", action="store", default="minimal",

@@ -1,0 +1,93 @@
+"""Deneb: process_execution_payload with blob commitments
+(parity: `test/deneb/block_processing/test_process_execution_payload.py`)."""
+
+from consensus_specs_tpu.testlib.context import (
+    DENEB,
+    spec_state_test,
+    with_all_phases_from,
+)
+from consensus_specs_tpu.testlib.helpers.blob import get_max_blobs_per_block
+from consensus_specs_tpu.testlib.helpers.execution_payload import (
+    build_empty_execution_payload,
+)
+from consensus_specs_tpu.testlib.helpers.state import next_slot
+from consensus_specs_tpu.testlib.utils import expect_assertion_error
+
+with_deneb_and_later = with_all_phases_from(DENEB)
+
+
+def run_execution_payload_processing(spec, state, execution_payload,
+                                     blob_kzg_commitments,
+                                     valid=True, execution_valid=True):
+    body = spec.BeaconBlockBody(
+        execution_payload=execution_payload,
+        blob_kzg_commitments=blob_kzg_commitments,
+    )
+
+    yield "pre", state
+    yield "execution", {"execution_valid": execution_valid}
+    yield "body", body
+
+    called_new_block = False
+
+    class TestEngine(spec.NoopExecutionEngine):
+        def verify_and_notify_new_payload(self, new_payload_request) -> bool:
+            nonlocal called_new_block
+            called_new_block = True
+            assert (new_payload_request.execution_payload
+                    == body.execution_payload)
+            return execution_valid
+
+    if not valid:
+        expect_assertion_error(
+            lambda: spec.process_execution_payload(state, body, TestEngine()))
+        yield "post", None
+        return
+
+    spec.process_execution_payload(state, body, TestEngine())
+    assert called_new_block
+    yield "post", state
+
+
+@with_deneb_and_later
+@spec_state_test
+def test_success_zero_blobs(spec, state):
+    next_slot(spec, state)
+    execution_payload = build_empty_execution_payload(spec, state)
+    yield from run_execution_payload_processing(spec, state,
+                                                execution_payload, [])
+
+
+@with_deneb_and_later
+@spec_state_test
+def test_success_with_blob_commitments(spec, state):
+    next_slot(spec, state)
+    execution_payload = build_empty_execution_payload(spec, state)
+    # commitments are opaque at this layer (the engine stub validates)
+    commitments = [spec.KZGCommitment(b"\xc0" + b"\x00" * 47)
+                   for _ in range(2)]
+    yield from run_execution_payload_processing(spec, state,
+                                                execution_payload,
+                                                commitments)
+
+
+@with_deneb_and_later
+@spec_state_test
+def test_invalid_exceed_max_blobs_per_block(spec, state):
+    next_slot(spec, state)
+    execution_payload = build_empty_execution_payload(spec, state)
+    commitments = [spec.KZGCommitment(b"\xc0" + b"\x00" * 47)
+                   for _ in range(get_max_blobs_per_block(spec) + 1)]
+    yield from run_execution_payload_processing(spec, state,
+                                                execution_payload,
+                                                commitments, valid=False)
+
+
+@with_deneb_and_later
+@spec_state_test
+def test_invalid_bad_execution(spec, state):
+    next_slot(spec, state)
+    execution_payload = build_empty_execution_payload(spec, state)
+    yield from run_execution_payload_processing(
+        spec, state, execution_payload, [], valid=False,
+        execution_valid=False)

@@ -1,0 +1,64 @@
+"""Deneb/electra/fulu fork upgrades
+(parity: `test/<fork>/fork/test_<fork>_fork_basic.py`)."""
+
+from consensus_specs_tpu.models.builder import build_spec
+from consensus_specs_tpu.testlib.context import (
+    DENEB,
+    ELECTRA,
+    FULU,
+    spec_state_test,
+    with_phases,
+)
+from consensus_specs_tpu.testlib.helpers.genesis import create_genesis_state
+
+
+def _state_for(fork, spec, state):
+    pre_spec = build_spec(fork, spec.preset_name)
+    balances = [int(b) for b in state.balances]
+    return pre_spec, create_genesis_state(
+        pre_spec, balances, pre_spec.MAX_EFFECTIVE_BALANCE)
+
+
+@with_phases([DENEB])
+@spec_state_test
+def test_fork_base_state(spec, state):
+    _, pre = _state_for("capella", spec, state)
+    yield "pre", pre
+    post = spec.upgrade_to_deneb(pre)
+    yield "post", post
+    assert post.fork.current_version == spec.config.DENEB_FORK_VERSION
+    assert post.latest_execution_payload_header.blob_gas_used == 0
+    assert post.latest_execution_payload_header.excess_blob_gas == 0
+    assert (spec.hash_tree_root(post.validators)
+            == spec.hash_tree_root(pre.validators))
+
+
+@with_phases([ELECTRA])
+@spec_state_test
+def test_electra_fork_base_state(spec, state):
+    _, pre = _state_for("deneb", spec, state)
+    yield "pre", pre
+    post = spec.upgrade_to_electra(pre)
+    yield "post", post
+    assert post.fork.current_version == spec.config.ELECTRA_FORK_VERSION
+    assert (post.deposit_requests_start_index
+            == spec.UNSET_DEPOSIT_REQUESTS_START_INDEX)
+    # all genesis validators are active: no re-queued deposits
+    assert len(post.pending_deposits) == 0
+    assert post.exit_balance_to_consume == \
+        spec.get_activation_exit_churn_limit(post)
+
+
+@with_phases([FULU])
+@spec_state_test
+def test_fulu_fork_base_state(spec, state):
+    el_spec, pre = _state_for("electra", spec, state)
+    yield "pre", pre
+    post = spec.upgrade_to_fulu(pre)
+    yield "post", post
+    assert post.fork.current_version == spec.config.FULU_FORK_VERSION
+    assert (len(post.proposer_lookahead)
+            == (int(spec.MIN_SEED_LOOKAHEAD) + 1) * int(spec.SLOTS_PER_EPOCH))
+    # the lookahead agrees with on-demand computation for the current epoch
+    expected = spec.get_beacon_proposer_indices(post, spec.Epoch(0))
+    assert list(post.proposer_lookahead[:int(spec.SLOTS_PER_EPOCH)]) == list(expected)

@@ -1,0 +1,54 @@
+"""Deneb: blob sidecar construction + inclusion proof
+(parity: `test/deneb/unittests/validator/test_validator_unittest.py` and
+`networking` sidecar tests)."""
+
+from consensus_specs_tpu.testlib.context import (
+    DENEB,
+    spec_state_test,
+    with_all_phases_from,
+)
+from consensus_specs_tpu.testlib.helpers.blob import (
+    get_blob_sidecar_subnet_count,
+)
+from consensus_specs_tpu.testlib.helpers.block import (
+    build_empty_block_for_next_slot,
+)
+from consensus_specs_tpu.testlib.helpers.state import (
+    state_transition_and_sign_block,
+)
+
+with_deneb_and_later = with_all_phases_from(DENEB)
+
+
+@with_deneb_and_later
+@spec_state_test
+def test_blob_sidecar_inclusion_proof_roundtrip(spec, state):
+    block = build_empty_block_for_next_slot(spec, state)
+    # a fake commitment is fine: the inclusion proof is pure merkle
+    block.body.blob_kzg_commitments.append(
+        spec.KZGCommitment(b"\xc0" + b"\x00" * 47))
+    signed_block = state_transition_and_sign_block(spec, state, block)
+
+    blob = spec.Blob(b"\x00" * int(spec.BYTES_PER_BLOB))
+    sidecars = spec.get_blob_sidecars(signed_block, [blob],
+                                      [spec.KZGProof()])
+    assert len(sidecars) == 1
+    sidecar = sidecars[0]
+    assert sidecar.index == 0
+    assert (len(sidecar.kzg_commitment_inclusion_proof)
+            == spec.KZG_COMMITMENT_INCLUSION_PROOF_DEPTH)
+    assert spec.verify_blob_sidecar_inclusion_proof(sidecar)
+
+    # Tamper: proof fails
+    bad = sidecar.copy()
+    bad.kzg_commitment = spec.KZGCommitment(b"\xc0" + b"\x01" * 47)
+    assert not spec.verify_blob_sidecar_inclusion_proof(bad)
+
+
+@with_deneb_and_later
+@spec_state_test
+def test_compute_subnet_for_blob_sidecar(spec, state):
+    count = get_blob_sidecar_subnet_count(spec)
+    subnets = {int(spec.compute_subnet_for_blob_sidecar(spec.BlobIndex(i)))
+               for i in range(count * 2)}
+    assert subnets == set(range(count))

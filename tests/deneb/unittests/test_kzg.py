@@ -1,0 +1,116 @@
+"""Deneb KZG library unit tests
+(parity: `test/deneb/unittests/polynomial_commitments/test_polynomial_commitments.py`)."""
+
+import random
+
+import pytest
+
+from consensus_specs_tpu.models.builder import build_spec
+from consensus_specs_tpu.testlib.helpers.blob import get_sample_blob
+
+
+@pytest.fixture(scope="module")
+def spec():
+    return build_spec("deneb", "minimal")
+
+
+@pytest.fixture(autouse=True)
+def _real_bls():
+    """KZG correctness is meaningless with the BLS kill-switch on: the
+    pairing check would accept everything."""
+    from consensus_specs_tpu.ops import bls
+
+    prev = bls.bls_active
+    bls.bls_active = True
+    yield
+    bls.bls_active = prev
+
+
+def test_bit_reversal_permutation_is_involution(spec):
+    seq = list(range(64))
+    brp = spec.bit_reversal_permutation(seq)
+    assert brp != seq
+    assert spec.bit_reversal_permutation(brp) == seq
+
+
+def test_compute_powers(spec):
+    x = spec.BLSFieldElement(5566)
+    powers = spec.compute_powers(x, 10)
+    expected = 1
+    for p in powers:
+        assert int(p) == expected
+        expected = expected * 5566 % int(spec.BLS_MODULUS)
+    assert spec.compute_powers(x, 0) == []
+
+
+def test_roots_of_unity(spec):
+    roots = spec.compute_roots_of_unity(spec.FIELD_ELEMENTS_PER_BLOB)
+    assert len(roots) == spec.FIELD_ELEMENTS_PER_BLOB
+    # w^order == 1 and w^(order/2) == -1
+    w = roots[1]
+    assert w.pow(spec.BLSFieldElement(spec.FIELD_ELEMENTS_PER_BLOB)) \
+        == spec.BLSFieldElement(1)
+    assert w.pow(spec.BLSFieldElement(spec.FIELD_ELEMENTS_PER_BLOB // 2)) \
+        == spec.BLSFieldElement(spec.BLS_MODULUS - 1)
+
+
+def test_bytes_to_bls_field_rejects_oversize(spec):
+    with pytest.raises(AssertionError):
+        spec.bytes_to_bls_field(
+            int(spec.BLS_MODULUS).to_bytes(32, spec.KZG_ENDIANNESS))
+
+
+@pytest.mark.slow
+def test_verify_kzg_proof_roundtrip(spec):
+    rng = random.Random(5566)
+    blob = get_sample_blob(spec, rng)
+    commitment = spec.blob_to_kzg_commitment(blob)
+
+    # point evaluation proof at a random z
+    z = rng.randrange(0, int(spec.BLS_MODULUS)).to_bytes(
+        32, spec.KZG_ENDIANNESS)
+    proof, y = spec.compute_kzg_proof(blob, z)
+    assert spec.verify_kzg_proof(commitment, z, y, proof)
+    # wrong claimed value fails
+    bad_y = ((int.from_bytes(y, spec.KZG_ENDIANNESS) + 1)
+             % int(spec.BLS_MODULUS)).to_bytes(32, spec.KZG_ENDIANNESS)
+    assert not spec.verify_kzg_proof(commitment, z, bad_y, proof)
+
+
+@pytest.mark.slow
+def test_verify_kzg_proof_within_domain(spec):
+    """Proof at a root of unity exercises the in-domain quotient path."""
+    rng = random.Random(42)
+    blob = get_sample_blob(spec, rng)
+    commitment = spec.blob_to_kzg_commitment(blob)
+    roots = spec.bit_reversal_permutation(
+        spec.compute_roots_of_unity(spec.FIELD_ELEMENTS_PER_BLOB))
+    z = int(roots[3]).to_bytes(32, spec.KZG_ENDIANNESS)
+    proof, y = spec.compute_kzg_proof(blob, z)
+    assert spec.verify_kzg_proof(commitment, z, y, proof)
+
+
+@pytest.mark.slow
+def test_verify_blob_kzg_proof_batch(spec):
+    rng = random.Random(7)
+    blobs, commitments, proofs = [], [], []
+    for _ in range(2):
+        blob = get_sample_blob(spec, rng)
+        commitment = spec.blob_to_kzg_commitment(blob)
+        proof = spec.compute_blob_kzg_proof(blob, commitment)
+        blobs.append(blob)
+        commitments.append(commitment)
+        proofs.append(proof)
+
+    assert spec.verify_blob_kzg_proof_batch(blobs, commitments, proofs)
+    # swapped proofs fail
+    assert not spec.verify_blob_kzg_proof_batch(
+        blobs, commitments, proofs[::-1])
+    # empty batch is vacuously true
+    assert spec.verify_blob_kzg_proof_batch([], [], [])
+
+
+def test_validate_kzg_g1_accepts_infinity(spec):
+    spec.validate_kzg_g1(spec.G1_POINT_AT_INFINITY)
+    with pytest.raises(AssertionError):
+        spec.validate_kzg_g1(b"\x12" * 48)

@@ -1,0 +1,245 @@
+"""Electra: EL-triggered request processing
+(parity: `test/electra/block_processing/test_process_{deposit_request,
+withdrawal_request,consolidation_request}.py`)."""
+
+from consensus_specs_tpu.testlib.context import (
+    ELECTRA,
+    spec_state_test,
+    with_all_phases_from,
+)
+from consensus_specs_tpu.testlib.helpers.keys import pubkeys
+
+with_electra_and_later = with_all_phases_from(ELECTRA)
+
+
+def _set_eth1_credentials(spec, state, index):
+    validator = state.validators[index]
+    validator.withdrawal_credentials = (
+        spec.ETH1_ADDRESS_WITHDRAWAL_PREFIX + b"\x00" * 11 + b"\x11" * 20)
+    return b"\x11" * 20
+
+
+def _set_compounding_credentials(spec, state, index):
+    validator = state.validators[index]
+    validator.withdrawal_credentials = (
+        spec.COMPOUNDING_WITHDRAWAL_PREFIX + b"\x00" * 11 + b"\x11" * 20)
+    return b"\x11" * 20
+
+
+# ---------------------------------------------------------------------------
+# deposit requests (EIP-6110)
+# ---------------------------------------------------------------------------
+
+
+@with_electra_and_later
+@spec_state_test
+def test_deposit_request_sets_start_index_and_queues(spec, state):
+    assert (state.deposit_requests_start_index
+            == spec.UNSET_DEPOSIT_REQUESTS_START_INDEX)
+    yield "pre", state
+    req = spec.DepositRequest(
+        pubkey=pubkeys[100], withdrawal_credentials=b"\x01" + b"\x00" * 31,
+        amount=spec.MIN_ACTIVATION_BALANCE, signature=b"\x00" * 96, index=42)
+    spec.process_deposit_request(state, req)
+    yield "post", state
+    assert state.deposit_requests_start_index == 42
+    assert len(state.pending_deposits) == 1
+    pd = state.pending_deposits[0]
+    assert pd.pubkey == req.pubkey and pd.slot == state.slot
+
+
+@with_electra_and_later
+@spec_state_test
+def test_deposit_request_start_index_set_once(spec, state):
+    yield "pre", state
+    for idx in (7, 9):
+        req = spec.DepositRequest(
+            pubkey=pubkeys[100 + idx],
+            withdrawal_credentials=b"\x01" + b"\x00" * 31,
+            amount=spec.MIN_ACTIVATION_BALANCE,
+            signature=b"\x00" * 96, index=idx)
+        spec.process_deposit_request(state, req)
+    yield "post", state
+    assert state.deposit_requests_start_index == 7
+    assert len(state.pending_deposits) == 2
+
+
+# ---------------------------------------------------------------------------
+# withdrawal requests (EIP-7002)
+# ---------------------------------------------------------------------------
+
+
+@with_electra_and_later
+@spec_state_test
+def test_withdrawal_request_full_exit(spec, state):
+    index = 3
+    addr = _set_eth1_credentials(spec, state, index)
+    # satisfy SHARD_COMMITTEE_PERIOD
+    state.slot += (int(spec.config.SHARD_COMMITTEE_PERIOD)
+                   * int(spec.SLOTS_PER_EPOCH))
+
+    yield "pre", state
+    req = spec.WithdrawalRequest(
+        source_address=addr,
+        validator_pubkey=state.validators[index].pubkey,
+        amount=spec.FULL_EXIT_REQUEST_AMOUNT)
+    spec.process_withdrawal_request(state, req)
+    yield "post", state
+    assert state.validators[index].exit_epoch < spec.FAR_FUTURE_EPOCH
+
+
+@with_electra_and_later
+@spec_state_test
+def test_withdrawal_request_incorrect_source_ignored(spec, state):
+    index = 3
+    _set_eth1_credentials(spec, state, index)
+    state.slot += (int(spec.config.SHARD_COMMITTEE_PERIOD)
+                   * int(spec.SLOTS_PER_EPOCH))
+
+    yield "pre", state
+    req = spec.WithdrawalRequest(
+        source_address=b"\x99" * 20,  # wrong address
+        validator_pubkey=state.validators[index].pubkey,
+        amount=spec.FULL_EXIT_REQUEST_AMOUNT)
+    spec.process_withdrawal_request(state, req)
+    yield "post", state
+    # silently ignored
+    assert state.validators[index].exit_epoch == spec.FAR_FUTURE_EPOCH
+
+
+@with_electra_and_later
+@spec_state_test
+def test_withdrawal_request_partial(spec, state):
+    index = 3
+    addr = _set_compounding_credentials(spec, state, index)
+    state.slot += (int(spec.config.SHARD_COMMITTEE_PERIOD)
+                   * int(spec.SLOTS_PER_EPOCH))
+    amount = spec.EFFECTIVE_BALANCE_INCREMENT
+    state.balances[index] = spec.MIN_ACTIVATION_BALANCE + 2 * amount
+
+    yield "pre", state
+    req = spec.WithdrawalRequest(
+        source_address=addr,
+        validator_pubkey=state.validators[index].pubkey,
+        amount=amount)
+    spec.process_withdrawal_request(state, req)
+    yield "post", state
+    assert len(state.pending_partial_withdrawals) == 1
+    ppw = state.pending_partial_withdrawals[0]
+    assert ppw.validator_index == index and ppw.amount == amount
+    # validator is NOT exited by a partial withdrawal
+    assert state.validators[index].exit_epoch == spec.FAR_FUTURE_EPOCH
+
+
+@with_electra_and_later
+@spec_state_test
+def test_withdrawal_request_partial_without_compounding_ignored(spec, state):
+    index = 3
+    addr = _set_eth1_credentials(spec, state, index)
+    state.slot += (int(spec.config.SHARD_COMMITTEE_PERIOD)
+                   * int(spec.SLOTS_PER_EPOCH))
+    state.balances[index] = (spec.MIN_ACTIVATION_BALANCE
+                             + 2 * spec.EFFECTIVE_BALANCE_INCREMENT)
+
+    yield "pre", state
+    req = spec.WithdrawalRequest(
+        source_address=addr,
+        validator_pubkey=state.validators[index].pubkey,
+        amount=spec.EFFECTIVE_BALANCE_INCREMENT)
+    spec.process_withdrawal_request(state, req)
+    yield "post", state
+    assert len(state.pending_partial_withdrawals) == 0
+
+
+# ---------------------------------------------------------------------------
+# consolidation requests (EIP-7251)
+# ---------------------------------------------------------------------------
+
+
+def spec_state_test_scaled_churn(fn):
+    """Genesis with enough stake that consolidation churn is non-zero."""
+    import functools
+
+    from consensus_specs_tpu.testlib.context import (
+        default_activation_threshold,
+        scaled_churn_balances_exceed_activation_exit_churn_limit,
+        vector_test,
+        with_custom_state,
+    )
+
+    inner = with_custom_state(
+        scaled_churn_balances_exceed_activation_exit_churn_limit,
+        default_activation_threshold)(fn)
+
+    @functools.wraps(fn)
+    def wrapper(*args, spec, generator_mode=False, **kwargs):
+        return vector_test(inner)(*args, spec=spec,
+                                  generator_mode=generator_mode, **kwargs)
+
+    return wrapper
+
+
+@with_electra_and_later
+@spec_state_test_scaled_churn
+def test_consolidation_request_basic(spec, state):
+    assert spec.get_consolidation_churn_limit(state) > spec.MIN_ACTIVATION_BALANCE
+    source, target = 3, 5
+    addr = _set_eth1_credentials(spec, state, source)
+    _set_compounding_credentials(spec, state, target)
+    state.slot += (int(spec.config.SHARD_COMMITTEE_PERIOD)
+                   * int(spec.SLOTS_PER_EPOCH))
+
+    yield "pre", state
+    req = spec.ConsolidationRequest(
+        source_address=addr,
+        source_pubkey=state.validators[source].pubkey,
+        target_pubkey=state.validators[target].pubkey)
+    spec.process_consolidation_request(state, req)
+    yield "post", state
+    assert len(state.pending_consolidations) == 1
+    pc = state.pending_consolidations[0]
+    assert pc.source_index == source and pc.target_index == target
+    assert state.validators[source].exit_epoch < spec.FAR_FUTURE_EPOCH
+
+
+@with_electra_and_later
+@spec_state_test
+def test_consolidation_request_switch_to_compounding(spec, state):
+    index = 3
+    addr = _set_eth1_credentials(spec, state, index)
+    state.balances[index] = (spec.MIN_ACTIVATION_BALANCE
+                             + spec.EFFECTIVE_BALANCE_INCREMENT)
+
+    yield "pre", state
+    req = spec.ConsolidationRequest(
+        source_address=addr,
+        source_pubkey=state.validators[index].pubkey,
+        target_pubkey=state.validators[index].pubkey)  # self: switch
+    spec.process_consolidation_request(state, req)
+    yield "post", state
+    assert spec.has_compounding_withdrawal_credential(
+        state.validators[index])
+    # excess balance above MIN_ACTIVATION queued as a pending deposit
+    assert state.balances[index] == spec.MIN_ACTIVATION_BALANCE
+    assert len(state.pending_deposits) == 1
+    assert (state.pending_deposits[0].amount
+            == spec.EFFECTIVE_BALANCE_INCREMENT)
+
+
+@with_electra_and_later
+@spec_state_test
+def test_consolidation_request_unknown_target_ignored(spec, state):
+    source = 3
+    addr = _set_eth1_credentials(spec, state, source)
+    state.slot += (int(spec.config.SHARD_COMMITTEE_PERIOD)
+                   * int(spec.SLOTS_PER_EPOCH))
+
+    yield "pre", state
+    req = spec.ConsolidationRequest(
+        source_address=addr,
+        source_pubkey=state.validators[source].pubkey,
+        target_pubkey=pubkeys[4096])  # not in the registry
+    spec.process_consolidation_request(state, req)
+    yield "post", state
+    assert len(state.pending_consolidations) == 0
+    assert state.validators[source].exit_epoch == spec.FAR_FUTURE_EPOCH

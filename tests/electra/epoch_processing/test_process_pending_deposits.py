@@ -1,0 +1,126 @@
+"""Electra: process_pending_deposits / process_pending_consolidations
+(parity: `test/electra/epoch_processing/test_process_pending_*.py`)."""
+
+from consensus_specs_tpu.testlib.context import (
+    ELECTRA,
+    spec_state_test,
+    with_all_phases_from,
+)
+from consensus_specs_tpu.testlib.helpers.epoch_processing import (
+    run_epoch_processing_with,
+)
+from consensus_specs_tpu.testlib.helpers.keys import privkeys, pubkeys
+
+with_electra_and_later = with_all_phases_from(ELECTRA)
+
+
+def _pending_deposit_for_existing(spec, state, index, amount):
+    validator = state.validators[index]
+    return spec.PendingDeposit(
+        pubkey=validator.pubkey,
+        withdrawal_credentials=validator.withdrawal_credentials,
+        amount=amount,
+        signature=spec.G2_POINT_AT_INFINITY,
+        slot=spec.GENESIS_SLOT,
+    )
+
+
+@with_electra_and_later
+@spec_state_test
+def test_pending_deposit_top_up_applied(spec, state):
+    index = 2
+    amount = spec.EFFECTIVE_BALANCE_INCREMENT
+    state.pending_deposits.append(
+        _pending_deposit_for_existing(spec, state, index, amount))
+    pre_balance = int(state.balances[index])
+
+    yield from run_epoch_processing_with(
+        spec, state, "process_pending_deposits")
+
+    assert len(state.pending_deposits) == 0
+    assert state.balances[index] == pre_balance + amount
+
+
+@with_electra_and_later
+@spec_state_test
+def test_pending_deposit_not_finalized_is_deferred(spec, state):
+    """A deposit whose slot is past finality stays queued."""
+    index = 2
+    amount = spec.EFFECTIVE_BALANCE_INCREMENT
+    pd = _pending_deposit_for_existing(spec, state, index, amount)
+    pd.slot = spec.Slot(state.slot + 100)  # far ahead of finality
+    state.pending_deposits.append(pd)
+    pre_balance = int(state.balances[index])
+
+    yield from run_epoch_processing_with(
+        spec, state, "process_pending_deposits")
+
+    assert len(state.pending_deposits) == 1
+    assert state.balances[index] == pre_balance
+
+
+@with_electra_and_later
+@spec_state_test
+def test_pending_deposit_new_validator(spec, state):
+    """A (correctly signed) deposit for an unknown pubkey registers a
+    new validator."""
+    from consensus_specs_tpu.ops import bls
+
+    new_index = len(state.validators)
+    pubkey = pubkeys[new_index]
+    creds = spec.BLS_WITHDRAWAL_PREFIX + spec.hash(pubkey)[1:]
+    amount = spec.MIN_ACTIVATION_BALANCE
+
+    deposit_message = spec.DepositMessage(
+        pubkey=pubkey, withdrawal_credentials=creds, amount=amount)
+    domain = spec.compute_domain(spec.DOMAIN_DEPOSIT)
+    signing_root = spec.compute_signing_root(deposit_message, domain)
+    signature = bls.Sign(privkeys[new_index], signing_root)
+
+    state.pending_deposits.append(spec.PendingDeposit(
+        pubkey=pubkey, withdrawal_credentials=creds, amount=amount,
+        signature=signature, slot=spec.GENESIS_SLOT))
+
+    yield from run_epoch_processing_with(
+        spec, state, "process_pending_deposits")
+
+    assert len(state.pending_deposits) == 0
+    assert len(state.validators) == new_index + 1
+    assert state.balances[new_index] == amount
+
+
+@with_electra_and_later
+@spec_state_test
+def test_pending_consolidation_applied_when_withdrawable(spec, state):
+    source, target = 2, 4
+    state.validators[source].withdrawable_epoch = spec.get_current_epoch(state)
+    state.pending_consolidations.append(spec.PendingConsolidation(
+        source_index=source, target_index=target))
+    pre_source = int(state.balances[source])
+    pre_target = int(state.balances[target])
+    moved = min(pre_source,
+                int(state.validators[source].effective_balance))
+
+    yield from run_epoch_processing_with(
+        spec, state, "process_pending_consolidations")
+
+    assert len(state.pending_consolidations) == 0
+    assert state.balances[source] == pre_source - moved
+    assert state.balances[target] == pre_target + moved
+
+
+@with_electra_and_later
+@spec_state_test
+def test_pending_consolidation_not_withdrawable_waits(spec, state):
+    source, target = 2, 4
+    assert (state.validators[source].withdrawable_epoch
+            == spec.FAR_FUTURE_EPOCH)
+    state.pending_consolidations.append(spec.PendingConsolidation(
+        source_index=source, target_index=target))
+    pre_source = int(state.balances[source])
+
+    yield from run_epoch_processing_with(
+        spec, state, "process_pending_consolidations")
+
+    assert len(state.pending_consolidations) == 1
+    assert state.balances[source] == pre_source

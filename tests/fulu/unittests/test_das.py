@@ -1,0 +1,142 @@
+"""Fulu DAS unit tests: custody assignment, erasure recovery, cell
+proofs (parity: `test/fulu/unittests/das/*`,
+`tests/generators/runners/kzg_7594.py` coverage)."""
+
+import random
+
+import pytest
+
+from consensus_specs_tpu.models.builder import build_spec
+from consensus_specs_tpu.testlib.helpers.blob import get_sample_blob
+
+
+@pytest.fixture(scope="module")
+def spec():
+    return build_spec("fulu", "minimal")
+
+
+@pytest.fixture(autouse=True)
+def _real_bls():
+    from consensus_specs_tpu.ops import bls
+
+    prev = bls.bls_active
+    bls.bls_active = True
+    yield
+    bls.bls_active = prev
+
+
+def test_custody_groups_deterministic_and_extending(spec):
+    node = spec.NodeID(987654321)
+    g4 = spec.get_custody_groups(node, 4)
+    g8 = spec.get_custody_groups(node, 8)
+    assert len(g4) == 4 and len(g8) == 8
+    # extending the count extends the set (no reshuffle)
+    assert set(g4) <= set(g8)
+    # deterministic
+    assert g4 == spec.get_custody_groups(node, 4)
+
+
+def test_compute_columns_for_custody_group_partition(spec):
+    # all groups together cover every column exactly once
+    seen = []
+    for g in range(int(spec.config.NUMBER_OF_CUSTODY_GROUPS)):
+        seen.extend(spec.compute_columns_for_custody_group(
+            spec.CustodyIndex(g)))
+    assert sorted(int(c) for c in seen) == list(
+        range(int(spec.config.NUMBER_OF_COLUMNS)))
+
+
+def test_fft_roundtrip(spec):
+    rng = random.Random(1)
+    n = 64
+    roots = spec.compute_roots_of_unity(n)
+    vals = [spec.BLSFieldElement(rng.randrange(spec.BLS_MODULUS))
+            for _ in range(n)]
+    freq = spec.fft_field(vals, roots)
+    back = spec.fft_field(freq, roots, inv=True)
+    assert back == vals
+
+
+def test_coset_fft_roundtrip(spec):
+    rng = random.Random(2)
+    n = 64
+    roots = spec.compute_roots_of_unity(n)
+    vals = [spec.BLSFieldElement(rng.randrange(spec.BLS_MODULUS))
+            for _ in range(n)]
+    shifted = spec.coset_fft_field(vals, roots)
+    back = spec.coset_fft_field(shifted, roots, inv=True)
+    assert back == vals
+
+
+def test_polynomial_coeff_algebra(spec):
+    B = spec.BLSFieldElement
+    a = spec.PolynomialCoeff([B(1), B(2)])        # 1 + 2x
+    b = spec.PolynomialCoeff([B(3), B(4), B(5)])  # 3 + 4x + 5x^2
+    s = spec.add_polynomialcoeff(a, b)
+    assert list(s) == [B(4), B(6), B(5)]
+    p = spec.multiply_polynomialcoeff(a, b)
+    # (1+2x)(3+4x+5x^2) = 3 + 10x + 13x^2 + 10x^3
+    assert list(p) == [B(3), B(10), B(13), B(10)]
+    q = spec.divide_polynomialcoeff(p, a)
+    assert list(q) == [B(3), B(4), B(5)]
+    # interpolation inverts evaluation
+    xs = [B(1), B(2), B(7)]
+    ys = [spec.evaluate_polynomialcoeff(b, x) for x in xs]
+    interp = spec.interpolate_polynomialcoeff(xs, ys)
+    for x, y in zip(xs, ys):
+        assert spec.evaluate_polynomialcoeff(interp, x) == y
+
+
+@pytest.mark.slow
+def test_recover_polynomial_from_half_cells(spec):
+    """Drop half the cells of an extended blob; FFT recovery returns the
+    original polynomial coefficients."""
+    rng = random.Random(3)
+    blob = get_sample_blob(spec, rng)
+    polynomial = spec.blob_to_polynomial(blob)
+    coeffs = spec.polynomial_eval_to_coeff(polynomial)
+
+    # extended evaluations via one big FFT (equivalent to compute_cells)
+    ext_coeffs = list(coeffs) + [spec.BLSFieldElement(0)] * int(
+        spec.FIELD_ELEMENTS_PER_BLOB)
+    roots_ext = spec.compute_roots_of_unity(spec.FIELD_ELEMENTS_PER_EXT_BLOB)
+    ext_evals = spec.fft_field(ext_coeffs, roots_ext)
+    ext_evals_rbo = spec.bit_reversal_permutation(ext_evals)
+    n_cell = int(spec.FIELD_ELEMENTS_PER_CELL)
+    cells_evals = [
+        ext_evals_rbo[i * n_cell:(i + 1) * n_cell]
+        for i in range(int(spec.CELLS_PER_EXT_BLOB))
+    ]
+
+    # keep a random half of the cells
+    keep = sorted(rng.sample(range(int(spec.CELLS_PER_EXT_BLOB)),
+                             int(spec.CELLS_PER_EXT_BLOB) // 2))
+    recovered = spec.recover_polynomialcoeff(
+        [spec.CellIndex(i) for i in keep],
+        [cells_evals[i] for i in keep])
+    assert list(recovered) == list(coeffs)
+
+
+@pytest.mark.slow
+def test_cell_proof_single_roundtrip(spec):
+    """One cell's multiproof verifies via the universal equation and a
+    corrupted cell does not."""
+    rng = random.Random(4)
+    blob = get_sample_blob(spec, rng)
+    commitment = spec.blob_to_kzg_commitment(blob)
+    polynomial = spec.blob_to_polynomial(blob)
+    coeffs = spec.polynomial_eval_to_coeff(polynomial)
+
+    cell_index = spec.CellIndex(5)
+    coset = spec.coset_for_cell(cell_index)
+    proof, ys = spec.compute_kzg_proof_multi_impl(coeffs, coset)
+    cell = spec.coset_evals_to_cell(ys)
+
+    assert spec.verify_cell_kzg_proof_batch(
+        [commitment], [cell_index], [cell], [proof])
+
+    # corrupt one field element
+    bad = bytearray(cell)
+    bad[5] ^= 0x01
+    assert not spec.verify_cell_kzg_proof_batch(
+        [commitment], [cell_index], [spec.Cell(bytes(bad))], [proof])

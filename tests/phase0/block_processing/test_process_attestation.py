@@ -49,9 +49,15 @@ def test_invalid_before_inclusion_delay(spec, state):
 @with_all_phases
 @spec_state_test
 def test_invalid_after_epoch_slots(spec, state):
+    from consensus_specs_tpu.testlib.helpers.forks import is_post_deneb
+
     attestation = get_valid_attestation(spec, state, signed=True)
-    # advance past the inclusion window
-    next_slots(spec, state, spec.SLOTS_PER_EPOCH + 1)
+    # advance past the inclusion window: one epoch pre-deneb; EIP-7045
+    # extends inclusion to target.epoch + 1, so go past that instead
+    if is_post_deneb(spec):
+        next_slots(spec, state, 2 * spec.SLOTS_PER_EPOCH + 1)
+    else:
+        next_slots(spec, state, spec.SLOTS_PER_EPOCH + 1)
     yield from run_attestation_processing(spec, state, attestation,
                                           valid=False)
 

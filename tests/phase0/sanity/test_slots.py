@@ -75,10 +75,20 @@ def test_over_epoch_boundary(spec, state):
 @with_all_phases
 @spec_state_test
 def test_historical_accumulator(spec, state):
+    from consensus_specs_tpu.testlib.helpers.forks import is_post_capella
+
     pre_historical_roots = list(state.historical_roots)
+    if is_post_capella(spec):
+        pre_historical_summaries = list(state.historical_summaries)
     yield "pre", state
     slots = spec.SLOTS_PER_HISTORICAL_ROOT
     yield "slots", "meta", int(slots)
     transition_to(spec, state, state.slot + slots)
     yield "post", state
-    assert len(state.historical_roots) == len(pre_historical_roots) + 1
+    if is_post_capella(spec):
+        # capella+ accumulates summaries; historical_roots is frozen
+        assert len(state.historical_roots) == len(pre_historical_roots)
+        assert (len(state.historical_summaries)
+                == len(pre_historical_summaries) + 1)
+    else:
+        assert len(state.historical_roots) == len(pre_historical_roots) + 1
