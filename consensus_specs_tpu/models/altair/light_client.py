@@ -212,6 +212,15 @@ def is_valid_normalized_merkle_branch(leaf: Bytes32, branch,
     return is_valid_merkle_branch(leaf, branch[num_extra:], depth, index, root)
 
 
+def normalize_merkle_branch(branch, gindex):
+    """Zero-pad a branch at the front to the depth of `gindex` (electra
+    light-client spec `specs/electra/light-client/sync-protocol.md`; a
+    no-op pre-electra where branch depths already match)."""
+    depth = floorlog2(gindex)
+    num_extra = depth - len(branch)
+    return [Bytes32()] * num_extra + [Bytes32(bytes(b)) for b in branch]
+
+
 def compute_sync_committee_period_at_slot(slot: Slot) -> uint64:
     return compute_sync_committee_period(compute_epoch_at_slot(slot))
 
@@ -491,8 +500,11 @@ def create_light_client_bootstrap(
         header=block_to_light_client_header(block),
         current_sync_committee=state.current_sync_committee,
         current_sync_committee_branch=CurrentSyncCommitteeBranch(
-            compute_merkle_proof(
-                state, current_sync_committee_gindex_at_slot(state.slot))),
+            normalize_merkle_branch(
+                compute_merkle_proof(
+                    state,
+                    current_sync_committee_gindex_at_slot(state.slot)),
+                CURRENT_SYNC_COMMITTEE_GINDEX)),
     )
 
 
@@ -531,9 +543,11 @@ def create_light_client_update(state: BeaconState, block: SignedBeaconBlock,
     if update_attested_period == update_signature_period:
         update.next_sync_committee = attested_state.next_sync_committee
         update.next_sync_committee_branch = NextSyncCommitteeBranch(
-            compute_merkle_proof(
-                attested_state,
-                next_sync_committee_gindex_at_slot(attested_state.slot)))
+            normalize_merkle_branch(
+                compute_merkle_proof(
+                    attested_state,
+                    next_sync_committee_gindex_at_slot(attested_state.slot)),
+                NEXT_SYNC_COMMITTEE_GINDEX))
 
     # Indicate finality whenever possible
     if finalized_block is not None:
@@ -545,9 +559,11 @@ def create_light_client_update(state: BeaconState, block: SignedBeaconBlock,
         else:
             assert attested_state.finalized_checkpoint.root == Bytes32()
         update.finality_branch = FinalityBranch(
-            compute_merkle_proof(
-                attested_state,
-                finalized_root_gindex_at_slot(attested_state.slot)))
+            normalize_merkle_branch(
+                compute_merkle_proof(
+                    attested_state,
+                    finalized_root_gindex_at_slot(attested_state.slot)),
+                FINALIZED_ROOT_GINDEX))
 
     update.sync_aggregate = block.message.body.sync_aggregate
     update.signature_slot = block.message.slot

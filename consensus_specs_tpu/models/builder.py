@@ -70,7 +70,8 @@ SPEC_SOURCES: dict[str, list[str]] = {
     "capella": ["beacon_chain.py", "fork.py"],
     "deneb": ["polynomial_commitments.py", "beacon_chain.py", "fork.py",
               "fork_choice.py", "p2p.py", "validator.py"],
-    "electra": ["beacon_chain.py", "fork.py", "validator.py"],
+    "electra": ["beacon_chain.py", "fork.py", "light_client.py",
+                "validator.py"],
     "fulu": ["polynomial_commitments_sampling.py", "das_core.py",
              "beacon_chain.py", "fork.py", "fork_choice.py", "p2p.py",
              "validator.py"],

@@ -1,0 +1,81 @@
+# Electra -- Light Client (gindex deepening).
+#
+# Parity contract: specs/electra/light-client/sync-protocol.md.
+# Electra grows BeaconState past 32 fields, deepening its merkle tree from
+# 5 to 6 levels; every light-client gindex and branch length changes.  The
+# altair constants stay available (suffixed) for verifying pre-electra
+# branches, and the `*_gindex_at_slot` selectors become fork-aware.
+
+FINALIZED_ROOT_GINDEX_ALTAIR = FINALIZED_ROOT_GINDEX
+CURRENT_SYNC_COMMITTEE_GINDEX_ALTAIR = CURRENT_SYNC_COMMITTEE_GINDEX
+NEXT_SYNC_COMMITTEE_GINDEX_ALTAIR = NEXT_SYNC_COMMITTEE_GINDEX
+
+FINALIZED_ROOT_GINDEX_ELECTRA = get_generalized_index(
+    BeaconState, "finalized_checkpoint", "root")
+CURRENT_SYNC_COMMITTEE_GINDEX_ELECTRA = get_generalized_index(
+    BeaconState, "current_sync_committee")
+NEXT_SYNC_COMMITTEE_GINDEX_ELECTRA = get_generalized_index(
+    BeaconState, "next_sync_committee")
+
+assert FINALIZED_ROOT_GINDEX_ELECTRA == 169, FINALIZED_ROOT_GINDEX_ELECTRA
+assert CURRENT_SYNC_COMMITTEE_GINDEX_ELECTRA == 86, \
+    CURRENT_SYNC_COMMITTEE_GINDEX_ELECTRA
+assert NEXT_SYNC_COMMITTEE_GINDEX_ELECTRA == 87, \
+    NEXT_SYNC_COMMITTEE_GINDEX_ELECTRA
+
+# Unsuffixed names now refer to the deepest (current-fork) tree; the shared
+# create_* functions normalize their branches against these.
+FINALIZED_ROOT_GINDEX = FINALIZED_ROOT_GINDEX_ELECTRA
+CURRENT_SYNC_COMMITTEE_GINDEX = CURRENT_SYNC_COMMITTEE_GINDEX_ELECTRA
+NEXT_SYNC_COMMITTEE_GINDEX = NEXT_SYNC_COMMITTEE_GINDEX_ELECTRA
+
+FinalityBranch = Vector[Bytes32, floorlog2(FINALIZED_ROOT_GINDEX_ELECTRA)]
+CurrentSyncCommitteeBranch = Vector[
+    Bytes32, floorlog2(CURRENT_SYNC_COMMITTEE_GINDEX_ELECTRA)]
+NextSyncCommitteeBranch = Vector[
+    Bytes32, floorlog2(NEXT_SYNC_COMMITTEE_GINDEX_ELECTRA)]
+
+
+class LightClientBootstrap(Container):
+    header: LightClientHeader
+    current_sync_committee: SyncCommittee
+    current_sync_committee_branch: CurrentSyncCommitteeBranch
+
+
+class LightClientUpdate(Container):
+    attested_header: LightClientHeader
+    next_sync_committee: SyncCommittee
+    next_sync_committee_branch: NextSyncCommitteeBranch
+    finalized_header: LightClientHeader
+    finality_branch: FinalityBranch
+    sync_aggregate: SyncAggregate
+    signature_slot: Slot
+
+
+class LightClientFinalityUpdate(Container):
+    attested_header: LightClientHeader
+    finalized_header: LightClientHeader
+    finality_branch: FinalityBranch
+    sync_aggregate: SyncAggregate
+    signature_slot: Slot
+
+
+def finalized_root_gindex_at_slot(slot: Slot):
+    epoch = compute_epoch_at_slot(slot)
+    if epoch >= config.ELECTRA_FORK_EPOCH:
+        return FINALIZED_ROOT_GINDEX_ELECTRA
+    return FINALIZED_ROOT_GINDEX_ALTAIR
+
+
+def current_sync_committee_gindex_at_slot(slot: Slot):
+    epoch = compute_epoch_at_slot(slot)
+    if epoch >= config.ELECTRA_FORK_EPOCH:
+        return CURRENT_SYNC_COMMITTEE_GINDEX_ELECTRA
+    return CURRENT_SYNC_COMMITTEE_GINDEX_ALTAIR
+
+
+def next_sync_committee_gindex_at_slot(slot: Slot):
+    epoch = compute_epoch_at_slot(slot)
+    if epoch >= config.ELECTRA_FORK_EPOCH:
+        return NEXT_SYNC_COMMITTEE_GINDEX_ELECTRA
+    return NEXT_SYNC_COMMITTEE_GINDEX_ALTAIR

@@ -61,6 +61,40 @@ def test_light_client_bootstrap(spec, state):
 
 @with_altair_and_later
 @spec_state_test_with_matching_config
+def test_normalized_branch_padding(spec, state):
+    """Cross-fork branch normalization: a branch zero-padded in front (as a
+    pre-electra depth-5 branch is when carried in electra's deeper branch
+    vectors) must verify; non-zero padding or wrong-end padding must not."""
+    yield "bootstrap_state", state
+    gindex = spec.current_sync_committee_gindex_at_slot(state.slot)
+    proof = spec.compute_merkle_proof(state, gindex)
+    leaf = spec.hash_tree_root(state.current_sync_committee)
+    root = spec.hash_tree_root(state)
+
+    # exact-depth branch verifies
+    assert spec.is_valid_normalized_merkle_branch(leaf, proof, gindex, root)
+
+    # normalize_merkle_branch pads zeros at the FRONT, to the target depth
+    deeper_gindex = gindex << 2  # two levels deeper
+    padded = spec.normalize_merkle_branch(proof, deeper_gindex)
+    assert len(padded) == len(proof) + 2
+    assert padded[0] == spec.Bytes32() and padded[1] == spec.Bytes32()
+    assert [bytes(b) for b in padded[2:]] == [bytes(b) for b in proof]
+
+    # a front-padded branch verifies against the original (shallower) gindex
+    assert spec.is_valid_normalized_merkle_branch(
+        leaf, [spec.Bytes32()] * 2 + list(proof), gindex, root)
+    # non-zero padding is rejected
+    assert not spec.is_valid_normalized_merkle_branch(
+        leaf, [spec.Bytes32(b"\x01" * 32), spec.Bytes32()] + list(proof),
+        gindex, root)
+    # padding at the wrong end (back) corrupts the branch
+    assert not spec.is_valid_normalized_merkle_branch(
+        leaf, list(proof) + [spec.Bytes32()] * 2, gindex, root)
+
+
+@with_altair_and_later
+@spec_state_test_with_matching_config
 def test_light_client_optimistic_progression(spec, state):
     store, _ = _bootstrap_store(spec, state)
     yield "bootstrap_state", state
