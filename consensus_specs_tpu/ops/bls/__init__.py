@@ -7,15 +7,69 @@ Sign/Verify/aggregate + point API re-exported at module level.
 
 Backends:
 - "py":  pure-Python oracle (fields/curve/pairing/hash_to_curve here)
-- "jax": batched device path for the hot aggregate checks (falls back to
-         "py" per-call semantics; batch entry points live in ops.bls_batch)
+- "jax": the TPU path — parsing/subgroup checks/hash-to-curve stay on host
+         (oracle code), every pairing runs on device via the batched
+         Miller-loop kernels in `ops.bls_batch` (limb-decomposed Fq in
+         int32 lanes, one shared final exponentiation per check); the RLC
+         batch entry point is `ops.bls_batch.batch_verify`.
+
+Accept/reject semantics are bit-identical between backends: both run the
+same host-side validation, and the device pairing check computes the same
+product-of-pairings predicate.
 """
 
 from . import ciphersuite as _py
+from . import curve as _curve
 from . import fields as _fields
+from . import hash_to_curve as _h2c
 
 bls_active = True
 _backend_name = "py"
+
+
+def _device_pairing_check(pairs) -> bool:
+    from .. import bls_batch
+    return bls_batch.pairing_check_device(pairs)
+
+
+def _verify_jax(pubkey, message, signature):
+    try:
+        pk = _py._pk_to_point(pubkey)
+        sig = _py._sig_to_point(signature)
+    except ValueError:
+        return False
+    h = _h2c.hash_to_g2(message, _h2c.DST_G2)
+    return _device_pairing_check(
+        [(pk, h), (_curve.g1.neg(_curve.G1_GEN), sig)])
+
+
+def _aggregate_verify_jax(pubkeys, messages, signature):
+    if len(pubkeys) == 0 or len(pubkeys) != len(messages):
+        return False
+    try:
+        sig = _py._sig_to_point(signature)
+        pks = [_py._pk_to_point(pk) for pk in pubkeys]
+    except ValueError:
+        return False
+    pairs = [(pk, _h2c.hash_to_g2(msg, _h2c.DST_G2))
+             for pk, msg in zip(pks, messages)]
+    pairs.append((_curve.g1.neg(_curve.G1_GEN), sig))
+    return _device_pairing_check(pairs)
+
+
+def _fast_aggregate_verify_jax(pubkeys, message, signature):
+    if len(pubkeys) == 0:
+        return False
+    try:
+        sig = _py._sig_to_point(signature)
+        agg = _curve.g1.infinity()
+        for pk in pubkeys:
+            agg = _curve.g1.add(agg, _py._pk_to_point(pk))
+    except ValueError:
+        return False
+    h = _h2c.hash_to_g2(message, _h2c.DST_G2)
+    return _device_pairing_check(
+        [(agg, h), (_curve.g1.neg(_curve.G1_GEN), sig)])
 
 STUB_SIGNATURE = b"\x11" * 96
 STUB_PUBKEY = b"\x22" * 48
@@ -46,6 +100,8 @@ def Sign(privkey, message):
 def Verify(pubkey, message, signature):
     if not bls_active:
         return True
+    if _backend_name == "jax":
+        return _verify_jax(bytes(pubkey), bytes(message), bytes(signature))
     return _py.Verify(bytes(pubkey), bytes(message), bytes(signature))
 
 
@@ -58,6 +114,10 @@ def Aggregate(signatures):
 def AggregateVerify(pubkeys, messages, signature):
     if not bls_active:
         return True
+    if _backend_name == "jax":
+        return _aggregate_verify_jax([bytes(p) for p in pubkeys],
+                                     [bytes(m) for m in messages],
+                                     bytes(signature))
     return _py.AggregateVerify([bytes(p) for p in pubkeys],
                                [bytes(m) for m in messages],
                                bytes(signature))
@@ -66,6 +126,9 @@ def AggregateVerify(pubkeys, messages, signature):
 def FastAggregateVerify(pubkeys, message, signature):
     if not bls_active:
         return True
+    if _backend_name == "jax":
+        return _fast_aggregate_verify_jax([bytes(p) for p in pubkeys],
+                                          bytes(message), bytes(signature))
     return _py.FastAggregateVerify([bytes(p) for p in pubkeys],
                                    bytes(message), bytes(signature))
 
@@ -108,6 +171,12 @@ bytes96_to_G2 = _py.bytes96_to_G2
 def pairing_check(values):
     if not bls_active:
         return True
+    if _backend_name == "jax":
+        pairs = []
+        for (tag1, p), (tag2, q) in values:
+            assert tag1 == 1 and tag2 == 2
+            pairs.append((p, q))
+        return _device_pairing_check(pairs)
     return _py.pairing_check(values)
 
 

@@ -1,0 +1,230 @@
+"""Batched Jacobian point arithmetic on G1/G2 for TPU.
+
+Generic over the coordinate field (Fq for G1, Fq2 for G2) via a small
+field-ops namespace, mirroring the oracle's `_Group` parametrization
+(`ops/bls/curve.py:42-130`) — but branchless: infinity / doubling /
+cancellation cases are resolved with masked selects so the whole batch
+runs as straight-line vector code under jit.
+
+Points are (X, Y, Z) tuples of limb arrays; Z == 0 encodes infinity.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable
+
+import numpy as np
+
+from ..bls import curve as _pycurve
+from . import fq as _fq
+from . import tower as _tw
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+@dataclass(frozen=True)
+class FieldOps:
+    name: str
+    add: Callable
+    sub: Callable
+    mul: Callable
+    sqr: Callable
+    neg: Callable
+    mul_small: Callable
+    is_zero: Callable          # exact (canonicalizing) zero test -> (...)
+    one: Any                   # numpy constant, element shape
+    zero: Any
+    expand: Callable           # mask (...) -> broadcastable over element
+
+
+F1 = FieldOps(
+    name="fq",
+    add=_fq.fq_add,
+    sub=_fq.fq_sub,
+    mul=_fq.fq_mul,
+    sqr=_fq.fq_sqr,
+    neg=_fq.fq_neg,
+    mul_small=_fq.fq_mul_small,
+    is_zero=_fq.fq_is_zero,
+    one=_fq.ONE_MONT,
+    zero=np.zeros(_fq.N_LIMBS, dtype=np.int32),
+    expand=lambda m: m[..., None],
+)
+
+F2 = FieldOps(
+    name="fq2",
+    add=_tw.fq2_add,
+    sub=_tw.fq2_sub,
+    mul=_tw.fq2_mul,
+    sqr=_tw.fq2_sqr,
+    neg=_tw.fq2_neg,
+    mul_small=_tw.fq2_mul_small,
+    is_zero=_tw.fq2_is_zero,
+    one=_tw.FQ2_ONE_L,
+    zero=np.zeros((2, _fq.N_LIMBS), dtype=np.int32),
+    expand=lambda m: m[..., None, None],
+)
+
+
+def pt_infinity(F: FieldOps, like):
+    jnp = _jnp()
+    one = jnp.broadcast_to(jnp.asarray(F.one), like[0].shape).astype(jnp.int32)
+    zero = jnp.zeros_like(like[0])
+    return (one, one, zero)
+
+
+def pt_select(F: FieldOps, mask, p, q):
+    jnp = _jnp()
+    m = F.expand(mask)
+    return tuple(jnp.where(m, a, b) for a, b in zip(p, q))
+
+
+def pt_neg(F: FieldOps, p):
+    return (p[0], F.neg(p[1]), p[2])
+
+
+def pt_is_inf(F: FieldOps, p):
+    return F.is_zero(p[2])
+
+
+def pt_double(F: FieldOps, p):
+    """dbl-2007-bl (the oracle's formula, `curve.py:82-98`); Z=0 and Y=0
+    both land on Z3=0, so infinity needs no special-casing."""
+    X, Y, Z = p
+    A = F.sqr(X)
+    B = F.sqr(Y)
+    C = F.sqr(B)
+    t = F.sub(F.sqr(F.add(X, B)), F.add(A, C))
+    D = F.add(t, t)
+    E = F.mul_small(A, 3)
+    Fv = F.sqr(E)
+    X3 = F.sub(Fv, F.add(D, D))
+    eight_c = F.mul_small(C, 8)
+    Y3 = F.sub(F.mul(E, F.sub(D, X3)), eight_c)
+    Z3 = F.mul(F.add(Y, Y), Z)
+    return (X3, Y3, Z3)
+
+
+def pt_add(F: FieldOps, p, q):
+    """add-2007-bl with masked resolution of the special cases."""
+    X1, Y1, Z1 = p
+    X2, Y2, Z2 = q
+    Z1Z1 = F.sqr(Z1)
+    Z2Z2 = F.sqr(Z2)
+    U1 = F.mul(X1, Z2Z2)
+    U2 = F.mul(X2, Z1Z1)
+    S1 = F.mul(Y1, F.mul(Z2Z2, Z2))
+    S2 = F.mul(Y2, F.mul(Z1Z1, Z1))
+    H = F.sub(U2, U1)
+    rr = F.sub(S2, S1)
+    rr2 = F.add(rr, rr)
+    I = F.sqr(F.add(H, H))
+    J = F.mul(H, I)
+    V = F.mul(U1, I)
+    X3 = F.sub(F.sub(F.sqr(rr2), J), F.add(V, V))
+    SJ = F.mul(S1, J)
+    Y3 = F.sub(F.mul(rr2, F.sub(V, X3)), F.add(SJ, SJ))
+    Z3 = F.mul(F.mul(F.add(Z1, Z2), F.add(Z1, Z2)), H)
+    Z3 = F.sub(Z3, F.mul(Z1Z1, H))
+    Z3 = F.sub(Z3, F.mul(Z2Z2, H))
+    out = (X3, Y3, Z3)
+
+    h_zero = F.is_zero(H)
+    r_zero = F.is_zero(rr)
+    # same x, same y -> doubling; same x, different y -> infinity
+    out = pt_select(F, h_zero & r_zero, pt_double(F, p), out)
+    out = pt_select(F, h_zero & ~r_zero, pt_infinity(F, p), out)
+    out = pt_select(F, pt_is_inf(F, p), q, out)
+    out = pt_select(F, pt_is_inf(F, q), p, out)
+    return out
+
+
+def pt_scalar_mul(F: FieldOps, p, scalar_bits):
+    """Batched double-and-add, MSB first.
+
+    scalar_bits: int32 (..., nbits) per batch element (leading dims must
+    match p's batch dims).  Runs as a lax.scan of nbits steps; the add is
+    always computed and masked in (bits differ across the batch)."""
+    import jax
+    jnp = _jnp()
+
+    bits = jnp.moveaxis(scalar_bits, -1, 0)     # (nbits, ...)
+
+    def step(acc, bit):
+        acc = pt_double(F, acc)
+        cand = pt_add(F, acc, p)
+        return pt_select(F, bit.astype(bool), cand, acc), None
+
+    acc0 = pt_infinity(F, p)
+    acc, _ = jax.lax.scan(step, acc0, bits)
+    return acc
+
+
+def pt_sum(F: FieldOps, p, n: int):
+    """Sum a batch of n points (leading axis) with a log-depth add tree."""
+    jnp = _jnp()
+    m = 1
+    while m < n:
+        m *= 2
+    if m != n:
+        pad = pt_infinity(F, tuple(c[:1] for c in p))
+        p = tuple(jnp.concatenate(
+            [c, jnp.broadcast_to(pc, (m - n,) + c.shape[1:])])
+            for c, pc in zip(p, pad))
+    while m > 1:
+        m //= 2
+        p = pt_add(F, tuple(c[:m] for c in p), tuple(c[m:2 * m] for c in p))
+    return tuple(c[0] for c in p)
+
+
+# --- host conversions -------------------------------------------------------
+
+
+def scalars_to_bits(scalars, nbits: int) -> np.ndarray:
+    """Python ints -> (B, nbits) int32 bit matrix, MSB first."""
+    out = np.zeros((len(scalars), nbits), dtype=np.int32)
+    for i, s in enumerate(scalars):
+        s = int(s)
+        assert 0 <= s < (1 << nbits)
+        for j in range(nbits):
+            out[i, nbits - 1 - j] = (s >> j) & 1
+    return out
+
+
+def g1_affine_to_limbs(points) -> tuple[np.ndarray, np.ndarray]:
+    """Oracle G1 Jacobian points -> (x, y) Montgomery limb stacks.
+    Points must not be at infinity (filter on host first)."""
+    xs, ys = [], []
+    for p in points:
+        aff = _pycurve.g1.to_affine(p)
+        assert aff is not None, "infinity must be filtered host-side"
+        xs.append(_fq.to_mont(aff[0]))
+        ys.append(_fq.to_mont(aff[1]))
+    return np.stack(xs), np.stack(ys)
+
+
+def g2_affine_to_limbs(points) -> tuple[np.ndarray, np.ndarray]:
+    xs, ys = [], []
+    for p in points:
+        aff = _pycurve.g2.to_affine(p)
+        assert aff is not None, "infinity must be filtered host-side"
+        xs.append(_tw.fq2_from_oracle(aff[0]))
+        ys.append(_tw.fq2_from_oracle(aff[1]))
+    return np.stack(xs), np.stack(ys)
+
+
+def g1_limbs_to_oracle(p):
+    """Device Jacobian G1 point (single element) -> oracle tuple."""
+    X, Y, Z = (np.asarray(c).reshape(_fq.N_LIMBS) for c in p)
+    return (_fq.from_mont(X), _fq.from_mont(Y), _fq.from_mont(Z))
+
+
+def g2_limbs_to_oracle(p):
+    X, Y, Z = p
+    return (_tw.fq2_to_oracle(np.asarray(X).reshape(2, _fq.N_LIMBS)),
+            _tw.fq2_to_oracle(np.asarray(Y).reshape(2, _fq.N_LIMBS)),
+            _tw.fq2_to_oracle(np.asarray(Z).reshape(2, _fq.N_LIMBS)))

@@ -1,0 +1,212 @@
+"""Batched BLS12-381 base-field arithmetic for TPU.
+
+Fq elements are vectors of 33 x 12-bit limbs held in int32 lanes — sized so
+every intermediate (Montgomery-multiply column sums, lazy add/sub chains)
+stays inside native int32 with headroom: no 64-bit emulation anywhere.
+Values live in the Montgomery domain (R = 2**396) and are *signed-lazy*:
+limbs may be negative and values range over (-64p, 64p) between
+multiplications — subtraction is plain limb subtraction (arithmetic-shift
+carries), and every Montgomery product collapses the magnitude back under
+2p.  Only equality/canonicalization fully normalizes.
+
+This is the device-side replacement for the native BLS backends behind the
+reference's `eth2spec/utils/bls.py` (milagro/arkworks); the pure-Python
+sibling `ops/bls/fields.py` is the correctness oracle.
+
+Shapes are batch-first: an Fq element is an int32 array `(..., 33)`; all
+ops broadcast over leading axes, so vmap is never required for batching.
+
+Safety budget (why these bounds hold):
+  - CIOS step value: |t + a_i*b + m*p| per limb < 2**15*2**15 + 2**15
+    + 2**12*2**12 < 2**31.
+  - Montgomery bound: inputs |x| < 2**388 (= 64p and far beyond) give
+    |out| = |(ab + mN)/R| < p + |ab|/R < 2p.
+  - Lazy chains between muls are <= ~5 adds/subs of fresh (<2p) products,
+    so values stay well under 2**388 and limbs under 2**15.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..bls.fields import Q
+
+LIMB_BITS = 12
+N_LIMBS = 33                      # 33 * 12 = 396 bits of capacity
+LIMB_MASK = (1 << LIMB_BITS) - 1
+R_BITS = LIMB_BITS * N_LIMBS      # Montgomery R = 2**396
+R_MONT = pow(2, R_BITS, Q)
+# -Q^-1 mod 2**12 (the CIOS per-step multiplier)
+Q_INV_NEG = (-pow(Q, -1, 1 << LIMB_BITS)) % (1 << LIMB_BITS)
+
+
+def int_to_limbs(x: int) -> np.ndarray:
+    """Python int (non-negative) -> (33,) int32 limb vector."""
+    assert 0 <= x < (1 << R_BITS)
+    return np.array([(x >> (LIMB_BITS * i)) & LIMB_MASK
+                     for i in range(N_LIMBS)], dtype=np.int32)
+
+
+def limbs_to_int(limbs) -> int:
+    """(..., 33) limb vector -> python int (single element; signed limbs)."""
+    arr = np.asarray(limbs).reshape(-1, N_LIMBS)
+    assert arr.shape[0] == 1
+    return sum(int(v) << (LIMB_BITS * i) for i, v in enumerate(arr[0]))
+
+
+def to_mont(x: int) -> np.ndarray:
+    """Canonical int -> Montgomery-domain limb vector (host-side)."""
+    return int_to_limbs((x % Q) * R_MONT % Q)
+
+
+def from_mont(limbs) -> int:
+    """Montgomery-domain limb vector -> canonical int (host-side)."""
+    return limbs_to_int(limbs) * pow(R_MONT, -1, Q) % Q
+
+
+# Device constants (plain numpy; jnp closes over them at trace time)
+P_LIMBS = int_to_limbs(Q)
+TWO_P_LIMBS = int_to_limbs(2 * Q)
+ONE_MONT = to_mont(1)
+
+# p - 2 bits, MSB first (Fermat inversion exponent)
+_P_MINUS_2_BITS = np.array(
+    [int(b) for b in bin(Q - 2)[2:]], dtype=np.int32)
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def fq_carry(x, passes: int = 1):
+    """Redistribute limb overflow: vectorized lo/hi passes with signed
+    (arithmetic-shift) carries.  The TOP limb is never split — it absorbs
+    the incoming carry raw (splitting it would drop a signed carry out of
+    the representation; mid-Montgomery intermediates reach ±2**395, so the
+    top limb legitimately holds a few signed bits)."""
+    jnp = _jnp()
+    for _ in range(passes):
+        lo = x & LIMB_MASK
+        hi = x >> LIMB_BITS          # arithmetic shift = floor division
+        y = lo + jnp.concatenate(
+            [jnp.zeros_like(hi[..., :1]), hi[..., :-1]], axis=-1)
+        x = jnp.concatenate(
+            [y[..., :-1], (x[..., -1:] + hi[..., -2:-1])], axis=-1)
+    return x
+
+
+def fq_add(a, b):
+    return fq_carry(a + b)
+
+
+def fq_sub(a, b):
+    return fq_carry(a - b)
+
+
+def fq_neg(a):
+    return fq_carry(-a)
+
+
+def fq_mul_small(a, k: int):
+    """Multiply by a small python int (|k| <= ~16)."""
+    return fq_carry(a * k, passes=2)
+
+
+def fq_mul(a, b):
+    """Montgomery product ab/R mod p (CIOS over a lax.scan).
+
+    Inputs may be signed-lazy (|value| < 2**388, |limbs| < 2**15); output
+    magnitude is < 2p with limbs ~2**12.  Each scan step is O(batch * 33)
+    vector work: t += a_i * b;  m = -t0/p mod 2**12;  t = (t + m*p) >> 12.
+    """
+    import jax
+    jnp = _jnp()
+
+    p = jnp.asarray(P_LIMBS)
+    a_steps = jnp.moveaxis(a, -1, 0)          # (33, ...) scan over a's limbs
+
+    def step(t, a_i):
+        u = t + a_i[..., None] * b
+        m = (u[..., 0] * Q_INV_NEG) & LIMB_MASK
+        u = u + m[..., None] * p
+        c0 = u[..., 0] >> LIMB_BITS            # u0 ≡ 0 mod 2**12 (exact)
+        t = jnp.concatenate(
+            [u[..., 1:], jnp.zeros_like(u[..., :1])], axis=-1)
+        t = t.at[..., 0].add(c0)
+        return fq_carry(t), None
+
+    t0 = jnp.zeros(jnp.broadcast_shapes(a.shape, b.shape), dtype=jnp.int32)
+    t, _ = jax.lax.scan(step, t0, a_steps)
+    return fq_carry(t)
+
+
+def fq_sqr(a):
+    return fq_mul(a, a)
+
+
+def fq_canon(x):
+    """Fully reduce to the canonical representative in [0, p), exact limbs.
+
+    Only needed at comparison boundaries (eq / is_one); the hot path stays
+    in the redundant signed representation."""
+    import jax
+    jnp = _jnp()
+
+    # collapse magnitude to (-2p, 2p), then shift positive into (0, 4p)
+    x = fq_mul(x, jnp.asarray(ONE_MONT))
+    x = fq_carry(x + jnp.asarray(TWO_P_LIMBS), passes=2)
+
+    # exact sequential carry (value in (0, 4p) ⊂ [0, 2**396))
+    def carry_step(c, xi):
+        v = xi + c
+        return v >> LIMB_BITS, v & LIMB_MASK
+
+    _, limbs = jax.lax.scan(carry_step,
+                            jnp.zeros(x.shape[:-1], dtype=jnp.int32),
+                            jnp.moveaxis(x, -1, 0))
+    x = jnp.moveaxis(limbs, 0, -1)
+
+    # conditional subtract p three times (value < 4p)
+    p = jnp.asarray(P_LIMBS)
+    for _ in range(3):
+        d = x - p
+
+        def borrow_step(c, di):
+            v = di + c
+            return v >> LIMB_BITS, v & LIMB_MASK
+
+        bo, dl = jax.lax.scan(borrow_step,
+                              jnp.zeros(x.shape[:-1], dtype=jnp.int32),
+                              jnp.moveaxis(d, -1, 0))
+        dsub = jnp.moveaxis(dl, 0, -1)
+        ge = (bo == 0)                       # no final borrow => x >= p
+        x = jnp.where(ge[..., None], dsub, x)
+    return x
+
+
+def fq_eq(a, b):
+    jnp = _jnp()
+    return jnp.all(fq_canon(a) == fq_canon(b), axis=-1)
+
+
+def fq_is_zero(a):
+    jnp = _jnp()
+    return jnp.all(fq_canon(a) == 0, axis=-1)
+
+
+def fq_inv(a):
+    """Fermat inversion a**(p-2); zero maps to zero."""
+    import jax
+    jnp = _jnp()
+
+    bits = jnp.asarray(_P_MINUS_2_BITS)
+
+    def step(acc, bit):
+        acc = fq_sqr(acc)
+        acc_mul = fq_mul(acc, a)
+        return jnp.where(bit, acc_mul, acc), None
+
+    one = jnp.broadcast_to(jnp.asarray(ONE_MONT), a.shape).astype(jnp.int32)
+    acc, _ = jax.lax.scan(step, one, bits)
+    return acc

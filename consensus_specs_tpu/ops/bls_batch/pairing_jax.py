@@ -1,0 +1,215 @@
+"""Batched optimal-ate pairing on TPU.
+
+The Miller loop runs on the sextic twist in Fq2 with Jacobian T and
+*inversion-free* line coefficients; the only Fq12 work per step is one
+square and one sparse multiply.  All B pairings advance in lockstep through
+a lax.scan over the fixed 64-bit BLS parameter, their Miller values are
+product-reduced, and ONE shared final exponentiation finishes the batch —
+the random-linear-combination batching trick of the KZG spec
+(`specs/deneb/polynomial-commitments.md:415` `verify_kzg_proof_batch`)
+applied to the pairing layer itself.
+
+Line equations (derived, not transcribed; scaling by Fq2 factors is free
+because any Fq2 element is killed by the easy part of the final
+exponentiation — a^(q^6-1) = 1 for a in Fq2):
+
+  tangent at T=(X,Y,Z):  L(x,y) = 2YZ^3·y − 3X^2Z^2·x + (3X^3 − 2Y^2)
+  chord T,(x2,y2):       L(x,y) = ZH·y − I·x + (I·x2 − ZH·y2)
+                          with H = X − x2·Z^2, I = Y − y2·Z^3
+
+evaluated at the untwist preimage of P, i.e. x = x_P·cx⁻¹, y = y_P·cy⁻¹
+where (cx, cy) are the oracle's derived untwist constants
+(`ops/bls/pairing.py:39-53`) — each a single w-power, so the line is a
+3-term sparse Fq12 element with fixed basis slots.
+
+The final exponentiation uses the BLS12 x-structure of the hard part:
+3·(q⁴−q²+1)/r = (x−1)²·(x+q)·(x²+q²−1) + 3, verified at import; the extra
+factor 3 is harmless for pairing *checks* (μ_r has prime order r ∤ 3).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..bls import pairing as _pyp
+from ..bls.fields import BLS_X, Q, R, Fq2
+from . import curve_jax as cj
+from . import fq as _fq
+from . import tower as tw
+
+# --- derived constants (host) ----------------------------------------------
+
+assert 3 * ((Q**4 - Q**2 + 1) // R) == \
+    (BLS_X - 1) ** 2 * (BLS_X + Q) * (BLS_X**2 + Q**2 - 1) + 3
+
+# |x| bits MSB-first, skipping the leading 1 (Miller loop schedule)
+_X_BITS = np.array([int(b) for b in bin(abs(BLS_X))[3:]], dtype=np.int32)
+# |x| bits MSB-first including the leading 1 (final-exp pow_x schedule)
+_X_BITS_FULL = np.array([int(b) for b in bin(abs(BLS_X))[2:]], dtype=np.int32)
+
+
+def _w_slot(e12) -> tuple[int, Fq2]:
+    """Decompose an Fq12 that is a single w-power multiple: (index, coeff)."""
+    coeffs = [e12.c0.c0, e12.c1.c0, e12.c0.c1, e12.c1.c1, e12.c0.c2,
+              e12.c1.c2]
+    nz = [(i, c) for i, c in enumerate(coeffs) if not c.is_zero()]
+    assert len(nz) == 1, "untwist constant is not a pure w-power"
+    return nz[0]
+
+
+# untwist preimage of P scales: x_P·cx⁻¹, y_P·cy⁻¹
+_JX, _SX = _w_slot(_pyp._fq2_to_fq12(Fq2(1, 0)) * _pyp._UNTWIST_CX.inv())
+_JY, _SY = _w_slot(_pyp._fq2_to_fq12(Fq2(1, 0)) * _pyp._UNTWIST_CY.inv())
+_SX_L = tw.fq2_from_oracle(_SX)
+_SY_L = tw.fq2_from_oracle(_SY)
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _line_to_fq12(c0, cx_xp, cy_yp):
+    """Place the three Fq2 line terms into their fixed w-power slots."""
+    jnp = _jnp()
+    slots = [None] * 6
+    slots[0] = c0
+    slots[_JX] = tw.fq2_mul(cx_xp, jnp.broadcast_to(
+        jnp.asarray(_SX_L), cx_xp.shape).astype(jnp.int32))
+    slots[_JY] = tw.fq2_mul(cy_yp, jnp.broadcast_to(
+        jnp.asarray(_SY_L), cy_yp.shape).astype(jnp.int32))
+    zero = jnp.zeros_like(c0)
+    slots = [zero if s is None else s for s in slots]
+    return tw._from_w_coeffs(slots)
+
+
+def _dbl_step(T, xp, yp):
+    """Tangent-line coefficients at T, evaluated at P; then T <- 2T."""
+    X, Y, Z = T
+    XX = tw.fq2_sqr(X)
+    YY = tw.fq2_sqr(Y)
+    ZZ = tw.fq2_sqr(Z)
+    cy = tw.fq2_mul_small(tw.fq2_mul(tw.fq2_mul(Y, Z), ZZ), 2)      # 2YZ^3
+    cx = tw.fq2_neg(tw.fq2_mul_small(tw.fq2_mul(XX, ZZ), 3))        # -3X^2Z^2
+    c0 = tw.fq2_sub(tw.fq2_mul_small(tw.fq2_mul(XX, X), 3),
+                    tw.fq2_mul_small(YY, 2))                        # 3X^3-2Y^2
+    line = _line_to_fq12(c0, tw.fq2_mul_fq(cx, xp), tw.fq2_mul_fq(cy, yp))
+    return cj.pt_double(cj.F2, T), line
+
+
+def _add_step(T, xq, yq, xp, yp):
+    """Chord-line coefficients through T and affine Q; then T <- T + Q."""
+    X, Y, Z = T
+    ZZ = tw.fq2_sqr(Z)
+    H = tw.fq2_sub(X, tw.fq2_mul(xq, ZZ))
+    I = tw.fq2_sub(Y, tw.fq2_mul(yq, tw.fq2_mul(ZZ, Z)))
+    ZH = tw.fq2_mul(Z, H)
+    cy = ZH
+    cx = tw.fq2_neg(I)
+    c0 = tw.fq2_sub(tw.fq2_mul(I, xq), tw.fq2_mul(ZH, yq))
+    line = _line_to_fq12(c0, tw.fq2_mul_fq(cx, xp), tw.fq2_mul_fq(cy, yp))
+    jnp = _jnp()
+    one = jnp.broadcast_to(jnp.asarray(tw.FQ2_ONE_L), xq.shape)
+    Tn = cj.pt_add(cj.F2, T, (xq, yq, one.astype(jnp.int32)))
+    return Tn, line
+
+
+def miller_batch(xp, yp, xq, yq):
+    """f_{|x|,Q}(P) for a batch: xp/yp (B,33) G1 affine (Fq limbs),
+    xq/yq (B,2,33) G2 affine on the twist.  Returns (B, <fq12>) Miller
+    values (conjugated for the negative parameter; NOT final-exponentiated).
+    """
+    import jax
+    jnp = _jnp()
+
+    B = xp.shape[0]
+    one2 = jnp.broadcast_to(jnp.asarray(tw.FQ2_ONE_L),
+                            xq.shape).astype(jnp.int32)
+    T0 = (xq, yq, one2)
+    f0 = jnp.broadcast_to(jnp.asarray(tw.FQ12_ONE_L),
+                          (B,) + tw.FQ12_ONE_L.shape).astype(jnp.int32)
+
+    def step(carry, bit):
+        f, T = carry
+        f = tw.fq12_sqr(f)
+        T, line = _dbl_step(T, xp, yp)
+        f = tw.fq12_mul(f, line)
+
+        def with_add(op):
+            f_, T_ = op
+            T2, line2 = _add_step(T_, xq, yq, xp, yp)
+            return tw.fq12_mul(f_, line2), T2
+
+        f, T = jax.lax.cond(bit == 1, with_add, lambda op: op, (f, T))
+        return (f, T), None
+
+    (f, _), _ = jax.lax.scan(step, (f0, T0), jnp.asarray(_X_BITS))
+    return tw.fq12_conj(f)       # negative BLS parameter
+
+
+def fq12_pow_x_abs(g):
+    """g^|x| via square-and-multiply over the fixed 64-bit parameter."""
+    import jax
+    jnp = _jnp()
+
+    def step(acc, bit):
+        acc = tw.fq12_sqr(acc)
+        acc = jax.lax.cond(bit == 1, lambda a: tw.fq12_mul(a, g),
+                           lambda a: a, acc)
+        return acc, None
+
+    one = jnp.broadcast_to(jnp.asarray(tw.FQ12_ONE_L),
+                           g.shape).astype(jnp.int32)
+    acc, _ = jax.lax.scan(step, one, jnp.asarray(_X_BITS_FULL))
+    return acc
+
+
+def final_exponentiate(f):
+    """f^(3·(q^12-1)/r) — x-structured hard part, cyclotomic inverses as
+    conjugates."""
+    # easy part: f^((q^6-1)(q^2+1))
+    f1 = tw.fq12_mul(tw.fq12_conj(f), tw.fq12_inv(f))
+    m = tw.fq12_mul(tw.fq12_frobenius(f1, 2), f1)
+
+    def pow_x(g):                      # g^x  (x negative)
+        return tw.fq12_conj(fq12_pow_x_abs(g))
+
+    def pow_xm1(g):                    # g^(x-1)
+        return tw.fq12_mul(pow_x(g), tw.fq12_conj(g))
+
+    t1 = pow_xm1(pow_xm1(m))                              # m^((x-1)^2)
+    t2 = tw.fq12_mul(pow_x(t1), tw.fq12_frobenius(t1, 1))  # ^(x+q)
+    t3 = tw.fq12_mul(
+        tw.fq12_mul(pow_x(pow_x(t2)), tw.fq12_frobenius(t2, 2)),
+        tw.fq12_conj(t2))                                 # ^(x^2+q^2-1)
+    return tw.fq12_mul(t3, tw.fq12_mul(tw.fq12_sqr(m), m))  # · m^3
+
+
+def _product_tree(f, n: int):
+    """Product over the leading batch axis (log-depth)."""
+    jnp = _jnp()
+    m = 1
+    while m < n:
+        m *= 2
+    if m != n:
+        pad = jnp.broadcast_to(jnp.asarray(tw.FQ12_ONE_L),
+                               (m - n,) + f.shape[1:]).astype(jnp.int32)
+        f = jnp.concatenate([f, pad])
+    while m > 1:
+        m //= 2
+        f = tw.fq12_mul(f[:m], f[m:2 * m])
+    return f[0]
+
+
+def multi_pairing_check(xp, yp, xq, yq, mask):
+    """prod_i e(P_i, Q_i)^(mask_i) == 1 with one final exponentiation.
+
+    mask (B,) bool lets callers pad the batch to a fixed shape (padded
+    lanes contribute the identity)."""
+    jnp = _jnp()
+    f = miller_batch(xp, yp, xq, yq)
+    one = jnp.broadcast_to(jnp.asarray(tw.FQ12_ONE_L),
+                           f.shape).astype(jnp.int32)
+    f = jnp.where(mask[:, None, None, None, None], f, one)
+    total = _product_tree(f, f.shape[0])
+    return tw.fq12_is_one(final_exponentiate(total))
