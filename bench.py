@@ -8,17 +8,27 @@ registry-scale merkleization (balances list root + validator registry root).
   program over a 2**20-validator struct-of-arrays registry.
 - Baseline: the executable spec's pure-Python pipeline + SSZ engine
   hash_tree_root, measured on a 1024-validator mainnet state and scaled
-  linearly (the pipeline is O(N); sorting terms are negligible).
+  linearly (the pipeline is O(N); sorting terms are negligible).  The
+  measured per-validator cost is persisted in `bench_baseline.json` (checked
+  in) so the driver run does not re-pay ~95s of pure-Python sweeps; delete
+  the file to re-measure.
 
 Prints ONE JSON line:
   {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
+
+Budget design (round-4 fix): baseline is read from disk (<1ms), the XLA
+compile is amortized through a persistent compilation cache in
+`.jax_cache/`, and the JSON line is printed immediately after the five
+measured steps — nothing optional runs before it.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
+from pathlib import Path
 
 import jax
 import numpy as np
@@ -26,12 +36,20 @@ import numpy as np
 # entry points own the process-wide uint64 switch (parallel.require_x64)
 jax.config.update("jax_enable_x64", True)
 
+# persistent compilation cache: the ~70s XLA compile of the fused step is
+# paid once per machine, not once per run
+from consensus_specs_tpu.utils.jaxtools import enable_compile_cache  # noqa: E402
+
+enable_compile_cache()
+
+BASELINE_FILE = Path(__file__).resolve().parent / "bench_baseline.json"
+
 
 def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
-def baseline_cpu_seconds_per_validator() -> float:
+def _measure_baseline(n: int = 1024, repeats: int = 3) -> dict:
     """Pure-Python spec pipeline + SSZ HTR, per validator."""
     from consensus_specs_tpu.models.builder import build_spec
     from consensus_specs_tpu.testlib.context import (
@@ -43,14 +61,13 @@ def baseline_cpu_seconds_per_validator() -> float:
     from consensus_specs_tpu.utils.ssz.ssz_impl import hash_tree_root
 
     spec = build_spec("phase0", "mainnet")
-    n = 1024
     balances = [spec.MAX_EFFECTIVE_BALANCE] * n
     state = create_genesis_state(
         spec, balances, default_activation_threshold(spec))
     prepare_state_with_attestations(spec, state)
 
     best = float("inf")
-    for _ in range(3):
+    for _ in range(repeats):
         st = state.copy()
         t0 = time.perf_counter()
         spec.process_justification_and_finalization(st)
@@ -60,20 +77,58 @@ def baseline_cpu_seconds_per_validator() -> float:
         hash_tree_root(st.balances)
         hash_tree_root(st.validators)
         best = min(best, time.perf_counter() - t0)
-    log(f"baseline: {best:.3f}s @ {n} validators "
-        f"({best / n * 1e6:.1f} us/validator)")
-    return best / n
+    return {
+        "seconds_per_validator": best / n,
+        "validators_measured": n,
+        "repeats": repeats,
+        "host_fingerprint": _host_fingerprint(),
+        "measured_at": time.strftime("%Y-%m-%d"),
+        "pipeline": ("process_justification_and_finalization + "
+                     "process_rewards_and_penalties + process_slashings + "
+                     "process_effective_balance_updates + "
+                     "hash_tree_root(balances) + hash_tree_root(validators)"),
+    }
+
+
+def _host_fingerprint() -> str:
+    import platform
+
+    return f"{platform.machine()}/{os.cpu_count()}cpu"
+
+
+def baseline_cpu_seconds_per_validator() -> float:
+    if BASELINE_FILE.exists() and not os.environ.get("CST_BENCH_REMEASURE"):
+        data = json.loads(BASELINE_FILE.read_text())
+        if data.get("host_fingerprint", _host_fingerprint()) \
+                != _host_fingerprint():
+            log(f"baseline host mismatch ({data['host_fingerprint']} vs "
+                f"{_host_fingerprint()}): re-measuring")
+        else:
+            log(f"baseline (persisted {data['measured_at']}): "
+                f"{data['seconds_per_validator'] * 1e6:.1f} us/validator "
+                f"@ {data['validators_measured']} validators")
+            return data["seconds_per_validator"]
+    data = _measure_baseline()
+    try:
+        BASELINE_FILE.write_text(json.dumps(data, indent=2) + "\n")
+        log(f"baseline (measured, persisted to {BASELINE_FILE.name}): "
+            f"{data['seconds_per_validator'] * 1e6:.1f} us/validator")
+    except OSError as e:  # persisting is an optimization, never fatal
+        log(f"baseline measured but not persisted: {e}")
+    return data["seconds_per_validator"]
 
 
 def tpu_seconds_per_step(n: int) -> float:
-    import jax
-
     from consensus_specs_tpu.models.builder import build_spec
     from consensus_specs_tpu.parallel import (
         EpochParams, EpochScalars, ValidatorLeaves, balances_list_root,
         epoch_sweep, validator_records_root, validator_registry_root)
 
     from __graft_entry__ import _synthetic_registry
+
+    t0 = time.perf_counter()
+    dev = jax.devices()[0]
+    log(f"device claim: {time.perf_counter() - t0:.1f}s -> {dev}")
 
     params = EpochParams.from_spec(build_spec("phase0", "mainnet"))
     reg = _synthetic_registry(n)
@@ -98,14 +153,13 @@ def tpu_seconds_per_step(n: int) -> float:
     args = (reg, sc, np.uint64(n), pk_root, cred)
     t0 = time.perf_counter()
     jax.block_until_ready(step(*args))
-    log(f"tpu: compile+first run {time.perf_counter() - t0:.1f}s "
-        f"on {jax.devices()[0]}")
+    log(f"compile+first run {time.perf_counter() - t0:.1f}s")
     iters = 5
     t0 = time.perf_counter()
     for _ in range(iters):
         out = jax.block_until_ready(step(*args))
     dt = (time.perf_counter() - t0) / iters
-    log(f"tpu: {dt * 1e3:.1f} ms/step @ {n} validators "
+    log(f"{dt * 1e3:.1f} ms/step @ {n} validators "
         f"(root {np.asarray(out[3])[:2]})")
     return dt
 
@@ -120,7 +174,7 @@ def main():
         "value": round(tpu_s, 4),
         "unit": "s",
         "vs_baseline": round(baseline_s / tpu_s, 1),
-    }))
+    }), flush=True)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,2 @@
+"""Debug / vector-support utilities: SSZ ⇄ plain-python encoding and the
+type-driven random object fuzzer (the reference's `eth2spec/debug/`)."""

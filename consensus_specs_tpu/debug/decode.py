@@ -1,0 +1,55 @@
+"""Plain-python (from YAML) → SSZ object; inverse of `debug.encode`.
+
+Mirrors `eth2spec/debug/decode.py`, extended to cover bit arrays (which the
+reference decoder omits): Bitlist/Bitvector decode from the 0x-hex of their
+serialization.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..utils.ssz.ssz_impl import hash_tree_root
+from ..utils.ssz.types import (
+    Bitlist,
+    Bitvector,
+    ByteList,
+    ByteVector,
+    Container,
+    List,
+    Union,
+    Vector,
+    boolean,
+    uint,
+)
+
+
+def decode(data: Any, typ):
+    if issubclass(typ, (uint, boolean)):
+        return typ(int(data))
+    if issubclass(typ, (Bitlist, Bitvector)):
+        return typ.decode_bytes(bytes.fromhex(data[2:]))
+    if issubclass(typ, (List, Vector)):
+        elem_t = typ._element_type
+        return typ([decode(e, elem_t) for e in data])
+    if issubclass(typ, (ByteVector, ByteList)):
+        return typ(bytes.fromhex(data[2:]))
+    if issubclass(typ, Container):
+        kwargs = {}
+        for field_name, field_type in typ.fields().items():
+            kwargs[field_name] = decode(data[field_name], field_type)
+            if field_name + "_hash_tree_root" in data:
+                assert (data[field_name + "_hash_tree_root"][2:]
+                        == hash_tree_root(kwargs[field_name]).hex())
+        obj = typ(**kwargs)
+        if "hash_tree_root" in data:
+            assert data["hash_tree_root"][2:] == hash_tree_root(obj).hex()
+        return obj
+    if issubclass(typ, Union):
+        selector = int(data["selector"])
+        value_typ = typ._options[selector]
+        if value_typ is None:
+            assert data["value"] is None
+            return typ(selector, None)
+        return typ(selector, decode(data["value"], value_typ))
+    raise TypeError(f"cannot decode into {typ!r}")

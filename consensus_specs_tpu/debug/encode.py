@@ -1,0 +1,57 @@
+"""SSZ object → plain-python (ints / '0x…' hex strings / dicts / lists).
+
+The value convention matches the reference's YAML vector format
+(`eth2spec/debug/encode.py`): uints wider than 64 bits become decimal
+strings, bit arrays and byte arrays become 0x-hex of their serialization,
+containers become dicts keyed by field name.
+"""
+
+from __future__ import annotations
+
+from ..utils.ssz.ssz_impl import hash_tree_root, serialize
+from ..utils.ssz.types import (
+    Bitlist,
+    Bitvector,
+    Container,
+    List,
+    Union,
+    Vector,
+    boolean,
+    uint,
+)
+
+
+def encode(value, include_hash_tree_roots: bool = False):
+    if isinstance(value, uint):
+        if value.type_byte_length() > 8:
+            return str(int(value))
+        return int(value)
+    if isinstance(value, boolean):
+        return value == 1
+    if isinstance(value, (Bitlist, Bitvector)):
+        return "0x" + serialize(value).hex()
+    if isinstance(value, (list, tuple)):
+        return [encode(e, include_hash_tree_roots) for e in value]
+    if isinstance(value, (List, Vector)):
+        return [encode(e, include_hash_tree_roots) for e in value]
+    if isinstance(value, bytes):  # bytes, ByteList, ByteVector
+        return "0x" + value.hex()
+    if isinstance(value, Container):
+        out = {}
+        for field_name in value.fields():
+            fv = getattr(value, field_name)
+            out[field_name] = encode(fv, include_hash_tree_roots)
+            if include_hash_tree_roots:
+                out[field_name + "_hash_tree_root"] = \
+                    "0x" + hash_tree_root(fv).hex()
+        if include_hash_tree_roots:
+            out["hash_tree_root"] = "0x" + hash_tree_root(value).hex()
+        return out
+    if isinstance(value, Union):
+        inner = value.value
+        return {
+            "selector": int(value.selector),
+            "value": None if inner is None
+            else encode(inner, include_hash_tree_roots),
+        }
+    raise TypeError(f"cannot encode {type(value).__name__}: {value!r}")

@@ -203,6 +203,96 @@ class Spec:
         return f"<Spec {self._namespace['fork']}/{self._namespace['preset_name']}>"
 
 
+class _LRU:
+    """Small dict-backed LRU (the reference uses the `lru-dict` C ext,
+    `pysetup/spec_builders/phase0.py:47-56`; this build avoids the dep)."""
+
+    __slots__ = ("size", "data")
+
+    def __init__(self, size: int):
+        self.size = size
+        self.data: dict = {}
+
+    def __contains__(self, key):
+        return key in self.data
+
+    def __getitem__(self, key):
+        v = self.data.pop(key)
+        self.data[key] = v  # move to back (most recent)
+        return v
+
+    def __setitem__(self, key, value):
+        if key in self.data:
+            self.data.pop(key)
+        elif len(self.data) >= self.size:
+            self.data.pop(next(iter(self.data)))
+        self.data[key] = value
+
+
+def _cache_this(key_fn, value_fn, lru_size: int):
+    cache = _LRU(lru_size)
+
+    def wrapper(*args, **kw):
+        key = key_fn(*args, **kw)
+        if key not in cache:
+            cache[key] = value_fn(*args, **kw)
+        return cache[key]
+
+    wrapper.__wrapped__ = value_fn  # monkeypatch/debug escape hatch
+    return wrapper
+
+
+def _install_caches(ns: dict[str, Any]) -> None:
+    """Wrap the committee/shuffle/balance lookups in per-namespace LRU
+    caches, mirroring the reference's generated-spec cache layer
+    (`pysetup/spec_builders/phase0.py:58-104`).  Installed after all fork
+    sources executed, so the wrappers capture each fork's final overrides;
+    keys lean on the SSZ engine's dirty-propagation root cache making
+    `.hash_tree_root()` cheap on unchanged subtrees."""
+    slots_per_epoch = int(ns["SLOTS_PER_EPOCH"])
+    max_committees = int(ns.get("MAX_COMMITTEES_PER_SLOT", 64))
+    epoch_at = ns["compute_epoch_at_slot"]
+
+    def wrap(name, key_fn, size):
+        if name in ns:
+            ns[name] = _cache_this(key_fn, ns[name], size)
+
+    wrap("compute_shuffled_index",
+         lambda index, index_count, seed: (int(index), int(index_count),
+                                           bytes(seed)),
+         slots_per_epoch * 3)
+    wrap("get_total_active_balance",
+         lambda state: (state.validators.hash_tree_root(),
+                        epoch_at(state.slot)),
+         10)
+    wrap("get_base_reward",
+         lambda state, index: (state.validators.hash_tree_root(), state.slot,
+                               int(index)),
+         2048)
+    wrap("get_committee_count_per_slot",
+         lambda state, epoch: (state.validators.hash_tree_root(), int(epoch)),
+         slots_per_epoch * 3)
+    wrap("get_active_validator_indices",
+         lambda state, epoch: (state.validators.hash_tree_root(), int(epoch)),
+         3)
+    wrap("get_beacon_committee",
+         lambda state, slot, index: (state.validators.hash_tree_root(),
+                                     state.randao_mixes.hash_tree_root(),
+                                     int(slot), int(index)),
+         slots_per_epoch * max_committees * 3)
+    wrap("get_matching_target_attestations",
+         lambda state, epoch: (state.hash_tree_root(), int(epoch)),
+         10)
+    wrap("get_matching_head_attestations",
+         lambda state, epoch: (state.hash_tree_root(), int(epoch)),
+         10)
+    wrap("get_attesting_indices",
+         lambda state, attestation: (state.randao_mixes.hash_tree_root(),
+                                     state.validators.hash_tree_root(),
+                                     attestation.hash_tree_root()),
+         slots_per_epoch * max_committees * 3)
+
+
 def _exec_sources(fork: str, ns: dict[str, Any]) -> None:
     for f in fork_chain(fork):
         ns["CURRENT_FORK"] = f
@@ -232,11 +322,31 @@ def build_spec(fork: str, preset_name: str) -> Spec:
     ns["TRUSTED_SETUPS_DIR"] = str(
         PKG_ROOT / "presets" / preset_name / "trusted_setups")
     _exec_sources(fork, ns)
+    _install_caches(ns)
     # bind functions' globals: they already close over `ns` via exec globals
     spec = Spec(fork, preset_name, ns)
     ns["spec"] = spec
     _SPEC_CACHE[key] = spec
     return spec
+
+
+def get_copy_of_spec(spec: Spec) -> Spec:
+    """Fresh, uncached spec namespace for tests that monkeypatch spec
+    functions (`spec.retrieve_blobs_and_proofs = stub` …): writes to the
+    copy never leak into the shared `build_spec` cache.  Mirrors the
+    reference's re-import isolation (`test/context.py:663-734`)."""
+    ns = _preamble_namespace()
+    ns.update(load_preset(spec.preset_name, spec.fork))
+    # carry the source spec's live config (it may hold overrides from
+    # spec_with_config), not a fresh load of the preset defaults
+    ns["config"] = Configuration(**spec.config.to_dict())
+    ns["TRUSTED_SETUPS_DIR"] = str(
+        PKG_ROOT / "presets" / spec.preset_name / "trusted_setups")
+    _exec_sources(spec.fork, ns)
+    _install_caches(ns)
+    fresh = Spec(spec.fork, spec.preset_name, ns)
+    ns["spec"] = fresh
+    return fresh
 
 
 _OVERRIDE_SPEC_CACHE: dict[tuple, Spec] = {}
@@ -268,6 +378,7 @@ def spec_with_config(spec: Spec, overrides: dict[str, Any]) -> Spec:
     ns["TRUSTED_SETUPS_DIR"] = str(
         PKG_ROOT / "presets" / spec.preset_name / "trusted_setups")
     _exec_sources(spec.fork, ns)
+    _install_caches(ns)
     fresh = Spec(spec.fork, spec.preset_name, ns)
     ns["spec"] = fresh
     _OVERRIDE_SPEC_CACHE[key] = fresh

@@ -71,7 +71,8 @@ def _cached_genesis(spec, balances_fn, threshold_fn):
     cfg_fp = tuple(sorted(
         (k, _hashable(v)) for k, v in spec.config.to_dict().items()))
     key = (spec.fork, spec.preset_name, cfg_fp,
-           balances_fn.__name__, threshold_fn.__name__)
+           f"{balances_fn.__module__}.{balances_fn.__qualname__}",
+           f"{threshold_fn.__module__}.{threshold_fn.__qualname__}")
     if key not in _GENESIS_CACHE:
         balances = balances_fn(spec)
         threshold = threshold_fn(spec)

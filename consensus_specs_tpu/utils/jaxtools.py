@@ -1,0 +1,36 @@
+"""Small JAX process-setup helpers shared by the entry points."""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent.parent
+
+
+def enable_compile_cache(cache_dir: Path | None = None) -> None:
+    """Point JAX's persistent compilation cache at `.jax_cache/` so repeated
+    bench / driver runs on one machine pay the XLA compile once.  Failure is
+    never fatal — the cache is an optimization."""
+    import jax
+
+    try:
+        d = cache_dir or (REPO_ROOT / ".jax_cache")
+        d.mkdir(exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", str(d))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception:
+        pass
+
+
+def backends_initialized() -> bool:
+    """True once any PJRT backend exists.  Must never *trigger* backend
+    initialization: on this image the default platform is a pooled TPU whose
+    claim can take minutes, so probing via `jax.devices()` is itself the
+    multi-minute stall this predicate exists to avoid."""
+    try:
+        from jax._src import xla_bridge
+
+        return bool(xla_bridge._backends)
+    except Exception:
+        return False

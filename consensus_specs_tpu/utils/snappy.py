@@ -1,0 +1,171 @@
+"""Pure-Python snappy block-format codec.
+
+The reference compresses every SSZ vector part with C `python-snappy`
+(`gen_helpers/gen_base/dumper.py:65-70`); that binding is not in this
+image, so the generator layer uses this self-contained implementation of
+the raw snappy block format (the same format `snappy.compress` emits:
+a varint uncompressed length followed by literal/copy elements).
+
+The compressor is a greedy hash-table LZ like the canonical algorithm:
+4-byte hashes into a 16k-entry table, copies emitted with the 2-byte
+offset encoding, literals for the rest.  Output decompresses with any
+conforming snappy decoder (the consumers of `.ssz_snappy` vectors);
+byte-identity with the C encoder's choices is not required by the format.
+"""
+
+from __future__ import annotations
+
+_TAG_LITERAL = 0
+_TAG_COPY1 = 1
+_TAG_COPY2 = 2
+
+_TABLE_BITS = 14
+_TABLE_SIZE = 1 << _TABLE_BITS
+
+
+def _write_varint(n: int, out: bytearray) -> None:
+    while n >= 0x80:
+        out.append((n & 0x7F) | 0x80)
+        n >>= 7
+    out.append(n)
+
+
+def _read_varint(data: bytes, pos: int) -> tuple[int, int]:
+    shift = 0
+    result = 0
+    while True:
+        b = data[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+        if shift > 35:
+            raise ValueError("varint too long")
+
+
+def _emit_literal(data: bytes, start: int, end: int, out: bytearray) -> None:
+    length = end - start
+    if length <= 0:
+        return
+    n = length - 1
+    if n < 60:
+        out.append((n << 2) | _TAG_LITERAL)
+    elif n < (1 << 8):
+        out.append((60 << 2) | _TAG_LITERAL)
+        out.append(n)
+    elif n < (1 << 16):
+        out.append((61 << 2) | _TAG_LITERAL)
+        out += n.to_bytes(2, "little")
+    elif n < (1 << 24):
+        out.append((62 << 2) | _TAG_LITERAL)
+        out += n.to_bytes(3, "little")
+    else:
+        out.append((63 << 2) | _TAG_LITERAL)
+        out += n.to_bytes(4, "little")
+    out += data[start:end]
+
+
+def _emit_copy(offset: int, length: int, out: bytearray) -> None:
+    # prefer copy1 (4..11 byte copies, offset < 2048), else chains of copy2
+    while length >= 68:
+        out.append((63 << 2) | _TAG_COPY2)
+        out += offset.to_bytes(2, "little")
+        length -= 64
+    if length > 64:
+        # emit a 60-byte copy2 so the remainder is >= 4
+        out.append((59 << 2) | _TAG_COPY2)
+        out += offset.to_bytes(2, "little")
+        length -= 60
+    if length >= 12 or offset >= 2048:
+        out.append(((length - 1) << 2) | _TAG_COPY2)
+        out += offset.to_bytes(2, "little")
+    else:
+        out.append(((offset >> 8) << 5) | ((length - 4) << 2) | _TAG_COPY1)
+        out.append(offset & 0xFF)
+
+
+def _hash4(v: int) -> int:
+    return ((v * 0x1E35A7BD) >> (32 - _TABLE_BITS)) & (_TABLE_SIZE - 1)
+
+
+def compress(data: bytes) -> bytes:
+    data = bytes(data)
+    n = len(data)
+    out = bytearray()
+    _write_varint(n, out)
+    if n == 0:
+        return bytes(out)
+    if n < 4:
+        _emit_literal(data, 0, n, out)
+        return bytes(out)
+
+    table = [-1] * _TABLE_SIZE
+    pos = 0
+    lit_start = 0
+    limit = n - 3  # last position where a 4-byte read fits
+    while pos < limit:
+        cur = int.from_bytes(data[pos:pos + 4], "little")
+        h = _hash4(cur)
+        cand = table[h]
+        table[h] = pos
+        if (cand >= 0 and pos - cand < 65536
+                and data[cand:cand + 4] == data[pos:pos + 4]):
+            _emit_literal(data, lit_start, pos, out)
+            # extend the match
+            length = 4
+            while (pos + length < n
+                   and data[cand + length] == data[pos + length]):
+                length += 1
+            _emit_copy(pos - cand, length, out)
+            pos += length
+            lit_start = pos
+        else:
+            pos += 1
+    _emit_literal(data, lit_start, n, out)
+    return bytes(out)
+
+
+def decompress(data: bytes) -> bytes:
+    data = bytes(data)
+    expected, pos = _read_varint(data, 0)
+    out = bytearray()
+    n = len(data)
+    while pos < n:
+        tag = data[pos]
+        pos += 1
+        kind = tag & 3
+        if kind == _TAG_LITERAL:
+            length = tag >> 2
+            if length >= 60:
+                extra = length - 59
+                length = int.from_bytes(data[pos:pos + extra], "little")
+                pos += extra
+            length += 1
+            out += data[pos:pos + length]
+            pos += length
+            continue
+        if kind == _TAG_COPY1:
+            length = ((tag >> 2) & 0x7) + 4
+            offset = ((tag >> 5) << 8) | data[pos]
+            pos += 1
+        elif kind == _TAG_COPY2:
+            length = (tag >> 2) + 1
+            offset = int.from_bytes(data[pos:pos + 2], "little")
+            pos += 2
+        else:  # copy4
+            length = (tag >> 2) + 1
+            offset = int.from_bytes(data[pos:pos + 4], "little")
+            pos += 4
+        if offset == 0 or offset > len(out):
+            raise ValueError("invalid copy offset")
+        if offset >= length:  # non-overlapping: one C-level slice copy
+            start = len(out) - offset
+            out += out[start:start + length]
+        else:  # overlapping run: byte-by-byte is the semantics
+            for _ in range(length):
+                out.append(out[-offset])
+    if len(out) != expected:
+        raise ValueError(
+            f"decompressed length {len(out)} != declared {expected}")
+    return bytes(out)
