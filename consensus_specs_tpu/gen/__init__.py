@@ -1,0 +1,11 @@
+"""Reference-test vector generator (layer L5).
+
+Re-runs the dual-mode spec tests in generator mode and writes the
+cross-client vector tree in the reference's on-disk contract
+(`tests/formats/README.md`):
+
+    <preset>/<fork>/<runner>/<handler>/<suite>/<case>/
+        meta.yaml  *.yaml  *.ssz_snappy
+
+Entry point: ``python -m consensus_specs_tpu.gen --output <dir> …``.
+"""

@@ -1,0 +1,46 @@
+"""CLI: ``python -m consensus_specs_tpu.gen --output <dir> [--runners …]``.
+
+Writes the reference-vector tree
+`<preset>/<fork>/<runner>/<handler>/<suite>/<case>/…` per
+`/root/reference/tests/formats/README.md`.
+"""
+
+from __future__ import annotations
+
+import sys
+from importlib import import_module
+
+from .runner import parse_arguments, run_generator
+from .runners import RUNNER_MODULES
+
+
+def main(argv=None) -> int:
+    args = parse_arguments(argv)
+    selected = args.runners or RUNNER_MODULES
+    unknown = [r for r in selected if r not in RUNNER_MODULES]
+    if unknown:
+        print(f"unknown runners: {unknown}; available: {RUNNER_MODULES}",
+              file=sys.stderr)
+        return 2
+
+    if args.disable_bls:
+        from ..ops import bls
+
+        bls.bls_active = False
+
+    cases = []
+    for name in selected:
+        mod = import_module(f"consensus_specs_tpu.gen.runners.{name}")
+        if args.modcheck:
+            print(f"runner {name}: module ok")
+            continue
+        got = mod.get_test_cases()
+        print(f"runner {name}: {len(got)} cases", flush=True)
+        cases.extend(got)
+    if args.modcheck:
+        return 0
+    return run_generator(cases, args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,100 @@
+"""Vector part writers: YAML (pyyaml) + `.ssz_snappy` via the in-tree
+snappy codec.  Output value conventions follow the reference dumper
+(`gen_helpers/gen_base/dumper.py`): 0x-hex strings single-quoted, None as
+null, config bytes as bare hex ints, snappy-compressed raw SSZ."""
+
+from __future__ import annotations
+
+import yaml
+
+from ..utils.snappy import compress
+from .typing import TestCase
+
+
+class _VectorDumper(yaml.SafeDumper):
+    pass
+
+
+def _repr_none(dumper, _):
+    return dumper.represent_scalar("tag:yaml.org,2002:null", "null")
+
+
+def _repr_str(dumper, data):
+    if data.startswith("0x"):
+        # quote hex strings so a zero-byte value can't parse as an int
+        return dumper.represent_scalar("tag:yaml.org,2002:str", data,
+                                       style="'")
+    return dumper.represent_str(data)
+
+
+_VectorDumper.add_representer(type(None), _repr_none)
+_VectorDumper.add_representer(str, _repr_str)
+
+
+class _CfgDumper(yaml.SafeDumper):
+    """Config YAML subset: one key per line, bytes as bare 0x ints."""
+
+
+def _cfg_repr_bytes(dumper, data):
+    return dumper.represent_scalar("tag:yaml.org,2002:int", "0x" + data.hex())
+
+
+def _cfg_repr_str(dumper, data):
+    return dumper.represent_scalar("tag:yaml.org,2002:str", data, style="'")
+
+
+_CfgDumper.add_representer(bytes, _cfg_repr_bytes)
+
+
+class quoted_str(str):
+    """Marker for strings that must be quoted in config YAML (the
+    reference's `context.quoted_str`)."""
+
+
+_CfgDumper.add_representer(quoted_str, _cfg_repr_str)
+
+
+def _coerce_ints(data):
+    """YAML-encodable plain data: spec uint subclasses print as plain ints,
+    nested structures recursively."""
+    if isinstance(data, bool):
+        return data
+    if isinstance(data, int):
+        return int(data)
+    if isinstance(data, (list, tuple)):
+        return [_coerce_ints(x) for x in data]
+    if isinstance(data, dict):
+        return {_coerce_ints(k): _coerce_ints(v) for k, v in data.items()}
+    if isinstance(data, bytes):
+        return "0x" + data.hex()
+    return data
+
+
+class Dumper:
+    def dump_meta(self, test_case: TestCase, meta: dict) -> None:
+        if not meta:
+            return
+        self._write_yaml(test_case, "meta", meta, _VectorDumper,
+                         default_flow_style=None)
+
+    def dump_cfg(self, test_case: TestCase, name: str, data) -> None:
+        self._write_yaml(test_case, name, data, _CfgDumper,
+                         default_flow_style=False)
+
+    def dump_data(self, test_case: TestCase, name: str, data) -> None:
+        self._write_yaml(test_case, name, data, _VectorDumper,
+                         default_flow_style=None)
+
+    def dump_ssz(self, test_case: TestCase, name: str, data: bytes) -> None:
+        path = test_case.dir / f"{name}.ssz_snappy"
+        path.parent.mkdir(parents=True, exist_ok=True)
+        path.write_bytes(compress(data))
+
+    def _write_yaml(self, test_case: TestCase, name: str, data, dumper_cls,
+                    default_flow_style) -> None:
+        path = test_case.dir / f"{name}.yaml"
+        path.parent.mkdir(parents=True, exist_ok=True)
+        with path.open("w") as f:
+            yaml.dump(_coerce_ints(data), f, Dumper=dumper_cls,
+                      default_flow_style=default_flow_style, width=1024,
+                      sort_keys=False)

@@ -1,0 +1,120 @@
+"""Reflection bridge: walk the repo's `tests/<fork>/<category>/test_*.py`
+modules, collect `test_*` functions, and wrap each as a TestCase running in
+generator mode (the reference's `gen_helpers/gen_from_tests/gen.py`).
+
+The repo's test tree is organized by fork first (`tests/phase0/sanity/…`)
+where the reference nests fork under the eth2spec test package; the
+reflection maps category directory → runner name identically.
+"""
+
+from __future__ import annotations
+
+import pkgutil
+import sys
+from collections.abc import Iterable
+from importlib import import_module
+from inspect import getmembers, isfunction
+from pathlib import Path
+
+from ..models.builder import ALL_FORKS, PKG_ROOT
+from ..ops import bls as bls_mod
+from .typing import TestCase
+
+REPO_ROOT = PKG_ROOT.parent
+
+ALL_PRESETS = ("mainnet", "minimal")
+TESTGEN_FORKS = tuple(ALL_FORKS)
+
+
+def _ensure_importable() -> None:
+    if str(REPO_ROOT) not in sys.path:
+        sys.path.insert(0, str(REPO_ROOT))
+
+
+def generate_case_fn(tfn, phase: str, preset: str, bls_active: bool):
+    def case_fn():
+        # default BLS-on for vectors (clients need real signatures); tests
+        # marked @always_bls/@never_bls flip the switch themselves during
+        # iteration, and the CLI's --disable-bls turns the default off
+        prev = bls_mod.bls_active
+        bls_mod.bls_active = bls_active and bls_mod.bls_active
+        try:
+            return tfn(generator_mode=True, phase=phase, preset=preset)
+        finally:
+            bls_mod.bls_active = prev
+
+    return case_fn
+
+
+def generate_from_tests(
+    runner_name: str,
+    handler_name: str,
+    src,
+    fork_name: str,
+    preset_name: str,
+    bls_active: bool = True,
+    phase: str | None = None,
+) -> Iterable[TestCase]:
+    fn_names = [name for (name, _) in getmembers(src, isfunction)
+                if name.startswith("test_")]
+    if phase is None:
+        phase = fork_name
+    for name in fn_names:
+        tfn = getattr(src, name)
+        yield TestCase(
+            fork_name=fork_name,
+            preset_name=preset_name,
+            runner_name=runner_name,
+            handler_name=handler_name,
+            suite_name=getattr(tfn, "suite_name", "pyspec_tests"),
+            case_name=name[5:] if name.startswith("test_") else name,
+            case_fn=generate_case_fn(tfn, phase=phase, preset=preset_name,
+                                     bls_active=bls_active),
+        )
+
+
+def get_test_modules(category: str) -> list[str]:
+    """Module paths of `tests/*/<category>/test_*.py` across every fork dir
+    (the test tree is flat below the category level).  Like the reference,
+    every module is offered to every target fork — a phase0 sanity test
+    emits vectors for all forks via its `@with_all_phases`, and a module
+    whose fork gate rejects the target simply skips."""
+    _ensure_importable()
+    out = []
+    for fork in ALL_FORKS:
+        pkg_dir = Path(REPO_ROOT) / "tests" / fork / category
+        if not pkg_dir.is_dir():
+            continue
+        for info in pkgutil.iter_modules([str(pkg_dir)]):
+            if info.name.startswith("test_"):
+                out.append(f"tests.{fork}.{category}.{info.name}")
+    return sorted(out)
+
+
+def default_handler_name_fn(mod: str) -> str:
+    return mod.split(".")[-1].replace("test_", "")
+
+
+def get_test_cases_for(
+    runner_name: str,
+    pkg: str | None = None,
+    handler_name_fn=default_handler_name_fn,
+    bls_active: bool = True,
+    presets: Iterable[str] = ALL_PRESETS,
+    forks: Iterable[str] = TESTGEN_FORKS,
+) -> list[TestCase]:
+    cases: list[TestCase] = []
+    modules = get_test_modules(pkg or runner_name)
+    for preset in presets:
+        for fork in forks:
+            for mod in modules:
+                src = import_module(mod)
+                cases.extend(generate_from_tests(
+                    runner_name=runner_name,
+                    handler_name=handler_name_fn(mod),
+                    src=src,
+                    fork_name=fork,
+                    preset_name=preset,
+                    bls_active=bls_active,
+                ))
+    return cases

@@ -1,0 +1,142 @@
+"""Generator driver: execute TestCases, write vector parts, CLI.
+
+Sequential or process-parallel (`--threads`), with per-case output-dir
+cleanup and an incremental summary — the role of the reference's
+`gen_helpers/gen_base/gen_runner.py` (pathos pool + rich table there;
+multiprocessing + plain prints here).
+"""
+
+from __future__ import annotations
+
+import argparse
+import shutil
+import sys
+import time
+from collections.abc import Iterable
+from typing import Any
+
+from .dumper import Dumper
+from .typing import SkippedTest, TestCase
+
+
+def execute_test(test_case: TestCase, dumper: Dumper) -> bool:
+    """Run one case; returns False if the case skipped itself.  Output files
+    are written only after the case function ran to completion, so a crash
+    never leaves a partial vector dir."""
+    meta: dict[str, Any] = {}
+    outputs: list[tuple[str, str, Any]] = []
+
+    parts = test_case.case_fn()
+    if parts is None:
+        return False
+    for name, kind, data in parts:
+        if kind == "meta":
+            meta[name] = data
+        elif kind in ("cfg", "data", "ssz"):
+            outputs.append((name, kind, data))
+        else:
+            raise ValueError(f"unknown part kind {kind!r}")
+
+    if test_case.dir.exists():
+        shutil.rmtree(test_case.dir)
+    for name, kind, data in outputs:
+        getattr(dumper, f"dump_{kind}")(test_case, name, data)
+    if meta:
+        dumper.dump_meta(test_case, meta)
+    return True
+
+
+def _run_one(test_case: TestCase) -> tuple[str, str, str]:
+    """Worker: returns (identifier, status, detail)."""
+    dumper = Dumper()
+    try:
+        wrote = execute_test(test_case, dumper)
+        return (test_case.get_identifier(),
+                "generated" if wrote else "skipped", "")
+    except SkippedTest as e:
+        return test_case.get_identifier(), "skipped", str(e)
+    except Exception as e:  # record and continue; one bad case != no vectors
+        import traceback
+
+        return (test_case.get_identifier(), "failed",
+                f"{type(e).__name__}: {e}\n{traceback.format_exc(limit=5)}")
+
+
+def filter_cases(cases: Iterable[TestCase], args) -> list[TestCase]:
+    out = []
+    for tc in cases:
+        if args.runners and tc.runner_name not in args.runners:
+            continue
+        if args.presets and tc.preset_name not in args.presets:
+            continue
+        if args.forks and tc.fork_name not in args.forks:
+            continue
+        if args.cases and not any(c in tc.case_name for c in args.cases):
+            continue
+        out.append(tc)
+    return out
+
+
+def parse_arguments(argv=None):
+    p = argparse.ArgumentParser(
+        prog="consensus_specs_tpu.gen",
+        description="generate cross-client reference test vectors")
+    p.add_argument("-o", "--output", required=True,
+                   help="output directory for the vector tree")
+    p.add_argument("--runners", nargs="*", default=[],
+                   help="limit to these runners (default: all)")
+    p.add_argument("--presets", nargs="*", default=[],
+                   help="limit to these presets")
+    p.add_argument("--forks", nargs="*", default=[],
+                   help="limit to these forks")
+    p.add_argument("--cases", nargs="*", default=[],
+                   help="substring filters on case names")
+    p.add_argument("--threads", type=int, default=1,
+                   help="process-parallel execution")
+    p.add_argument("--disable-bls", action="store_true",
+                   help="skip real BLS signing/verification (vectors will "
+                        "carry empty signatures; for pipeline debugging)")
+    p.add_argument("--modcheck", action="store_true",
+                   help="only check that runner modules import")
+    p.add_argument("-v", "--verbose", action="store_true")
+    return p.parse_args(argv)
+
+
+def run_generator(test_cases: Iterable[TestCase], args) -> int:
+    start = time.time()
+    cases = filter_cases(test_cases, args)
+    for tc in cases:
+        tc.set_output_dir(args.output)
+    print(f"{len(cases)} test cases selected", flush=True)
+
+    results: list[tuple[str, str, str]] = []
+    if args.threads > 1:
+        import multiprocessing as mp
+
+        with mp.get_context("fork").Pool(args.threads) as pool:
+            for res in pool.imap_unordered(_run_one, cases):
+                results.append(res)
+                _report(res, args)
+    else:
+        for tc in cases:
+            res = _run_one(tc)
+            results.append(res)
+            _report(res, args)
+
+    n = {"generated": 0, "skipped": 0, "failed": 0}
+    for _, status, _ in results:
+        n[status] += 1
+    dt = time.time() - start
+    print(f"done in {dt:.1f}s: {n['generated']} generated, "
+          f"{n['skipped']} skipped, {n['failed']} failed", flush=True)
+    if n["failed"]:
+        for ident, status, detail in results:
+            if status == "failed":
+                print(f"FAILED {ident}\n{detail}", file=sys.stderr)
+    return 1 if n["failed"] else 0
+
+
+def _report(res: tuple[str, str, str], args) -> None:
+    ident, status, _ = res
+    if args.verbose or status == "failed":
+        print(f"[{status}] {ident}", flush=True)

@@ -1,0 +1,35 @@
+"""Runner registry: every module here exposes `get_test_cases() ->
+list[TestCase]` (the reference's `tests/generators/runners/`)."""
+
+from __future__ import annotations
+
+from importlib import import_module
+
+RUNNER_MODULES = [
+    "bls",
+    "epoch_processing",
+    "finality",
+    "fork_choice",
+    "forks",
+    "genesis",
+    "light_client",
+    "merkle_proof",
+    "networking",
+    "operations",
+    "random",
+    "rewards",
+    "sanity",
+    "shuffling",
+    "ssz_generic",
+    "ssz_static",
+    "sync",
+    "transition",
+]
+
+
+def all_test_cases():
+    cases = []
+    for name in RUNNER_MODULES:
+        mod = import_module(f"{__name__}.{name}")
+        cases.extend(mod.get_test_cases())
+    return cases

@@ -1,0 +1,224 @@
+"""BLS test vectors: the spec-level aggregate helpers with valid / edge /
+invalid inputs (the reference's `tests/generators/runners/bls.py` — same
+handler names and 'general' preset identity, vectors produced by the
+in-tree BLS implementation)."""
+
+from ...models.builder import build_spec
+from ...ops import bls
+from ..typing import TestCase
+
+MESSAGES = [b"\x00" * 32, b"\x56" * 32, b"\xab" * 32]
+SAMPLE_MESSAGE = b"\x12" * 32
+
+PRIVKEYS = [
+    int("263dbd792f5b1be47ed85f8938c0f29586af0d3ac7b977f21c278fe1462040e3",
+        16),
+    int("47b8192d77bf871b62e87859d653922725724a5c031afeabc60bcef5ff665138",
+        16),
+    int("328388aff0d4a5b7dc9205abd374e7e98f3cd9f3418edb4eafda5fb16473d216",
+        16),
+]
+
+ZERO_PUBKEY = b"\x00" * 48
+G1_POINT_AT_INFINITY = b"\xc0" + b"\x00" * 47
+ZERO_SIGNATURE = b"\x00" * 96
+G2_POINT_AT_INFINITY = b"\xc0" + b"\x00" * 95
+
+
+def _hex(b: bytes) -> str:
+    return "0x" + bytes(b).hex()
+
+
+def _try(fn, *args):
+    try:
+        return fn(*args)
+    except Exception:
+        return None
+
+
+def case_eth_aggregate_pubkeys():
+    spec = build_spec("altair", "minimal")
+
+    def runner(get_inputs):
+        def _run():
+            pubkeys = get_inputs()
+            out = _try(spec.eth_aggregate_pubkeys, pubkeys)
+            return [("data", "data", {
+                "input": [_hex(pk) for pk in pubkeys],
+                "output": _hex(out) if out is not None else None,
+            })]
+        return _run
+
+    for i, privkey in enumerate(PRIVKEYS):
+        yield (f"eth_aggregate_pubkeys_valid_{i}",
+               runner(lambda privkey=privkey: [bls.SkToPk(privkey)]))
+    yield ("eth_aggregate_pubkeys_valid_pubkeys",
+           runner(lambda: [bls.SkToPk(sk) for sk in PRIVKEYS]))
+    yield "eth_aggregate_pubkeys_empty_list", runner(lambda: [])
+    yield ("eth_aggregate_pubkeys_zero_pubkey",
+           runner(lambda: [ZERO_PUBKEY]))
+    yield ("eth_aggregate_pubkeys_infinity_pubkey",
+           runner(lambda: [G1_POINT_AT_INFINITY]))
+    yield ("eth_aggregate_pubkeys_x40_pubkey",
+           runner(lambda: [b"\x40" + b"\x00" * 47]))
+
+
+def case_eth_fast_aggregate_verify():
+    spec = build_spec("altair", "minimal")
+
+    def runner(get_inputs):
+        def _run():
+            pubkeys, message, signature = get_inputs()
+            ok = bool(_try(spec.eth_fast_aggregate_verify,
+                           pubkeys, message, signature))
+            return [("data", "data", {
+                "input": {
+                    "pubkeys": [_hex(pk) for pk in pubkeys],
+                    "message": _hex(message),
+                    "signature": _hex(signature),
+                },
+                "output": ok,
+            })]
+        return _run
+
+    for i, message in enumerate(MESSAGES):
+        sks = PRIVKEYS[:i + 1]
+        pubkeys = [bls.SkToPk(sk) for sk in sks]
+        sig = bls.Aggregate([bls.Sign(sk, message) for sk in sks])
+        yield (f"eth_fast_aggregate_verify_valid_{i}",
+               runner(lambda p=pubkeys, m=message, s=sig: (p, m, s)))
+        # tampered signature
+        bad = sig[:-4] + b"\xff\xff\xff\xff"
+        yield (f"eth_fast_aggregate_verify_tampered_signature_{i}",
+               runner(lambda p=pubkeys, m=message, s=bad: (p, m, s)))
+        # extra pubkey not in the aggregate
+        extra = pubkeys + [bls.SkToPk(PRIVKEYS[-1])]
+        yield (f"eth_fast_aggregate_verify_extra_pubkey_{i}",
+               runner(lambda p=extra, m=message, s=sig: (p, m, s)))
+    # the eth_ variant accepts the empty aggregate
+    yield ("eth_fast_aggregate_verify_na_pubkeys_and_infinity_signature",
+           runner(lambda: ([], MESSAGES[-1], G2_POINT_AT_INFINITY)))
+    yield ("eth_fast_aggregate_verify_na_pubkeys_and_zero_signature",
+           runner(lambda: ([], MESSAGES[-1], ZERO_SIGNATURE)))
+    yield ("eth_fast_aggregate_verify_infinity_pubkey",
+           runner(lambda: (
+               [bls.SkToPk(sk) for sk in PRIVKEYS] + [G1_POINT_AT_INFINITY],
+               SAMPLE_MESSAGE,
+               bls.Aggregate([bls.Sign(sk, SAMPLE_MESSAGE)
+                              for sk in PRIVKEYS]))))
+
+
+def case_sign():
+    def runner(privkey, message):
+        def _run():
+            sig = _try(bls.Sign, privkey, message)
+            return [("data", "data", {
+                "input": {"privkey": "0x" + privkey.to_bytes(32, "big").hex(),
+                          "message": _hex(message)},
+                "output": _hex(sig) if sig is not None else None,
+            })]
+        return _run
+
+    for i, privkey in enumerate(PRIVKEYS):
+        for j, message in enumerate(MESSAGES):
+            yield f"sign_case_{i}_{j}", runner(privkey, message)
+    yield "sign_case_zero_privkey", runner(0, SAMPLE_MESSAGE)
+
+
+def case_verify():
+    def runner(get_inputs):
+        def _run():
+            pubkey, message, signature = get_inputs()
+            ok = bool(_try(bls.Verify, pubkey, message, signature))
+            return [("data", "data", {
+                "input": {"pubkey": _hex(pubkey), "message": _hex(message),
+                          "signature": _hex(signature)},
+                "output": ok,
+            })]
+        return _run
+
+    for i, privkey in enumerate(PRIVKEYS):
+        for j, message in enumerate(MESSAGES):
+            pk = bls.SkToPk(privkey)
+            sig = bls.Sign(privkey, message)
+            yield (f"verify_valid_case_{i}_{j}",
+                   runner(lambda p=pk, m=message, s=sig: (p, m, s)))
+            wrong = bls.Sign(PRIVKEYS[(i + 1) % len(PRIVKEYS)], message)
+            yield (f"verify_wrong_pubkey_case_{i}_{j}",
+                   runner(lambda p=pk, m=message, s=wrong: (p, m, s)))
+            bad = sig[:-4] + b"\xff\xff\xff\xff"
+            yield (f"verify_tampered_signature_case_{i}_{j}",
+                   runner(lambda p=pk, m=message, s=bad: (p, m, s)))
+    yield ("verify_infinity_pubkey_and_infinity_signature",
+           runner(lambda: (G1_POINT_AT_INFINITY, SAMPLE_MESSAGE,
+                           G2_POINT_AT_INFINITY)))
+
+
+def case_aggregate():
+    def runner(get_sigs):
+        def _run():
+            sigs = get_sigs()
+            out = _try(bls.Aggregate, sigs)
+            return [("data", "data", {
+                "input": [_hex(s) for s in sigs],
+                "output": _hex(out) if out is not None else None,
+            })]
+        return _run
+
+    for i, message in enumerate(MESSAGES):
+        sigs = [bls.Sign(sk, message) for sk in PRIVKEYS]
+        yield f"aggregate_{i}", runner(lambda s=sigs: s)
+    yield "aggregate_na_signatures", runner(lambda: [])
+    yield ("aggregate_infinity_signature",
+           runner(lambda: [G2_POINT_AT_INFINITY]))
+
+
+def case_fast_aggregate_verify():
+    def runner(get_inputs):
+        def _run():
+            pubkeys, message, signature = get_inputs()
+            ok = bool(_try(bls.FastAggregateVerify,
+                           pubkeys, message, signature))
+            return [("data", "data", {
+                "input": {
+                    "pubkeys": [_hex(pk) for pk in pubkeys],
+                    "message": _hex(message),
+                    "signature": _hex(signature),
+                },
+                "output": ok,
+            })]
+        return _run
+
+    for i, message in enumerate(MESSAGES):
+        sks = PRIVKEYS[:i + 1]
+        pubkeys = [bls.SkToPk(sk) for sk in sks]
+        sig = bls.Aggregate([bls.Sign(sk, message) for sk in sks])
+        yield (f"fast_aggregate_verify_valid_{i}",
+               runner(lambda p=pubkeys, m=message, s=sig: (p, m, s)))
+    # unlike the eth_ variant, the empty aggregate is INVALID here
+    yield ("fast_aggregate_verify_na_pubkeys_and_infinity_signature",
+           runner(lambda: ([], MESSAGES[-1], G2_POINT_AT_INFINITY)))
+
+
+def get_test_cases():
+    cases = []
+    handlers = {
+        "sign": case_sign,
+        "verify": case_verify,
+        "aggregate": case_aggregate,
+        "fast_aggregate_verify": case_fast_aggregate_verify,
+        "eth_aggregate_pubkeys": case_eth_aggregate_pubkeys,
+        "eth_fast_aggregate_verify": case_eth_fast_aggregate_verify,
+    }
+    for method, fn in handlers.items():
+        for case_name, case_fn in fn():
+            cases.append(TestCase(
+                fork_name="altair",
+                preset_name="general",
+                runner_name="bls",
+                handler_name=method,
+                suite_name="bls",
+                case_name=case_name,
+                case_fn=case_fn,
+            ))
+    return cases

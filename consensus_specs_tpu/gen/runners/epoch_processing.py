@@ -1,0 +1,14 @@
+from ..from_tests import get_test_cases_for
+
+
+def handler_name_fn(mod):
+    handler_name = mod.split(".")[-1]
+    if handler_name == "test_apply_pending_deposit":
+        return "pending_deposits"
+    handler_name = handler_name.replace("test_process_", "")
+    return handler_name.replace("test_apply_", "")
+
+
+def get_test_cases():
+    return get_test_cases_for("epoch_processing",
+                              handler_name_fn=handler_name_fn)

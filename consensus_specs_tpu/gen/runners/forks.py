@@ -1,0 +1,10 @@
+from ..from_tests import get_test_cases_for
+
+
+def handler_name_fn(mod):
+    return "fork"
+
+
+def get_test_cases():
+    return get_test_cases_for("forks", pkg="fork",
+                              handler_name_fn=handler_name_fn)

@@ -1,0 +1,12 @@
+from ..from_tests import get_test_cases_for
+
+
+def handler_name_fn(mod):
+    handler_name = mod.split(".")[-1]
+    if handler_name == "test_sync_protocol":
+        return "sync"
+    return handler_name.replace("test_", "")
+
+
+def get_test_cases():
+    return get_test_cases_for("light_client", handler_name_fn=handler_name_fn)

@@ -1,0 +1,13 @@
+from ..from_tests import get_test_cases_for
+
+
+def handler_name_fn(mod):
+    handler_name = mod.split(".")[-1]
+    if handler_name == "test_process_sync_aggregate_random":
+        return "sync_aggregate"
+    return handler_name.replace("test_process_", "")
+
+
+def get_test_cases():
+    return get_test_cases_for("operations", pkg="block_processing",
+                              handler_name_fn=handler_name_fn)

@@ -1,0 +1,5 @@
+from ..from_tests import get_test_cases_for
+
+
+def get_test_cases():
+    return get_test_cases_for("random")

@@ -1,0 +1,16 @@
+from ..from_tests import get_test_cases_for
+
+
+def handler_name_fn(mod):
+    handler_name = mod.split(".")[-1]
+    if handler_name == "test_deposit_transition":
+        return "blocks"
+    if handler_name == "test_lookahead":
+        return "blocks"
+    if handler_name == "test_lookahead_slots":
+        return "slots"
+    return handler_name.replace("test_", "")
+
+
+def get_test_cases():
+    return get_test_cases_for("sanity", handler_name_fn=handler_name_fn)

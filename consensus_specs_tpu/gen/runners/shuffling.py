@@ -1,0 +1,39 @@
+"""Shuffling vectors: seed × count → full shuffled mapping (the
+reference's `tests/generators/runners/shuffling.py`)."""
+
+import random
+
+from ...models.builder import build_spec
+from ..from_tests import ALL_PRESETS
+from ..typing import TestCase
+
+
+def shuffling_case_fn(spec, seed, count):
+    yield ("mapping", "data", {
+        "seed": "0x" + seed.hex(),
+        "count": count,
+        "mapping": [int(spec.compute_shuffled_index(i, count, seed))
+                    for i in range(count)],
+    })
+
+
+def get_test_cases():
+    cases = []
+    for preset in ALL_PRESETS:
+        spec = build_spec("phase0", preset)
+        rng = random.Random(1234)
+        seeds = [bytes(rng.randint(0, 255) for _ in range(32))
+                 for _ in range(30)]
+        for seed in seeds:
+            for count in (0, 1, 2, 3, 5, 10, 33, 100, 1000, 9999):
+                cases.append(TestCase(
+                    fork_name="phase0",
+                    preset_name=preset,
+                    runner_name="shuffling",
+                    handler_name="core",
+                    suite_name="shuffle",
+                    case_name=f"shuffle_0x{seed.hex()}_{count}",
+                    case_fn=(lambda spec=spec, seed=seed, count=count:
+                             list(shuffling_case_fn(spec, seed, count))),
+                ))
+    return cases

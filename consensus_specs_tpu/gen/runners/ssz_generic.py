@@ -1,0 +1,272 @@
+"""ssz_generic vectors: spec-independent SSZ wire-format cases — valid
+encodings with value/root, and malformed encodings clients must reject
+(the reference's `tests/generators/runners/ssz_generic*`; same handler and
+suite naming, cases authored for this engine)."""
+
+from random import Random
+
+from ...debug.encode import encode
+from ...debug.random_value import RandomizationMode, get_random_ssz_object
+from ...utils.ssz.ssz_impl import hash_tree_root, serialize
+from ...utils.ssz.types import (
+    Bitlist,
+    Bitvector,
+    Container,
+    List,
+    Vector,
+    boolean,
+    uint8,
+    uint16,
+    uint32,
+    uint64,
+    uint128,
+    uint256,
+)
+from ..typing import TestCase
+
+UINTS = (uint8, uint16, uint32, uint64, uint128, uint256)
+
+
+def valid_test_case(value_fn):
+    def case_fn():
+        value = value_fn()
+        return [
+            ("value", "data", encode(value)),
+            ("serialized", "ssz", serialize(value)),
+            ("root", "meta", "0x" + hash_tree_root(value).hex()),
+        ]
+    return case_fn
+
+
+def invalid_test_case(bytez_fn):
+    def case_fn():
+        return [("serialized", "ssz", bytez_fn())]
+    return case_fn
+
+
+def _random(rng, typ, mode):
+    return get_random_ssz_object(rng, typ, max_bytes_length=1024,
+                                 max_list_length=1024, mode=mode, chaos=False)
+
+
+# -- boolean ---------------------------------------------------------------
+
+def boolean_valid():
+    yield "true", valid_test_case(lambda: boolean(True))
+    yield "false", valid_test_case(lambda: boolean(False))
+
+
+def boolean_invalid():
+    yield "byte_2", invalid_test_case(lambda: b"\x02")
+    yield "byte_rev_nibble", invalid_test_case(lambda: b"\x10")
+    yield "byte_0x80", invalid_test_case(lambda: b"\x80")
+    yield "byte_full", invalid_test_case(lambda: b"\xff")
+
+
+# -- uints -----------------------------------------------------------------
+
+def uints_valid():
+    rng = Random(1234)
+    for t in UINTS:
+        n = t.type_byte_length()
+        yield f"uint_{n * 8}_zero", valid_test_case(lambda t=t: t(0))
+        yield (f"uint_{n * 8}_max",
+               valid_test_case(lambda t=t, n=n: t(256 ** n - 1)))
+        for i in range(3):
+            yield (f"uint_{n * 8}_random_{i}", valid_test_case(
+                lambda t=t, v=rng.randint(0, 256 ** n - 1): t(v)))
+
+
+def uints_invalid():
+    for t in UINTS:
+        n = t.type_byte_length()
+        yield (f"uint_{n * 8}_one_too_high_byte_count",
+               invalid_test_case(lambda n=n: b"\x00" * (n + 1)))
+        yield (f"uint_{n * 8}_one_byte_shorter",
+               invalid_test_case(lambda n=n: b"\xff" * (n - 1)))
+
+
+# -- bitvector -------------------------------------------------------------
+
+def bitvector_valid():
+    rng = Random(1234)
+    for size in (1, 2, 3, 4, 5, 8, 16, 31, 512, 513):
+        for mode in (RandomizationMode.mode_random,
+                     RandomizationMode.mode_zero,
+                     RandomizationMode.mode_max):
+            yield (f"bitvec_{size}_{mode.to_name()}", valid_test_case(
+                lambda rng=rng, size=size, mode=mode:
+                _random(rng, Bitvector[size], mode)))
+
+
+def bitvector_invalid():
+    yield "bitvec_0", invalid_test_case(lambda: b"")
+    for size, ser in (
+            (8, b""), (8, b"\x00\x00"),
+            (9, b"\xff"),  # one byte short
+            (5, b"\xff"),  # pad bits set beyond length 5
+            (3, b"\x08"),  # bit 3 set in a 3-bit vector
+    ):
+        yield (f"bitvec_{size}_bad_{ser.hex() or 'empty'}",
+               invalid_test_case(lambda ser=ser: ser))
+
+
+# -- bitlist ---------------------------------------------------------------
+
+def bitlist_valid():
+    rng = Random(1234)
+    for limit in (1, 2, 3, 4, 5, 8, 16, 31, 512, 513):
+        for mode in (RandomizationMode.mode_random,
+                     RandomizationMode.mode_zero,
+                     RandomizationMode.mode_max_count):
+            yield (f"bitlist_{limit}_{mode.to_name()}", valid_test_case(
+                lambda rng=rng, limit=limit, mode=mode:
+                _random(rng, Bitlist[limit], mode)))
+
+
+def bitlist_invalid():
+    yield "bitlist_no_delimiter_empty", invalid_test_case(lambda: b"")
+    yield ("bitlist_no_delimiter_zero_byte",
+           invalid_test_case(lambda: b"\x00"))
+    yield ("bitlist_no_delimiter_zeroes",
+           invalid_test_case(lambda: b"\x00\x00"))
+    # 9 bits in a limit-8 list (delimiter at bit 9)
+    yield ("bitlist_8_but_9_bits",
+           invalid_test_case(lambda: b"\xff\x03"))
+    # delimiter-only trailing zero byte
+    yield ("bitlist_trailing_zero_byte",
+           invalid_test_case(lambda: b"\x01\x00"))
+
+
+# -- basic_vector ----------------------------------------------------------
+
+def basic_vector_valid():
+    rng = Random(1234)
+    for t in (boolean, uint8, uint16, uint32, uint64, uint128, uint256):
+        for length in (1, 2, 3, 4, 5, 8, 16, 31, 512, 513):
+            for mode in (RandomizationMode.mode_random,
+                         RandomizationMode.mode_zero,
+                         RandomizationMode.mode_max):
+                name = (f"vec_{t.__name__}_{length}_{mode.to_name()}")
+                yield (name, valid_test_case(
+                    lambda rng=rng, t=t, length=length, mode=mode:
+                    _random(rng, Vector[t, length], mode)))
+
+
+def basic_vector_invalid():
+    yield "vec_bool_0", invalid_test_case(lambda: b"")
+    yield ("vec_uint16_3_one_byte_short",
+           invalid_test_case(lambda: b"\x11\x22\x33\x44\x55"))
+    yield ("vec_uint16_3_one_byte_long",
+           invalid_test_case(lambda: b"\x11" * 7))
+    yield ("vec_uint64_2_one_byte_short",
+           invalid_test_case(lambda: b"\xee" * 15))
+
+
+# -- containers ------------------------------------------------------------
+
+class SingleFieldTestStruct(Container):
+    A: uint8
+
+
+class SmallTestStruct(Container):
+    A: uint16
+    B: uint16
+
+
+class FixedTestStruct(Container):
+    A: uint8
+    B: uint64
+    C: uint32
+
+
+class VarTestStruct(Container):
+    A: uint16
+    B: List[uint16, 1024]
+    C: uint8
+
+
+class ComplexTestStruct(Container):
+    A: uint16
+    B: List[uint16, 128]
+    C: uint8
+    D: List[uint8, 256]
+    E: VarTestStruct
+    F: Vector[FixedTestStruct, 4]
+    G: Vector[VarTestStruct, 2]
+
+
+class BitsStruct(Container):
+    A: Bitlist[5]
+    B: Bitvector[2]
+    C: Bitvector[1]
+    D: Bitlist[6]
+    E: Bitvector[8]
+
+
+CONTAINER_TYPES = [SingleFieldTestStruct, SmallTestStruct, FixedTestStruct,
+                   VarTestStruct, ComplexTestStruct, BitsStruct]
+
+
+def container_valid():
+    rng = Random(1234)
+    for typ in CONTAINER_TYPES:
+        for mode in (RandomizationMode.mode_random,
+                     RandomizationMode.mode_zero,
+                     RandomizationMode.mode_max,
+                     RandomizationMode.mode_nil_count,
+                     RandomizationMode.mode_max_count):
+            yield (f"{typ.__name__}_{mode.to_name()}", valid_test_case(
+                lambda rng=rng, typ=typ, mode=mode:
+                _random(rng, typ, mode)))
+
+
+def container_invalid():
+    yield ("SingleFieldTestStruct_empty", invalid_test_case(lambda: b""))
+    yield ("SingleFieldTestStruct_extra_byte",
+           invalid_test_case(lambda: b"\xab\xcd"))
+    yield ("SmallTestStruct_one_byte_short",
+           invalid_test_case(lambda: b"\x00" * 3))
+    # VarTestStruct: offset points before the fixed part ends
+    yield ("VarTestStruct_offset_early",
+           invalid_test_case(
+               lambda: b"\xaa\xaa" + (2).to_bytes(4, "little") + b"\xff"))
+    # VarTestStruct: offset beyond the buffer
+    yield ("VarTestStruct_offset_beyond",
+           invalid_test_case(
+               lambda: b"\xaa\xaa" + (100).to_bytes(4, "little") + b"\xff"))
+    # VarTestStruct: odd length tail for a uint16 list
+    yield ("VarTestStruct_odd_list_tail",
+           invalid_test_case(
+               lambda: b"\xaa\xaa" + (7).to_bytes(4, "little")
+               + b"\xff" + b"\x01\x02\x03"))
+
+
+def get_test_cases():
+    groups = [
+        ("basic_vector", "valid", basic_vector_valid),
+        ("basic_vector", "invalid", basic_vector_invalid),
+        ("bitlist", "valid", bitlist_valid),
+        ("bitlist", "invalid", bitlist_invalid),
+        ("bitvector", "valid", bitvector_valid),
+        ("bitvector", "invalid", bitvector_invalid),
+        ("boolean", "valid", boolean_valid),
+        ("boolean", "invalid", boolean_invalid),
+        ("uints", "valid", uints_valid),
+        ("uints", "invalid", uints_invalid),
+        ("containers", "valid", container_valid),
+        ("containers", "invalid", container_invalid),
+    ]
+    cases = []
+    for handler_name, suite_name, gen in groups:
+        for case_name, case_fn in gen():
+            cases.append(TestCase(
+                fork_name="phase0",
+                preset_name="general",
+                runner_name="ssz_generic",
+                handler_name=handler_name,
+                suite_name=suite_name,
+                case_name=case_name,
+                case_fn=case_fn,
+            ))
+    return cases

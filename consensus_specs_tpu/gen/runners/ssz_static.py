@@ -1,0 +1,80 @@
+"""ssz_static vectors: random objects of every container of every
+fork × preset, 5 modes + chaos (the reference's
+`tests/generators/runners/ssz_static.py`)."""
+
+import hashlib
+from random import Random
+
+from ...debug import random_value
+from ...debug.encode import encode
+from ...models.builder import build_spec
+from ...utils.ssz.ssz_impl import hash_tree_root, serialize
+from ...utils.ssz.types import Container
+from ..from_tests import TESTGEN_FORKS
+from ..typing import TestCase
+
+MAX_BYTES_LENGTH = 1000
+MAX_LIST_LENGTH = 10
+
+
+def create_test_case(seed, typ, mode, chaos):
+    rng = Random(seed)
+    value = random_value.get_random_ssz_object(
+        rng, typ, MAX_BYTES_LENGTH, MAX_LIST_LENGTH, mode, chaos)
+    yield "value", "data", encode(value)
+    yield "serialized", "ssz", serialize(value)
+    yield "roots", "data", {"root": "0x" + hash_tree_root(value).hex()}
+
+
+def get_spec_ssz_types(spec):
+    return sorted(
+        (name, v) for name, v in spec._namespace.items()
+        if isinstance(v, type) and issubclass(v, Container)
+        and v is not Container and v.fields())
+
+
+def deterministic_seed(**kwargs) -> int:
+    """hash() is not deterministic between runs; sha256 the kwargs."""
+    m = hashlib.sha256()
+    for k, v in sorted(kwargs.items()):
+        m.update(f"{k}={v}".encode())
+    return int.from_bytes(m.digest()[:8], "little")
+
+
+def ssz_static_cases(fork, preset, name, ssz_type, mode, chaos, count):
+    random_mode_name = mode.to_name()
+    for i in range(count):
+        seed = deterministic_seed(
+            fork_name=fork, preset_name=preset, name=name,
+            ssz_type_name=ssz_type.__name__,
+            random_mode_name=random_mode_name, chaos=chaos, count=count, i=i)
+        yield TestCase(
+            fork_name=fork,
+            preset_name=preset,
+            runner_name="ssz_static",
+            handler_name=name,
+            suite_name=f"ssz_{random_mode_name}{'_chaos' if chaos else ''}",
+            case_name=f"case_{i}",
+            case_fn=(lambda seed=seed, t=ssz_type, m=mode, c=chaos:
+                     list(create_test_case(seed, t, m, c))),
+        )
+
+
+def get_test_cases():
+    settings = []
+    for mode in random_value.RandomizationMode:
+        settings.append(("minimal", mode, False, 30))
+    settings.append(
+        ("minimal", random_value.RandomizationMode.mode_random, True, 30))
+    settings.append(
+        ("mainnet", random_value.RandomizationMode.mode_random, False, 5))
+
+    cases = []
+    for fork in TESTGEN_FORKS:
+        for preset, mode, chaos, cases_if_random in settings:
+            count = cases_if_random if chaos or mode.is_changing() else 1
+            spec = build_spec(fork, preset)
+            for name, ssz_type in get_spec_ssz_types(spec):
+                cases.extend(ssz_static_cases(
+                    fork, preset, name, ssz_type, mode, chaos, count))
+    return cases

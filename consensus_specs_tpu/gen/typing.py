@@ -1,0 +1,46 @@
+"""TestCase identity + part protocol (the reference's
+`gen_helpers/gen_base/gen_typing.py`)."""
+
+from __future__ import annotations
+
+from collections.abc import Callable, Iterable
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Any
+
+# (name, out_kind, data); out_kind in {"meta", "cfg", "data", "ssz"}
+TestCasePart = tuple[str, str, Any]
+
+
+class SkippedTest(Exception):
+    """Raised by a case_fn to bail without writing files (preset/fork
+    mismatch discovered at execution time)."""
+
+
+@dataclass
+class TestCase:
+    fork_name: str
+    preset_name: str
+    runner_name: str
+    handler_name: str
+    suite_name: str
+    case_name: str
+    case_fn: Callable[[], Iterable[TestCasePart] | None]
+    dir: Path | None = None
+
+    def get_identifier(self) -> str:
+        return "::".join([
+            self.preset_name, self.fork_name, self.runner_name,
+            self.handler_name, self.suite_name, self.case_name,
+        ])
+
+    def set_output_dir(self, output_dir: str) -> None:
+        self.dir = (
+            Path(output_dir)
+            / self.preset_name
+            / self.fork_name
+            / self.runner_name
+            / self.handler_name
+            / self.suite_name
+            / self.case_name
+        )

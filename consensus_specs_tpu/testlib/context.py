@@ -152,7 +152,9 @@ def with_phases(phases, other_phases=None):
                 run_phases = [p for p in run_phases
                               if p == DEFAULT_FORK_RESTRICTION]
             if phase is not None:
-                run_phases = [phase]
+                # explicit phase (generator mode): skip rather than run a
+                # fork the test does not declare
+                run_phases = [p for p in run_phases if p == phase]
             results = None
             for p in run_phases:
                 spec = build_spec(p, preset or DEFAULT_TEST_PRESET)
@@ -292,6 +294,9 @@ def _bls_switch(value):
             try:
                 res = fn(*args, **kwargs)
                 if res is not None:
+                    # vector meta: 1 = BLS required, 2 = BLS ignored
+                    # (`tests/formats/README.md` meta.yaml bls_setting)
+                    yield "bls_setting", "meta", 1 if value else 2
                     yield from res
             finally:
                 bls_mod.bls_active = prev

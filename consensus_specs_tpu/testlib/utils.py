@@ -12,22 +12,16 @@ import functools
 from typing import Any
 
 
-def _infer_kind(value: Any):
-    from ..utils.ssz.types import View
-
-    if isinstance(value, View):
-        return "ssz"
-    if isinstance(value, bytes):
-        return "ssz"
-    return "data"
-
-
 def vector_test(fn):
     """Wrap a yielding test function.
 
     - pytest mode (default): drain the generator, discard yields.
-    - generator mode (`generator_mode=True`): collect (name, kind, value)
-      triples and return them for the vector dumper.
+    - generator mode (`generator_mode=True`): transform the yields into
+      (name, kind, value) triples for the vector dumper, applying the
+      reference's part contract (`test/utils/utils.py:31-58`): SSZ views
+      serialize to "ssz" bytes, lists of views expand to indexed parts plus
+      a `<name>_count` meta, `None` values are dropped, everything else is
+      "data".
     """
 
     @functools.wraps(fn)
@@ -35,17 +29,36 @@ def vector_test(fn):
         out = fn(*args, **kwargs)
         if out is None:
             return None
+        if not generator_mode:
+            for _ in out:
+                continue
+            return None
+
+        from ..utils.ssz.ssz_impl import serialize
+        from ..utils.ssz.types import View
+
         parts = []
         for item in out:
-            if not generator_mode:
+            if len(item) != 2:
+                parts.append(item)  # already (name, kind, value)
                 continue
-            if len(item) == 3:
-                name, kind, value = item
+            key, value = item
+            if value is None:
+                continue
+            if isinstance(value, View):
+                parts.append((key, "ssz", serialize(value)))
+            elif isinstance(value, bytes):
+                parts.append((key, "ssz", value))
+            elif (isinstance(value, list)
+                  and all(isinstance(el, (View, bytes)) for el in value)):
+                for i, el in enumerate(value):
+                    parts.append((
+                        f"{key}_{i}", "ssz",
+                        serialize(el) if isinstance(el, View) else el))
+                parts.append((f"{key}_count", "meta", len(value)))
             else:
-                name, value = item
-                kind = _infer_kind(value)
-            parts.append((name, kind, value))
-        return parts if generator_mode else None
+                parts.append((key, "data", value))
+        return parts
 
     return entry
 

@@ -20,7 +20,7 @@ def test_slots_1(spec, state):
     yield "pre", state
 
     slots = 1
-    yield "slots", "meta", int(slots)
+    yield "slots", int(slots)
     next_slot(spec, state)
 
     yield "post", state
@@ -33,7 +33,7 @@ def test_slots_1(spec, state):
 def test_slots_2(spec, state):
     yield "pre", state
     slots = 2
-    yield "slots", "meta", int(slots)
+    yield "slots", int(slots)
     transition_to(spec, state, state.slot + slots)
     yield "post", state
 
@@ -44,7 +44,7 @@ def test_empty_epoch(spec, state):
     pre_slot = state.slot
     yield "pre", state
     slots = spec.SLOTS_PER_EPOCH
-    yield "slots", "meta", int(slots)
+    yield "slots", int(slots)
     transition_to(spec, state, state.slot + slots)
     yield "post", state
     assert state.slot == pre_slot + spec.SLOTS_PER_EPOCH
@@ -55,7 +55,7 @@ def test_empty_epoch(spec, state):
 def test_double_empty_epoch(spec, state):
     yield "pre", state
     slots = spec.SLOTS_PER_EPOCH * 2
-    yield "slots", "meta", int(slots)
+    yield "slots", int(slots)
     transition_to(spec, state, state.slot + slots)
     yield "post", state
 
@@ -67,7 +67,7 @@ def test_over_epoch_boundary(spec, state):
         next_slot(spec, state)
     yield "pre", state
     slots = spec.SLOTS_PER_EPOCH
-    yield "slots", "meta", int(slots)
+    yield "slots", int(slots)
     transition_to(spec, state, state.slot + slots)
     yield "post", state
 
@@ -82,7 +82,7 @@ def test_historical_accumulator(spec, state):
         pre_historical_summaries = list(state.historical_summaries)
     yield "pre", state
     slots = spec.SLOTS_PER_HISTORICAL_ROOT
-    yield "slots", "meta", int(slots)
+    yield "slots", int(slots)
     transition_to(spec, state, state.slot + slots)
     yield "post", state
     if is_post_capella(spec):

@@ -1,0 +1,136 @@
+"""Generator-layer contract tests: the vector tree layout
+(`/root/reference/tests/formats/README.md`), part files, and
+consumer-side round-trips of emitted `.ssz_snappy` parts."""
+
+import argparse
+
+import pytest
+import yaml
+
+from consensus_specs_tpu.gen.runner import run_generator
+from consensus_specs_tpu.models.builder import build_spec
+from consensus_specs_tpu.utils.snappy import decompress
+from consensus_specs_tpu.utils.ssz.ssz_impl import hash_tree_root
+
+
+def _args(output, **kw):
+    base = dict(output=str(output), runners=[], presets=[], forks=[],
+                cases=[], threads=1, disable_bls=True, modcheck=False,
+                verbose=False)
+    base.update(kw)
+    return argparse.Namespace(**base)
+
+
+def test_sanity_vector_tree(tmp_path):
+    from consensus_specs_tpu.gen.runners import sanity
+
+    cases = [tc for tc in sanity.get_test_cases()
+             if tc.preset_name == "minimal" and tc.fork_name == "phase0"]
+    assert cases, "no sanity cases reflected"
+    rc = run_generator(cases, _args(tmp_path))
+    assert rc == 0
+
+    # tree identity: <preset>/<fork>/<runner>/<handler>/<suite>/<case>/
+    block_dirs = list(
+        (tmp_path / "minimal/phase0/sanity/blocks/pyspec_tests").iterdir())
+    assert block_dirs
+    case = tmp_path / \
+        "minimal/phase0/sanity/blocks/pyspec_tests/empty_block_transition"
+    assert (case / "pre.ssz_snappy").exists()
+    assert (case / "post.ssz_snappy").exists()
+    assert (case / "blocks_0.ssz_snappy").exists()
+    meta = yaml.safe_load((case / "meta.yaml").read_text())
+    assert meta["blocks_count"] == 1
+
+    # consumer round-trip: parts decompress + deserialize + transition
+    spec = build_spec("phase0", "minimal")
+    pre = spec.BeaconState.decode_bytes(
+        decompress((case / "pre.ssz_snappy").read_bytes()))
+    block = spec.SignedBeaconBlock.decode_bytes(
+        decompress((case / "blocks_0.ssz_snappy").read_bytes()))
+    post = spec.BeaconState.decode_bytes(
+        decompress((case / "post.ssz_snappy").read_bytes()))
+    st = pre.copy()
+    spec.state_transition(st, block, validate_result=False)
+    assert hash_tree_root(st) == hash_tree_root(post)
+
+    # invalid case: no post part, bls_setting meta present
+    invalid = tmp_path / \
+        "minimal/phase0/sanity/blocks/pyspec_tests/invalid_block_sig"
+    assert (invalid / "pre.ssz_snappy").exists()
+    assert not (invalid / "post.ssz_snappy").exists()
+    meta = yaml.safe_load((invalid / "meta.yaml").read_text())
+    assert meta["bls_setting"] == 1
+
+    # slots handler: slots.yaml data part
+    slots_case = tmp_path / \
+        "minimal/phase0/sanity/slots/pyspec_tests/empty_epoch"
+    assert yaml.safe_load((slots_case / "slots.yaml").read_text()) == 8
+
+
+def test_ssz_static_slice_roundtrip(tmp_path):
+    from consensus_specs_tpu.gen.runners import ssz_static
+
+    cases = [tc for tc in ssz_static.get_test_cases()
+             if tc.preset_name == "minimal" and tc.fork_name == "phase0"
+             and tc.handler_name == "Attestation"]
+    assert cases
+    rc = run_generator(cases, _args(tmp_path))
+    assert rc == 0
+    spec = build_spec("phase0", "minimal")
+    case = tmp_path / \
+        "minimal/phase0/ssz_static/Attestation/ssz_random/case_0"
+    obj = spec.Attestation.decode_bytes(
+        decompress((case / "serialized.ssz_snappy").read_bytes()))
+    roots = yaml.safe_load((case / "roots.yaml").read_text())
+    assert roots["root"] == "0x" + hash_tree_root(obj).hex()
+
+
+def test_ssz_generic_invalid_cases_reject(tmp_path):
+    from consensus_specs_tpu.gen.runners import ssz_generic
+
+    cases = ssz_generic.get_test_cases()
+    invalid = [tc for tc in cases if tc.suite_name == "invalid"]
+    assert len(invalid) > 15
+    rc = run_generator(invalid, _args(tmp_path))
+    assert rc == 0
+    # every invalid serialized payload must fail to deserialize
+    from consensus_specs_tpu.gen.runners.ssz_generic import (
+        BitsStruct, ComplexTestStruct, FixedTestStruct, SingleFieldTestStruct,
+        SmallTestStruct, VarTestStruct)
+    from consensus_specs_tpu.utils.ssz.types import (
+        Bitlist, Bitvector, Vector, boolean, uint8, uint16, uint64)
+
+    types_by_handler = {
+        "boolean": lambda name: boolean,
+        "uints": lambda name: {
+            "8": uint8, "16": uint16, "64": uint64}.get(
+            name.split("_")[1], uint64),
+    }
+    checked = 0
+    for tc in invalid:
+        path = (tmp_path / "general/phase0/ssz_generic" / tc.handler_name
+                / "invalid" / tc.case_name / "serialized.ssz_snappy")
+        assert path.exists(), tc.case_name
+        data = decompress(path.read_bytes())
+        typ = None
+        if tc.handler_name == "boolean":
+            typ = boolean
+        elif tc.handler_name == "uints":
+            bits = int(tc.case_name.split("_")[1])
+            typ = {8: uint8, 16: uint16, 64: uint64}.get(bits)
+        elif tc.handler_name == "containers":
+            typ = {
+                "SingleFieldTestStruct": SingleFieldTestStruct,
+                "SmallTestStruct": SmallTestStruct,
+                "FixedTestStruct": FixedTestStruct,
+                "VarTestStruct": VarTestStruct,
+                "ComplexTestStruct": ComplexTestStruct,
+                "BitsStruct": BitsStruct,
+            }.get(tc.case_name.split("_")[0])
+        if typ is None:
+            continue
+        with pytest.raises((ValueError, IndexError, AssertionError)):
+            typ.decode_bytes(data)
+        checked += 1
+    assert checked >= 10
