@@ -1,0 +1,40 @@
+# Altair -- p2p deltas: MetaData gains syncnets, message-id becomes
+# topic-aware, sync-committee gossip topics.
+# Parity contract: specs/altair/p2p-interface.md (:44-61 MetaData,
+# :84-102 message-id, :318-340 req/resp context table).
+
+
+class MetaData(Container):
+    seq_number: uint64
+    attnets: Bitvector[64]  # ATTESTATION_SUBNET_COUNT
+    syncnets: Bitvector[4]  # SYNC_COMMITTEE_SUBNET_COUNT
+
+
+def compute_message_id(topic: str, message_data: bytes) -> bytes:
+    """Altair message-id mixes in the topic (altair/p2p-interface.md
+    :84-95); messages on phase0-digest topics keep the phase0 rule."""
+    topic_bytes = topic.encode()
+    prefix_len = uint_to_bytes(uint64(len(topic_bytes)))
+    try:
+        from consensus_specs_tpu.utils.snappy import decompress
+
+        decompressed = decompress(message_data)
+        return hash(config.MESSAGE_DOMAIN_VALID_SNAPPY + prefix_len
+                    + topic_bytes + decompressed)[:20]
+    except Exception:
+        return hash(config.MESSAGE_DOMAIN_INVALID_SNAPPY + prefix_len
+                    + topic_bytes + message_data)[:20]
+
+
+def compute_sync_committee_subnet_topic(fork_digest: ForkDigest,
+                                        subnet_id: uint64) -> str:
+    return compute_gossip_topic(fork_digest,
+                                f"sync_committee_{int(subnet_id)}")
+
+
+def compute_response_context(epoch: Epoch,
+                             genesis_validators_root: Root) -> ForkDigest:
+    """Context bytes for v2 req/resp chunks: the fork digest of the epoch
+    the payload belongs to (altair/p2p-interface.md :307-340)."""
+    return compute_fork_digest(compute_fork_version(epoch),
+                               genesis_validators_root)

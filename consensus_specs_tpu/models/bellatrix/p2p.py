@@ -1,0 +1,15 @@
+# Bellatrix -- p2p deltas: the Merge keeps the altair wire surface; the
+# only executable change is the gossip block-validity condition around
+# execution payloads (specs/bellatrix/p2p-interface.md, beacon_block topic
+# conditions) -- everything else is payload-type swaps handled by the
+# container overrides in beacon_chain.py.
+
+
+def is_valid_gossip_execution_payload_timestamp(
+        state: BeaconState, block: BeaconBlock) -> bool:
+    """beacon_block gossip condition: the payload timestamp must match the
+    slot (bellatrix/p2p-interface.md beacon_block validation)."""
+    if not is_execution_enabled(state, block.body):
+        return True
+    return (block.body.execution_payload.timestamp
+            == compute_timestamp_at_slot(state, block.slot))

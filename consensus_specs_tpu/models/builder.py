@@ -62,16 +62,16 @@ ALL_FORKS = list(PREVIOUS_FORK_OF)
 # delta files (ancestors' files run first)
 SPEC_SOURCES: dict[str, list[str]] = {
     "phase0": ["beacon_chain.py", "fork_choice.py", "validator.py",
-               "genesis.py"],
+               "genesis.py", "p2p.py"],
     "altair": ["beacon_chain.py", "fork.py", "light_client.py",
-               "validator.py"],
+               "validator.py", "p2p.py"],
     "bellatrix": ["beacon_chain.py", "fork.py", "fork_choice.py",
-                  "validator.py"],
-    "capella": ["beacon_chain.py", "fork.py"],
+                  "validator.py", "p2p.py"],
+    "capella": ["beacon_chain.py", "fork.py", "p2p.py"],
     "deneb": ["polynomial_commitments.py", "beacon_chain.py", "fork.py",
               "fork_choice.py", "p2p.py", "validator.py"],
     "electra": ["beacon_chain.py", "fork.py", "light_client.py",
-                "validator.py"],
+                "validator.py", "p2p.py"],
     "fulu": ["polynomial_commitments_sampling.py", "das_core.py",
              "beacon_chain.py", "fork.py", "fork_choice.py", "p2p.py",
              "validator.py"],

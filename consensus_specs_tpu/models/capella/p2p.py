@@ -1,0 +1,25 @@
+# Capella -- p2p deltas: the bls_to_execution_change gossip topic and its
+# uniqueness condition (specs/capella/p2p-interface.md).
+
+
+def compute_bls_to_execution_change_topic(fork_digest: ForkDigest) -> str:
+    return compute_gossip_topic(fork_digest, "bls_to_execution_change")
+
+
+def is_valid_bls_to_execution_change_gossip(
+        state: BeaconState,
+        signed_change: SignedBLSToExecutionChange) -> bool:
+    """Gossip condition: the change must target a validator whose
+    credentials are still BLS-prefixed, with a valid signature
+    (capella/p2p-interface.md bls_to_execution_change conditions)."""
+    change = signed_change.message
+    if change.validator_index >= len(state.validators):
+        return False
+    validator = state.validators[change.validator_index]
+    if validator.withdrawal_credentials[:1] != BLS_WITHDRAWAL_PREFIX:
+        return False
+    try:
+        process_bls_to_execution_change(state.copy(), signed_change)
+        return True
+    except (AssertionError, IndexError, ValueError):
+        return False

@@ -1,0 +1,30 @@
+# Electra -- p2p deltas: blob-sidecar limits move to the _ELECTRA config
+# values and the attestation gossip conditions adapt to EIP-7549
+# committee-bits attestations (specs/electra/p2p-interface.md :34-120).
+
+
+class BlobSidecarsByRangeRequest(Container):
+    start_slot: Slot
+    count: uint64
+
+
+def get_max_blobs_per_block(epoch: Epoch) -> uint64:
+    """Electra raises the blob count (electra/p2p-interface.md config)."""
+    return uint64(config.MAX_BLOBS_PER_BLOCK_ELECTRA)
+
+
+def get_blob_sidecar_subnet_count(epoch: Epoch) -> uint64:
+    return uint64(config.BLOB_SIDECAR_SUBNET_COUNT_ELECTRA)
+
+
+def compute_subnet_for_blob_sidecar_electra(blob_index: BlobIndex) -> SubnetID:
+    return SubnetID(blob_index % config.BLOB_SIDECAR_SUBNET_COUNT_ELECTRA)
+
+
+def is_valid_attestation_gossip_aggregation_bits(
+        attestation: Attestation) -> bool:
+    """beacon_attestation_{subnet_id} condition: exactly one committee bit
+    set and aggregation bits matching that committee's length
+    (electra/p2p-interface.md beacon_attestation conditions)."""
+    committee_indices = get_committee_indices(attestation.committee_bits)
+    return len(committee_indices) == 1

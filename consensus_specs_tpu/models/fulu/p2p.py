@@ -59,3 +59,34 @@ def compute_subnet_for_data_column_sidecar(
         column_index: ColumnIndex) -> SubnetID:
     return SubnetID(column_index
                     % config.DATA_COLUMN_SIDECAR_SUBNET_COUNT)
+
+
+# -- EIP-7892 digest plumbing: fulu redefines compute_fork_digest to take
+# (genesis_validators_root, epoch) (fulu/p2p-interface.md :296,:551), so the
+# digest-consuming p2p helpers re-bind to the new signature.
+
+
+def compute_enr_fork_id(current_epoch: Epoch,
+                        genesis_validators_root: Root) -> ENRForkID:
+    fork_digest = compute_fork_digest(genesis_validators_root, current_epoch)
+    next_version = compute_fork_version(current_epoch)
+    next_epoch = FAR_FUTURE_EPOCH
+    for name in ("ALTAIR", "BELLATRIX", "CAPELLA", "DENEB", "ELECTRA",
+                 "FULU"):
+        epoch = getattr(config, name + "_FORK_EPOCH", None)
+        version = getattr(config, name + "_FORK_VERSION", None)
+        if epoch is None or version is None:
+            continue
+        if current_epoch < epoch < next_epoch:
+            next_epoch = epoch
+            next_version = version
+    return ENRForkID(
+        fork_digest=fork_digest,
+        next_fork_version=Version(next_version),
+        next_fork_epoch=next_epoch,
+    )
+
+
+def compute_response_context(epoch: Epoch,
+                             genesis_validators_root: Root) -> ForkDigest:
+    return compute_fork_digest(genesis_validators_root, epoch)

@@ -1,0 +1,115 @@
+# phase0 -- p2p pure functions: gossip topics, message ids, req/resp
+# containers, ENR fork identity, message-size math.
+# Parity contract: specs/phase0/p2p-interface.md (:196-275 custom types and
+# size functions, :231-253 MetaData, :900-1170 req/resp message contents,
+# :1268-1298 ENRForkID, :1629-1643 message-id computation).
+
+# The gossip/req-resp *transport* (libp2p, noise, yamux) is client-side and
+# carries no executable spec; everything below is the pure-function surface
+# clients test against.
+
+
+class MetaData(Container):
+    seq_number: uint64
+    attnets: Bitvector[64]  # ATTESTATION_SUBNET_COUNT
+
+
+class ENRForkID(Container):
+    fork_digest: ForkDigest
+    next_fork_version: Version
+    next_fork_epoch: Epoch
+
+
+class StatusMessage(Container):
+    fork_digest: ForkDigest
+    finalized_root: Root
+    finalized_epoch: Epoch
+    head_root: Root
+    head_slot: Slot
+
+
+class BeaconBlocksByRangeRequest(Container):
+    start_slot: Slot
+    count: uint64
+    step: uint64  # deprecated, must be 1
+
+
+class BeaconBlocksByRootRequest(Container):
+    block_roots: List[Root, 1024]  # MAX_REQUEST_BLOCKS
+
+
+class Goodbye(uint64):
+    pass
+
+
+class Ping(uint64):
+    pass
+
+
+def max_compressed_len(n: uint64) -> uint64:
+    # Worst-case snappy output for an n-byte payload (p2p-interface.md :261)
+    return uint64(32 + n + n // 6)
+
+
+def max_message_size() -> uint64:
+    # 1024 bytes framing allowance, floor of 1 MiB (p2p-interface.md :270)
+    return max(max_compressed_len(config.MAX_PAYLOAD_SIZE) + 1024,
+               uint64(1024 * 1024))
+
+
+def compute_gossip_topic(fork_digest: ForkDigest, name: str,
+                         encoding: str = "ssz_snappy") -> str:
+    """Topic strings have form /eth2/ForkDigestValue/Name/Encoding
+    (p2p-interface.md :310-330)."""
+    return f"/eth2/{bytes(fork_digest).hex()}/{name}/{encoding}"
+
+
+def compute_attestation_subnet_topic(fork_digest: ForkDigest,
+                                     subnet_id: SubnetID) -> str:
+    return compute_gossip_topic(fork_digest,
+                                f"beacon_attestation_{int(subnet_id)}")
+
+
+def compute_message_id(message_data: bytes) -> bytes:
+    """Gossip message-id: 20-byte SHA256 over a validity-domain-separated
+    payload (p2p-interface.md :1629-1643).  `message_data` is the raw
+    (snappy-compressed) wire payload."""
+    try:
+        from consensus_specs_tpu.utils.snappy import decompress
+
+        decompressed = decompress(message_data)
+        return hash(config.MESSAGE_DOMAIN_VALID_SNAPPY + decompressed)[:20]
+    except Exception:
+        return hash(config.MESSAGE_DOMAIN_INVALID_SNAPPY + message_data)[:20]
+
+
+def compute_enr_fork_id(current_epoch: Epoch,
+                        genesis_validators_root: Root) -> ENRForkID:
+    """ENR eth2 field contents (p2p-interface.md :1268-1298).  Pre-genesis
+    and with no scheduled fork, next_* degrade to the current values."""
+    current_fork_version = compute_fork_version(current_epoch)
+    fork_digest = compute_fork_digest(current_fork_version,
+                                      genesis_validators_root)
+    # find the next scheduled fork (FAR_FUTURE_EPOCH when none)
+    next_version = current_fork_version
+    next_epoch = FAR_FUTURE_EPOCH
+    for name in ("ALTAIR", "BELLATRIX", "CAPELLA", "DENEB", "ELECTRA",
+                 "FULU"):
+        epoch = getattr(config, name + "_FORK_EPOCH", None)
+        version = getattr(config, name + "_FORK_VERSION", None)
+        if epoch is None or version is None:
+            continue
+        if current_epoch < epoch < next_epoch:
+            next_epoch = epoch
+            next_version = version
+    return ENRForkID(
+        fork_digest=fork_digest,
+        next_fork_version=Version(next_version),
+        next_fork_epoch=next_epoch,
+    )
+
+
+def compute_fork_version(epoch: Epoch) -> Version:
+    """phase0 base case; later forks override with their schedule
+    (altair/fork.md :35 introduces the laddered version)."""
+    return config.GENESIS_FORK_VERSION

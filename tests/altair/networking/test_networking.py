@@ -1,0 +1,68 @@
+"""altair p2p deltas (spec: specs/altair/p2p-interface.md)."""
+
+import hashlib
+
+from consensus_specs_tpu.testlib.context import (
+    single_phase,
+    spec_test,
+    with_all_phases_from,
+)
+from consensus_specs_tpu.utils.snappy import compress
+
+
+@with_all_phases_from("altair")
+@spec_test
+@single_phase
+def test_metadata_gains_syncnets(spec):
+    md = spec.MetaData(seq_number=1)
+    md.syncnets[2] = True
+    back = spec.MetaData.decode_bytes(md.encode_bytes())
+    assert back.syncnets[2] and not back.syncnets[0]
+    assert len(md.encode_bytes()) == 8 + 8 + 1
+    yield None
+
+
+@with_all_phases_from("altair")
+@spec_test
+@single_phase
+def test_topic_aware_message_id(spec):
+    topic = "/eth2/01020304/beacon_block/ssz_snappy"
+    payload = b"signed beacon block bytes"
+    wire = compress(payload)
+    prefix = bytes(spec.config.MESSAGE_DOMAIN_VALID_SNAPPY) \
+        + len(topic.encode()).to_bytes(8, "little") + topic.encode()
+    assert (spec.compute_message_id(topic, wire)
+            == hashlib.sha256(prefix + payload).digest()[:20])
+
+    garbage = b"\x00\xff garbage"
+    prefix = bytes(spec.config.MESSAGE_DOMAIN_INVALID_SNAPPY) \
+        + len(topic.encode()).to_bytes(8, "little") + topic.encode()
+    assert (spec.compute_message_id(topic, garbage)
+            == hashlib.sha256(prefix + garbage).digest()[:20])
+    yield None
+
+
+@with_all_phases_from("altair")
+@spec_test
+@single_phase
+def test_response_context_is_fork_digest(spec):
+    root = spec.Root(b"\x07" * 32)
+    epoch = spec.Epoch(5)
+    ctx = spec.compute_response_context(epoch, root)
+    if spec.fork == "fulu":
+        expected = spec.compute_fork_digest(root, epoch)
+    else:
+        expected = spec.compute_fork_digest(
+            spec.compute_fork_version(epoch), root)
+    assert ctx == expected
+    yield None
+
+
+@with_all_phases_from("altair")
+@spec_test
+@single_phase
+def test_sync_committee_topic(spec):
+    digest = spec.ForkDigest(b"\xaa\xbb\xcc\xdd")
+    assert (spec.compute_sync_committee_subnet_topic(digest, 3)
+            == "/eth2/aabbccdd/sync_committee_3/ssz_snappy")
+    yield None

@@ -1,0 +1,34 @@
+"""capella p2p deltas (spec: specs/capella/p2p-interface.md)."""
+
+from consensus_specs_tpu.testlib.context import (
+    single_phase,
+    spec_state_test,
+    spec_test,
+    with_all_phases_from,
+)
+
+
+@with_all_phases_from("capella")
+@spec_test
+@single_phase
+def test_bls_to_execution_change_topic(spec):
+    digest = spec.ForkDigest(b"\x00\x11\x22\x33")
+    assert (spec.compute_bls_to_execution_change_topic(digest)
+            == "/eth2/00112233/bls_to_execution_change/ssz_snappy")
+    yield None
+
+
+@with_all_phases_from("capella")
+@spec_state_test
+def test_bls_to_execution_change_gossip_condition(spec, state):
+    from consensus_specs_tpu.testlib.helpers.bls_to_execution_changes \
+        import get_signed_address_change
+
+    signed = get_signed_address_change(spec, state)
+    assert spec.is_valid_bls_to_execution_change_gossip(state, signed)
+
+    # out-of-range validator index is rejected, not crashed
+    bad = signed.copy()
+    bad.message.validator_index = len(state.validators) + 10
+    assert not spec.is_valid_bls_to_execution_change_gossip(state, bad)
+    yield None

@@ -1,0 +1,45 @@
+"""electra p2p deltas (spec: specs/electra/p2p-interface.md)."""
+
+from consensus_specs_tpu.testlib.context import (
+    single_phase,
+    spec_state_test,
+    spec_test,
+    with_all_phases_from,
+)
+
+
+@with_all_phases_from("electra")
+@spec_test
+@single_phase
+def test_electra_blob_limits(spec):
+    assert (int(spec.get_max_blobs_per_block(spec.Epoch(0)))
+            == int(spec.config.MAX_BLOBS_PER_BLOCK_ELECTRA))
+    assert (int(spec.get_blob_sidecar_subnet_count(spec.Epoch(0)))
+            == int(spec.config.BLOB_SIDECAR_SUBNET_COUNT_ELECTRA))
+    count = int(spec.config.BLOB_SIDECAR_SUBNET_COUNT_ELECTRA)
+    for idx in range(2 * count):
+        s = spec.compute_subnet_for_blob_sidecar_electra(spec.BlobIndex(idx))
+        assert int(s) == idx % count
+    yield None
+
+
+@with_all_phases_from("electra")
+@spec_state_test
+def test_attestation_gossip_single_committee_condition(spec, state):
+    from consensus_specs_tpu.testlib.helpers.attestations import (
+        get_valid_attestation)
+
+    from consensus_specs_tpu.testlib.helpers.state import next_slots
+
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    attestation = get_valid_attestation(spec, state,
+                                        slot=state.slot - 1)
+    assert spec.is_valid_attestation_gossip_aggregation_bits(attestation)
+
+    multi = attestation.copy()
+    # set a second committee bit: gossip must reject
+    free = next(i for i in range(len(multi.committee_bits))
+                if not multi.committee_bits[i])
+    multi.committee_bits[free] = True
+    assert not spec.is_valid_attestation_gossip_aggregation_bits(multi)
+    yield None

@@ -1,0 +1,136 @@
+"""phase0 p2p pure functions (spec: specs/phase0/p2p-interface.md)."""
+
+import hashlib
+
+from consensus_specs_tpu.testlib.context import (
+    single_phase,
+    spec_test,
+    with_all_phases,
+    with_phases,
+)
+from consensus_specs_tpu.utils.snappy import compress
+
+
+@with_all_phases
+@spec_test
+@single_phase
+def test_max_message_size(spec):
+    # 10 MiB payload → 32 + n + n/6 + 1024 framing
+    n = int(spec.config.MAX_PAYLOAD_SIZE)
+    expected = 32 + n + n // 6 + 1024
+    assert int(spec.max_message_size()) == max(expected, 1024 * 1024)
+    assert int(spec.max_compressed_len(0)) == 32
+    yield None
+
+
+@with_all_phases
+@spec_test
+@single_phase
+def test_gossip_topic_format(spec):
+    digest = spec.ForkDigest(b"\x01\x02\x03\x04")
+    assert (spec.compute_gossip_topic(digest, "beacon_block")
+            == "/eth2/01020304/beacon_block/ssz_snappy")
+    assert (spec.compute_attestation_subnet_topic(digest, 7)
+            == "/eth2/01020304/beacon_attestation_7/ssz_snappy")
+    yield None
+
+
+@with_phases(["phase0"])
+@spec_test
+@single_phase
+def test_message_id_valid_and_invalid_snappy(spec):
+    payload = b"attestation payload bytes"
+    wire = compress(payload)
+    expected_valid = hashlib.sha256(
+        bytes(spec.config.MESSAGE_DOMAIN_VALID_SNAPPY) + payload
+    ).digest()[:20]
+    assert spec.compute_message_id(wire) == expected_valid
+
+    garbage = b"\xff\xff\xff\xff not snappy"
+    expected_invalid = hashlib.sha256(
+        bytes(spec.config.MESSAGE_DOMAIN_INVALID_SNAPPY) + garbage
+    ).digest()[:20]
+    assert spec.compute_message_id(garbage) == expected_invalid
+    yield None
+
+
+def _expected_digest(spec, epoch, root):
+    if spec.fork == "fulu":
+        # EIP-7892: fulu's digest takes (root, epoch) and folds in the
+        # blob-parameter schedule
+        return spec.compute_fork_digest(root, epoch)
+    return spec.compute_fork_digest(spec.compute_fork_version(epoch), root)
+
+
+@with_all_phases
+@spec_test
+@single_phase
+def test_enr_fork_id_no_scheduled_fork(spec):
+    root = spec.Root(b"\x22" * 32)
+    current_epoch = spec.Epoch(10)
+    enr = spec.compute_enr_fork_id(current_epoch, root)
+    version = spec.compute_fork_version(current_epoch)
+    assert enr.fork_digest == _expected_digest(spec, current_epoch, root)
+    # minimal/mainnet configs schedule every fork at FAR_FUTURE_EPOCH, so
+    # the next-fork fields stay degenerate
+    assert enr.next_fork_epoch == spec.FAR_FUTURE_EPOCH
+    assert enr.next_fork_version == version
+    yield None
+
+
+@with_phases(["phase0"])
+@spec_test
+@single_phase
+def test_enr_fork_id_with_scheduled_fork(spec):
+    from consensus_specs_tpu.models.builder import spec_with_config
+
+    overridden = spec_with_config(spec, {"ALTAIR_FORK_EPOCH": 100})
+    root = overridden.Root(b"\x00" * 32)
+    enr = overridden.compute_enr_fork_id(overridden.Epoch(10), root)
+    assert enr.next_fork_epoch == 100
+    assert (enr.next_fork_version
+            == overridden.config.ALTAIR_FORK_VERSION)
+    yield None
+
+
+@with_all_phases
+@spec_test
+@single_phase
+def test_metadata_roundtrip(spec):
+    md = spec.MetaData(seq_number=3)
+    md.attnets[5] = True
+    back = spec.MetaData.decode_bytes(md.encode_bytes())
+    assert back.seq_number == 3 and back.attnets[5]
+    yield None
+
+
+@with_all_phases
+@spec_test
+@single_phase
+def test_subscribed_subnets_deterministic_and_in_range(spec):
+    node_id = spec.NodeID(2**200 + 12345)
+    epoch = spec.Epoch(1234)
+    subnets = spec.compute_subscribed_subnets(node_id, epoch)
+    assert len(subnets) == int(spec.config.SUBNETS_PER_NODE)
+    assert subnets == spec.compute_subscribed_subnets(node_id, epoch)
+    for s in subnets:
+        assert 0 <= int(s) < int(spec.config.ATTESTATION_SUBNET_COUNT)
+    # consecutive indices land on consecutive subnets mod count
+    assert (int(subnets[1]) - int(subnets[0])) \
+        % int(spec.config.ATTESTATION_SUBNET_COUNT) == 1
+    yield None
+
+
+@with_all_phases
+@spec_test
+@single_phase
+def test_status_message_shape(spec):
+    msg = spec.StatusMessage(
+        fork_digest=spec.ForkDigest(b"\x00" * 4),
+        finalized_root=spec.Root(b"\x00" * 32),
+        finalized_epoch=0,
+        head_root=spec.Root(b"\x11" * 32),
+        head_slot=42,
+    )
+    assert len(msg.encode_bytes()) == 4 + 32 + 8 + 32 + 8
+    yield None
