@@ -1,0 +1,106 @@
+# Bellatrix -- Optimistic sync (executable spec source).
+# Parity contract: sync/optimistic.md (:50-123 store + helpers, :138-260
+# import conditions and NOT_VALIDATED transition machinery).
+
+SAFE_SLOTS_TO_IMPORT_OPTIMISTICALLY = 128
+
+
+@dataclass
+class OptimisticStore(object):
+    optimistic_roots: Set[Root]
+    head_block_root: Root
+    blocks: Dict[Root, BeaconBlock] = field(default_factory=dict)
+    block_states: Dict[Root, BeaconState] = field(default_factory=dict)
+
+
+def is_optimistic(opt_store: OptimisticStore, block: BeaconBlock) -> bool:
+    return hash_tree_root(block) in opt_store.optimistic_roots
+
+
+def latest_verified_ancestor(opt_store: OptimisticStore,
+                             block: BeaconBlock) -> BeaconBlock:
+    # It is assumed that the `block` parameter is never an INVALIDATED block.
+    while True:
+        if not is_optimistic(opt_store, block) or block.parent_root == Root():
+            return block
+        block = opt_store.blocks[block.parent_root]
+
+
+def is_execution_block(block: BeaconBlock) -> bool:
+    return block.body.execution_payload != ExecutionPayload()
+
+
+def is_optimistic_candidate_block(opt_store: OptimisticStore,
+                                  current_slot: Slot,
+                                  block: BeaconBlock) -> bool:
+    if is_execution_block(opt_store.blocks[block.parent_root]):
+        return True
+
+    if block.slot + SAFE_SLOTS_TO_IMPORT_OPTIMISTICALLY <= current_slot:
+        return True
+
+    return False
+
+
+def mark_block_valid(opt_store: OptimisticStore, block_root: Root) -> None:
+    """NOT_VALIDATED -> VALID: the block and all its optimistic ancestors
+    leave the optimistic set (sync/optimistic.md :225-232)."""
+    block = opt_store.blocks[block_root]
+    while True:
+        opt_store.optimistic_roots.discard(hash_tree_root(block))
+        if block.parent_root == Root() \
+                or block.parent_root not in opt_store.blocks:
+            return
+        parent = opt_store.blocks[block.parent_root]
+        if hash_tree_root(parent) not in opt_store.optimistic_roots:
+            return
+        block = parent
+
+
+def mark_block_invalidated(opt_store: OptimisticStore,
+                           block_root: Root) -> None:
+    """NOT_VALIDATED -> INVALIDATED: the block and all its descendants are
+    removed from the optimistic store (sync/optimistic.md :234-241)."""
+    invalidated = {block_root}
+    # repeatedly sweep for descendants of the invalidated set
+    changed = True
+    while changed:
+        changed = False
+        for root, blk in list(opt_store.blocks.items()):
+            if root in invalidated:
+                continue
+            if blk.parent_root in invalidated:
+                invalidated.add(root)
+                changed = True
+    for root in invalidated:
+        opt_store.optimistic_roots.discard(root)
+        opt_store.blocks.pop(root, None)
+        opt_store.block_states.pop(root, None)
+
+
+def get_invalidated_block_roots(opt_store: OptimisticStore,
+                                block_root: Root,
+                                latest_valid_hash: Hash32) -> Set[Root]:
+    """The blocks to invalidate for an INVALID payload status with the
+    given latestValidHash (sync/optimistic.md latestValidHash table):
+    everything in the chain of `block_root` *after* the block whose payload
+    hash equals latest_valid_hash; the whole execution chain when the hash
+    is all zeroes or unknown."""
+    chain = []
+    root = block_root
+    while root in opt_store.blocks:
+        block = opt_store.blocks[root]
+        chain.append((root, block))
+        if block.body.execution_payload.block_hash == latest_valid_hash \
+                and latest_valid_hash != Hash32():
+            # blocks after this one (walked newest->oldest: all collected
+            # before, minus this entry) are invalid
+            return set(r for r, _ in chain[:-1])
+        if block.parent_root == Root():
+            break
+        root = block.parent_root
+    if latest_valid_hash == Hash32():
+        # invalidate back to (and excluding) the last pre-execution block
+        return set(r for r, b in chain if is_execution_block(b))
+    # unknown hash: treat as null -- only the block in question
+    return {block_root}

@@ -66,7 +66,7 @@ SPEC_SOURCES: dict[str, list[str]] = {
     "altair": ["beacon_chain.py", "fork.py", "light_client.py",
                "validator.py", "p2p.py"],
     "bellatrix": ["beacon_chain.py", "fork.py", "fork_choice.py",
-                  "validator.py", "p2p.py"],
+                  "validator.py", "p2p.py", "optimistic.py"],
     "capella": ["beacon_chain.py", "fork.py", "p2p.py"],
     "deneb": ["polynomial_commitments.py", "beacon_chain.py", "fork.py",
               "fork_choice.py", "p2p.py", "validator.py"],

@@ -1,0 +1,186 @@
+"""Optimistic-sync test harness: engine payload statuses, the combined
+fork-choice + optimistic store, and the optimistic block-import driver
+(the reference's `test/helpers/optimistic_sync.py:1-225`)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from enum import Enum
+
+from .fork_choice import add_block, get_block_file_name
+
+
+def encode_hex(b: bytes) -> str:
+    return "0x" + bytes(b).hex()
+
+
+class PayloadStatusV1StatusAlias(Enum):
+    NOT_VALIDATED = "NOT_VALIDATED"
+    INVALIDATED = "INVALIDATED"
+
+
+class PayloadStatusV1Status(Enum):
+    VALID = "VALID"
+    INVALID = "INVALID"
+    SYNCING = "SYNCING"
+    ACCEPTED = "ACCEPTED"
+    INVALID_BLOCK_HASH = "INVALID_BLOCK_HASH"
+
+    @property
+    def alias(self) -> PayloadStatusV1StatusAlias | None:
+        if self.value in (self.SYNCING.value, self.ACCEPTED.value):
+            return PayloadStatusV1StatusAlias.NOT_VALIDATED
+        if self.value in (self.INVALID.value, self.INVALID_BLOCK_HASH.value):
+            return PayloadStatusV1StatusAlias.INVALIDATED
+        return None  # VALID has no alias
+
+
+@dataclass
+class PayloadStatusV1:
+    status: PayloadStatusV1Status = PayloadStatusV1Status.VALID
+    latest_valid_hash: bytes | None = None
+    validation_error: str | None = None
+
+    @property
+    def formatted_output(self):
+        return {
+            "status": str(self.status.value),
+            "latest_valid_hash": (encode_hex(self.latest_valid_hash)
+                                  if self.latest_valid_hash is not None
+                                  else None),
+            "validation_error": (str(self.validation_error)
+                                 if self.validation_error is not None
+                                 else None),
+        }
+
+
+class MegaStore:
+    """Fork-choice store + optimistic store + per-block engine statuses."""
+
+    def __init__(self, spec, fc_store, opt_store):
+        self.spec = spec
+        self.fc_store = fc_store
+        self.opt_store = opt_store
+        self.block_payload_statuses: dict = {}
+
+
+def get_optimistic_store(spec, anchor_state, anchor_block):
+    assert anchor_block.state_root == anchor_state.hash_tree_root()
+    opt_store = spec.OptimisticStore(
+        optimistic_roots=set(),
+        head_block_root=anchor_block.hash_tree_root(),
+    )
+    root = anchor_block.hash_tree_root()
+    opt_store.blocks[root] = anchor_block.copy()
+    opt_store.block_states[root] = anchor_state.copy()
+    return opt_store
+
+
+def get_valid_flag_value(status: PayloadStatusV1Status) -> bool:
+    if status == PayloadStatusV1Status.VALID:
+        return True
+    return status.alias == PayloadStatusV1StatusAlias.NOT_VALIDATED
+
+
+def add_optimistic_block(spec, mega_store, signed_block, test_steps,
+                         payload_status=None,
+                         status=PayloadStatusV1Status.SYNCING):
+    """Import a block under optimistic-sync rules: record the engine's
+    payload status, propagate INVALID up to latestValidHash, run on_block,
+    then update the optimistic store + head."""
+    block = signed_block.message
+    block_root = block.hash_tree_root()
+    el_block_hash = block.body.execution_payload.block_hash
+
+    if payload_status is None:
+        payload_status = PayloadStatusV1(status=status)
+        if payload_status.status == PayloadStatusV1Status.VALID:
+            payload_status.latest_valid_hash = el_block_hash
+
+    mega_store.block_payload_statuses[block_root] = payload_status
+    test_steps.append({
+        "block_hash": encode_hex(el_block_hash),
+        "payload_status": payload_status.formatted_output,
+    })
+
+    valid = get_valid_flag_value(payload_status.status)
+
+    # INVALID with latestValidHash: walk ancestors up to the valid hash,
+    # marking them INVALID too (sync/optimistic.md latestValidHash table)
+    if payload_status.status == PayloadStatusV1Status.INVALID:
+        assert payload_status.latest_valid_hash is not None
+        current_block = block
+        current_hash = el_block_hash
+        while (current_hash != payload_status.latest_valid_hash
+               and current_hash != spec.Bytes32()):
+            current_root = current_block.hash_tree_root()
+            assert current_root in mega_store.block_payload_statuses
+            mega_store.block_payload_statuses[current_root].status = \
+                PayloadStatusV1Status.INVALID
+            if current_block.parent_root not in mega_store.fc_store.blocks:
+                break
+            current_block = mega_store.fc_store.blocks[
+                current_block.parent_root]
+            current_hash = current_block.body.execution_payload.block_hash
+
+    yield from add_block(spec, mega_store.fc_store, signed_block,
+                         test_steps=test_steps, valid=valid,
+                         is_optimistic=True)
+
+    # update the optimistic store
+    if spec.is_optimistic_candidate_block(
+            mega_store.opt_store,
+            current_slot=spec.get_current_slot(mega_store.fc_store),
+            block=block):
+        mega_store.opt_store.optimistic_roots.add(block_root)
+        mega_store.opt_store.blocks[block_root] = block.copy()
+        if not is_invalidated(mega_store, block_root):
+            mega_store.opt_store.block_states[block_root] = \
+                mega_store.fc_store.block_states[block_root].copy()
+
+    mega_store.opt_store.head_block_root = \
+        get_opt_head_block_root(spec, mega_store)
+    test_steps.append({
+        "checks": {
+            "head": get_formatted_optimistic_head_output(mega_store),
+        }
+    })
+
+
+def get_opt_head_block_root(spec, mega_store):
+    """LMD-GHOST head over the filtered tree, skipping INVALIDATED blocks
+    (the optimistic variant of `get_head`)."""
+    store = mega_store.fc_store
+    blocks = spec.get_filtered_block_tree(store)
+    head = store.justified_checkpoint.root
+    while True:
+        children = [
+            root for root in blocks
+            if (blocks[root].parent_root == head
+                and not is_invalidated(mega_store, root))
+        ]
+        if len(children) == 0:
+            return head
+        head = max(children,
+                   key=lambda root: (spec.get_weight(store, root), root))
+
+
+def is_invalidated(mega_store, block_root) -> bool:
+    status = mega_store.block_payload_statuses.get(block_root)
+    if status is None:
+        return False
+    return status.status.alias == PayloadStatusV1StatusAlias.INVALIDATED
+
+
+def get_formatted_optimistic_head_output(mega_store):
+    head = mega_store.opt_store.head_block_root
+    slot = mega_store.fc_store.blocks[head].slot
+    return {"slot": int(slot), "root": encode_hex(head)}
+
+
+__all__ = [
+    "MegaStore", "PayloadStatusV1", "PayloadStatusV1Status",
+    "PayloadStatusV1StatusAlias", "add_optimistic_block",
+    "get_optimistic_store", "get_opt_head_block_root", "is_invalidated",
+    "get_block_file_name",
+]

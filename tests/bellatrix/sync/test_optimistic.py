@@ -1,0 +1,218 @@
+"""Optimistic sync: NOT_VALIDATED import + retroactive INVALID transition
+(spec: sync/optimistic.md; reference test:
+bellatrix/sync/test_optimistic.py)."""
+
+from consensus_specs_tpu.testlib.context import (
+    spec_state_test,
+    with_all_phases_from,
+)
+from consensus_specs_tpu.testlib.helpers.block import (
+    build_empty_block_for_next_slot,
+)
+from consensus_specs_tpu.testlib.helpers.execution_payload import (
+    compute_el_block_hash,
+)
+from consensus_specs_tpu.testlib.helpers.fork_choice import (
+    get_genesis_forkchoice_store_and_block,
+    on_tick_and_append_step,
+)
+from consensus_specs_tpu.testlib.helpers.optimistic_sync import (
+    MegaStore,
+    PayloadStatusV1,
+    PayloadStatusV1Status,
+    add_optimistic_block,
+    get_optimistic_store,
+)
+from consensus_specs_tpu.testlib.helpers.state import (
+    next_epoch,
+    state_transition_and_sign_block,
+)
+
+
+def _build_exec_block(spec, state, parent_hash, tag):
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.execution_payload.parent_hash = parent_hash
+    block.body.execution_payload.extra_data = spec.hash(tag.encode())
+    block.body.execution_payload.block_hash = compute_el_block_hash(
+        spec, block.body.execution_payload, state)
+    return block
+
+
+@with_all_phases_from("bellatrix")
+@spec_state_test
+def test_from_syncing_to_invalid(spec, state):
+    test_steps = []
+    fc_store, anchor_block = get_genesis_forkchoice_store_and_block(
+        spec, state)
+    opt_store = get_optimistic_store(spec, state, anchor_block)
+    mega_store = MegaStore(spec, fc_store, opt_store)
+    block_hashes = {}
+    yield "anchor_state", state
+    yield "anchor_block", anchor_block
+
+    next_epoch(spec, state)
+
+    current_time = (
+        (spec.SAFE_SLOTS_TO_IMPORT_OPTIMISTICALLY * 10 + state.slot)
+        * spec.config.SECONDS_PER_SLOT + fc_store.genesis_time)
+    on_tick_and_append_step(spec, fc_store, current_time, test_steps)
+
+    # block 0: VALID execution
+    block_0 = build_empty_block_for_next_slot(spec, state)
+    block_hashes["block_0"] = block_0.body.execution_payload.block_hash
+    signed = state_transition_and_sign_block(spec, state, block_0)
+    yield from add_optimistic_block(spec, mega_store, signed, test_steps,
+                                    status=PayloadStatusV1Status.VALID)
+    assert spec.get_head(fc_store) == mega_store.opt_store.head_block_root
+
+    state_0 = state.copy()
+
+    # chain a: three VALID blocks
+    signed_a = []
+    for i in range(3):
+        parent = (block_hashes[f"chain_a_{i - 1}"] if i
+                  else block_hashes["block_0"])
+        block = _build_exec_block(spec, state, parent, f"chain_a_{i}")
+        block_hashes[f"chain_a_{i}"] = \
+            block.body.execution_payload.block_hash
+        signed = state_transition_and_sign_block(spec, state, block)
+        yield from add_optimistic_block(spec, mega_store, signed, test_steps,
+                                        status=PayloadStatusV1Status.VALID)
+        signed_a.append(signed.copy())
+
+    # chain b: three SYNCING (optimistically imported) blocks
+    signed_b = []
+    state = state_0.copy()
+    for i in range(3):
+        parent = (block_hashes[f"chain_b_{i - 1}"] if i
+                  else block_hashes["block_0"])
+        block = _build_exec_block(spec, state, parent, f"chain_b_{i}")
+        block_hashes[f"chain_b_{i}"] = \
+            block.body.execution_payload.block_hash
+        signed = state_transition_and_sign_block(spec, state, block)
+        signed_b.append(signed.copy())
+        yield from add_optimistic_block(spec, mega_store, signed, test_steps,
+                                        status=PayloadStatusV1Status.SYNCING)
+        root = signed.message.hash_tree_root()
+        assert spec.is_optimistic(mega_store.opt_store, signed.message)
+        assert root in mega_store.opt_store.optimistic_roots
+
+    # block 4 on chain b: engine says INVALID back to block_0
+    block = _build_exec_block(spec, state,
+                              block_hashes["chain_b_2"], "chain_b_3")
+    block_hashes["chain_b_3"] = block.body.execution_payload.block_hash
+    assert len(block_hashes) == len(set(block_hashes.values()))
+
+    signed = state_transition_and_sign_block(spec, state, block)
+    payload_status = PayloadStatusV1(
+        status=PayloadStatusV1Status.INVALID,
+        latest_valid_hash=block_0.body.execution_payload.block_hash,
+        validation_error="invalid",
+    )
+    yield from add_optimistic_block(spec, mega_store, signed, test_steps,
+                                    payload_status=payload_status)
+    # the whole b-chain is invalidated; the head must be chain a's tip
+    assert (mega_store.opt_store.head_block_root
+            == signed_a[-1].message.hash_tree_root())
+    yield "steps", test_steps
+
+
+@with_all_phases_from("bellatrix")
+@spec_state_test
+def test_optimistic_store_transitions(spec, state):
+    """Unit coverage of the OptimisticStore transition machinery."""
+    fc_store, anchor_block = get_genesis_forkchoice_store_and_block(
+        spec, state)
+    opt_store = get_optimistic_store(spec, state, anchor_block)
+
+    next_epoch(spec, state)
+    current_time = (
+        (spec.SAFE_SLOTS_TO_IMPORT_OPTIMISTICALLY * 10 + state.slot)
+        * spec.config.SECONDS_PER_SLOT + fc_store.genesis_time)
+    spec.on_tick(fc_store, current_time)
+
+    # chain of three execution blocks, all optimistically imported
+    roots = []
+    blocks = []
+    for i in range(3):
+        if i == 0:
+            block = build_empty_block_for_next_slot(spec, state)
+        else:
+            block = _build_exec_block(
+                spec, state, blocks[-1].body.execution_payload.block_hash,
+                f"chain_{i}")
+        signed = state_transition_and_sign_block(spec, state, block)
+        spec.on_block(fc_store, signed)
+        root = block.hash_tree_root()
+        assert spec.is_optimistic_candidate_block(
+            opt_store, spec.get_current_slot(fc_store), block) \
+            or i == 0  # genesis parent has no payload; slot distance covers
+        opt_store.blocks[root] = block.copy()
+        opt_store.block_states[root] = \
+            fc_store.block_states[root].copy()
+        opt_store.optimistic_roots.add(root)
+        roots.append(root)
+        blocks.append(block.copy())
+
+    # every block is optimistic; the latest verified ancestor walks to
+    # the anchor
+    tip = opt_store.blocks[roots[-1]]
+    assert spec.is_optimistic(opt_store, tip)
+    verified = spec.latest_verified_ancestor(opt_store, tip)
+    assert verified.hash_tree_root() not in opt_store.optimistic_roots
+
+    # NOT_VALIDATED -> VALID on the middle block validates its ancestors
+    spec.mark_block_valid(opt_store, roots[1])
+    assert roots[0] not in opt_store.optimistic_roots
+    assert roots[1] not in opt_store.optimistic_roots
+    assert roots[2] in opt_store.optimistic_roots
+
+    # NOT_VALIDATED -> INVALIDATED on the middle block removes descendants
+    spec.mark_block_invalidated(opt_store, roots[1])
+    assert roots[1] not in opt_store.blocks
+    assert roots[2] not in opt_store.blocks
+    assert roots[2] not in opt_store.optimistic_roots
+
+    yield None
+
+
+@with_all_phases_from("bellatrix")
+@spec_state_test
+def test_invalidated_block_roots_latest_valid_hash(spec, state):
+    """The latestValidHash table (sync/optimistic.md)."""
+    fc_store, anchor_block = get_genesis_forkchoice_store_and_block(
+        spec, state)
+    opt_store = get_optimistic_store(spec, state, anchor_block)
+    next_epoch(spec, state)
+    current_time = (
+        (spec.SAFE_SLOTS_TO_IMPORT_OPTIMISTICALLY * 10 + state.slot)
+        * spec.config.SECONDS_PER_SLOT + fc_store.genesis_time)
+    spec.on_tick(fc_store, current_time)
+
+    blocks = []
+    for i in range(3):
+        if blocks:
+            block = _build_exec_block(
+                spec, state, blocks[-1].body.execution_payload.block_hash,
+                f"c{i}")
+        else:
+            block = build_empty_block_for_next_slot(spec, state)
+        signed = state_transition_and_sign_block(spec, state, block)
+        spec.on_block(fc_store, signed)
+        root = block.hash_tree_root()
+        opt_store.blocks[root] = block.copy()
+        opt_store.optimistic_roots.add(root)
+        blocks.append(block.copy())
+
+    roots = [b.hash_tree_root() for b in blocks]
+
+    # latest_valid_hash = hash of blocks[0]: blocks 1..2 invalid
+    bad = spec.get_invalidated_block_roots(
+        opt_store, roots[-1], blocks[0].body.execution_payload.block_hash)
+    assert bad == {roots[1], roots[2]}
+
+    # unknown hash behaves like null: only the block in question
+    bad = spec.get_invalidated_block_roots(
+        opt_store, roots[-1], spec.Hash32(b"\x99" * 32))
+    assert bad == {roots[-1]}
+    yield None
