@@ -67,9 +67,11 @@ SPEC_SOURCES: dict[str, list[str]] = {
                "validator.py", "p2p.py"],
     "bellatrix": ["beacon_chain.py", "fork.py", "fork_choice.py",
                   "validator.py", "p2p.py", "optimistic.py"],
-    "capella": ["beacon_chain.py", "fork.py", "p2p.py"],
+    "capella": ["beacon_chain.py", "fork.py", "fork_choice.py",
+                "validator.py", "light_client.py", "p2p.py"],
     "deneb": ["polynomial_commitments.py", "beacon_chain.py", "fork.py",
-              "fork_choice.py", "p2p.py", "validator.py"],
+              "fork_choice.py", "light_client.py", "p2p.py",
+              "validator.py"],
     "electra": ["beacon_chain.py", "fork.py", "light_client.py",
                 "validator.py", "p2p.py"],
     "fulu": ["polynomial_commitments_sampling.py", "das_core.py",

@@ -1,0 +1,56 @@
+# Capella -- Fork choice deltas (executable spec source).
+# Parity contract: specs/capella/fork-choice.md (:51-120): PayloadAttributes
+# gains withdrawals; on_block drops the merge-transition validation.
+
+
+@dataclass
+class PayloadAttributes(object):
+    timestamp: uint64
+    prev_randao: Bytes32
+    suggested_fee_recipient: ExecutionAddress
+    withdrawals: Sequence[Any] = ()  # [New in Capella]
+
+
+def on_block(store: Store, signed_block: SignedBeaconBlock) -> None:
+    """phase0 on_block without the merge-transition checks
+    (capella/fork-choice.md :66-120)."""
+    block = signed_block.message
+    # Parent must be known
+    assert block.parent_root in store.block_states
+    pre_state = copy(store.block_states[block.parent_root])
+    # Future blocks wait until their slot arrives
+    assert get_current_slot(store) >= block.slot
+
+    # Later than the finalized slot, descending from the finalized block
+    finalized_slot = compute_start_slot_at_epoch(
+        store.finalized_checkpoint.epoch)
+    assert block.slot > finalized_slot
+    finalized_checkpoint_block = get_checkpoint_block(
+        store, block.parent_root, store.finalized_checkpoint.epoch)
+    assert store.finalized_checkpoint.root == finalized_checkpoint_block
+
+    # [Modified in Capella] no validate_merge_block: the transition is done
+
+    # Validity + post-state
+    state = pre_state
+    block_root = hash_tree_root(block)
+    state_transition(state, signed_block, True)
+
+    store.blocks[block_root] = block
+    store.block_states[block_root] = state
+
+    # Timeliness + proposer boost
+    time_into_slot = (store.time - store.genesis_time) % config.SECONDS_PER_SLOT
+    is_before_attesting_interval = (
+        time_into_slot < config.SECONDS_PER_SLOT // INTERVALS_PER_SLOT)
+    is_timely = (get_current_slot(store) == block.slot
+                 and is_before_attesting_interval)
+    store.block_timeliness[hash_tree_root(block)] = is_timely
+
+    is_first_block = store.proposer_boost_root == Root()
+    if is_timely and is_first_block:
+        store.proposer_boost_root = hash_tree_root(block)
+
+    update_checkpoints(store, state.current_justified_checkpoint,
+                       state.finalized_checkpoint)
+    compute_pulled_up_tip(store, block_root)

@@ -1,0 +1,203 @@
+# Capella -- Light Client (execution payload proofs).
+#
+# Parity contract: specs/capella/light-client/sync-protocol.md (modified
+# LightClientHeader + execution-root helpers), full-node.md (header
+# construction with the execution branch), fork.md (upgrade functions).
+# From capella onward the light-client header commits to the execution
+# payload header via a merkle branch into the block body.
+
+EXECUTION_PAYLOAD_GINDEX = get_generalized_index(
+    BeaconBlockBody, "execution_payload")
+assert EXECUTION_PAYLOAD_GINDEX == 25, EXECUTION_PAYLOAD_GINDEX
+
+ExecutionBranch = Vector[Bytes32, floorlog2(EXECUTION_PAYLOAD_GINDEX)]
+
+
+class LightClientHeader(Container):
+    # Beacon block header
+    beacon: BeaconBlockHeader
+    # Execution payload header for `beacon.body_root` (from Capella onward)
+    execution: ExecutionPayloadHeader
+    execution_branch: ExecutionBranch
+
+
+# Containers embedding the header bind the field type at class creation;
+# re-declare them against the capella header (fork.md modified containers).
+
+
+class LightClientBootstrap(Container):
+    header: LightClientHeader
+    current_sync_committee: SyncCommittee
+    current_sync_committee_branch: CurrentSyncCommitteeBranch
+
+
+class LightClientUpdate(Container):
+    attested_header: LightClientHeader
+    next_sync_committee: SyncCommittee
+    next_sync_committee_branch: NextSyncCommitteeBranch
+    finalized_header: LightClientHeader
+    finality_branch: FinalityBranch
+    sync_aggregate: SyncAggregate
+    signature_slot: Slot
+
+
+class LightClientFinalityUpdate(Container):
+    attested_header: LightClientHeader
+    finalized_header: LightClientHeader
+    finality_branch: FinalityBranch
+    sync_aggregate: SyncAggregate
+    signature_slot: Slot
+
+
+class LightClientOptimisticUpdate(Container):
+    attested_header: LightClientHeader
+    sync_aggregate: SyncAggregate
+    signature_slot: Slot
+
+
+@dataclass
+class LightClientStore(object):
+    finalized_header: LightClientHeader
+    current_sync_committee: SyncCommittee
+    next_sync_committee: SyncCommittee
+    best_valid_update: Optional[LightClientUpdate]
+    optimistic_header: LightClientHeader
+    previous_max_active_participants: uint64
+    current_max_active_participants: uint64
+
+
+def get_lc_execution_root(header: LightClientHeader) -> Root:
+    epoch = compute_epoch_at_slot(header.beacon.slot)
+
+    if epoch >= config.CAPELLA_FORK_EPOCH:
+        return hash_tree_root(header.execution)
+
+    return Root()
+
+
+def is_valid_light_client_header(header: LightClientHeader) -> bool:
+    epoch = compute_epoch_at_slot(header.beacon.slot)
+
+    if epoch < config.CAPELLA_FORK_EPOCH:
+        return (header.execution == ExecutionPayloadHeader()
+                and header.execution_branch == ExecutionBranch())
+
+    return is_valid_merkle_branch(
+        leaf=get_lc_execution_root(header),
+        branch=header.execution_branch,
+        depth=floorlog2(EXECUTION_PAYLOAD_GINDEX),
+        index=get_subtree_index(EXECUTION_PAYLOAD_GINDEX),
+        root=header.beacon.body_root,
+    )
+
+
+def get_lc_execution_payload_header(payload) -> ExecutionPayloadHeader:
+    return ExecutionPayloadHeader(
+        parent_hash=payload.parent_hash,
+        fee_recipient=payload.fee_recipient,
+        state_root=payload.state_root,
+        receipts_root=payload.receipts_root,
+        logs_bloom=payload.logs_bloom,
+        prev_randao=payload.prev_randao,
+        block_number=payload.block_number,
+        gas_limit=payload.gas_limit,
+        gas_used=payload.gas_used,
+        timestamp=payload.timestamp,
+        extra_data=payload.extra_data,
+        base_fee_per_gas=payload.base_fee_per_gas,
+        block_hash=payload.block_hash,
+        transactions_root=hash_tree_root(payload.transactions),
+        withdrawals_root=hash_tree_root(payload.withdrawals),
+    )
+
+
+def block_to_light_client_header(block: SignedBeaconBlock) -> LightClientHeader:
+    epoch = compute_epoch_at_slot(block.message.slot)
+
+    if epoch >= config.CAPELLA_FORK_EPOCH:
+        execution_header = get_lc_execution_payload_header(
+            block.message.body.execution_payload)
+        execution_branch = ExecutionBranch(
+            compute_merkle_proof(block.message.body,
+                                 EXECUTION_PAYLOAD_GINDEX))
+    else:
+        # Legacy data through upgrade_lc_header_to_capella carries no
+        # execution info even though bellatrix blocks have payloads
+        execution_header = ExecutionPayloadHeader()
+        execution_branch = ExecutionBranch()
+
+    return LightClientHeader(
+        beacon=BeaconBlockHeader(
+            slot=block.message.slot,
+            proposer_index=block.message.proposer_index,
+            parent_root=block.message.parent_root,
+            state_root=block.message.state_root,
+            body_root=hash_tree_root(block.message.body),
+        ),
+        execution=execution_header,
+        execution_branch=execution_branch,
+    )
+
+
+# -- fork.md upgrade functions ----------------------------------------------
+
+
+def upgrade_lc_header_to_capella(pre) -> LightClientHeader:
+    return LightClientHeader(beacon=pre.beacon)
+
+
+def upgrade_lc_bootstrap_to_capella(pre) -> LightClientBootstrap:
+    return LightClientBootstrap(
+        header=upgrade_lc_header_to_capella(pre.header),
+        current_sync_committee=pre.current_sync_committee,
+        current_sync_committee_branch=pre.current_sync_committee_branch,
+    )
+
+
+def upgrade_lc_update_to_capella(pre) -> LightClientUpdate:
+    return LightClientUpdate(
+        attested_header=upgrade_lc_header_to_capella(pre.attested_header),
+        next_sync_committee=pre.next_sync_committee,
+        next_sync_committee_branch=pre.next_sync_committee_branch,
+        finalized_header=upgrade_lc_header_to_capella(pre.finalized_header),
+        finality_branch=pre.finality_branch,
+        sync_aggregate=pre.sync_aggregate,
+        signature_slot=pre.signature_slot,
+    )
+
+
+def upgrade_lc_finality_update_to_capella(pre) -> LightClientFinalityUpdate:
+    return LightClientFinalityUpdate(
+        attested_header=upgrade_lc_header_to_capella(pre.attested_header),
+        finalized_header=upgrade_lc_header_to_capella(pre.finalized_header),
+        finality_branch=pre.finality_branch,
+        sync_aggregate=pre.sync_aggregate,
+        signature_slot=pre.signature_slot,
+    )
+
+
+def upgrade_lc_optimistic_update_to_capella(pre) -> LightClientOptimisticUpdate:
+    return LightClientOptimisticUpdate(
+        attested_header=upgrade_lc_header_to_capella(pre.attested_header),
+        sync_aggregate=pre.sync_aggregate,
+        signature_slot=pre.signature_slot,
+    )
+
+
+def upgrade_lc_store_to_capella(pre) -> LightClientStore:
+    if pre.best_valid_update is None:
+        best_valid_update = None
+    else:
+        best_valid_update = upgrade_lc_update_to_capella(
+            pre.best_valid_update)
+    return LightClientStore(
+        finalized_header=upgrade_lc_header_to_capella(pre.finalized_header),
+        current_sync_committee=pre.current_sync_committee,
+        next_sync_committee=pre.next_sync_committee,
+        best_valid_update=best_valid_update,
+        optimistic_header=upgrade_lc_header_to_capella(
+            pre.optimistic_header),
+        previous_max_active_participants=(
+            pre.previous_max_active_participants),
+        current_max_active_participants=pre.current_max_active_participants,
+    )

@@ -1,0 +1,31 @@
+# Capella -- Honest validator deltas (executable spec source).
+# Parity contract: specs/capella/validator.md (:41-114): GetPayloadResponse
+# gains block_value; prepare_execution_payload drops the merge-transition
+# branch and passes expected withdrawals in the payload attributes.
+
+
+@dataclass
+class GetPayloadResponse(object):
+    execution_payload: ExecutionPayload
+    block_value: uint256 = uint256(0)
+
+
+def prepare_execution_payload(state: BeaconState, safe_block_hash: Hash32,
+                              finalized_block_hash: Hash32,
+                              suggested_fee_recipient: ExecutionAddress,
+                              execution_engine: ExecutionEngine):
+    # [Modified in Capella] the merge is over: no transition branch
+    parent_hash = state.latest_execution_payload_header.block_hash
+
+    payload_attributes = PayloadAttributes(
+        timestamp=compute_time_at_slot(state, state.slot),
+        prev_randao=get_randao_mix(state, get_current_epoch(state)),
+        suggested_fee_recipient=suggested_fee_recipient,
+        withdrawals=get_expected_withdrawals(state),  # [New in Capella]
+    )
+    return execution_engine.notify_forkchoice_updated(
+        head_block_hash=parent_hash,
+        safe_block_hash=safe_block_hash,
+        finalized_block_hash=finalized_block_hash,
+        payload_attributes=payload_attributes,
+    )

@@ -1,0 +1,268 @@
+# Deneb -- Light Client (blob-gas fields in the execution header).
+#
+# Parity contract: specs/deneb/light-client/sync-protocol.md (modified
+# get_lc_execution_root / is_valid_light_client_header), full-node.md,
+# fork.md (upgrade functions).  The LightClientHeader layout is unchanged
+# from capella; only the embedded ExecutionPayloadHeader grows
+# blob_gas_used / excess_blob_gas, so capella-epoch headers must be
+# re-rooted against the capella field set.
+
+
+class _CapellaExecutionPayloadHeader(Container):
+    # The capella-era header shape, kept for re-rooting pre-deneb headers
+    # (the reference reaches into `capella.ExecutionPayloadHeader`;
+    # this build re-declares the shape in place).
+    parent_hash: Hash32
+    fee_recipient: ExecutionAddress
+    state_root: Bytes32
+    receipts_root: Bytes32
+    logs_bloom: ByteVector[BYTES_PER_LOGS_BLOOM]
+    prev_randao: Bytes32
+    block_number: uint64
+    gas_limit: uint64
+    gas_used: uint64
+    timestamp: uint64
+    extra_data: ByteList[MAX_EXTRA_DATA_BYTES]
+    base_fee_per_gas: uint256
+    block_hash: Hash32
+    transactions_root: Root
+    withdrawals_root: Root
+
+
+# Deneb's beacon chain redefines ExecutionPayloadHeader (blob-gas fields);
+# the LC containers bind field types at class creation, so re-declare them
+# against the new header shape (the reference's generated module rebuilds
+# every class per fork).
+
+
+class LightClientHeader(Container):
+    beacon: BeaconBlockHeader
+    execution: ExecutionPayloadHeader
+    execution_branch: ExecutionBranch
+
+
+class LightClientBootstrap(Container):
+    header: LightClientHeader
+    current_sync_committee: SyncCommittee
+    current_sync_committee_branch: CurrentSyncCommitteeBranch
+
+
+class LightClientUpdate(Container):
+    attested_header: LightClientHeader
+    next_sync_committee: SyncCommittee
+    next_sync_committee_branch: NextSyncCommitteeBranch
+    finalized_header: LightClientHeader
+    finality_branch: FinalityBranch
+    sync_aggregate: SyncAggregate
+    signature_slot: Slot
+
+
+class LightClientFinalityUpdate(Container):
+    attested_header: LightClientHeader
+    finalized_header: LightClientHeader
+    finality_branch: FinalityBranch
+    sync_aggregate: SyncAggregate
+    signature_slot: Slot
+
+
+class LightClientOptimisticUpdate(Container):
+    attested_header: LightClientHeader
+    sync_aggregate: SyncAggregate
+    signature_slot: Slot
+
+
+@dataclass
+class LightClientStore(object):
+    finalized_header: LightClientHeader
+    current_sync_committee: SyncCommittee
+    next_sync_committee: SyncCommittee
+    best_valid_update: Optional[LightClientUpdate]
+    optimistic_header: LightClientHeader
+    previous_max_active_participants: uint64
+    current_max_active_participants: uint64
+
+
+def get_lc_execution_root(header: LightClientHeader) -> Root:
+    epoch = compute_epoch_at_slot(header.beacon.slot)
+
+    # [New in Deneb]
+    if epoch >= config.DENEB_FORK_EPOCH:
+        return hash_tree_root(header.execution)
+
+    # [Modified in Deneb] capella-era headers root over the capella shape
+    if epoch >= config.CAPELLA_FORK_EPOCH:
+        execution_header = _CapellaExecutionPayloadHeader(
+            parent_hash=header.execution.parent_hash,
+            fee_recipient=header.execution.fee_recipient,
+            state_root=header.execution.state_root,
+            receipts_root=header.execution.receipts_root,
+            logs_bloom=header.execution.logs_bloom,
+            prev_randao=header.execution.prev_randao,
+            block_number=header.execution.block_number,
+            gas_limit=header.execution.gas_limit,
+            gas_used=header.execution.gas_used,
+            timestamp=header.execution.timestamp,
+            extra_data=header.execution.extra_data,
+            base_fee_per_gas=header.execution.base_fee_per_gas,
+            block_hash=header.execution.block_hash,
+            transactions_root=header.execution.transactions_root,
+            withdrawals_root=header.execution.withdrawals_root,
+        )
+        return hash_tree_root(execution_header)
+
+    return Root()
+
+
+def is_valid_light_client_header(header: LightClientHeader) -> bool:
+    epoch = compute_epoch_at_slot(header.beacon.slot)
+
+    # [New in Deneb:EIP4844] blob-gas fields must be zero before deneb
+    if epoch < config.DENEB_FORK_EPOCH:
+        if header.execution.blob_gas_used != uint64(0):
+            return False
+        if header.execution.excess_blob_gas != uint64(0):
+            return False
+
+    if epoch < config.CAPELLA_FORK_EPOCH:
+        return (header.execution == ExecutionPayloadHeader()
+                and header.execution_branch == ExecutionBranch())
+
+    return is_valid_merkle_branch(
+        leaf=get_lc_execution_root(header),
+        branch=header.execution_branch,
+        depth=floorlog2(EXECUTION_PAYLOAD_GINDEX),
+        index=get_subtree_index(EXECUTION_PAYLOAD_GINDEX),
+        root=header.beacon.body_root,
+    )
+
+
+def get_lc_execution_payload_header(payload) -> ExecutionPayloadHeader:
+    # [Modified in Deneb] carries the blob-gas fields
+    return ExecutionPayloadHeader(
+        parent_hash=payload.parent_hash,
+        fee_recipient=payload.fee_recipient,
+        state_root=payload.state_root,
+        receipts_root=payload.receipts_root,
+        logs_bloom=payload.logs_bloom,
+        prev_randao=payload.prev_randao,
+        block_number=payload.block_number,
+        gas_limit=payload.gas_limit,
+        gas_used=payload.gas_used,
+        timestamp=payload.timestamp,
+        extra_data=payload.extra_data,
+        base_fee_per_gas=payload.base_fee_per_gas,
+        block_hash=payload.block_hash,
+        transactions_root=hash_tree_root(payload.transactions),
+        withdrawals_root=hash_tree_root(payload.withdrawals),
+        blob_gas_used=payload.blob_gas_used,
+        excess_blob_gas=payload.excess_blob_gas,
+    )
+
+
+def block_to_light_client_header(block: SignedBeaconBlock) -> LightClientHeader:
+    epoch = compute_epoch_at_slot(block.message.slot)
+
+    if epoch >= config.CAPELLA_FORK_EPOCH:
+        execution_header = get_lc_execution_payload_header(
+            block.message.body.execution_payload)
+        execution_branch = ExecutionBranch(
+            compute_merkle_proof(block.message.body,
+                                 EXECUTION_PAYLOAD_GINDEX))
+    else:
+        execution_header = ExecutionPayloadHeader()
+        execution_branch = ExecutionBranch()
+
+    return LightClientHeader(
+        beacon=BeaconBlockHeader(
+            slot=block.message.slot,
+            proposer_index=block.message.proposer_index,
+            parent_root=block.message.parent_root,
+            state_root=block.message.state_root,
+            body_root=hash_tree_root(block.message.body),
+        ),
+        execution=execution_header,
+        execution_branch=execution_branch,
+    )
+
+
+# -- fork.md upgrade functions ----------------------------------------------
+
+
+def upgrade_lc_header_to_deneb(pre) -> LightClientHeader:
+    return LightClientHeader(
+        beacon=pre.beacon,
+        execution=ExecutionPayloadHeader(
+            parent_hash=pre.execution.parent_hash,
+            fee_recipient=pre.execution.fee_recipient,
+            state_root=pre.execution.state_root,
+            receipts_root=pre.execution.receipts_root,
+            logs_bloom=pre.execution.logs_bloom,
+            prev_randao=pre.execution.prev_randao,
+            block_number=pre.execution.block_number,
+            gas_limit=pre.execution.gas_limit,
+            gas_used=pre.execution.gas_used,
+            timestamp=pre.execution.timestamp,
+            extra_data=pre.execution.extra_data,
+            base_fee_per_gas=pre.execution.base_fee_per_gas,
+            block_hash=pre.execution.block_hash,
+            transactions_root=pre.execution.transactions_root,
+            withdrawals_root=pre.execution.withdrawals_root,
+            # blob_gas_used / excess_blob_gas default to zero
+        ),
+        execution_branch=pre.execution_branch,
+    )
+
+
+def upgrade_lc_bootstrap_to_deneb(pre) -> LightClientBootstrap:
+    return LightClientBootstrap(
+        header=upgrade_lc_header_to_deneb(pre.header),
+        current_sync_committee=pre.current_sync_committee,
+        current_sync_committee_branch=pre.current_sync_committee_branch,
+    )
+
+
+def upgrade_lc_update_to_deneb(pre) -> LightClientUpdate:
+    return LightClientUpdate(
+        attested_header=upgrade_lc_header_to_deneb(pre.attested_header),
+        next_sync_committee=pre.next_sync_committee,
+        next_sync_committee_branch=pre.next_sync_committee_branch,
+        finalized_header=upgrade_lc_header_to_deneb(pre.finalized_header),
+        finality_branch=pre.finality_branch,
+        sync_aggregate=pre.sync_aggregate,
+        signature_slot=pre.signature_slot,
+    )
+
+
+def upgrade_lc_finality_update_to_deneb(pre) -> LightClientFinalityUpdate:
+    return LightClientFinalityUpdate(
+        attested_header=upgrade_lc_header_to_deneb(pre.attested_header),
+        finalized_header=upgrade_lc_header_to_deneb(pre.finalized_header),
+        finality_branch=pre.finality_branch,
+        sync_aggregate=pre.sync_aggregate,
+        signature_slot=pre.signature_slot,
+    )
+
+
+def upgrade_lc_optimistic_update_to_deneb(pre) -> LightClientOptimisticUpdate:
+    return LightClientOptimisticUpdate(
+        attested_header=upgrade_lc_header_to_deneb(pre.attested_header),
+        sync_aggregate=pre.sync_aggregate,
+        signature_slot=pre.signature_slot,
+    )
+
+
+def upgrade_lc_store_to_deneb(pre) -> LightClientStore:
+    if pre.best_valid_update is None:
+        best_valid_update = None
+    else:
+        best_valid_update = upgrade_lc_update_to_deneb(pre.best_valid_update)
+    return LightClientStore(
+        finalized_header=upgrade_lc_header_to_deneb(pre.finalized_header),
+        current_sync_committee=pre.current_sync_committee,
+        next_sync_committee=pre.next_sync_committee,
+        best_valid_update=best_valid_update,
+        optimistic_header=upgrade_lc_header_to_deneb(pre.optimistic_header),
+        previous_max_active_participants=(
+            pre.previous_max_active_participants),
+        current_max_active_participants=pre.current_max_active_participants,
+    )

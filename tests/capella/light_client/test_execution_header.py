@@ -1,0 +1,70 @@
+"""Capella light-client execution-header commitment
+(spec: specs/capella/light-client/sync-protocol.md, full-node.md,
+fork.md)."""
+
+from consensus_specs_tpu.testlib.context import (
+    spec_state_test_with_matching_config,
+    with_phases,
+)
+from consensus_specs_tpu.testlib.helpers.block import (
+    build_empty_block_for_next_slot,
+)
+from consensus_specs_tpu.testlib.helpers.state import (
+    state_transition_and_sign_block,
+)
+
+
+@with_phases(["capella", "deneb", "electra", "fulu"])
+@spec_state_test_with_matching_config
+def test_block_to_light_client_header_has_valid_execution_branch(spec, state):
+    block = build_empty_block_for_next_slot(spec, state)
+    signed = state_transition_and_sign_block(spec, state, block)
+
+    header = spec.block_to_light_client_header(signed)
+    assert header.beacon.body_root == spec.hash_tree_root(
+        signed.message.body)
+    # the execution header commits to the payload, proven into body_root
+    assert header.execution.block_hash == \
+        signed.message.body.execution_payload.block_hash
+    assert spec.is_valid_light_client_header(header)
+
+    # tampering with the execution header breaks the branch
+    bad = header.copy()
+    bad.execution.gas_limit += 1
+    assert not spec.is_valid_light_client_header(bad)
+    yield None
+
+
+@with_phases(["capella", "deneb", "electra", "fulu"])
+@spec_state_test_with_matching_config
+def test_lc_execution_root_matches_header_root(spec, state):
+    block = build_empty_block_for_next_slot(spec, state)
+    signed = state_transition_and_sign_block(spec, state, block)
+    header = spec.block_to_light_client_header(signed)
+    assert (spec.get_lc_execution_root(header)
+            == spec.hash_tree_root(header.execution))
+    yield None
+
+
+@with_phases(["capella", "deneb", "electra", "fulu"])
+@spec_state_test_with_matching_config
+def test_upgrade_lc_header_from_altair_shape(spec, state):
+    """A pre-capella header (beacon only) upgrades with empty execution
+    data and stays valid for pre-capella epochs."""
+    from consensus_specs_tpu.models.builder import build_spec
+
+    altair_spec = build_spec("altair", spec.preset_name)
+    beacon = altair_spec.BeaconBlockHeader(
+        slot=5, proposer_index=1,
+        parent_root=altair_spec.Root(b"\x01" * 32),
+        state_root=altair_spec.Root(b"\x02" * 32),
+        body_root=altair_spec.Root(b"\x03" * 32))
+    pre = altair_spec.LightClientHeader(beacon=beacon)
+    upgraded = spec.upgrade_lc_header_to_capella(pre)
+    assert upgraded.beacon == pre.beacon
+    assert upgraded.execution.block_hash == spec.Hash32()
+    # under the matching config every fork is active from genesis, so this
+    # empty-execution header is *post*-capella and must fail the branch
+    # check: the validity gate actually bites
+    assert not spec.is_valid_light_client_header(upgraded)
+    yield None

@@ -1,0 +1,41 @@
+"""Deneb light-client deltas: blob-gas fields and capella re-rooting
+(spec: specs/deneb/light-client/sync-protocol.md)."""
+
+from consensus_specs_tpu.testlib.context import (
+    spec_state_test_with_matching_config,
+    with_phases,
+)
+from consensus_specs_tpu.testlib.helpers.block import (
+    build_empty_block_for_next_slot,
+)
+from consensus_specs_tpu.testlib.helpers.state import (
+    state_transition_and_sign_block,
+)
+
+
+@with_phases(["deneb", "electra", "fulu"])
+@spec_state_test_with_matching_config
+def test_pre_deneb_header_rejects_blob_gas(spec, state):
+    """A header dated before DENEB_FORK_EPOCH must carry zero blob-gas
+    fields; the capella-era root path is exercised via config override."""
+    from consensus_specs_tpu.models.builder import spec_with_config
+
+    # schedule deneb in the future so a current-slot header is capella-era
+    future = int(spec.compute_epoch_at_slot(state.slot)) + 1000
+    shifted = spec_with_config(spec, {"DENEB_FORK_EPOCH": future})
+
+    block = build_empty_block_for_next_slot(spec, state)
+    signed = state_transition_and_sign_block(spec, state, block)
+    header = shifted.block_to_light_client_header(
+        shifted.SignedBeaconBlock.decode_bytes(signed.encode_bytes()))
+
+    # capella-era root path: roots over the capella shape, not deneb's
+    cap_root = shifted.get_lc_execution_root(header)
+    assert cap_root != shifted.hash_tree_root(header.execution)
+    assert spec.is_valid_light_client_header is not None
+
+    # blob-gas gate: nonzero blob gas before deneb is invalid
+    bad = header.copy()
+    bad.execution.blob_gas_used = 1
+    assert not shifted.is_valid_light_client_header(bad)
+    yield None
