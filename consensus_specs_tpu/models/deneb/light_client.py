@@ -136,9 +136,9 @@ def is_valid_light_client_header(header: LightClientHeader) -> bool:
     )
 
 
-def get_lc_execution_payload_header(payload) -> ExecutionPayloadHeader:
-    # [Modified in Deneb] carries the blob-gas fields
-    return ExecutionPayloadHeader(
+def get_lc_execution_payload_header(payload,
+                                    epoch: Epoch) -> ExecutionPayloadHeader:
+    header = ExecutionPayloadHeader(
         parent_hash=payload.parent_hash,
         fee_recipient=payload.fee_recipient,
         state_root=payload.state_root,
@@ -154,9 +154,12 @@ def get_lc_execution_payload_header(payload) -> ExecutionPayloadHeader:
         block_hash=payload.block_hash,
         transactions_root=hash_tree_root(payload.transactions),
         withdrawals_root=hash_tree_root(payload.withdrawals),
-        blob_gas_used=payload.blob_gas_used,
-        excess_blob_gas=payload.excess_blob_gas,
     )
+    # [New in Deneb] capella-era payloads carry no blob-gas fields
+    if epoch >= config.DENEB_FORK_EPOCH:
+        header.blob_gas_used = payload.blob_gas_used
+        header.excess_blob_gas = payload.excess_blob_gas
+    return header
 
 
 def block_to_light_client_header(block: SignedBeaconBlock) -> LightClientHeader:
@@ -164,7 +167,7 @@ def block_to_light_client_header(block: SignedBeaconBlock) -> LightClientHeader:
 
     if epoch >= config.CAPELLA_FORK_EPOCH:
         execution_header = get_lc_execution_payload_header(
-            block.message.body.execution_payload)
+            block.message.body.execution_payload, epoch)
         execution_branch = ExecutionBranch(
             compute_merkle_proof(block.message.body,
                                  EXECUTION_PAYLOAD_GINDEX))

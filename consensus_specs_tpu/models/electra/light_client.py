@@ -79,3 +79,79 @@ def next_sync_committee_gindex_at_slot(slot: Slot):
     if epoch >= config.ELECTRA_FORK_EPOCH:
         return NEXT_SYNC_COMMITTEE_GINDEX_ELECTRA
     return NEXT_SYNC_COMMITTEE_GINDEX_ALTAIR
+
+
+# -- electra light-client fork.md upgrade functions --------------------------
+# Branches deepen with the 6-level electra state tree; pre-electra branches
+# are zero-padded at the front via normalize_merkle_branch.
+
+
+def upgrade_lc_header_to_electra(pre) -> LightClientHeader:
+    return LightClientHeader(
+        beacon=pre.beacon,
+        execution=pre.execution,
+        execution_branch=pre.execution_branch,
+    )
+
+
+def upgrade_lc_bootstrap_to_electra(pre) -> LightClientBootstrap:
+    return LightClientBootstrap(
+        header=upgrade_lc_header_to_electra(pre.header),
+        current_sync_committee=pre.current_sync_committee,
+        current_sync_committee_branch=normalize_merkle_branch(
+            pre.current_sync_committee_branch,
+            CURRENT_SYNC_COMMITTEE_GINDEX_ELECTRA),
+    )
+
+
+def upgrade_lc_update_to_electra(pre) -> LightClientUpdate:
+    return LightClientUpdate(
+        attested_header=upgrade_lc_header_to_electra(pre.attested_header),
+        next_sync_committee=pre.next_sync_committee,
+        next_sync_committee_branch=normalize_merkle_branch(
+            pre.next_sync_committee_branch,
+            NEXT_SYNC_COMMITTEE_GINDEX_ELECTRA),
+        finalized_header=upgrade_lc_header_to_electra(pre.finalized_header),
+        finality_branch=normalize_merkle_branch(
+            pre.finality_branch, FINALIZED_ROOT_GINDEX_ELECTRA),
+        sync_aggregate=pre.sync_aggregate,
+        signature_slot=pre.signature_slot,
+    )
+
+
+def upgrade_lc_finality_update_to_electra(pre) -> LightClientFinalityUpdate:
+    return LightClientFinalityUpdate(
+        attested_header=upgrade_lc_header_to_electra(pre.attested_header),
+        finalized_header=upgrade_lc_header_to_electra(pre.finalized_header),
+        finality_branch=normalize_merkle_branch(
+            pre.finality_branch, FINALIZED_ROOT_GINDEX_ELECTRA),
+        sync_aggregate=pre.sync_aggregate,
+        signature_slot=pre.signature_slot,
+    )
+
+
+def upgrade_lc_optimistic_update_to_electra(pre) -> LightClientOptimisticUpdate:
+    return LightClientOptimisticUpdate(
+        attested_header=upgrade_lc_header_to_electra(pre.attested_header),
+        sync_aggregate=pre.sync_aggregate,
+        signature_slot=pre.signature_slot,
+    )
+
+
+def upgrade_lc_store_to_electra(pre) -> LightClientStore:
+    if pre.best_valid_update is None:
+        best_valid_update = None
+    else:
+        best_valid_update = upgrade_lc_update_to_electra(
+            pre.best_valid_update)
+    return LightClientStore(
+        finalized_header=upgrade_lc_header_to_electra(pre.finalized_header),
+        current_sync_committee=pre.current_sync_committee,
+        next_sync_committee=pre.next_sync_committee,
+        best_valid_update=best_valid_update,
+        optimistic_header=upgrade_lc_header_to_electra(
+            pre.optimistic_header),
+        previous_max_active_participants=(
+            pre.previous_max_active_participants),
+        current_max_active_participants=pre.current_max_active_participants,
+    )

@@ -320,3 +320,96 @@ def dump_skipping_message(reason: str):
     import pytest
 
     pytest.skip(reason)
+
+
+# ---------------------------------------------------------------------------
+# fork-transition machinery (`test/context.py:773-860`)
+# ---------------------------------------------------------------------------
+
+import dataclasses  # noqa: E402
+
+
+@dataclasses.dataclass
+class ForkMeta:
+    pre_fork_name: str
+    post_fork_name: str
+    fork_epoch: int | None = None
+
+
+def with_fork_metas(fork_metas):
+    """Build a transition test: runs once per ForkMeta whose pre fork is
+    implemented, passing (state, fork_epoch, spec, post_spec, pre_tag,
+    post_tag); yields post_fork/fork_epoch/fork_block meta parts."""
+
+    def decorator(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, generator_mode=False, phase=None, preset=None,
+                    **kwargs):
+            implemented = _implemented_forks()
+            metas = [m for m in fork_metas
+                     if m.pre_fork_name in implemented
+                     and m.post_fork_name in implemented]
+            if DEFAULT_FORK_RESTRICTION is not None:
+                metas = [m for m in metas
+                         if m.pre_fork_name == DEFAULT_FORK_RESTRICTION]
+            if phase is not None:
+                metas = [m for m in metas if m.pre_fork_name == phase]
+            results = None
+            for meta in metas:
+                spec = build_spec(meta.pre_fork_name,
+                                  preset or DEFAULT_TEST_PRESET)
+                post_spec = build_spec(meta.post_fork_name,
+                                       preset or DEFAULT_TEST_PRESET)
+                inner = with_state()(_yield_fork_meta(meta, post_spec)(fn))
+                out = vector_test(inner)(
+                    *args, spec=spec, generator_mode=generator_mode,
+                    **kwargs)
+                if out is not None:  # accumulate parts across metas
+                    results = (results or []) + out
+            return results
+
+        # keep pytest from reading the wrapped signature as fixtures
+        if hasattr(wrapper, "__wrapped__"):
+            del wrapper.__wrapped__
+        return wrapper
+
+    return decorator
+
+
+def _yield_fork_meta(meta: ForkMeta, post_spec):
+    def decorator(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, spec, state, **kw):
+            pre_fork_counter = 0
+
+            def pre_tag(obj):
+                nonlocal pre_fork_counter
+                pre_fork_counter += 1
+                return obj
+
+            def post_tag(obj):
+                return obj
+
+            yield "post_fork", "meta", meta.post_fork_name
+
+            has_fork_epoch = False
+            if meta.fork_epoch is not None:
+                kw["fork_epoch"] = meta.fork_epoch
+                has_fork_epoch = True
+                yield "fork_epoch", "meta", int(meta.fork_epoch)
+
+            result = fn(*args, spec=spec, state=state, post_spec=post_spec,
+                        pre_tag=pre_tag, post_tag=post_tag, **kw)
+            if result is not None:
+                for part in result:
+                    if part[0] == "fork_epoch":
+                        has_fork_epoch = True
+                    yield part
+            assert has_fork_epoch
+
+            if pre_fork_counter > 0:
+                yield "fork_block", "meta", pre_fork_counter - 1
+
+        return wrapper
+
+    return decorator

@@ -3,6 +3,7 @@
 
 from __future__ import annotations
 
+from ...utils.ssz.ssz_impl import hash_tree_root
 from ..utils import expect_assertion_error
 from .block import build_empty_block_for_next_slot, get_parent_root
 from .keys import privkeys
@@ -250,11 +251,13 @@ def state_transition_with_full_block(spec, state, fill_cur_epoch,
 def prepare_state_with_attestations(spec, state, participation_fn=None):
     """Advance until previous-epoch attestations cover a full epoch
     (`helpers/attestations.py` `prepare_state_with_attestations`)."""
-    # advance some slots to leave the genesis edge
+    start_slot = state.slot
+    start_epoch = spec.get_current_epoch(state)
+    next_epoch_start_slot = spec.compute_start_slot_at_epoch(start_epoch + 1)
     attestations = []
     for _ in range(spec.SLOTS_PER_EPOCH + spec.MIN_ATTESTATION_INCLUSION_DELAY):
-        # create an attestation for each index in each slot in epoch
-        if state.slot < spec.SLOTS_PER_EPOCH:
+        # create an attestation for each index in each slot of this epoch
+        if state.slot < next_epoch_start_slot:
             for committee_index in range(
                     spec.get_committee_count_per_slot(
                         state, spec.get_current_epoch(state))):
@@ -264,7 +267,7 @@ def prepare_state_with_attestations(spec, state, participation_fn=None):
                     filter_participant_set=participation_fn)
                 attestations.append(attestation)
         # fill each created slot in state after inclusion delay
-        if state.slot >= spec.MIN_ATTESTATION_INCLUSION_DELAY:
+        if state.slot >= start_slot + spec.MIN_ATTESTATION_INCLUSION_DELAY:
             inclusion_slot = (state.slot
                               - spec.MIN_ATTESTATION_INCLUSION_DELAY)
             include_attestations = [
@@ -274,9 +277,31 @@ def prepare_state_with_attestations(spec, state, participation_fn=None):
                                       state.slot)
         next_slot(spec, state)
 
-    assert state.slot == (spec.SLOTS_PER_EPOCH
+    assert state.slot == (start_slot + spec.SLOTS_PER_EPOCH
                           + spec.MIN_ATTESTATION_INCLUSION_DELAY)
-    assert (len(state.previous_epoch_attestations)
-            == len(attestations))
+    if hasattr(state, "previous_epoch_attestations"):  # pre-altair record
+        assert (len(state.previous_epoch_attestations)
+                == len(attestations))
 
     return attestations
+
+
+_prepared_state_cache: dict = {}
+
+
+def cached_prepare_state_with_attestations(spec, state):
+    """Mutate `state` to the fully-attested shape, via a per-(fork, preset,
+    pre-root) cache — the epoch of block building behind
+    prepare_state_with_attestations dominates rewards-test runtime
+    (`helpers/attestations.py` `cached_prepare_state_with_attestations`)."""
+    key = (spec.fork, spec.preset_name, hash_tree_root(state))
+    if key not in _prepared_state_cache:
+        fresh = state.copy()
+        prepare_state_with_attestations(spec, fresh)
+        _prepared_state_cache[key] = fresh
+    # mutate the caller's state in place to match the cached shape
+    prepared = _prepared_state_cache[key]
+    data = prepared.encode_bytes()
+    restored = type(state).decode_bytes(data)
+    for name in type(state).fields():
+        setattr(state, name, getattr(restored, name))

@@ -150,3 +150,20 @@ def run_deposit_processing(spec, state, deposit, validator_index,
         assert (state.balances[validator_index]
                 == pre_balance + deposit.data.amount)
     assert state.eth1_deposit_index == state.eth1_data.deposit_count
+
+
+def mock_deposit(spec, state, index):
+    """Flip an active validator back to just-deposited (not yet eligible),
+    used by the randomized-state machinery (`helpers/deposits.py:18`)."""
+    from .forks import is_post_altair
+
+    assert spec.is_active_validator(state.validators[index],
+                                    spec.get_current_epoch(state))
+    state.validators[index].activation_eligibility_epoch = \
+        spec.FAR_FUTURE_EPOCH
+    state.validators[index].activation_epoch = spec.FAR_FUTURE_EPOCH
+    state.validators[index].effective_balance = spec.MAX_EFFECTIVE_BALANCE
+    if is_post_altair(spec):
+        state.inactivity_scores[index] = 0
+    assert not spec.is_active_validator(state.validators[index],
+                                        spec.get_current_epoch(state))

@@ -1,0 +1,117 @@
+"""Cross-fork transition driving: blocks up to a fork boundary, the
+irregular upgrade step, and block production under the post spec
+(the reference's `test/helpers/fork_transition.py:84-330`)."""
+
+from __future__ import annotations
+
+from ...models.builder import PREVIOUS_FORK_OF
+from .block import build_empty_block, build_empty_block_for_next_slot, \
+    sign_block
+from .state import next_slot, state_transition_and_sign_block, transition_to
+
+
+def _state_transition_and_sign_block_at_slot(spec, state,
+                                             sync_aggregate=None,
+                                             operation_dict=None):
+    """Produce the first block of an irregular transition: process_slots
+    already ran, so only process_block applies here."""
+    block = build_empty_block(spec, state)
+    if sync_aggregate is not None:
+        block.body.sync_aggregate = sync_aggregate
+    if operation_dict:
+        for key, value in operation_dict.items():
+            setattr(block.body, key, value)
+
+    assert state.latest_block_header.slot < block.slot
+    assert state.slot == block.slot
+    spec.process_block(state, block)
+    block.state_root = state.hash_tree_root()
+    return sign_block(spec, state, block)
+
+
+def _all_blocks(_):
+    return True
+
+
+def skip_slots(*slots):
+    """Make no block at the given slots."""
+    def f(state_at_prior_slot):
+        return state_at_prior_slot.slot + 1 not in slots
+    return f
+
+
+def no_blocks(_):
+    return False
+
+
+def only_at(slot):
+    """Make a block only at `slot`."""
+    def f(state_at_prior_slot):
+        return state_at_prior_slot.slot + 1 == slot
+    return f
+
+
+def state_transition_across_slots(spec, state, to_slot,
+                                  block_filter=_all_blocks):
+    assert state.slot < to_slot
+    while state.slot < to_slot:
+        if block_filter(state):
+            block = build_empty_block_for_next_slot(spec, state)
+            yield state_transition_and_sign_block(spec, state, block)
+        else:
+            next_slot(spec, state)
+
+
+def get_upgrade_fn(spec, fork: str):
+    fn = getattr(spec, f"upgrade_to_{fork}", None)
+    if fn is None:
+        raise ValueError(f"no upgrade function for fork {fork!r}")
+    return fn
+
+
+def do_fork(state, spec, post_spec, fork_epoch, with_block=True,
+            sync_aggregate=None, operation_dict=None):
+    """The irregular transition: advance one slot onto the fork boundary,
+    apply the upgrade function, verify the fork record, and (optionally)
+    produce the first post-fork block."""
+    spec.process_slots(state, state.slot + 1)
+
+    assert state.slot % spec.SLOTS_PER_EPOCH == 0
+    assert spec.get_current_epoch(state) == fork_epoch
+
+    state = get_upgrade_fn(post_spec, post_spec.fork)(state)
+
+    assert state.fork.epoch == fork_epoch
+
+    previous_fork = PREVIOUS_FORK_OF[post_spec.fork]
+    if previous_fork == "phase0":
+        previous_version = spec.config.GENESIS_FORK_VERSION
+    else:
+        previous_version = getattr(
+            post_spec.config, f"{previous_fork.upper()}_FORK_VERSION")
+    current_version = getattr(
+        post_spec.config, f"{post_spec.fork.upper()}_FORK_VERSION")
+
+    assert bytes(state.fork.previous_version) == bytes(previous_version)
+    assert bytes(state.fork.current_version) == bytes(current_version)
+
+    if with_block:
+        return state, _state_transition_and_sign_block_at_slot(
+            post_spec, state, sync_aggregate=sync_aggregate,
+            operation_dict=operation_dict)
+    return state, None
+
+
+def transition_until_fork(spec, state, fork_epoch):
+    """Advance to the last pre-fork slot."""
+    transition_to(spec, state, fork_epoch * spec.SLOTS_PER_EPOCH - 1)
+
+
+def transition_to_next_epoch_and_append_blocks(spec, state, post_tag, blocks,
+                                               only_last_block=False):
+    to_slot = spec.SLOTS_PER_EPOCH + state.slot
+    block_filter = only_at(to_slot) if only_last_block else _all_blocks
+    blocks.extend(
+        post_tag(block)
+        for block in state_transition_across_slots(
+            spec, state, to_slot, block_filter=block_filter))

@@ -49,3 +49,14 @@ def get_spec_for_fork_version(spec, fork_version):
         if getattr(spec.config, key, None) == fork_version:
             return fork
     raise ValueError(f"unknown fork version {fork_version!r}")
+
+
+def all_pre_post_forks():
+    """(pre, post) pairs of consecutive implemented forks."""
+    from ...models.builder import ALL_FORKS, PREVIOUS_FORK_OF
+
+    return [(PREVIOUS_FORK_OF[f], f) for f in ALL_FORKS
+            if PREVIOUS_FORK_OF[f] is not None]
+
+
+ALL_PRE_POST_FORKS = all_pre_post_forks()

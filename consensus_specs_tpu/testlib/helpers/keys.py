@@ -12,23 +12,36 @@ from ...ops.bls import ciphersuite as _cs
 
 _PUBKEY_CACHE: dict[int, bytes] = {}
 
+# the reference materializes 32*256 keypairs (`helpers/keys.py:3-6`);
+# negative indices must wrap over that pool like a real list's would
+KEY_COUNT = 32 * 256
+
 
 def privkey(index: int) -> int:
+    if index < 0:
+        index += KEY_COUNT
+    assert 0 <= index < KEY_COUNT, f"key index {index} out of pool"
     return index + 1
 
 
 class _Privkeys:
     def __getitem__(self, i):
         if isinstance(i, slice):
-            return [privkey(j) for j in range(*i.indices(1 << 20))]
+            return [privkey(j) for j in range(*i.indices(KEY_COUNT))]
         return privkey(int(i))
+
+    def __len__(self):
+        return KEY_COUNT
 
 
 class _Pubkeys:
     def __getitem__(self, i):
         if isinstance(i, slice):
-            return [pubkey(j) for j in range(*i.indices(1 << 20))]
+            return [pubkey(j) for j in range(*i.indices(KEY_COUNT))]
         return pubkey(int(i))
+
+    def __len__(self):
+        return KEY_COUNT
 
 
 def pubkey(index: int) -> bytes:

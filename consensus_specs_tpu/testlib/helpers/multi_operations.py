@@ -1,0 +1,167 @@
+"""Random multi-operation block building: every operation type mixed into
+one block (the reference's `test/helpers/multi_operations.py:22-364`).
+Feeds the `random` test category and the randomized-block scenarios."""
+
+from __future__ import annotations
+
+from random import Random
+
+from .attestations import get_valid_attestation
+from .attester_slashings import get_valid_attester_slashing_by_indices
+from .block import build_empty_block_for_next_slot
+from .deposits import build_deposit, deposit_from_context
+from .forks import is_post_electra
+from .keys import privkeys, pubkeys
+from .proposer_slashings import get_valid_proposer_slashing
+from .state import state_transition_and_sign_block
+from .voluntary_exits import prepare_signed_exits
+
+
+def get_max_attestations(spec):
+    if is_post_electra(spec):
+        return spec.MAX_ATTESTATIONS_ELECTRA
+    return spec.MAX_ATTESTATIONS
+
+
+def get_random_proposer_slashings(spec, state, rng):
+    num_slashings = rng.randrange(1, spec.MAX_PROPOSER_SLASHINGS)
+    active = list(spec.get_active_validator_indices(
+        state, spec.get_current_epoch(state)))
+    indices = [i for i in active if not state.validators[i].slashed]
+    return [
+        get_valid_proposer_slashing(
+            spec, state,
+            slashed_index=indices.pop(rng.randrange(len(indices))),
+            signed_1=True, signed_2=True)
+        for _ in range(num_slashings)
+    ]
+
+
+def get_random_attester_slashings(spec, state, rng, slashed_indices=()):
+    num_slashings = rng.randrange(1, spec.MAX_ATTESTER_SLASHINGS)
+    active = list(spec.get_active_validator_indices(
+        state, spec.get_current_epoch(state)))
+    indices = [i for i in active
+               if not state.validators[i].slashed
+               and i not in slashed_indices]
+    sample_upper_bound = 4
+    if len(indices) < num_slashings * sample_upper_bound - 1:
+        return []
+    slot_range = list(range(state.slot - spec.SLOTS_PER_HISTORICAL_ROOT + 1,
+                            state.slot))
+    return [
+        get_valid_attester_slashing_by_indices(
+            spec, state,
+            sorted(indices.pop(rng.randrange(len(indices)))
+                   for _ in range(rng.randrange(1, sample_upper_bound))),
+            slot=slot_range.pop(rng.randrange(len(slot_range))),
+            signed_1=True, signed_2=True)
+        for _ in range(num_slashings)
+    ]
+
+
+def get_random_attestations(spec, state, rng):
+    num_attestations = rng.randrange(1, get_max_attestations(spec))
+    return [
+        get_valid_attestation(
+            spec, state,
+            slot=rng.randrange(state.slot - spec.SLOTS_PER_EPOCH + 1,
+                               state.slot),
+            signed=True)
+        for _ in range(num_attestations)
+    ]
+
+
+def get_random_deposits(spec, state, rng, num_deposits=None):
+    if num_deposits is None:
+        num_deposits = rng.randrange(1, spec.MAX_DEPOSITS)
+    if num_deposits == 0:
+        return [], b"\x00" * 32
+
+    deposit_data_leaves = [spec.DepositData()
+                           for _ in range(len(state.validators))]
+    root = None
+    for i in range(num_deposits):
+        index = len(state.validators) + i
+        withdrawal_pubkey = pubkeys[-1 - index]
+        withdrawal_credentials = (bytes(spec.BLS_WITHDRAWAL_PREFIX)
+                                  + spec.hash(withdrawal_pubkey)[1:])
+        _, root, deposit_data_leaves = build_deposit(
+            spec, deposit_data_leaves, pubkeys[index], privkeys[index],
+            spec.MAX_EFFECTIVE_BALANCE,
+            withdrawal_credentials=withdrawal_credentials, signed=True)
+
+    deposits = []
+    for i in range(num_deposits):
+        index = len(state.validators) + i
+        deposit, _, _ = deposit_from_context(spec, deposit_data_leaves,
+                                             index)
+        deposits.append(deposit)
+    return deposits, root
+
+
+def prepare_state_and_get_random_deposits(spec, state, rng,
+                                          num_deposits=None):
+    deposits, root = get_random_deposits(spec, state, rng,
+                                         num_deposits=num_deposits)
+    state.eth1_data.deposit_root = root
+    state.eth1_data.deposit_count += len(deposits)
+    return deposits
+
+
+def _eligible_for_exit(spec, state, index):
+    validator = state.validators[index]
+    current_epoch = spec.get_current_epoch(state)
+    return (not validator.slashed
+            and current_epoch >= (validator.activation_epoch
+                                  + spec.config.SHARD_COMMITTEE_PERIOD)
+            and validator.exit_epoch == spec.FAR_FUTURE_EPOCH)
+
+
+def get_random_voluntary_exits(spec, state, to_be_slashed_indices, rng):
+    num_exits = rng.randrange(1, spec.MAX_VOLUNTARY_EXITS)
+    active = set(spec.get_active_validator_indices(
+        state, spec.get_current_epoch(state)))
+    eligible = set(i for i in active if _eligible_for_exit(spec, state, i))
+    eligible -= set(to_be_slashed_indices)
+    exit_indices = [eligible.pop()
+                    for _ in range(min(num_exits, len(eligible)))]
+    return prepare_signed_exits(spec, state, exit_indices)
+
+
+def build_random_block_from_state_for_next_slot(spec, state, rng=None,
+                                                deposits=None):
+    rng = rng or Random(2188)
+    block = build_empty_block_for_next_slot(spec, state)
+    proposer_slashings = get_random_proposer_slashings(spec, state, rng)
+    block.body.proposer_slashings = proposer_slashings
+    slashed_indices = [s.signed_header_1.message.proposer_index
+                       for s in proposer_slashings]
+    block.body.attester_slashings = get_random_attester_slashings(
+        spec, state, rng, slashed_indices)
+    block.body.attestations = get_random_attestations(spec, state, rng)
+    if deposits:
+        block.body.deposits = deposits
+
+    slashed = set(s.signed_header_1.message.proposer_index
+                  for s in block.body.proposer_slashings)
+    for attester_slashing in block.body.attester_slashings:
+        slashed |= set(attester_slashing.attestation_1.attesting_indices)
+        slashed |= set(attester_slashing.attestation_2.attesting_indices)
+    block.body.voluntary_exits = get_random_voluntary_exits(
+        spec, state, slashed, rng)
+    return block
+
+
+def run_test_full_random_operations(spec, state, rng=None):
+    rng = rng or Random(2080)
+    # age the registry so validators are eligible to exit
+    state.slot += spec.config.SHARD_COMMITTEE_PERIOD * spec.SLOTS_PER_EPOCH
+
+    deposits = prepare_state_and_get_random_deposits(spec, state, rng)
+    block = build_random_block_from_state_for_next_slot(spec, state, rng,
+                                                        deposits=deposits)
+    yield "pre", state
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    yield "blocks", [signed_block]
+    yield "post", state

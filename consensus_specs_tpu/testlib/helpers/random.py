@@ -1,0 +1,192 @@
+"""Randomized-state mutators: validator status churn + participation noise
+(the reference's `test/helpers/random.py:9-212`).  These feed the rewards
+suites and the randomized block scenarios."""
+
+from __future__ import annotations
+
+from random import Random
+
+from .attestations import cached_prepare_state_with_attestations
+from .deposits import mock_deposit
+from .forks import is_post_altair
+from .state import next_epoch
+
+
+def set_some_activations(spec, state, rng, activation_epoch=None):
+    if activation_epoch is None:
+        activation_epoch = spec.get_current_epoch(state)
+    num_validators = len(state.validators)
+    selected = []
+    for index in range(num_validators):
+        v = state.validators[index]
+        if v.slashed or v.exit_epoch != spec.FAR_FUTURE_EPOCH:
+            continue
+        # ~1/10 get a pending activation
+        if rng.randrange(num_validators) < num_validators // 10:
+            v.activation_eligibility_epoch = max(
+                int(activation_epoch) - int(spec.MAX_SEED_LOOKAHEAD) - 1,
+                int(spec.GENESIS_EPOCH))
+            v.activation_epoch = activation_epoch
+            selected.append(index)
+    return selected
+
+
+def set_some_new_deposits(spec, state, rng):
+    deposited = []
+    num_validators = len(state.validators)
+    for index in range(num_validators):
+        if not spec.is_active_validator(state.validators[index],
+                                        spec.get_current_epoch(state)):
+            continue
+        # ~1/10 look recently deposited
+        if rng.randrange(num_validators) < num_validators // 10:
+            mock_deposit(spec, state, index)
+            if rng.choice([True, False]):
+                state.validators[index].activation_eligibility_epoch = \
+                    spec.get_current_epoch(state)
+            else:
+                deposited.append(index)
+    return deposited
+
+
+def exit_random_validators(spec, state, rng, fraction=0.5, exit_epoch=None,
+                           withdrawable_epoch=None, from_epoch=None):
+    """Exit ~fraction of active validators; with no explicit epochs, exit
+    times scatter over the recent past and half become withdrawable."""
+    if from_epoch is None:
+        from_epoch = spec.MAX_SEED_LOOKAHEAD + 1
+    for _ in range(int(from_epoch) - int(spec.get_current_epoch(state))):
+        next_epoch(spec, state)
+
+    current_epoch = spec.get_current_epoch(state)
+    exited = []
+    for index in spec.get_active_validator_indices(state, current_epoch):
+        if rng.random() >= fraction:
+            continue
+        exited.append(index)
+        validator = state.validators[index]
+        if exit_epoch is None:
+            assert withdrawable_epoch is None
+            validator.exit_epoch = rng.choice(
+                [current_epoch, current_epoch - 1,
+                 current_epoch - 2, current_epoch - 3])
+            if rng.choice([True, False]):
+                validator.withdrawable_epoch = current_epoch
+            else:
+                validator.withdrawable_epoch = current_epoch + 1
+        else:
+            validator.exit_epoch = exit_epoch
+            if withdrawable_epoch is None:
+                validator.withdrawable_epoch = (
+                    validator.exit_epoch
+                    + spec.config.MIN_VALIDATOR_WITHDRAWABILITY_DELAY)
+            else:
+                validator.withdrawable_epoch = withdrawable_epoch
+    return exited
+
+
+def slash_random_validators(spec, state, rng, fraction=0.5):
+    slashed = []
+    for index in range(len(state.validators)):
+        # always slash at least index 0
+        if index == 0 or rng.random() < fraction:
+            spec.slash_validator(state, index)
+            slashed.append(index)
+    return slashed
+
+
+def randomize_epoch_participation(spec, state, epoch, rng):
+    assert epoch in (spec.get_current_epoch(state),
+                     spec.get_previous_epoch(state))
+    if not is_post_altair(spec):
+        if epoch == spec.get_current_epoch(state):
+            pending_attestations = state.current_epoch_attestations
+        else:
+            pending_attestations = state.previous_epoch_attestations
+        for pending in pending_attestations:
+            if rng.randint(0, 2) == 0:  # ~1/3 bad target
+                pending.data.target.root = b"\x55" * 32
+            if rng.randint(0, 2) == 0:  # ~1/3 bad head
+                pending.data.beacon_block_root = b"\x66" * 32
+            pending.aggregation_bits = type(pending.aggregation_bits)(
+                [rng.choice([True, False])
+                 for _ in pending.aggregation_bits])
+            pending.inclusion_delay = rng.randint(1, spec.SLOTS_PER_EPOCH)
+    else:
+        if epoch == spec.get_current_epoch(state):
+            participation = state.current_epoch_participation
+        else:
+            participation = state.previous_epoch_participation
+        for index in range(len(state.validators)):
+            flags = participation[index]
+
+            def set_flag(i, value):
+                nonlocal flags
+                flag = spec.ParticipationFlags(2**i)
+                if value:
+                    flags |= flag
+                else:
+                    flags &= 0xFF ^ flag
+
+            # timely head implies timely source+target
+            is_timely_correct_head = rng.randint(0, 2) != 0
+            set_flag(spec.TIMELY_HEAD_FLAG_INDEX, is_timely_correct_head)
+            if is_timely_correct_head:
+                set_flag(spec.TIMELY_TARGET_FLAG_INDEX, True)
+                set_flag(spec.TIMELY_SOURCE_FLAG_INDEX, True)
+            else:
+                set_flag(spec.TIMELY_TARGET_FLAG_INDEX,
+                         rng.choice([True, False]))
+                set_flag(spec.TIMELY_SOURCE_FLAG_INDEX,
+                         rng.choice([True, False]))
+            participation[index] = flags
+
+
+def randomize_previous_epoch_participation(spec, state, rng=None):
+    rng = rng or Random(8020)
+    cached_prepare_state_with_attestations(spec, state)
+    randomize_epoch_participation(spec, state,
+                                  spec.get_previous_epoch(state), rng)
+    if not is_post_altair(spec):
+        state.current_epoch_attestations = []
+    else:
+        state.current_epoch_participation = [
+            spec.ParticipationFlags(0) for _ in range(len(state.validators))]
+
+
+def randomize_attestation_participation(spec, state, rng=None):
+    rng = rng or Random(8020)
+    cached_prepare_state_with_attestations(spec, state)
+    randomize_epoch_participation(spec, state,
+                                  spec.get_previous_epoch(state), rng)
+    randomize_epoch_participation(spec, state,
+                                  spec.get_current_epoch(state), rng)
+
+
+def randomize_state(spec, state, rng=None, exit_fraction=0.5,
+                    slash_fraction=0.5):
+    rng = rng or Random(8020)
+    set_some_new_deposits(spec, state, rng)
+    exit_random_validators(spec, state, rng, fraction=exit_fraction)
+    slash_random_validators(spec, state, rng, fraction=slash_fraction)
+    randomize_attestation_participation(spec, state, rng)
+
+
+def patch_state_to_non_leaking(spec, state):
+    """Rewrite justification so a (possibly randomized) state is not in an
+    inactivity leak: justified = previous epoch, finalized = the epoch
+    before it."""
+    state.justification_bits[0] = True
+    state.justification_bits[1] = True
+    previous_epoch = spec.get_previous_epoch(state)
+    previous_root = spec.get_block_root(state, previous_epoch)
+    previous_previous_epoch = max(spec.GENESIS_EPOCH,
+                                  spec.Epoch(previous_epoch - 1))
+    previous_previous_root = spec.get_block_root(state,
+                                                 previous_previous_epoch)
+    state.previous_justified_checkpoint = spec.Checkpoint(
+        epoch=previous_previous_epoch, root=previous_previous_root)
+    state.current_justified_checkpoint = spec.Checkpoint(
+        epoch=previous_epoch, root=previous_root)
+    state.finalized_checkpoint = spec.Checkpoint(
+        epoch=previous_previous_epoch, root=previous_previous_root)

@@ -1,0 +1,418 @@
+"""Rewards-deltas harness: run every per-component delta function and
+check each validator's reward/penalty against its participation and
+eligibility (the reference's `test/helpers/rewards.py:27-545`).  The same
+scenario runners feed pytest assertions and the rewards vector suite.
+
+No `from __future__ import annotations` here: the Deltas container's field
+annotations must stay live types for the SSZ engine's fields()."""
+
+from random import Random
+
+from ...utils.ssz.types import Container, List, uint64
+from .attestations import cached_prepare_state_with_attestations
+from .forks import is_post_altair, is_post_bellatrix
+from .random import (
+    exit_random_validators,
+    randomize_state,
+    set_some_new_deposits,
+    slash_random_validators,
+)
+from .state import next_epoch
+
+VALIDATOR_REGISTRY_LIMIT = 2**40
+
+
+class Deltas(Container):
+    rewards: List[uint64, VALIDATOR_REGISTRY_LIMIT]
+    penalties: List[uint64, VALIDATOR_REGISTRY_LIMIT]
+
+
+def get_inactivity_penalty_quotient(spec):
+    if is_post_bellatrix(spec):
+        return spec.INACTIVITY_PENALTY_QUOTIENT_BELLATRIX
+    if is_post_altair(spec):
+        return spec.INACTIVITY_PENALTY_QUOTIENT_ALTAIR
+    return spec.INACTIVITY_PENALTY_QUOTIENT
+
+
+def has_enough_for_reward(spec, state, index):
+    """Zero-balance edge: positive effective balance can still round the
+    base reward down to zero."""
+    return (
+        state.validators[index].effective_balance * spec.BASE_REWARD_FACTOR
+        > spec.integer_squareroot(spec.get_total_active_balance(state))
+        // spec.BASE_REWARDS_PER_EPOCH
+    )
+
+
+def has_enough_for_leak_penalty(spec, state, index):
+    if is_post_altair(spec):
+        return (state.validators[index].effective_balance
+                * state.inactivity_scores[index]
+                > spec.config.INACTIVITY_SCORE_BIAS
+                * get_inactivity_penalty_quotient(spec))
+    return (state.validators[index].effective_balance
+            * spec.get_finality_delay(state)
+            > spec.INACTIVITY_PENALTY_QUOTIENT)
+
+
+def deltas_name_to_flag_index(spec, deltas_name):
+    if "source" in deltas_name:
+        return spec.TIMELY_SOURCE_FLAG_INDEX
+    if "head" in deltas_name:
+        return spec.TIMELY_HEAD_FLAG_INDEX
+    if "target" in deltas_name:
+        return spec.TIMELY_TARGET_FLAG_INDEX
+    raise ValueError(f"unknown deltas name {deltas_name}")
+
+
+def run_deltas(spec, state):
+    """Yield pre + one Deltas part per reward component, asserting every
+    validator's deltas along the way."""
+    yield "pre", state
+
+    if is_post_altair(spec):
+        def get_source_deltas(state):
+            return spec.get_flag_index_deltas(
+                state, spec.TIMELY_SOURCE_FLAG_INDEX)
+
+        def get_target_deltas(state):
+            return spec.get_flag_index_deltas(
+                state, spec.TIMELY_TARGET_FLAG_INDEX)
+
+        def get_head_deltas(state):
+            return spec.get_flag_index_deltas(
+                state, spec.TIMELY_HEAD_FLAG_INDEX)
+    else:
+        get_source_deltas = spec.get_source_deltas
+        get_target_deltas = spec.get_target_deltas
+        get_head_deltas = spec.get_head_deltas
+
+    yield from run_attestation_component_deltas(
+        spec, state, get_source_deltas,
+        spec.get_matching_source_attestations, "source_deltas")
+    yield from run_attestation_component_deltas(
+        spec, state, get_target_deltas,
+        spec.get_matching_target_attestations, "target_deltas")
+    yield from run_attestation_component_deltas(
+        spec, state, get_head_deltas,
+        spec.get_matching_head_attestations, "head_deltas")
+    if not is_post_altair(spec):
+        yield from run_get_inclusion_delay_deltas(spec, state)
+    yield from run_get_inactivity_penalty_deltas(spec, state)
+
+
+def run_attestation_component_deltas(spec, state, component_delta_fn,
+                                     matching_att_fn, deltas_name):
+    rewards, penalties = component_delta_fn(state)
+    yield deltas_name, Deltas(rewards=rewards, penalties=penalties)
+
+    if is_post_altair(spec):
+        matching_indices = spec.get_unslashed_participating_indices(
+            state, deltas_name_to_flag_index(spec, deltas_name),
+            spec.get_previous_epoch(state))
+    else:
+        matching_attestations = matching_att_fn(
+            state, spec.get_previous_epoch(state))
+        matching_indices = spec.get_unslashed_attesting_indices(
+            state, matching_attestations)
+
+    eligible_indices = spec.get_eligible_validator_indices(state)
+    for index in range(len(state.validators)):
+        if index not in eligible_indices:
+            assert rewards[index] == 0
+            assert penalties[index] == 0
+            continue
+
+        validator = state.validators[index]
+        enough_for_reward = has_enough_for_reward(spec, state, index)
+        if index in matching_indices and not validator.slashed:
+            if is_post_altair(spec):
+                if (not spec.is_in_inactivity_leak(state)
+                        and enough_for_reward):
+                    assert rewards[index] > 0
+                else:
+                    assert rewards[index] == 0
+            elif enough_for_reward:
+                assert rewards[index] > 0
+            else:
+                assert rewards[index] == 0
+            assert penalties[index] == 0
+        else:
+            assert rewards[index] == 0
+            if is_post_altair(spec) and "head" in deltas_name:
+                assert penalties[index] == 0  # no head penalty post-altair
+            elif enough_for_reward:
+                assert penalties[index] > 0
+            else:
+                assert penalties[index] == 0
+
+
+def run_get_inclusion_delay_deltas(spec, state):
+    if is_post_altair(spec):
+        yield ("inclusion_delay_deltas",
+               Deltas(rewards=[0] * len(state.validators),
+                      penalties=[0] * len(state.validators)))
+        return
+
+    rewards, penalties = spec.get_inclusion_delay_deltas(state)
+    yield ("inclusion_delay_deltas",
+           Deltas(rewards=rewards, penalties=penalties))
+
+    eligible_attestations = spec.get_matching_source_attestations(
+        state, spec.get_previous_epoch(state))
+    attesting_indices = spec.get_unslashed_attesting_indices(
+        state, eligible_attestations)
+
+    rewarded_indices = set()
+    rewarded_proposer_indices = set()
+    for index in range(len(state.validators)):
+        if (index in attesting_indices
+                and has_enough_for_reward(spec, state, index)):
+            assert rewards[index] > 0
+            rewarded_indices.add(index)
+            # earliest inclusion's proposer earns the proposer cut
+            earliest = min(
+                (a for a in eligible_attestations
+                 if index in spec.get_attesting_indices(state, a)),
+                key=lambda a: a.inclusion_delay)
+            rewarded_proposer_indices.add(earliest.proposer_index)
+
+    for index in (a.proposer_index for a in eligible_attestations):
+        if index in rewarded_proposer_indices:
+            assert rewards[index] > 0
+            rewarded_indices.add(index)
+
+    for index in range(len(state.validators)):
+        assert penalties[index] == 0
+        if index not in rewarded_indices:
+            assert rewards[index] == 0
+
+
+def run_get_inactivity_penalty_deltas(spec, state):
+    rewards, penalties = spec.get_inactivity_penalty_deltas(state)
+    yield ("inactivity_penalty_deltas",
+           Deltas(rewards=rewards, penalties=penalties))
+
+    if is_post_altair(spec):
+        matching_attesting_indices = \
+            spec.get_unslashed_participating_indices(
+                state, spec.TIMELY_TARGET_FLAG_INDEX,
+                spec.get_previous_epoch(state))
+    else:
+        matching_attestations = spec.get_matching_target_attestations(
+            state, spec.get_previous_epoch(state))
+        matching_attesting_indices = spec.get_unslashed_attesting_indices(
+            state, matching_attestations)
+
+    eligible_indices = spec.get_eligible_validator_indices(state)
+    for index in range(len(state.validators)):
+        assert rewards[index] == 0
+        if index not in eligible_indices:
+            assert penalties[index] == 0
+            continue
+
+        if spec.is_in_inactivity_leak(state):
+            if not is_post_altair(spec):
+                base_reward = spec.get_base_reward(state, index)
+                base_penalty = (spec.BASE_REWARDS_PER_EPOCH * base_reward
+                                - spec.get_proposer_reward(state, index))
+            if not has_enough_for_reward(spec, state, index):
+                assert penalties[index] == 0
+            elif (index in matching_attesting_indices
+                  or not has_enough_for_leak_penalty(spec, state, index)):
+                if is_post_altair(spec):
+                    assert penalties[index] == 0
+                else:
+                    assert penalties[index] == base_penalty
+            elif is_post_altair(spec):
+                assert penalties[index] > 0
+            else:
+                assert penalties[index] > base_penalty
+        elif not is_post_altair(spec):
+            assert penalties[index] == 0
+        # post-altair the penalty tracks the inactivity score, leak or not
+        elif index in matching_attesting_indices:
+            assert penalties[index] == 0
+        else:
+            penalty_numerator = (state.validators[index].effective_balance
+                                 * state.inactivity_scores[index])
+            penalty_denominator = (spec.config.INACTIVITY_SCORE_BIAS
+                                   * get_inactivity_penalty_quotient(spec))
+            assert penalties[index] == \
+                penalty_numerator // penalty_denominator
+
+
+def transition_state_to_leak(spec, state, epochs=None):
+    if epochs is None:
+        epochs = spec.MIN_EPOCHS_TO_INACTIVITY_PENALTY + 2
+    assert epochs > spec.MIN_EPOCHS_TO_INACTIVITY_PENALTY
+    for _ in range(epochs):
+        next_epoch(spec, state)
+    assert spec.is_in_inactivity_leak(state)
+
+
+_leak_cache: dict = {}
+
+
+def leaking(epochs=None):
+    """Decorator: hand the test a leaked version of its state (cached per
+    pre-state root)."""
+    def deco(fn):
+        def entry(*args, spec, state, **kw):
+            key = (state.hash_tree_root(),
+                   spec.MIN_EPOCHS_TO_INACTIVITY_PENALTY,
+                   spec.SLOTS_PER_EPOCH, epochs)
+            if key not in _leak_cache:
+                leaked = state.copy()
+                transition_state_to_leak(spec, leaked, epochs=epochs)
+                _leak_cache[key] = leaked
+            return fn(*args, spec=spec, state=_leak_cache[key].copy(), **kw)
+        return entry
+    return deco
+
+
+# -- scenario runners --------------------------------------------------------
+
+
+def run_test_empty(spec, state):
+    yield from run_deltas(spec, state)
+
+
+def run_test_full_all_correct(spec, state):
+    cached_prepare_state_with_attestations(spec, state)
+    yield from run_deltas(spec, state)
+
+
+def run_test_full_but_partial_participation(spec, state, rng=None):
+    rng = rng or Random(5522)
+    cached_prepare_state_with_attestations(spec, state)
+    if not is_post_altair(spec):
+        for a in state.previous_epoch_attestations:
+            a.aggregation_bits = type(a.aggregation_bits)(
+                [rng.choice([True, False]) for _ in a.aggregation_bits])
+    else:
+        for index in range(len(state.validators)):
+            if rng.choice([True, False]):
+                state.previous_epoch_participation[index] = \
+                    spec.ParticipationFlags(0)
+    yield from run_deltas(spec, state)
+
+
+def run_test_partial(spec, state, fraction_filled):
+    cached_prepare_state_with_attestations(spec, state)
+    if not is_post_altair(spec):
+        num_attestations = int(len(state.previous_epoch_attestations)
+                               * fraction_filled)
+        state.previous_epoch_attestations = \
+            state.previous_epoch_attestations[:num_attestations]
+    else:
+        for index in range(int(len(state.validators) * fraction_filled)):
+            state.previous_epoch_participation[index] = \
+                spec.ParticipationFlags(0)
+    yield from run_deltas(spec, state)
+
+
+def run_test_half_full(spec, state):
+    yield from run_test_partial(spec, state, 0.5)
+
+
+def run_test_one_attestation_one_correct(spec, state):
+    cached_prepare_state_with_attestations(spec, state)
+    state.previous_epoch_attestations = \
+        state.previous_epoch_attestations[:1]
+    yield from run_deltas(spec, state)
+
+
+def run_test_with_not_yet_activated_validators(spec, state, rng=None):
+    rng = rng or Random(5555)
+    set_some_new_deposits(spec, state, rng)
+    cached_prepare_state_with_attestations(spec, state)
+    yield from run_deltas(spec, state)
+
+
+def run_test_with_exited_validators(spec, state, rng=None):
+    rng = rng or Random(1337)
+    exit_random_validators(spec, state, rng)
+    cached_prepare_state_with_attestations(spec, state)
+    yield from run_deltas(spec, state)
+
+
+def run_test_with_slashed_validators(spec, state, rng=None):
+    rng = rng or Random(3322)
+    exit_random_validators(spec, state, rng)
+    slash_random_validators(spec, state, rng)
+    cached_prepare_state_with_attestations(spec, state)
+    yield from run_deltas(spec, state)
+
+
+def run_test_some_very_low_effective_balances_that_attested(spec, state):
+    cached_prepare_state_with_attestations(spec, state)
+    assert len(state.validators) >= 5
+    for i, index in enumerate(range(5)):
+        state.validators[index].effective_balance = i
+    yield from run_deltas(spec, state)
+
+
+def run_test_some_very_low_effective_balances_that_did_not_attest(
+        spec, state):
+    cached_prepare_state_with_attestations(spec, state)
+    if not is_post_altair(spec):
+        attestation = state.previous_epoch_attestations[0]
+        state.previous_epoch_attestations = \
+            state.previous_epoch_attestations[1:]
+        indices = spec.get_unslashed_attesting_indices(state, [attestation])
+        for i, index in enumerate(indices):
+            state.validators[index].effective_balance = i
+    else:
+        state.validators[0].effective_balance = 1
+        state.previous_epoch_participation[0] = spec.ParticipationFlags(0)
+    yield from run_deltas(spec, state)
+
+
+def run_test_full_fraction_incorrect(spec, state, correct_target,
+                                     correct_head, fraction_incorrect):
+    cached_prepare_state_with_attestations(spec, state)
+    num_incorrect = int(fraction_incorrect
+                        * len(state.previous_epoch_attestations))
+    for pending in state.previous_epoch_attestations[:num_incorrect]:
+        if not correct_target:
+            pending.data.target.root = b"\x55" * 32
+        if not correct_head:
+            pending.data.beacon_block_root = b"\x66" * 32
+    yield from run_deltas(spec, state)
+
+
+def run_test_full_delay_one_slot(spec, state):
+    cached_prepare_state_with_attestations(spec, state)
+    for a in state.previous_epoch_attestations:
+        a.inclusion_delay += 1
+    yield from run_deltas(spec, state)
+
+
+def run_test_full_delay_max_slots(spec, state):
+    cached_prepare_state_with_attestations(spec, state)
+    for a in state.previous_epoch_attestations:
+        a.inclusion_delay += spec.SLOTS_PER_EPOCH
+    yield from run_deltas(spec, state)
+
+
+def run_test_full_mixed_delay(spec, state, rng=None):
+    rng = rng or Random(1234)
+    cached_prepare_state_with_attestations(spec, state)
+    for a in state.previous_epoch_attestations:
+        a.inclusion_delay = rng.randint(1, spec.SLOTS_PER_EPOCH)
+    yield from run_deltas(spec, state)
+
+
+def run_test_all_balances_too_low_for_reward(spec, state):
+    cached_prepare_state_with_attestations(spec, state)
+    for index in range(len(state.validators)):
+        state.validators[index].effective_balance = 10
+    yield from run_deltas(spec, state)
+
+
+def run_test_full_random(spec, state, rng=None):
+    rng = rng or Random(8020)
+    randomize_state(spec, state, rng)
+    yield from run_deltas(spec, state)

@@ -1,0 +1,134 @@
+"""Fork-transition scenarios across every consecutive fork pair
+(reference: test/altair/transition/test_transition.py)."""
+
+from consensus_specs_tpu.testlib.context import ForkMeta, with_fork_metas
+from consensus_specs_tpu.testlib.helpers.forks import ALL_PRE_POST_FORKS
+from consensus_specs_tpu.testlib.helpers.fork_transition import (
+    do_fork,
+    no_blocks,
+    only_at,
+    skip_slots,
+    state_transition_across_slots,
+    transition_to_next_epoch_and_append_blocks,
+    transition_until_fork,
+)
+
+FORK_METAS = [ForkMeta(pre_fork_name=pre, post_fork_name=post, fork_epoch=2)
+              for pre, post in ALL_PRE_POST_FORKS]
+
+
+@with_fork_metas(FORK_METAS)
+def test_simple_transition(state, fork_epoch, spec, post_spec, pre_tag,
+                           post_tag):
+    transition_until_fork(spec, state, fork_epoch)
+    assert spec.get_current_epoch(state) < fork_epoch
+
+    yield "pre", state
+
+    blocks = []
+    state, block = do_fork(state, spec, post_spec, fork_epoch)
+    blocks.append(post_tag(block))
+
+    transition_to_next_epoch_and_append_blocks(
+        post_spec, state, post_tag, blocks, only_last_block=True)
+
+    yield "blocks", blocks
+    yield "post", state
+
+
+@with_fork_metas(FORK_METAS)
+def test_normal_transition(state, fork_epoch, spec, post_spec, pre_tag,
+                           post_tag):
+    """Blocks for every slot through the fork boundary and one epoch
+    beyond; every pre-fork slot is filled."""
+    yield "pre", state
+    assert spec.get_current_epoch(state) < fork_epoch
+
+    to_slot = fork_epoch * spec.SLOTS_PER_EPOCH - 1
+    blocks = []
+    blocks.extend(pre_tag(b) for b in
+                  state_transition_across_slots(spec, state, to_slot))
+
+    state, block = do_fork(state, spec, post_spec, fork_epoch)
+    blocks.append(post_tag(block))
+
+    transition_to_next_epoch_and_append_blocks(
+        post_spec, state, post_tag, blocks)
+
+    assert state.slot % post_spec.SLOTS_PER_EPOCH == 0
+    assert post_spec.get_current_epoch(state) == fork_epoch + 1
+
+    slots_with_blocks = [block.message.slot for block in blocks]
+    assert len(set(slots_with_blocks)) == len(slots_with_blocks)
+    assert set(range(1, state.slot + 1)) == set(slots_with_blocks)
+
+    yield "blocks", blocks
+    yield "post", state
+
+
+@with_fork_metas(FORK_METAS)
+def test_transition_missing_first_post_block(state, fork_epoch, spec,
+                                             post_spec, pre_tag, post_tag):
+    yield "pre", state
+
+    to_slot = fork_epoch * spec.SLOTS_PER_EPOCH - 1
+    blocks = []
+    blocks.extend(pre_tag(b) for b in
+                  state_transition_across_slots(spec, state, to_slot))
+
+    # the fork boundary slot stays empty
+    state, _ = do_fork(state, spec, post_spec, fork_epoch, with_block=False)
+
+    transition_to_next_epoch_and_append_blocks(
+        post_spec, state, post_tag, blocks)
+
+    assert post_spec.get_current_epoch(state) == fork_epoch + 1
+    yield "blocks", blocks
+    yield "post", state
+
+
+@with_fork_metas(FORK_METAS)
+def test_transition_missing_last_pre_fork_block(state, fork_epoch, spec,
+                                                post_spec, pre_tag,
+                                                post_tag):
+    yield "pre", state
+
+    to_slot = fork_epoch * spec.SLOTS_PER_EPOCH - 1
+    blocks = []
+    blocks.extend(pre_tag(b) for b in state_transition_across_slots(
+        spec, state, to_slot, block_filter=skip_slots(to_slot)))
+
+    state, block = do_fork(state, spec, post_spec, fork_epoch)
+    blocks.append(post_tag(block))
+
+    transition_to_next_epoch_and_append_blocks(
+        post_spec, state, post_tag, blocks)
+
+    assert post_spec.get_current_epoch(state) == fork_epoch + 1
+    yield "blocks", blocks
+    yield "post", state
+
+
+@with_fork_metas(FORK_METAS)
+def test_transition_only_blocks_post_fork(state, fork_epoch, spec, post_spec,
+                                          pre_tag, post_tag):
+    """No pre-fork blocks at all; the chain resumes post-fork."""
+    yield "pre", state
+
+    to_slot = fork_epoch * spec.SLOTS_PER_EPOCH - 1
+    blocks = []
+    blocks.extend(pre_tag(b) for b in state_transition_across_slots(
+        spec, state, to_slot, block_filter=no_blocks))
+    assert not blocks
+
+    state, _ = do_fork(state, spec, post_spec, fork_epoch, with_block=False)
+
+    to_slot = post_spec.SLOTS_PER_EPOCH + state.slot
+    last_slot = to_slot
+    blocks.extend(post_tag(b) for b in state_transition_across_slots(
+        post_spec, state, to_slot, block_filter=only_at(last_slot)))
+
+    assert len(blocks) == 1
+    assert blocks[0].message.slot == last_slot
+    yield "blocks", blocks
+    yield "post", state

@@ -20,19 +20,29 @@ def test_pre_deneb_header_rejects_blob_gas(spec, state):
     fields; the capella-era root path is exercised via config override."""
     from consensus_specs_tpu.models.builder import spec_with_config
 
+    from consensus_specs_tpu.models.builder import build_spec
+    from consensus_specs_tpu.testlib.context import (
+        _cached_genesis, default_activation_threshold, default_balances)
+
     # schedule deneb in the future so a current-slot header is capella-era
     future = int(spec.compute_epoch_at_slot(state.slot)) + 1000
     shifted = spec_with_config(spec, {"DENEB_FORK_EPOCH": future})
 
-    block = build_empty_block_for_next_slot(spec, state)
-    signed = state_transition_and_sign_block(spec, state, block)
-    header = shifted.block_to_light_client_header(
-        shifted.SignedBeaconBlock.decode_bytes(signed.encode_bytes()))
+    # the capella-era block itself comes from the capella spec: its body
+    # root commits to the capella-shaped payload
+    cap_spec = build_spec("capella", spec.preset_name)
+    cap_state = _cached_genesis(cap_spec, default_balances,
+                                default_activation_threshold)
+    cap_block = build_empty_block_for_next_slot(cap_spec, cap_state)
+    cap_signed = state_transition_and_sign_block(cap_spec, cap_state,
+                                                 cap_block)
 
-    # capella-era root path: roots over the capella shape, not deneb's
+    header = shifted.block_to_light_client_header(cap_signed)
+    # capella-era root path: roots over the capella shape, not deneb's,
+    # and the branch into the capella body must verify
     cap_root = shifted.get_lc_execution_root(header)
     assert cap_root != shifted.hash_tree_root(header.execution)
-    assert spec.is_valid_light_client_header is not None
+    assert shifted.is_valid_light_client_header(header)
 
     # blob-gas gate: nonzero blob gas before deneb is invalid
     bad = header.copy()

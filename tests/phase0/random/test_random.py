@@ -1,0 +1,119 @@
+"""Randomized state + block scenarios (compact analogue of the
+reference's generated <fork>/random/test_random.py modules driven by
+test/utils/randomized_block_tests.py)."""
+
+from random import Random
+
+from consensus_specs_tpu.testlib.context import (
+    spec_state_test,
+    with_all_phases,
+    with_phases,
+)
+from consensus_specs_tpu.testlib.helpers.attestations import (
+    next_slots_with_attestations,
+)
+from consensus_specs_tpu.testlib.helpers.multi_operations import (
+    run_test_full_random_operations,
+)
+from consensus_specs_tpu.testlib.helpers.random import (
+    patch_state_to_non_leaking,
+    randomize_state,
+)
+from consensus_specs_tpu.testlib.helpers.rewards import (
+    transition_state_to_leak,
+)
+from consensus_specs_tpu.testlib.helpers.state import (
+    next_epoch,
+    next_slot,
+    state_transition_and_sign_block,
+)
+from consensus_specs_tpu.testlib.helpers.block import (
+    build_empty_block_for_next_slot,
+)
+
+
+@with_all_phases
+@spec_state_test
+def test_full_random_operations(spec, state):
+    yield from run_test_full_random_operations(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_randomized_state_then_empty_blocks(spec, state):
+    """A heavily randomized state (deposits/exits/slashings/participation)
+    must still accept a run of empty blocks."""
+    rng = Random(101)
+    randomize_state(spec, state, rng, exit_fraction=0.1, slash_fraction=0.1)
+    patch_state_to_non_leaking(spec, state)
+    yield "pre", state
+
+    blocks = []
+    made = 0
+    while made < spec.SLOTS_PER_EPOCH // 2:
+        # slashed validators cannot propose: skip their slots
+        probe = state.copy()
+        next_slot(spec, probe)
+        if probe.validators[
+                spec.get_beacon_proposer_index(probe)].slashed:
+            next_slot(spec, state)
+            continue
+        block = build_empty_block_for_next_slot(spec, state)
+        blocks.append(state_transition_and_sign_block(spec, state, block))
+        made += 1
+    yield "blocks", blocks
+    yield "post", state
+
+
+@with_all_phases
+@spec_state_test
+def test_randomized_state_epoch_transition(spec, state):
+    """Randomized state survives a full epoch transition (the epoch
+    pipeline over churned registries is where edge cases live)."""
+    from consensus_specs_tpu.testlib.helpers.random import (
+        set_some_activations)
+
+    rng = Random(202)
+    randomize_state(spec, state, rng, exit_fraction=0.2, slash_fraction=0.2)
+    set_some_activations(spec, state, rng)
+    yield "pre", state
+    next_epoch(spec, state)
+    next_slot(spec, state)
+    yield "post", state
+
+
+@with_all_phases
+@spec_state_test
+def test_randomized_state_leak_then_transition(spec, state):
+    """Randomize, let the chain leak, then run the epoch pipeline."""
+    rng = Random(303)
+    randomize_state(spec, state, rng, exit_fraction=0.05,
+                    slash_fraction=0.05)
+    transition_state_to_leak(spec, state)
+    assert spec.is_in_inactivity_leak(state)
+    yield "pre", state
+    next_epoch(spec, state)
+    yield "post", state
+
+
+@with_phases(["phase0", "altair", "bellatrix", "capella", "deneb",
+              "electra"])
+@spec_state_test
+def test_random_regular_chain_with_attestations(spec, state):
+    """A couple of epochs of full attestation traffic after a randomized
+    start, signing every block."""
+    from consensus_specs_tpu.testlib.helpers.random import (
+        exit_random_validators, randomize_attestation_participation,
+        set_some_new_deposits)
+
+    rng = Random(404)
+    # no slashing in this scenario: every slot must have a valid proposer
+    set_some_new_deposits(spec, state, rng)
+    exit_random_validators(spec, state, rng, fraction=0.05)
+    randomize_attestation_participation(spec, state, rng)
+    patch_state_to_non_leaking(spec, state)
+    yield "pre", state
+    _, blocks, state = next_slots_with_attestations(
+        spec, state, spec.SLOTS_PER_EPOCH, True, False)
+    yield "blocks", blocks
+    yield "post", state

@@ -1,0 +1,124 @@
+"""Rewards component-deltas suite (runs for every fork; spec:
+phase0/beacon-chain.md rewards-and-penalties, altair/beacon-chain.md flag
+deltas.  Reference: test/phase0/rewards/test_basic.py)."""
+
+from consensus_specs_tpu.testlib.context import (
+    spec_state_test,
+    with_all_phases,
+    with_phases,
+)
+from consensus_specs_tpu.testlib.helpers import rewards
+
+
+@with_all_phases
+@spec_state_test
+def test_empty(spec, state):
+    yield from rewards.run_test_empty(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_full_all_correct(spec, state):
+    yield from rewards.run_test_full_all_correct(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_half_full(spec, state):
+    yield from rewards.run_test_half_full(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_full_but_partial_participation(spec, state):
+    yield from rewards.run_test_full_but_partial_participation(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_quarter_full(spec, state):
+    yield from rewards.run_test_partial(spec, state, 0.25)
+
+
+@with_all_phases
+@spec_state_test
+def test_with_not_yet_activated_validators(spec, state):
+    yield from rewards.run_test_with_not_yet_activated_validators(
+        spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_with_exited_validators(spec, state):
+    yield from rewards.run_test_with_exited_validators(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_with_slashed_validators(spec, state):
+    yield from rewards.run_test_with_slashed_validators(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_some_very_low_effective_balances_that_attested(spec, state):
+    yield from rewards.run_test_some_very_low_effective_balances_that_attested(
+        spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_some_very_low_effective_balances_that_did_not_attest(spec, state):
+    yield from \
+        rewards.run_test_some_very_low_effective_balances_that_did_not_attest(
+            spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_all_balances_too_low_for_reward(spec, state):
+    yield from rewards.run_test_all_balances_too_low_for_reward(spec, state)
+
+
+# -- phase0-only scenarios: pending-attestation shapes (inclusion delay,
+# wrong target/head) have no post-altair analogue
+
+
+@with_phases(["phase0"])
+@spec_state_test
+def test_one_attestation_one_correct(spec, state):
+    yield from rewards.run_test_one_attestation_one_correct(spec, state)
+
+
+@with_phases(["phase0"])
+@spec_state_test
+def test_full_half_incorrect_target(spec, state):
+    yield from rewards.run_test_full_fraction_incorrect(
+        spec, state, correct_target=False, correct_head=True,
+        fraction_incorrect=0.5)
+
+
+@with_phases(["phase0"])
+@spec_state_test
+def test_full_half_incorrect_head(spec, state):
+    yield from rewards.run_test_full_fraction_incorrect(
+        spec, state, correct_target=True, correct_head=False,
+        fraction_incorrect=0.5)
+
+
+@with_phases(["phase0"])
+@spec_state_test
+def test_full_delay_one_slot(spec, state):
+    yield from rewards.run_test_full_delay_one_slot(spec, state)
+
+
+@with_phases(["phase0"])
+@spec_state_test
+def test_full_delay_max_slots(spec, state):
+    yield from rewards.run_test_full_delay_max_slots(spec, state)
+
+
+@with_phases(["phase0"])
+@spec_state_test
+def test_full_mixed_delay(spec, state):
+    yield from rewards.run_test_full_mixed_delay(spec, state)

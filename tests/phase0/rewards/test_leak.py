@@ -1,0 +1,41 @@
+"""Rewards under an inactivity leak (reference:
+test/phase0/rewards/test_leak.py)."""
+
+from consensus_specs_tpu.testlib.context import (
+    spec_state_test,
+    with_all_phases,
+)
+from consensus_specs_tpu.testlib.helpers import rewards
+from consensus_specs_tpu.testlib.helpers.rewards import leaking
+
+
+@with_all_phases
+@spec_state_test
+@leaking()
+def test_empty_leak(spec, state):
+    assert spec.is_in_inactivity_leak(state)
+    yield from rewards.run_test_empty(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+@leaking()
+def test_full_leak(spec, state):
+    assert spec.is_in_inactivity_leak(state)
+    yield from rewards.run_test_full_all_correct(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+@leaking()
+def test_half_full_leak(spec, state):
+    assert spec.is_in_inactivity_leak(state)
+    yield from rewards.run_test_half_full(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+@leaking()
+def test_full_but_partial_participation_leak(spec, state):
+    assert spec.is_in_inactivity_leak(state)
+    yield from rewards.run_test_full_but_partial_participation(spec, state)
