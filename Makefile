@@ -1,0 +1,44 @@
+# consensus_specs_tpu — developer entry points (the reference's
+# Makefile:73-271 equivalents, adapted: no pip installs are available in
+# this environment, so `lint` is a compile + full-spec-build check instead
+# of ruff/mypy).
+
+PYTHON ?= python
+CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
+VECTOR_OUT ?= out/vectors
+
+.PHONY: test test-fast test-all lint vectors kzg_setups bench multichip help
+
+help:
+	@echo "targets: test (fast suite) | test-all (incl. slow crypto) |"
+	@echo "  lint (compile + build all specs) | vectors [VECTOR_OUT=dir] |"
+	@echo "  kzg_setups | bench (real TPU) | multichip (8-dev CPU dryrun)"
+
+test:
+	$(PYTHON) -m pytest tests/ -q -m "not slow"
+
+test-all:
+	$(PYTHON) -m pytest tests/ -q
+
+lint:
+	$(PYTHON) -m compileall -q consensus_specs_tpu tests bench.py __graft_entry__.py
+	$(CPU_ENV) $(PYTHON) -c "\
+	from consensus_specs_tpu.models.builder import build_spec, ALL_FORKS; \
+	[build_spec(f, p) for f in ALL_FORKS for p in ('minimal', 'mainnet')]; \
+	print('all fork x preset specs build clean')"
+
+vectors:
+	$(CPU_ENV) $(PYTHON) -m consensus_specs_tpu.gen --output $(VECTOR_OUT) \
+		--runners sanity operations epoch_processing finality genesis \
+		rewards random transition forks shuffling ssz_generic networking
+
+kzg_setups:
+	$(CPU_ENV) $(PYTHON) -m consensus_specs_tpu.utils.kzg_setup \
+		--secret 1337 --g1-length 4096 --g2-length 65 \
+		--output-dir out/trusted_setups
+
+bench:
+	$(PYTHON) bench.py
+
+multichip:
+	$(CPU_ENV) $(PYTHON) -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('ok')"

@@ -1,0 +1,110 @@
+"""KZG trusted-setup generator: tau powers in G1/G2, group FFT into the
+Lagrange basis, JSON dump (the reference's `eth2spec/utils/kzg.py:22-125`;
+the shipped ceremony setup JSONs in `presets/*/trusted_setups/` are data
+artifacts — this module regenerates *testing* setups from a known secret,
+`make kzg_setups`)."""
+
+from __future__ import annotations
+
+import json
+import os
+from pathlib import Path
+
+from ..ops import bls
+from ..ops.bls.curve import R as BLS_MODULUS
+
+PRIMITIVE_ROOT_OF_UNITY = 7
+
+
+def generate_setup(generator, secret: int, length: int):
+    """[generator * secret**i for i in range(length)]."""
+    result = [generator]
+    for _ in range(1, length):
+        result.append(bls.multiply(result[-1], secret))
+    return tuple(result)
+
+
+def compute_root_of_unity(length: int) -> int:
+    assert (BLS_MODULUS - 1) % length == 0
+    return pow(PRIMITIVE_ROOT_OF_UNITY, (BLS_MODULUS - 1) // length,
+               BLS_MODULUS)
+
+
+def compute_roots_of_unity(order: int) -> tuple:
+    order = int(order)
+    root = compute_root_of_unity(order)
+    roots = []
+    current = 1
+    for _ in range(order):
+        roots.append(current)
+        current = current * root % BLS_MODULUS
+    return tuple(roots)
+
+
+def fft(vals, modulus: int, domain):
+    """Radix-2 FFT over group elements (scalars in the exponent)."""
+    if len(vals) == 1:
+        return vals
+    left = fft(vals[::2], modulus, domain[::2])
+    right = fft(vals[1::2], modulus, domain[::2])
+    out = [None] * len(vals)
+    for i, (x, y) in enumerate(zip(left, right)):
+        y_times_root = bls.multiply(y, domain[i])
+        out[i] = bls.add(x, y_times_root)
+        out[i + len(left)] = bls.add(x, bls.neg(y_times_root))
+    return out
+
+
+def get_lagrange(setup) -> tuple:
+    """Monomial G1 setup -> Lagrange basis over the roots-of-unity domain
+    (an inverse FFT expressed as FFT + index reversal + 1/n scaling)."""
+    root_of_unity = compute_root_of_unity(len(setup))
+    assert pow(root_of_unity, len(setup), BLS_MODULUS) == 1
+    domain = [pow(root_of_unity, i, BLS_MODULUS)
+              for i in range(len(setup))]
+    fft_output = fft(setup, BLS_MODULUS, domain)
+    inv_length = pow(len(setup), BLS_MODULUS - 2, BLS_MODULUS)
+    return tuple(
+        bls.G1_to_bytes48(bls.multiply(fft_output[-i], inv_length))
+        for i in range(len(fft_output)))
+
+
+def dump_kzg_trusted_setup_files(secret: int, g1_length: int,
+                                 g2_length: int, output_dir: str) -> None:
+    setup_g1 = generate_setup(bls.G1(), secret, g1_length)
+    setup_g2 = generate_setup(bls.G2(), secret, g2_length)
+    setup_g1_lagrange = get_lagrange(setup_g1)
+    roots_of_unity = compute_roots_of_unity(g1_length)
+
+    out = Path(output_dir)
+    os.makedirs(out, exist_ok=True)
+    path = out / "testing_trusted_setups.json"
+    with open(path, "w") as f:
+        json.dump({
+            "setup_G1": ["0x" + bls.G1_to_bytes48(p).hex()
+                         for p in setup_g1],
+            "setup_G2": ["0x" + bls.G2_to_bytes96(p).hex()
+                         for p in setup_g2],
+            "setup_G1_lagrange": ["0x" + b.hex()
+                                  for b in setup_g1_lagrange],
+            "roots_of_unity": roots_of_unity,
+        }, f)
+    print(f"Generated trusted setup file: {path}")
+
+
+def main(argv=None):
+    import argparse
+
+    p = argparse.ArgumentParser(
+        description="generate a testing KZG trusted setup")
+    p.add_argument("--secret", type=int, required=True)
+    p.add_argument("--g1-length", type=int, required=True)
+    p.add_argument("--g2-length", type=int, required=True)
+    p.add_argument("--output-dir", required=True)
+    args = p.parse_args(argv)
+    dump_kzg_trusted_setup_files(args.secret, args.g1_length,
+                                 args.g2_length, args.output_dir)
+
+
+if __name__ == "__main__":
+    main()

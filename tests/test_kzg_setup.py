@@ -1,0 +1,53 @@
+"""KZG trusted-setup generator correctness: the Lagrange basis produced
+by the group FFT must commit to polynomials identically to the monomial
+basis (spec contract: utils/kzg.py + deneb polynomial-commitments)."""
+
+import pytest
+
+from consensus_specs_tpu.ops import bls
+from consensus_specs_tpu.ops.bls.curve import R as BLS_MODULUS
+from consensus_specs_tpu.utils.kzg_setup import (
+    compute_roots_of_unity,
+    generate_setup,
+    get_lagrange,
+)
+
+pytestmark = pytest.mark.slow  # ~100 pure-Python scalar mults
+
+
+def test_lagrange_setup_commits_like_monomial():
+    secret = 1337
+    n = 8
+    setup_g1 = generate_setup(bls.G1(), secret, n)
+    lagrange = get_lagrange(setup_g1)
+    roots = compute_roots_of_unity(n)
+
+    # polynomial p(x) = 3 + 2x + x^5
+    coeffs = [3, 2, 0, 0, 0, 1, 0, 0]
+
+    # commitment from the monomial basis: sum coeffs[i] * secret^i * G1
+    commit_mono = None
+    for c, point in zip(coeffs, setup_g1):
+        if c == 0:
+            continue
+        term = bls.multiply(point, c)
+        commit_mono = term if commit_mono is None \
+            else bls.add(commit_mono, term)
+
+    # commitment from the Lagrange basis: sum p(w^i) * L_i
+    def poly_eval(x):
+        return sum(c * pow(x, i, BLS_MODULUS)
+                   for i, c in enumerate(coeffs)) % BLS_MODULUS
+
+    from consensus_specs_tpu.ops.bls.ciphersuite import bytes48_to_G1
+
+    commit_lag = None
+    for i, root in enumerate(roots):
+        v = poly_eval(root)
+        if v == 0:
+            continue
+        term = bls.multiply(bytes48_to_G1(lagrange[i]), v)
+        commit_lag = term if commit_lag is None \
+            else bls.add(commit_lag, term)
+
+    assert bls.G1_to_bytes48(commit_mono) == bls.G1_to_bytes48(commit_lag)
