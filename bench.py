@@ -35,6 +35,10 @@ import numpy as np
 
 # entry points own the process-wide uint64 switch (parallel.require_x64)
 jax.config.update("jax_enable_x64", True)
+# the image's sitecustomize pins the platform to the pooled TPU through
+# live config; let an explicit JAX_PLATFORMS env override it (CPU smoke)
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
 # persistent compilation cache: the ~70s XLA compile of the fused step is
 # paid once per machine, not once per run

@@ -1,0 +1,152 @@
+"""BLS batch benchmarks — BASELINE.md configs #2 and #3.
+
+#2: 128 aggregate-attestation verifications (FastAggregateVerify-style
+    statements, 64-strong committees) — device RLC batch (129 pairings,
+    one final exponentiation) vs the pure-Python oracle loop.
+#3: one 512-member sync-committee aggregate (eth_fast_aggregate_verify
+    hot path) — device pairing check vs oracle.
+
+Prints one JSON line per metric:
+  {"metric": ..., "value": ..., "unit": "s", "vs_baseline": ...}
+
+Oracle costs are measured from ONE representative verify and scaled
+(each verify is an independent 2-pairing check; the loop is linear), and
+persisted in bench_bls_baseline.json next to this file.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+# the image's sitecustomize pins the platform to the pooled TPU through
+# live config; let an explicit JAX_PLATFORMS env override it (CPU smoke)
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+from consensus_specs_tpu.utils.jaxtools import enable_compile_cache  # noqa: E402
+
+enable_compile_cache()
+
+BASELINE_FILE = Path(__file__).resolve().parent / "bench_bls_baseline.json"
+
+# env knobs let the smoke path run on CPU; the measured configs are the
+# defaults (BASELINE.md #2/#3 shapes) on the real chip
+N_ATTESTATIONS = int(os.environ.get("CST_BLS_BENCH_N", 128))
+COMMITTEE_SIZE = int(os.environ.get("CST_BLS_BENCH_COMMITTEE", 64))
+SYNC_COMMITTEE_SIZE = int(os.environ.get("CST_BLS_BENCH_SYNC", 512))
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def _build_tasks(n_tasks: int, keys_per_task: int, seed_base: int):
+    """Valid FastAggregateVerify statements as (agg_pk, msg, sig) points."""
+    from consensus_specs_tpu.ops.bls import ciphersuite as cs
+    from consensus_specs_tpu.ops.bls.curve import g1, g2
+    from consensus_specs_tpu.ops.bls.hash_to_curve import DST_G2, hash_to_g2
+
+    tasks = []
+    raw = []
+    for t in range(n_tasks):
+        msg = (seed_base + t).to_bytes(32, "little")
+        h = hash_to_g2(msg, DST_G2)
+        # aggregate secret key -> one scalar mult for pk and sig each;
+        # statements are identical in shape to real per-key aggregation
+        agg_sk = sum(seed_base + t * keys_per_task + i + 1
+                     for i in range(keys_per_task))
+        pk = g1.mul(cs.G1_GEN, agg_sk)
+        sig = g2.mul(h, agg_sk)
+        tasks.append((pk, msg, sig))
+        raw.append((cs.g1_to_bytes(pk), msg, cs.g2_to_bytes(sig)))
+    return tasks, raw
+
+
+def _measure_oracle_single(raw_task) -> float:
+    from consensus_specs_tpu.ops.bls import ciphersuite as cs
+
+    pk_b, msg, sig_b = raw_task
+    t0 = time.perf_counter()
+    assert cs.FastAggregateVerify([pk_b], msg, sig_b)
+    return time.perf_counter() - t0
+
+
+def _baselines() -> dict:
+    if BASELINE_FILE.exists() and not os.environ.get("CST_BENCH_REMEASURE"):
+        return json.loads(BASELINE_FILE.read_text())
+    log("measuring oracle baselines (one verify each)...")
+    _, raw_att = _build_tasks(1, COMMITTEE_SIZE, seed_base=1000)
+    att_single = _measure_oracle_single(raw_att[0])
+    _, raw_sync = _build_tasks(1, SYNC_COMMITTEE_SIZE, seed_base=2000)
+    sync_single = _measure_oracle_single(raw_sync[0])
+    data = {
+        "oracle_seconds_per_fast_aggregate_verify": att_single,
+        "oracle_seconds_per_sync_aggregate_verify": sync_single,
+        "measured_at": time.strftime("%Y-%m-%d"),
+    }
+    try:
+        BASELINE_FILE.write_text(json.dumps(data, indent=2) + "\n")
+    except OSError as e:
+        log(f"baseline not persisted: {e}")
+    return data
+
+
+def main():
+    from consensus_specs_tpu.ops.bls_batch import (
+        batch_verify, pairing_check_device)
+    from consensus_specs_tpu.ops.bls import ciphersuite as cs
+    from consensus_specs_tpu.ops.bls.curve import g1
+    from consensus_specs_tpu.ops.bls.hash_to_curve import DST_G2, hash_to_g2
+
+    base = _baselines()
+
+    # config #2: attestation batch
+    tasks, _ = _build_tasks(N_ATTESTATIONS, COMMITTEE_SIZE, seed_base=1000)
+    t0 = time.perf_counter()
+    assert batch_verify(tasks)
+    log(f"attestation batch compile+first: {time.perf_counter() - t0:.1f}s")
+    iters = 3
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        assert batch_verify(tasks)
+    dt = (time.perf_counter() - t0) / iters
+    baseline = (base["oracle_seconds_per_fast_aggregate_verify"]
+                * N_ATTESTATIONS)
+    print(json.dumps({
+        "metric": f"attestation_batch_{N_ATTESTATIONS}x"
+                  f"{COMMITTEE_SIZE}_verify_wall",
+        "value": round(dt, 4),
+        "unit": "s",
+        "vs_baseline": round(baseline / dt, 1),
+    }), flush=True)
+
+    # config #3: sync aggregate (one 512-member statement)
+    sync_tasks, _ = _build_tasks(1, SYNC_COMMITTEE_SIZE, seed_base=2000)
+    pk, msg, sig = sync_tasks[0]
+    h = hash_to_g2(msg, DST_G2)
+    pairs = [(pk, h), (g1.neg(cs.G1_GEN), sig)]
+    t0 = time.perf_counter()
+    assert pairing_check_device(pairs)
+    log(f"sync aggregate compile+first: {time.perf_counter() - t0:.1f}s")
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        assert pairing_check_device(pairs)
+    dt = (time.perf_counter() - t0) / iters
+    baseline = base["oracle_seconds_per_sync_aggregate_verify"]
+    print(json.dumps({
+        "metric": f"sync_aggregate_{SYNC_COMMITTEE_SIZE}_verify_wall",
+        "value": round(dt, 4),
+        "unit": "s",
+        "vs_baseline": round(baseline / dt, 1),
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

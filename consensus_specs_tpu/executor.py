@@ -1,0 +1,45 @@
+"""Batched block executor — the TPU-first state-transition entry point.
+
+`state_transition(spec, state, block)` in the executable spec verifies
+every aggregate signature inline (pure host pairings).  This executor
+restructures that for the device: the spec runs with its
+FastAggregateVerify pairings *deferred* (inputs still validated eagerly),
+and all recorded statements — attestations, sync aggregates, indexed
+attestations from slashings — settle afterwards in ONE random-linear-
+combination batch on the accelerator (`ops.bls_batch.batch_verify`: B+1
+pairings, one final exponentiation).  Semantics match the inline path for
+every valid block; an invalid aggregate signature surfaces as the batch
+check failing (AssertionError), the same acceptance boundary as the spec.
+
+Individual signatures (proposer, randao, exits, deposits) stay eager:
+deposits with bad signatures are *valid* blocks per the spec, so their
+checks must resolve before affecting control flow.
+
+Reference seam being replaced: `eth2spec/utils/bls.py:141-296`'s native
+milagro calls inside `state_transition` (specs/phase0/beacon-chain.md
+:1358-1381).
+"""
+
+from __future__ import annotations
+
+from .ops import bls
+
+
+def state_transition_batched(spec, state, signed_block,
+                             validate_result: bool = True,
+                             device: bool | None = None):
+    """Run `spec.state_transition` with aggregate pairings batched on the
+    device.  Raises AssertionError exactly where the spec would (plus at
+    the end if the signature batch fails); on failure the state is
+    partially advanced — run on a copy, as `on_block` does."""
+    block = signed_block.message
+    spec.process_slots(state, block.slot)
+    if validate_result:
+        assert spec.verify_block_signature(state, signed_block)
+    with bls.deferred_batch_verification() as batch:
+        spec.process_block(state, block)
+    assert batch.verify(device=device), \
+        "batched aggregate-signature verification failed"
+    if validate_result:
+        assert block.state_root == spec.hash_tree_root(state)
+    return state

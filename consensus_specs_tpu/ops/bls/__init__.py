@@ -18,6 +18,8 @@ same host-side validation, and the device pairing check computes the same
 product-of-pairings predicate.
 """
 
+from __future__ import annotations
+
 from . import ciphersuite as _py
 from . import curve as _curve
 from . import fields as _fields
@@ -126,6 +128,9 @@ def AggregateVerify(pubkeys, messages, signature):
 def FastAggregateVerify(pubkeys, message, signature):
     if not bls_active:
         return True
+    if _deferred is not None:
+        return _deferred.record([bytes(p) for p in pubkeys],
+                                bytes(message), bytes(signature))
     if _backend_name == "jax":
         return _fast_aggregate_verify_jax([bytes(p) for p in pubkeys],
                                           bytes(message), bytes(signature))
@@ -149,6 +154,79 @@ def KeyValidate(pubkey):
     if not bls_active:
         return True
     return _py.KeyValidate(bytes(pubkey))
+
+
+# --- deferred batch verification --------------------------------------------
+# The block executor's collection point: inside the context, every
+# FastAggregateVerify statement (attestations, sync aggregates, indexed
+# attestations) is input-validated eagerly but its pairing is deferred;
+# `DeferredBatch.verify()` then settles ALL of them in one device RLC
+# batch (B+1 pairings, one final exponentiation) via `ops.bls_batch`.
+# Plain Verify stays eager: its few per-block call sites include deposit
+# signatures whose invalidity must not fail the block.
+
+
+class DeferredBatch:
+    """Recorded FastAggregateVerify statements awaiting one batch check."""
+
+    def __init__(self):
+        self.tasks = []      # (g1_pk_jacobian, message, g2_sig_jacobian)
+        self.failed = False  # an input failed eager validation
+
+    def record(self, pubkeys, message, signature) -> bool:
+        from .ciphersuite import _pk_to_point, _sig_to_point, g1
+
+        if len(pubkeys) == 0:
+            self.failed = True
+            return False
+        try:
+            sig = _sig_to_point(bytes(signature))
+            agg = g1.infinity()
+            for pk in pubkeys:
+                agg = g1.add(agg, _pk_to_point(bytes(pk)))
+        except ValueError:
+            self.failed = True
+            return False
+        self.tasks.append((agg, bytes(message), sig))
+        return True
+
+    def verify(self, device: bool | None = None) -> bool:
+        """Settle every recorded statement.  device=None follows the
+        active backend (jax -> device batch, py -> host oracle)."""
+        if self.failed:
+            return False
+        if not self.tasks:
+            return True
+        if device is None:
+            device = _backend_name == "jax"
+        if device:
+            from ..bls_batch import batch_verify
+
+            return batch_verify(self.tasks)
+        from .ciphersuite import G1_GEN, _pairing_check, g1
+        from .hash_to_curve import DST_G2, hash_to_g2
+
+        return all(
+            _pairing_check([(pk, hash_to_g2(msg, DST_G2)),
+                            (g1.neg(G1_GEN), sig)])
+            for pk, msg, sig in self.tasks)
+
+
+_deferred: DeferredBatch | None = None
+
+
+class deferred_batch_verification:
+    """Context manager handing out the recording handle."""
+
+    def __enter__(self) -> DeferredBatch:
+        global _deferred
+        assert _deferred is None, "deferred batch already active"
+        _deferred = DeferredBatch()
+        return _deferred
+
+    def __exit__(self, *exc) -> None:
+        global _deferred
+        _deferred = None
 
 
 # --- point API (always active; KZG needs real math even with sigs off) ------

@@ -1,0 +1,131 @@
+"""Batched block executor: parity with the inline-verifying spec path and
+rejection of tampered aggregate signatures (consensus_specs_tpu.executor,
+replacing the reference's native per-call BLS seam)."""
+
+import pytest
+
+from consensus_specs_tpu.executor import state_transition_batched
+from consensus_specs_tpu.models.builder import build_spec
+from consensus_specs_tpu.ops import bls
+from consensus_specs_tpu.testlib.context import (
+    _cached_genesis,
+    default_activation_threshold,
+    default_balances,
+)
+from consensus_specs_tpu.testlib.helpers.attestations import (
+    get_valid_attestation,
+)
+from consensus_specs_tpu.testlib.helpers.block import (
+    build_empty_block_for_next_slot,
+    sign_block,
+    transition_unsigned_block,
+)
+from consensus_specs_tpu.testlib.helpers.state import next_slots
+
+
+def _make_attested_block(spec, state):
+    """A signed block carrying one signed attestation."""
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY + 1)
+    attestation = get_valid_attestation(
+        spec, state, slot=state.slot - spec.MIN_ATTESTATION_INCLUSION_DELAY,
+        signed=True)
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.attestations.append(attestation)
+    return block
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("fork", ["phase0", "altair"])
+def test_batched_executor_matches_inline_path(fork):
+    spec = build_spec(fork, "minimal")
+    prev_active = bls.bls_active
+    bls.bls_active = True
+    try:
+        state = _cached_genesis(spec, default_balances,
+                                default_activation_threshold)
+        block = _make_attested_block(spec, state)
+
+        inline_state = state.copy()
+        transition_unsigned_block(spec, inline_state, block)
+        block.state_root = spec.hash_tree_root(inline_state)
+        signed = sign_block(spec, state.copy(), block)
+
+        batched_state = state.copy()
+        state_transition_batched(spec, batched_state, signed, device=False)
+        assert (spec.hash_tree_root(batched_state)
+                == spec.hash_tree_root(inline_state))
+    finally:
+        bls.bls_active = prev_active
+
+
+@pytest.mark.slow
+def test_batched_executor_rejects_tampered_attestation():
+    spec = build_spec("phase0", "minimal")
+    prev_active = bls.bls_active
+    bls.bls_active = True
+    try:
+        state = _cached_genesis(spec, default_balances,
+                                default_activation_threshold)
+        block = _make_attested_block(spec, state)
+
+        shadow = state.copy()
+        transition_unsigned_block(spec, shadow, block)
+        block.state_root = spec.hash_tree_root(shadow)
+        # corrupt the attestation's aggregate signature AFTER computing
+        # the post root, then sign the block over the tampered body
+        block.body.attestations[0].signature = bls.Sign(
+            12345, b"\x42" * 32)
+        signed = sign_block(spec, state.copy(), block)
+
+        with pytest.raises(AssertionError):
+            state_transition_batched(spec, state.copy(), signed,
+                                     validate_result=False, device=False)
+    finally:
+        bls.bls_active = prev_active
+
+
+def test_batched_executor_with_bls_off_matches():
+    """With the kill-switch off nothing records and the executor is a
+    plain state transition."""
+    spec = build_spec("altair", "minimal")
+    state = _cached_genesis(spec, default_balances,
+                            default_activation_threshold)
+    block = _make_attested_block(spec, state)
+
+    inline_state = state.copy()
+    transition_unsigned_block(spec, inline_state, block)
+    block.state_root = spec.hash_tree_root(inline_state)
+    signed = sign_block(spec, state.copy(), block)
+
+    batched = state.copy()
+    state_transition_batched(spec, batched, signed, validate_result=False)
+    assert spec.hash_tree_root(batched) == spec.hash_tree_root(inline_state)
+
+
+@pytest.mark.slow
+def test_batched_executor_device_path():
+    """The full RLC device batch (jax backend on the CPU mesh) accepts a
+    valid block and rejects a tampered one."""
+    spec = build_spec("phase0", "minimal")
+    prev_active = bls.bls_active
+    bls.bls_active = True
+    try:
+        state = _cached_genesis(spec, default_balances,
+                                default_activation_threshold)
+        block = _make_attested_block(spec, state)
+        shadow = state.copy()
+        transition_unsigned_block(spec, shadow, block)
+        block.state_root = spec.hash_tree_root(shadow)
+        signed = sign_block(spec, state.copy(), block)
+
+        state_transition_batched(spec, state.copy(), signed, device=True)
+
+        bad = signed.copy()
+        bad.message.body.attestations[0].signature = bls.Sign(
+            999, b"\x13" * 32)
+        bad = sign_block(spec, state.copy(), bad.message)
+        with pytest.raises(AssertionError):
+            state_transition_batched(spec, state.copy(), bad,
+                                     validate_result=False, device=True)
+    finally:
+        bls.bls_active = prev_active
