@@ -234,8 +234,23 @@ class deferred_batch_verification:
 add = _py.add
 multiply = _py.multiply
 neg = _py.neg
-multi_exp = _py.multi_exp
 eq = _py.eq
+
+# G1 batches below this size are cheaper on the host Pippenger than a
+# device dispatch round-trip
+_MSM_DEVICE_MIN = 16
+
+
+def multi_exp(points, integers):
+    """MSM; G1 batches route to the device kernel under the jax backend
+    (the KZG `g1_lincomb`/`verify_kzg_proof_batch` hot path)."""
+    if (_backend_name == "jax" and len(points) >= _MSM_DEVICE_MIN
+            and points and points[0][0] == 1):
+        from ..bls_batch import g1_multi_exp_device
+
+        return (1, g1_multi_exp_device([p for _, p in points],
+                                       [int(i) for i in integers]))
+    return _py.multi_exp(points, integers)
 Z1 = _py.Z1
 Z2 = _py.Z2
 G1 = _py.G1

@@ -148,6 +148,65 @@ def _rlc_kernel(batch: int):
     return jax.jit(run)
 
 
+@functools.lru_cache(maxsize=16)
+def _msm_kernel(batch: int):
+    """Jitted G1 MSM: batched 255-step double-and-add over all points at
+    once, then a log-depth tree sum.  Uniform control flow — the
+    TPU-idiomatic MSM (bucketed Pippenger's data-dependent gathers do not
+    vectorize onto the MXU)."""
+    import jax
+    jnp = _jnp()
+
+    def run(x, y, bits, mask):
+        B = x.shape[0]
+        one1 = jnp.broadcast_to(jnp.asarray(_fq.ONE_MONT),
+                                x.shape).astype(jnp.int32)
+        muls = cj.pt_scalar_mul(cj.F1, (x, y, one1), bits)
+        muls = cj.pt_select(cj.F1, mask, muls,
+                            cj.pt_infinity(cj.F1, muls))
+        return cj.pt_sum(cj.F1, muls, B)
+
+    return jax.jit(run)
+
+
+SCALAR_BITS = 255  # BLS12-381 subgroup order is 255 bits
+
+
+def g1_multi_exp_device(points, scalars):
+    """Device G1 multiscalar multiplication.
+
+    points: oracle Jacobian G1 points; scalars: ints (reduced mod r).
+    Returns an oracle Jacobian point.  The KZG batch path's `g1_lincomb`
+    (`specs/deneb/polynomial-commitments.md:415-460` algorithms) lands
+    here when the jax backend is active."""
+    import jax.numpy as jnp
+
+    assert len(points) == len(scalars) and len(points) > 0
+    live = []
+    for p, s in zip(points, scalars):
+        s = int(s) % _pycurve.R
+        if s == 0 or _pycurve.g1.is_inf(p):
+            continue
+        live.append((p, s))
+    if not live:
+        return _pycurve.g1.infinity()
+
+    B = _bucket(len(live))
+    x, y = cj.g1_affine_to_limbs([p for p, _ in live])
+    bits = cj.scalars_to_bits([s for _, s in live], SCALAR_BITS)
+    pad = B - len(live)
+    if pad:
+        x = np.concatenate([x, np.repeat(x[:1], pad, 0)])
+        y = np.concatenate([y, np.repeat(y[:1], pad, 0)])
+        bits = np.concatenate([bits,
+                               np.zeros((pad, SCALAR_BITS), np.int32)])
+    mask = np.arange(B) < len(live)
+
+    out = _msm_kernel(B)(jnp.asarray(x), jnp.asarray(y),
+                         jnp.asarray(bits), jnp.asarray(mask))
+    return cj.g1_limbs_to_oracle(tuple(np.asarray(c) for c in out))
+
+
 def batch_verify(tasks, rng=None) -> bool:
     """tasks: [(g1_pubkey_jacobian, message_bytes, g2_sig_jacobian)].
 
