@@ -2,55 +2,67 @@
 
 North-star config #4 (BASELINE.md): the per-validator epoch pipeline
 (rewards/penalties + slashings + effective-balance updates) plus the
-registry-scale merkleization (balances list root + validator registry root).
+registry-scale merkleization (balances list root + validator registry
+root), with BLS batch (configs #2/#3) extras folded into the same JSON
+line when the time budget allows.
 
 - TPU path: `parallel.epoch_sweep` + device merkle kernels, one fused XLA
   program over a 2**20-validator struct-of-arrays registry.
 - Baseline: the executable spec's pure-Python pipeline + SSZ engine
   hash_tree_root, measured on a 1024-validator mainnet state and scaled
-  linearly (the pipeline is O(N); sorting terms are negligible).  The
-  measured per-validator cost is persisted in `bench_baseline.json` (checked
-  in) so the driver run does not re-pay ~95s of pure-Python sweeps; delete
-  the file to re-measure.
+  linearly (the pipeline is O(N)).  The measured per-validator cost is
+  persisted in `bench_baseline.json` (checked in) so the driver run does
+  not re-pay ~95s of pure-Python sweeps; delete the file to re-measure.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
+  {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ..., ...}
 
-Budget design (round-4 fix): baseline is read from disk (<1ms), the XLA
-compile is amortized through a persistent compilation cache in
-`.jax_cache/`, and the JSON line is printed immediately after the five
-measured steps — nothing optional runs before it.
+Robustness design (round-5 fix — rounds 3/4 produced no number):
+- every measurement runs in a fresh subprocess: a failed TPU backend init
+  poisons the parent process's jax state, so retries must not share one;
+- bounded retries (3) for the flagship metric; the second attempt disables
+  the persistent compile cache (CST_NO_COMPILE_CACHE=1) to rule out a
+  poisoned cache entry, the third also waits out transient pool pressure;
+- the compile cache itself is keyed by host fingerprint
+  (`utils/jaxtools.host_cache_key`) so cross-machine XLA:CPU AOT entries
+  can never be loaded — the round-4 failure mode;
+- if every TPU attempt fails, a CPU-platform fallback still lands a
+  measured number (flagged `"platform": "cpu-fallback"` + `"error"`), and
+  if even that fails the JSON line carries `"value": null` and the error —
+  the driver always parses *something*.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 from pathlib import Path
 
-import jax
-import numpy as np
+HERE = Path(__file__).resolve().parent
+BASELINE_FILE = HERE / "bench_baseline.json"
 
-# entry points own the process-wide uint64 switch (parallel.require_x64)
-jax.config.update("jax_enable_x64", True)
-# the image's sitecustomize pins the platform to the pooled TPU through
-# live config; let an explicit JAX_PLATFORMS env override it (CPU smoke)
-if os.environ.get("JAX_PLATFORMS"):
-    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
-
-# persistent compilation cache: the ~70s XLA compile of the fused step is
-# paid once per machine, not once per run
-from consensus_specs_tpu.utils.jaxtools import enable_compile_cache  # noqa: E402
-
-enable_compile_cache()
-
-BASELINE_FILE = Path(__file__).resolve().parent / "bench_baseline.json"
+N_VALIDATORS = int(os.environ.get("CST_BENCH_N", 1 << 20))
+ATTEMPT_TIMEOUT = int(os.environ.get("CST_BENCH_ATTEMPT_TIMEOUT", 420))
+# extras (BLS configs #2/#3) only start while elapsed < this, so the
+# flagship line cannot be lost to an external driver timeout
+EXTRAS_DEADLINE = int(os.environ.get("CST_BENCH_EXTRAS_DEADLINE", 420))
 
 
 def log(*a):
     print(*a, file=sys.stderr, flush=True)
+
+
+# ---------------------------------------------------------------------------
+# CPU baselines (pure-Python spec pipeline; persisted, no jax involved)
+# ---------------------------------------------------------------------------
+
+def _host_fingerprint() -> str:
+    import platform
+
+    return f"{platform.machine()}/{os.cpu_count()}cpu"
 
 
 def _measure_baseline(n: int = 1024, repeats: int = 3) -> dict:
@@ -94,21 +106,15 @@ def _measure_baseline(n: int = 1024, repeats: int = 3) -> dict:
     }
 
 
-def _host_fingerprint() -> str:
-    import platform
-
-    return f"{platform.machine()}/{os.cpu_count()}cpu"
-
-
 def baseline_cpu_seconds_per_validator() -> float:
     if BASELINE_FILE.exists() and not os.environ.get("CST_BENCH_REMEASURE"):
         data = json.loads(BASELINE_FILE.read_text())
-        if data.get("host_fingerprint", _host_fingerprint()) \
-                != _host_fingerprint():
+        if data.get("host_fingerprint",
+                    _host_fingerprint()) != _host_fingerprint():
             log(f"baseline host mismatch ({data['host_fingerprint']} vs "
                 f"{_host_fingerprint()}): re-measuring")
         else:
-            log(f"baseline (persisted {data['measured_at']}): "
+            log(f"baseline (persisted {data.get('measured_at')}): "
                 f"{data['seconds_per_validator'] * 1e6:.1f} us/validator "
                 f"@ {data['validators_measured']} validators")
             return data["seconds_per_validator"]
@@ -122,7 +128,29 @@ def baseline_cpu_seconds_per_validator() -> float:
     return data["seconds_per_validator"]
 
 
-def tpu_seconds_per_step(n: int) -> float:
+# ---------------------------------------------------------------------------
+# workers (run in fresh subprocesses; print one JSON line on success)
+# ---------------------------------------------------------------------------
+
+def _worker_setup_jax():
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+    # the image's sitecustomize pins the platform to the pooled TPU through
+    # live config; let an explicit JAX_PLATFORMS env override it (CPU smoke)
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    from consensus_specs_tpu.utils.jaxtools import enable_compile_cache
+
+    enable_compile_cache()
+    return jax
+
+
+def worker_epoch(n: int) -> None:
+    """Config #4: fused epoch sweep + registry merkleization on device."""
+    import numpy as np
+
+    jax = _worker_setup_jax()
     from consensus_specs_tpu.models.builder import build_spec
     from consensus_specs_tpu.parallel import (
         EpochParams, EpochScalars, ValidatorLeaves, balances_list_root,
@@ -165,21 +193,166 @@ def tpu_seconds_per_step(n: int) -> float:
     dt = (time.perf_counter() - t0) / iters
     log(f"{dt * 1e3:.1f} ms/step @ {n} validators "
         f"(root {np.asarray(out[3])[:2]})")
-    return dt
+    print(json.dumps({"seconds": dt, "platform": dev.platform}), flush=True)
 
 
-def main():
-    n = 1 << 20
-    per_val_cpu = baseline_cpu_seconds_per_validator()
-    baseline_s = per_val_cpu * n
-    tpu_s = tpu_seconds_per_step(n)
+def worker_bls() -> None:
+    """Configs #2/#3: attestation RLC batch + sync-aggregate pairing."""
+    _worker_setup_jax()
+    import bench_bls
+
+    base = bench_bls._baselines()
+    n_att = bench_bls.N_ATTESTATIONS
+    committee = bench_bls.COMMITTEE_SIZE
+    sync_n = bench_bls.SYNC_COMMITTEE_SIZE
+
+    from consensus_specs_tpu.ops.bls import ciphersuite as cs
+    from consensus_specs_tpu.ops.bls.curve import g1
+    from consensus_specs_tpu.ops.bls.hash_to_curve import DST_G2, hash_to_g2
+    from consensus_specs_tpu.ops.bls_batch import (
+        batch_verify, pairing_check_device)
+
+    tasks, _ = bench_bls._build_tasks(n_att, committee, seed_base=1000)
+    t0 = time.perf_counter()
+    assert batch_verify(tasks)
+    log(f"attestation batch compile+first: {time.perf_counter() - t0:.1f}s")
+    iters = 3
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        assert batch_verify(tasks)
+    att_dt = (time.perf_counter() - t0) / iters
+    att_base = base["oracle_seconds_per_fast_aggregate_verify"] * n_att
+
+    sync_tasks, _ = bench_bls._build_tasks(1, sync_n, seed_base=2000)
+    pk, msg, sig = sync_tasks[0]
+    h = hash_to_g2(msg, DST_G2)
+    pairs = [(pk, h), (g1.neg(cs.G1_GEN), sig)]
+    t0 = time.perf_counter()
+    assert pairing_check_device(pairs)
+    log(f"sync aggregate compile+first: {time.perf_counter() - t0:.1f}s")
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        assert pairing_check_device(pairs)
+    sync_dt = (time.perf_counter() - t0) / iters
+    sync_base = base["oracle_seconds_per_sync_aggregate_verify"]
+
     print(json.dumps({
-        "metric": "mainnet_epoch_sweep_1m_validators_wall",
-        "value": round(tpu_s, 4),
-        "unit": "s",
-        "vs_baseline": round(baseline_s / tpu_s, 1),
+        f"attestation_batch_{n_att}x{committee}_verify_wall":
+            {"value": round(att_dt, 4), "unit": "s",
+             "vs_baseline": round(att_base / att_dt, 1)},
+        f"sync_aggregate_{sync_n}_verify_wall":
+            {"value": round(sync_dt, 4), "unit": "s",
+             "vs_baseline": round(sync_base / sync_dt, 1)},
     }), flush=True)
 
 
+# ---------------------------------------------------------------------------
+# driver (parent process: never initializes a jax backend)
+# ---------------------------------------------------------------------------
+
+def _run_worker(mode: str, timeout: float, extra_env: dict | None = None):
+    """Run `python bench.py --worker <mode>` and parse its last stdout line.
+    Returns (dict | None, error_string)."""
+    env = dict(os.environ)
+    env.update(extra_env or {})
+    try:
+        proc = subprocess.run(
+            [sys.executable, str(HERE / "bench.py"), "--worker", mode],
+            capture_output=True, text=True, timeout=timeout, env=env,
+            cwd=str(HERE))
+    except subprocess.TimeoutExpired:
+        return None, f"{mode} worker timed out after {timeout:.0f}s"
+    if proc.stderr:
+        sys.stderr.write(proc.stderr[-4000:])
+        sys.stderr.flush()
+    if proc.returncode != 0:
+        tail = " | ".join((proc.stderr or "").strip().splitlines()[-2:])
+        return None, (f"{mode} worker rc={proc.returncode}: "
+                      + tail[-300:])
+    for line in reversed((proc.stdout or "").strip().splitlines()):
+        try:
+            return json.loads(line), ""
+        except json.JSONDecodeError:
+            continue
+    return None, f"{mode} worker produced no JSON"
+
+
+def main():
+    start = time.time()
+    per_val_cpu = baseline_cpu_seconds_per_validator()
+    baseline_s = per_val_cpu * N_VALIDATORS
+
+    attempts = [
+        ("tpu attempt 1 (persistent cache)", {}),
+        ("tpu attempt 2 (cache disabled)", {"CST_NO_COMPILE_CACHE": "1"}),
+        ("tpu attempt 3 (cache disabled, after backoff)",
+         {"CST_NO_COMPILE_CACHE": "1"}),
+    ]
+    result, errors = None, []
+    for i, (label, env) in enumerate(attempts):
+        if i == 2:
+            log("backing off 30s before final attempt...")
+            time.sleep(30)
+        log(f"--- {label} ---")
+        result, err = _run_worker("epoch", ATTEMPT_TIMEOUT, env)
+        if result is not None:
+            break
+        errors.append(err)
+        log(f"FAILED: {err}")
+
+    platform = None
+    if result is None:
+        log("--- cpu fallback (TPU unavailable) ---")
+        result, err = _run_worker(
+            "epoch", ATTEMPT_TIMEOUT,
+            {"JAX_PLATFORMS": "cpu", "CST_NO_COMPILE_CACHE": "1"})
+        if result is not None:
+            platform = "cpu-fallback"
+        else:
+            errors.append(err)
+
+    out = {
+        "metric": "mainnet_epoch_sweep_1m_validators_wall",
+        "value": None,
+        "unit": "s",
+        "vs_baseline": None,
+    }
+    if result is not None:
+        out["value"] = round(result["seconds"], 4)
+        out["vs_baseline"] = round(baseline_s / result["seconds"], 1)
+        out["platform"] = platform or result.get("platform", "tpu")
+    if errors:
+        out["error"] = "; ".join(errors)
+
+    # the flagship line goes out FIRST so an external driver timeout during
+    # the extras can never lose it (the rounds-3/4 failure mode)
+    print(json.dumps(out), flush=True)
+
+    # extras: BLS configs #2/#3, only while comfortably inside the budget
+    # and only when the flagship ran on the real chip; on success a second,
+    # superset JSON line is printed (drivers parsing either the first or
+    # the last line both see the flagship metric)
+    elapsed = time.time() - start
+    if (result is not None and platform is None
+            and elapsed < EXTRAS_DEADLINE):
+        log(f"--- bls extras (elapsed {elapsed:.0f}s) ---")
+        extras, err = _run_worker("bls", ATTEMPT_TIMEOUT)
+        if extras is not None:
+            out["extra"] = extras
+            print(json.dumps(out), flush=True)
+        else:
+            log(f"bls extras skipped: {err}")
+
+    sys.exit(0 if result is not None else 1)
+
+
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) >= 3 and sys.argv[1] == "--worker":
+        if sys.argv[2] == "epoch":
+            worker_epoch(N_VALIDATORS)
+        elif sys.argv[2] == "bls":
+            worker_bls()
+        else:
+            raise SystemExit(f"unknown worker {sys.argv[2]!r}")
+    else:
+        main()

@@ -46,6 +46,18 @@ def execute_test(test_case: TestCase, dumper: Dumper) -> bool:
     return True
 
 
+# Process-parallel support: TestCase.case_fn is a closure (built by the
+# reflection bridge), so TestCase objects cannot be pickled into a Pool.
+# Instead the selected case list is published here in the parent process
+# and fork()ed workers receive *indices*, rebuilding nothing — the closure
+# travels via copy-on-write memory inheritance.
+_POOL_CASES: list[TestCase] = []
+
+
+def _run_by_index(idx: int) -> tuple[str, str, str]:
+    return _run_one(_POOL_CASES[idx])
+
+
 def _run_one(test_case: TestCase) -> tuple[str, str, str]:
     """Worker: returns (identifier, status, detail)."""
     dumper = Dumper()
@@ -109,19 +121,16 @@ def run_generator(test_cases: Iterable[TestCase], args) -> int:
         tc.set_output_dir(args.output)
     print(f"{len(cases)} test cases selected", flush=True)
 
-    results: list[tuple[str, str, str]] = []
-    if args.threads > 1:
-        import multiprocessing as mp
+    # honor disable_bls regardless of entry point (gen/__main__ also sets it,
+    # but programmatic callers pass an args namespace directly)
+    from ..ops import bls
 
-        with mp.get_context("fork").Pool(args.threads) as pool:
-            for res in pool.imap_unordered(_run_one, cases):
-                results.append(res)
-                _report(res, args)
-    else:
-        for tc in cases:
-            res = _run_one(tc)
-            results.append(res)
-            _report(res, args)
+    prev_bls = bls.bls_active
+    bls.bls_active = not getattr(args, "disable_bls", False)
+    try:
+        results = _execute_all(cases, args)
+    finally:
+        bls.bls_active = prev_bls
 
     n = {"generated": 0, "skipped": 0, "failed": 0}
     for _, status, _ in results:
@@ -134,6 +143,29 @@ def run_generator(test_cases: Iterable[TestCase], args) -> int:
             if status == "failed":
                 print(f"FAILED {ident}\n{detail}", file=sys.stderr)
     return 1 if n["failed"] else 0
+
+
+def _execute_all(cases: list[TestCase], args) -> list[tuple[str, str, str]]:
+    results: list[tuple[str, str, str]] = []
+    if args.threads > 1:
+        import multiprocessing as mp
+
+        global _POOL_CASES
+        _POOL_CASES = cases
+        try:
+            with mp.get_context("fork").Pool(args.threads) as pool:
+                for res in pool.imap_unordered(
+                        _run_by_index, range(len(cases))):
+                    results.append(res)
+                    _report(res, args)
+        finally:
+            _POOL_CASES = []
+    else:
+        for tc in cases:
+            res = _run_one(tc)
+            results.append(res)
+            _report(res, args)
+    return results
 
 
 def _report(res: tuple[str, str, str], args) -> None:

@@ -12,4 +12,4 @@ def is_valid_gossip_execution_payload_timestamp(
     if not is_execution_enabled(state, block.body):
         return True
     return (block.body.execution_payload.timestamp
-            == compute_timestamp_at_slot(state, block.slot))
+            == compute_time_at_slot(state, block.slot))

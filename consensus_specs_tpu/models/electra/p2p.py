@@ -22,9 +22,13 @@ def compute_subnet_for_blob_sidecar_electra(blob_index: BlobIndex) -> SubnetID:
 
 
 def is_valid_attestation_gossip_aggregation_bits(
-        attestation: Attestation) -> bool:
+        state: BeaconState, attestation: Attestation) -> bool:
     """beacon_attestation_{subnet_id} condition: exactly one committee bit
     set and aggregation bits matching that committee's length
     (electra/p2p-interface.md beacon_attestation conditions)."""
     committee_indices = get_committee_indices(attestation.committee_bits)
-    return len(committee_indices) == 1
+    if len(committee_indices) != 1:
+        return False
+    committee = get_beacon_committee(
+        state, attestation.data.slot, committee_indices[0])
+    return len(attestation.aggregation_bits) == len(committee)

@@ -90,8 +90,10 @@ def default_balances(spec):
 
 
 def scaled_churn_balances_min_churn_limit(spec):
+    # firmly over the churn limit: +2 because get_validator_churn_limit
+    # floors the active-count quotient
     num_validators = (spec.config.CHURN_LIMIT_QUOTIENT
-                      * spec.config.MIN_PER_EPOCH_CHURN_LIMIT)
+                      * (spec.config.MIN_PER_EPOCH_CHURN_LIMIT + 2))
     return [spec.MAX_EFFECTIVE_BALANCE] * num_validators
 
 

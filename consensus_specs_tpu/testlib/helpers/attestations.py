@@ -261,10 +261,17 @@ def prepare_state_with_attestations(spec, state, participation_fn=None):
             for committee_index in range(
                     spec.get_committee_count_per_slot(
                         state, spec.get_current_epoch(state))):
+                # participation_fn protocol: (slot, comm_index, comm) ->
+                # participating subset (reference signature)
+                def participants_filter(comm, _slot=state.slot,
+                                        _index=committee_index):
+                    if participation_fn is None:
+                        return comm
+                    return participation_fn(_slot, _index, comm)
                 attestation = get_valid_attestation(
                     spec, state, index=committee_index,
                     signed=True,
-                    filter_participant_set=participation_fn)
+                    filter_participant_set=participants_filter)
                 attestations.append(attestation)
         # fill each created slot in state after inclusion delay
         if state.slot >= start_slot + spec.MIN_ATTESTATION_INCLUSION_DELAY:

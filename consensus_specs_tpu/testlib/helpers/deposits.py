@@ -62,6 +62,58 @@ def deposit_from_context(spec, deposit_data_list, index):
     return deposit, root, deposit_data_list
 
 
+def prepare_full_genesis_deposits(spec, amount, deposit_count,
+                                  min_pubkey_index=0, signed=False,
+                                  deposit_data_list=None):
+    """`deposit_count` uniform deposits for consecutive pubkeys
+    (mirrors `helpers/deposits.py prepare_full_genesis_deposits`)."""
+    if deposit_data_list is None:
+        deposit_data_list = []
+    genesis_deposits = []
+    root = None
+    for pubkey_index in range(min_pubkey_index,
+                              min_pubkey_index + deposit_count):
+        pk = pubkey(pubkey_index)
+        withdrawal_credentials = (
+            bytes(spec.BLS_WITHDRAWAL_PREFIX) + spec.hash(pk)[1:])
+        deposit, root, deposit_data_list = build_deposit(
+            spec, deposit_data_list, pk, privkeys[pubkey_index], amount,
+            withdrawal_credentials, signed)
+        genesis_deposits.append(deposit)
+    return genesis_deposits, root, deposit_data_list
+
+
+def prepare_random_genesis_deposits(spec, deposit_count, max_pubkey_index,
+                                    min_pubkey_index=0, max_amount=None,
+                                    min_amount=None, deposit_data_list=None,
+                                    rng=None):
+    """Random-amount deposits over a random pubkey range (mirrors
+    `helpers/deposits.py prepare_random_genesis_deposits`)."""
+    import random as _random
+
+    rng = rng or _random.Random(3131)
+    if max_amount is None:
+        max_amount = int(spec.MAX_EFFECTIVE_BALANCE)
+    if min_amount is None:
+        min_amount = int(spec.MIN_DEPOSIT_AMOUNT)
+    if deposit_data_list is None:
+        deposit_data_list = []
+    deposits = []
+    root = None
+    for _ in range(deposit_count):
+        pubkey_index = rng.randint(min_pubkey_index, max_pubkey_index)
+        amount = rng.randint(min_amount, max_amount)
+        random_byte = bytes([rng.randint(0, 255)])
+        withdrawal_credentials = (
+            bytes(spec.BLS_WITHDRAWAL_PREFIX) + spec.hash(random_byte)[1:])
+        deposit, root, deposit_data_list = build_deposit(
+            spec, deposit_data_list, pubkey(pubkey_index),
+            privkeys[pubkey_index], amount, withdrawal_credentials,
+            signed=True)
+        deposits.append(deposit)
+    return deposits, root, deposit_data_list
+
+
 def prepare_state_and_deposit(spec, state, validator_index, amount,
                               withdrawal_credentials=None, signed=False):
     """Prepare state for a deposit for validator_index (new or top-up),

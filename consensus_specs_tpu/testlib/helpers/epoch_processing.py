@@ -3,15 +3,21 @@ sub-transition (mirrors `test/helpers/epoch_processing.py:7-104`)."""
 
 from __future__ import annotations
 
-from .forks import is_post_altair
+from .forks import (
+    is_post_altair,
+    is_post_capella,
+    is_post_electra,
+    is_post_fulu,
+)
 
 
 def get_process_calls(spec):
-    """Ordered sub-transition names of `process_epoch` for this fork."""
-    if is_post_altair(spec):
+    """Ordered sub-transition names of `process_epoch` for this fork
+    (must mirror each fork's `process_epoch` body exactly — the slicing
+    helpers below replay a prefix/suffix of this list)."""
+    if not is_post_altair(spec):
         return [
             "process_justification_and_finalization",
-            "process_inactivity_updates",
             "process_rewards_and_penalties",
             "process_registry_updates",
             "process_slashings",
@@ -20,31 +26,48 @@ def get_process_calls(spec):
             "process_slashings_reset",
             "process_randao_mixes_reset",
             "process_historical_roots_update",
-            "process_participation_flag_updates",
-            "process_sync_committee_updates",
+            "process_participation_record_updates",
         ]
-    return [
+    calls = [
         "process_justification_and_finalization",
+        "process_inactivity_updates",
         "process_rewards_and_penalties",
         "process_registry_updates",
         "process_slashings",
         "process_eth1_data_reset",
-        "process_effective_balance_updates",
-        "process_slashings_reset",
-        "process_randao_mixes_reset",
-        "process_historical_roots_update",
-        "process_participation_record_updates",
     ]
+    if is_post_electra(spec):
+        calls += [
+            "process_pending_deposits",
+            "process_pending_consolidations",
+        ]
+    calls += ["process_effective_balance_updates",
+              "process_slashings_reset",
+              "process_randao_mixes_reset"]
+    calls += (["process_historical_summaries_update"]
+              if is_post_capella(spec)
+              else ["process_historical_roots_update"])
+    calls += ["process_participation_flag_updates",
+              "process_sync_committee_updates"]
+    if is_post_fulu(spec):
+        calls += ["process_proposer_lookahead"]
+    return calls
 
 
-def run_epoch_processing_to(spec, state, process_name: str):
-    """Advance to the last slot of the epoch and run the pipeline UP TO
-    (not including) `process_name`."""
+def run_process_slots_up_to_epoch_boundary(spec, state):
+    """Advance slot processing to the last slot of the current epoch."""
     slot = state.slot + (spec.SLOTS_PER_EPOCH
                          - state.slot % spec.SLOTS_PER_EPOCH)
-    # transition to the last slot of the epoch
     if state.slot < slot - 1:
         spec.process_slots(state, slot - 1)
+
+
+def run_epoch_processing_to(spec, state, process_name: str,
+                            enable_slots_processing: bool = True):
+    """Advance to the last slot of the epoch and run the pipeline UP TO
+    (not including) `process_name`."""
+    if enable_slots_processing:
+        run_process_slots_up_to_epoch_boundary(spec, state)
     # start the epoch transition, stopping before `process_name`
     for name in get_process_calls(spec):
         if name == process_name:
@@ -61,10 +84,12 @@ def run_epoch_processing_with(spec, state, process_name: str):
 
 
 def run_epoch_processing_from(spec, state, process_name: str):
-    """Run the pipeline FROM `process_name` (inclusive) to the end."""
+    """Run the pipeline AFTER `process_name` (exclusive) to the end."""
+    assert (state.slot + 1) % spec.SLOTS_PER_EPOCH == 0
     hit = False
     for name in get_process_calls(spec):
         if name == process_name:
             hit = True
+            continue
         if hit:
             getattr(spec, name)(state)

@@ -1,0 +1,105 @@
+"""FFG justification/finalization scenario machinery.
+
+Builds mocked epoch-attestation support so the four finality rules
+(234/23/123/12 — `process_justification_and_finalization`,
+specs/phase0/beacon-chain.md "Justification and finalization") can be
+exercised in isolation.  Scenario parity with the reference's
+`test/phase0/epoch_processing/test_process_justification_and_finalization.py`
+harness (`add_mock_attestations` there).
+"""
+
+from __future__ import annotations
+
+from .forks import is_post_altair
+
+
+def put_mock_attestations(spec, state, epoch, source, target,
+                          sufficient_support=True, messed_up_target=False):
+    """Record attestation support for `target` at `epoch` with `source`,
+    crossing the 2/3 threshold iff `sufficient_support`.
+
+    phase0: appends PendingAttestations to the matching epoch list.
+    altair+: sets participation flags on the attesting committee members
+    (target flag withheld when `messed_up_target`).
+    """
+    # the caller must sit on the last slot of the epoch, as
+    # run_epoch_processing_to leaves it
+    assert (state.slot + 1) % spec.SLOTS_PER_EPOCH == 0
+
+    previous_epoch = spec.get_previous_epoch(state)
+    current_epoch = spec.get_current_epoch(state)
+    assert epoch in (previous_epoch, current_epoch), \
+        f"epoch {epoch} is neither previous nor current"
+
+    if not is_post_altair(spec):
+        attestations = (state.current_epoch_attestations
+                        if epoch == current_epoch
+                        else state.previous_epoch_attestations)
+    else:
+        participation = (state.current_epoch_participation
+                         if epoch == current_epoch
+                         else state.previous_epoch_participation)
+
+    total = int(spec.get_total_active_balance(state))
+    budget = total * 2 // 3  # stop adding support once the 2/3 line is met
+
+    start_slot = spec.compute_start_slot_at_epoch(epoch)
+    per_slot = spec.get_committee_count_per_slot(state, epoch)
+    for slot in range(start_slot, start_slot + spec.SLOTS_PER_EPOCH):
+        for index in range(per_slot):
+            if budget < 0:
+                return
+            committee = spec.get_beacon_committee(state, slot, index)
+            bits = [0] * len(committee)
+            for pos in range(len(committee) * 2 // 3 + 1):
+                if budget <= 0:
+                    break
+                budget -= int(state.validators[committee[pos]]
+                              .effective_balance)
+                bits[pos] = 1
+            if not sufficient_support:
+                # drop a fifth of the attesters: support stays below 2/3
+                for pos in range(max(len(committee) // 5, 1)):
+                    bits[pos] = 0
+
+            if not is_post_altair(spec):
+                pending = spec.PendingAttestation(
+                    aggregation_bits=bits,
+                    data=spec.AttestationData(
+                        slot=slot,
+                        beacon_block_root=b"\xff" * 32,
+                        source=source,
+                        target=target,
+                        index=index,
+                    ),
+                    inclusion_delay=1,
+                )
+                if messed_up_target:
+                    pending.data.target.root = b"\x99" * 32
+                attestations.append(pending)
+            else:
+                flags = (spec.ParticipationFlags(
+                    2**spec.TIMELY_HEAD_FLAG_INDEX
+                    | 2**spec.TIMELY_SOURCE_FLAG_INDEX))
+                if not messed_up_target:
+                    flags |= spec.ParticipationFlags(
+                        2**spec.TIMELY_TARGET_FLAG_INDEX)
+                for pos, vindex in enumerate(committee):
+                    if bits[pos]:
+                        participation[vindex] |= flags
+
+
+def mock_checkpoints(spec, epoch):
+    """Distinct checkpoints 1..5 epochs back (None where out of range)."""
+    marks = (b"\xaa", b"\xbb", b"\xcc", b"\xdd", b"\xee")
+    return tuple(
+        spec.Checkpoint(epoch=epoch - back, root=marks[back - 1] * 32)
+        if epoch >= back else None
+        for back in range(1, 6))
+
+
+def put_checkpoint_roots(spec, state, checkpoints):
+    for c in checkpoints:
+        if c is not None:
+            slot = spec.compute_start_slot_at_epoch(c.epoch)
+            state.block_roots[slot % spec.SLOTS_PER_HISTORICAL_ROOT] = c.root

@@ -61,6 +61,45 @@ def state_transition_and_sign_block(spec, state, block,
     return sign_block(spec, state, block)
 
 
+def _full_flags(spec):
+    flags = spec.ParticipationFlags(0)
+    for flag_index in range(len(spec.PARTICIPATION_FLAG_WEIGHTS)):
+        flags = spec.add_flag(flags, flag_index)
+    return flags
+
+
+def set_full_participation(spec, state, current=True, previous=True):
+    """Mark every validator as fully participating (altair+ flags)."""
+    from .forks import is_post_altair
+
+    assert is_post_altair(spec)
+    flags = _full_flags(spec)
+    for index in range(len(state.validators)):
+        if current:
+            state.current_epoch_participation[index] = flags
+        if previous:
+            state.previous_epoch_participation[index] = flags
+
+
+def set_empty_participation(spec, state, current=True, previous=True):
+    from .forks import is_post_altair
+
+    assert is_post_altair(spec)
+    for index in range(len(state.validators)):
+        if current:
+            state.current_epoch_participation[index] = \
+                spec.ParticipationFlags(0)
+        if previous:
+            state.previous_epoch_participation[index] = \
+                spec.ParticipationFlags(0)
+
+
+def next_epoch_with_full_participation(spec, state):
+    """Transition to the next-epoch start slot with full participation."""
+    set_full_participation(spec, state)
+    next_epoch(spec, state)
+
+
 def get_balance(state, index):
     return state.balances[index]
 

@@ -14,7 +14,12 @@ def compute_sync_committee_signature(spec, state, slot, privkey_int,
     domain = spec.get_domain(state, domain_type or spec.DOMAIN_SYNC_COMMITTEE,
                              spec.compute_epoch_at_slot(slot))
     if block_root is None:
-        block_root = spec.get_block_root_at_slot(state, slot)
+        if slot == state.slot:
+            # head root of the in-progress slot: the next block's parent
+            block_root = build_empty_block_for_next_slot(
+                spec, state).parent_root
+        else:
+            block_root = spec.get_block_root_at_slot(state, slot)
     signing_root = spec.compute_signing_root(block_root, domain)
     return bls.Sign(privkey_int, signing_root)
 

@@ -35,6 +35,25 @@ def sign_voluntary_exit(spec, state, voluntary_exit, privkey_int,
     )
 
 
+def get_unslashed_exited_validators(spec, state):
+    """Indices that exited (epoch <= current) without being slashed."""
+    current_epoch = spec.get_current_epoch(state)
+    return [
+        index for index, validator in enumerate(state.validators)
+        if not validator.slashed and validator.exit_epoch <= current_epoch
+    ]
+
+
+def exit_validators(spec, state, indices):
+    """Force-exit `indices` immediately (no signed exits involved)."""
+    current_epoch = spec.get_current_epoch(state)
+    for index in indices:
+        validator = state.validators[index]
+        validator.exit_epoch = current_epoch
+        validator.withdrawable_epoch = spec.Epoch(
+            current_epoch + spec.config.MIN_VALIDATOR_WITHDRAWABILITY_DELAY)
+
+
 def run_voluntary_exit_processing(spec, state, signed_voluntary_exit,
                                   valid=True):
     validator_index = signed_voluntary_exit.message.validator_index

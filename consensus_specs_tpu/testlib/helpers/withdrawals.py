@@ -44,6 +44,50 @@ def set_validator_partially_withdrawable(spec, state, index,
         validator, state.balances[index])
 
 
+def set_eth1_withdrawal_credential_with_balance(spec, state, index,
+                                                balance=None,
+                                                effective_balance=None,
+                                                address=None):
+    if address is None:
+        address = b"\x11" * 20
+    state.validators[index].withdrawal_credentials = (
+        bytes(spec.ETH1_ADDRESS_WITHDRAWAL_PREFIX) + b"\x00" * 11 + address)
+    if balance is None and effective_balance is None:
+        return
+    if balance is None:
+        balance = effective_balance
+    elif effective_balance is None:
+        effective_balance = min(
+            balance - balance % spec.EFFECTIVE_BALANCE_INCREMENT,
+            spec.MAX_EFFECTIVE_BALANCE)
+    state.validators[index].effective_balance = effective_balance
+    state.balances[index] = balance
+
+
+def set_compounding_withdrawal_credential(spec, state, index, address=None):
+    if address is None:
+        address = b"\x11" * 20
+    state.validators[index].withdrawal_credentials = (
+        bytes(spec.COMPOUNDING_WITHDRAWAL_PREFIX) + b"\x00" * 11 + address)
+
+
+def set_compounding_withdrawal_credential_with_balance(
+        spec, state, index, effective_balance=None, balance=None,
+        address=None):
+    set_compounding_withdrawal_credential(spec, state, index, address)
+    if balance is None and effective_balance is None:
+        balance = spec.MAX_EFFECTIVE_BALANCE_ELECTRA
+        effective_balance = spec.MAX_EFFECTIVE_BALANCE_ELECTRA
+    elif balance is None:
+        balance = effective_balance
+    elif effective_balance is None:
+        effective_balance = min(
+            balance - balance % spec.EFFECTIVE_BALANCE_INCREMENT,
+            spec.MAX_EFFECTIVE_BALANCE_ELECTRA)
+    state.validators[index].effective_balance = effective_balance
+    state.balances[index] = balance
+
+
 def prepare_expected_withdrawals(spec, state, rng,
                                  num_full_withdrawals=0,
                                  num_partial_withdrawals=0):

@@ -7,15 +7,49 @@ from pathlib import Path
 REPO_ROOT = Path(__file__).resolve().parent.parent.parent
 
 
+def host_cache_key() -> str:
+    """Host+platform fingerprint for the compile-cache directory.  XLA:CPU
+    AOT results are machine-feature sensitive, and this repo moves between
+    machines (driver vs dev box): a shared flat cache demonstrably loaded
+    cross-machine entries (round-4 multichip log was full of 'machine
+    features ... doesn't match' warnings), and a poisoned entry can break a
+    later TPU compile.  Keying the directory by machine/cpu-count/platform
+    pin makes stale cross-host reuse structurally impossible."""
+    import hashlib
+    import os
+    import platform
+
+    plat = os.environ.get("JAX_PLATFORMS", "default") or "default"
+    # machine()/cpu_count alone cannot distinguish two x86_64 hosts with
+    # different ISA extensions — hash the kernel's CPU feature flags too
+    feats = ""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    feats = hashlib.sha256(
+                        line.encode()).hexdigest()[:12]
+                    break
+    except OSError:
+        pass
+    return f"{platform.machine()}-{os.cpu_count()}cpu-{feats}-{plat}"
+
+
 def enable_compile_cache(cache_dir: Path | None = None) -> None:
-    """Point JAX's persistent compilation cache at `.jax_cache/` so repeated
-    bench / driver runs on one machine pay the XLA compile once.  Failure is
-    never fatal — the cache is an optimization."""
+    """Point JAX's persistent compilation cache at a host-keyed subdir of
+    `.jax_cache/` so repeated bench / driver runs on one machine pay the
+    XLA compile once.  Failure is never fatal — the cache is an
+    optimization.  Set CST_NO_COMPILE_CACHE=1 to disable entirely (bench
+    retry path uses this to rule out cache poisoning)."""
+    import os
+
     import jax
 
+    if os.environ.get("CST_NO_COMPILE_CACHE"):
+        return
     try:
-        d = cache_dir or (REPO_ROOT / ".jax_cache")
-        d.mkdir(exist_ok=True)
+        d = cache_dir or (REPO_ROOT / ".jax_cache" / host_cache_key())
+        d.mkdir(parents=True, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", str(d))
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)

@@ -76,20 +76,34 @@ def dump_kzg_trusted_setup_files(secret: int, g1_length: int,
     setup_g1_lagrange = get_lagrange(setup_g1)
     roots_of_unity = compute_roots_of_unity(g1_length)
 
+    g1_monomial = ["0x" + bls.G1_to_bytes48(p).hex() for p in setup_g1]
+    g2_monomial = ["0x" + bls.G2_to_bytes96(p).hex() for p in setup_g2]
+    g1_lagrange = ["0x" + b.hex() for b in setup_g1_lagrange]
+
     out = Path(output_dir)
     os.makedirs(out, exist_ok=True)
-    path = out / "testing_trusted_setups.json"
+    # modern key names, loadable by the in-tree setup loader
+    # (models/deneb/polynomial_commitments.py reads g1_monomial/g1_lagrange/
+    # g2_monomial from trusted_setup_<n>.json)
+    path = out / f"trusted_setup_{len(setup_g1)}.json"
     with open(path, "w") as f:
         json.dump({
-            "setup_G1": ["0x" + bls.G1_to_bytes48(p).hex()
-                         for p in setup_g1],
-            "setup_G2": ["0x" + bls.G2_to_bytes96(p).hex()
-                         for p in setup_g2],
-            "setup_G1_lagrange": ["0x" + b.hex()
-                                  for b in setup_g1_lagrange],
-            "roots_of_unity": roots_of_unity,
+            "g1_monomial": g1_monomial,
+            "g1_lagrange": g1_lagrange,
+            "g2_monomial": g2_monomial,
         }, f)
     print(f"Generated trusted setup file: {path}")
+    # legacy-named companion kept for parity with the reference's
+    # testing_trusted_setups.json output shape
+    legacy = out / "testing_trusted_setups.json"
+    with open(legacy, "w") as f:
+        json.dump({
+            "setup_G1": g1_monomial,
+            "setup_G2": g2_monomial,
+            "setup_G1_lagrange": g1_lagrange,
+            "roots_of_unity": roots_of_unity,
+        }, f)
+    print(f"Generated trusted setup file: {legacy}")
 
 
 def main(argv=None):

@@ -453,6 +453,17 @@ class _BitsBase(MutableView):
         return bool(self._bits[i])
 
     def __setitem__(self, i, v):
+        if isinstance(i, slice):
+            idxs = range(*i.indices(self._nbits))
+            vals = list(v)
+            if len(vals) != len(idxs):
+                raise ValueError(
+                    f"cannot assign {len(vals)} bits to slice of "
+                    f"length {len(idxs)}")
+            for j, val in zip(idxs, vals):
+                self._bits[j] = bool(val)
+            self._mark_dirty()
+            return
         i = int(i)
         if i < 0 or i >= self._nbits:
             raise IndexError(f"bit index {i} out of range for length {self._nbits}")

@@ -1,0 +1,125 @@
+"""Altair: process_participation_flag_updates (scenario parity:
+`test/altair/epoch_processing/test_process_participation_flag_updates.py`)."""
+
+from random import Random
+
+from consensus_specs_tpu.testlib.context import (
+    ALTAIR,
+    spec_state_test,
+    with_all_phases_from,
+)
+from consensus_specs_tpu.testlib.helpers.epoch_processing import (
+    run_epoch_processing_with,
+)
+from consensus_specs_tpu.testlib.helpers.state import next_epoch_via_block
+
+with_altair_and_later = with_all_phases_from(ALTAIR)
+
+
+def get_full_flags(spec):
+    full_flags = spec.ParticipationFlags(0)
+    for flag_index in range(len(spec.PARTICIPATION_FLAG_WEIGHTS)):
+        full_flags = spec.add_flag(full_flags, flag_index)
+    return full_flags
+
+
+def run_process_participation_flag_updates(spec, state):
+    old = state.current_epoch_participation.copy()
+    yield from run_epoch_processing_with(
+        spec, state, "process_participation_flag_updates")
+    assert state.current_epoch_participation == \
+        [0] * len(state.validators)
+    assert state.previous_epoch_participation == old
+
+
+@with_altair_and_later
+@spec_state_test
+def test_all_zeroed(spec, state):
+    next_epoch_via_block(spec, state)
+    state.current_epoch_participation = [0] * len(state.validators)
+    state.previous_epoch_participation = [0] * len(state.validators)
+    yield from run_process_participation_flag_updates(spec, state)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_filled(spec, state):
+    next_epoch_via_block(spec, state)
+    state.previous_epoch_participation = \
+        [get_full_flags(spec)] * len(state.validators)
+    state.current_epoch_participation = \
+        [get_full_flags(spec)] * len(state.validators)
+    yield from run_process_participation_flag_updates(spec, state)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_previous_filled(spec, state):
+    next_epoch_via_block(spec, state)
+    state.previous_epoch_participation = \
+        [get_full_flags(spec)] * len(state.validators)
+    state.current_epoch_participation = [0] * len(state.validators)
+    yield from run_process_participation_flag_updates(spec, state)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_current_filled(spec, state):
+    next_epoch_via_block(spec, state)
+    state.previous_epoch_participation = [0] * len(state.validators)
+    state.current_epoch_participation = \
+        [get_full_flags(spec)] * len(state.validators)
+    yield from run_process_participation_flag_updates(spec, state)
+
+
+def random_flags(spec, state, seed, previous=True, current=True):
+    rng = Random(seed)
+    count = len(state.validators)
+    bound = 2 ** len(spec.PARTICIPATION_FLAG_WEIGHTS)
+    if previous:
+        state.previous_epoch_participation = [
+            rng.randrange(0, bound) for _ in range(count)]
+    if current:
+        state.current_epoch_participation = [
+            rng.randrange(0, bound) for _ in range(count)]
+
+
+@with_altair_and_later
+@spec_state_test
+def test_random_0(spec, state):
+    next_epoch_via_block(spec, state)
+    random_flags(spec, state, 100)
+    yield from run_process_participation_flag_updates(spec, state)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_random_1(spec, state):
+    next_epoch_via_block(spec, state)
+    random_flags(spec, state, 101)
+    yield from run_process_participation_flag_updates(spec, state)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_random_genesis(spec, state):
+    random_flags(spec, state, 11)
+    yield from run_process_participation_flag_updates(spec, state)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_current_epoch_zeroed(spec, state):
+    next_epoch_via_block(spec, state)
+    random_flags(spec, state, 12, current=False)
+    state.current_epoch_participation = [0] * len(state.validators)
+    yield from run_process_participation_flag_updates(spec, state)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_previous_epoch_zeroed(spec, state):
+    next_epoch_via_block(spec, state)
+    random_flags(spec, state, 13, previous=False)
+    state.previous_epoch_participation = [0] * len(state.validators)
+    yield from run_process_participation_flag_updates(spec, state)

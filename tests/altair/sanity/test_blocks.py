@@ -1,0 +1,117 @@
+"""Altair sanity: blocks exercising sync aggregates and inactivity
+(scenario parity: `test/altair/sanity/test_blocks.py`)."""
+
+from consensus_specs_tpu.testlib.context import (
+    ALTAIR,
+    spec_state_test,
+    with_all_phases_from,
+)
+from consensus_specs_tpu.testlib.helpers.block import (
+    build_empty_block_for_next_slot,
+)
+from consensus_specs_tpu.testlib.helpers.state import (
+    next_epoch,
+    state_transition_and_sign_block,
+    transition_to,
+)
+from consensus_specs_tpu.testlib.helpers.sync_committee import (
+    compute_aggregate_sync_committee_signature,
+    compute_committee_indices,
+)
+
+with_altair_and_later = with_all_phases_from(ALTAIR)
+
+
+def run_sync_committee_sanity_test(spec, state, fraction_full=1.0, rng=None):
+    all_pubkeys = [v.pubkey for v in state.validators]
+    committee = [all_pubkeys.index(pubkey) for pubkey in
+                 state.current_sync_committee.pubkeys]
+    participants = int(len(committee) * fraction_full)
+
+    yield "pre", state
+
+    block = build_empty_block_for_next_slot(spec, state)
+
+    committee_indices = compute_committee_indices(
+        state, state.current_sync_committee)
+    committee_bits = [index in committee[:participants]
+                      for index in committee]
+    participating = [idx for idx, bit in
+                     zip(committee_indices, committee_bits) if bit]
+    block.body.sync_aggregate = spec.SyncAggregate(
+        sync_committee_bits=committee_bits,
+        sync_committee_signature=compute_aggregate_sync_committee_signature(
+            spec, state, block.slot - 1, participating),
+    )
+    signed_block = state_transition_and_sign_block(spec, state, block)
+
+    yield "blocks", [signed_block]
+    yield "post", state
+
+
+@with_altair_and_later
+@spec_state_test
+def test_full_sync_committee_committee(spec, state):
+    next_epoch(spec, state)
+    yield from run_sync_committee_sanity_test(spec, state, 1.0)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_half_sync_committee_committee(spec, state):
+    next_epoch(spec, state)
+    yield from run_sync_committee_sanity_test(spec, state, 0.5)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_empty_sync_committee_committee(spec, state):
+    next_epoch(spec, state)
+    yield from run_sync_committee_sanity_test(spec, state, 0.0)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_full_sync_committee_committee_genesis(spec, state):
+    yield from run_sync_committee_sanity_test(spec, state, 1.0)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_half_sync_committee_committee_genesis(spec, state):
+    yield from run_sync_committee_sanity_test(spec, state, 0.5)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_empty_sync_committee_committee_genesis(spec, state):
+    yield from run_sync_committee_sanity_test(spec, state, 0.0)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_inactivity_scores_updated_over_epoch(spec, state):
+    """Leak long enough that inactivity scores rise through block-driven
+    epoch transitions."""
+    # move into the leak
+    for _ in range(int(spec.MIN_EPOCHS_TO_INACTIVITY_PENALTY) + 2):
+        next_epoch(spec, state)
+    assert spec.is_in_inactivity_leak(state)
+    previous_scores = state.inactivity_scores.copy()
+
+    yield "pre", state
+
+    # one empty-block epoch inside the leak
+    blocks = []
+    target = state.slot + spec.SLOTS_PER_EPOCH \
+        - state.slot % spec.SLOTS_PER_EPOCH
+    block = build_empty_block_for_next_slot(spec, state)
+    signed = state_transition_and_sign_block(spec, state, block)
+    blocks.append(signed)
+    transition_to(spec, state, target)
+
+    yield "blocks", blocks
+    yield "post", state
+
+    for index in spec.get_eligible_validator_indices(state):
+        assert state.inactivity_scores[index] > previous_scores[index]

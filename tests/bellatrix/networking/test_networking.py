@@ -1,0 +1,48 @@
+"""bellatrix p2p deltas (spec: specs/bellatrix/p2p-interface.md —
+beacon_block gossip conditions around execution payloads)."""
+
+from consensus_specs_tpu.testlib.context import (
+    spec_state_test,
+    with_all_phases_from,
+)
+from consensus_specs_tpu.testlib.helpers.block import build_empty_block
+from consensus_specs_tpu.testlib.helpers.execution_payload import (
+    build_empty_execution_payload,
+    build_state_with_complete_transition,
+    build_state_with_incomplete_transition,
+)
+from consensus_specs_tpu.testlib.helpers.state import next_slot
+
+
+@with_all_phases_from("bellatrix")
+@spec_state_test
+def test_gossip_execution_payload_timestamp_valid(spec, state):
+    state = build_state_with_complete_transition(spec, state)
+    next_slot(spec, state)
+    block = build_empty_block(spec, state)
+    block.body.execution_payload = build_empty_execution_payload(spec, state)
+    assert spec.is_valid_gossip_execution_payload_timestamp(state, block)
+    yield None
+
+
+@with_all_phases_from("bellatrix")
+@spec_state_test
+def test_gossip_execution_payload_timestamp_invalid(spec, state):
+    state = build_state_with_complete_transition(spec, state)
+    next_slot(spec, state)
+    block = build_empty_block(spec, state)
+    block.body.execution_payload = build_empty_execution_payload(spec, state)
+    block.body.execution_payload.timestamp += 1
+    assert not spec.is_valid_gossip_execution_payload_timestamp(state, block)
+    yield None
+
+
+@with_all_phases_from("bellatrix")
+@spec_state_test
+def test_gossip_execution_payload_timestamp_pre_transition(spec, state):
+    # before the merge transition completes, the condition is vacuous
+    state = build_state_with_incomplete_transition(spec, state)
+    next_slot(spec, state)
+    block = build_empty_block(spec, state)
+    assert spec.is_valid_gossip_execution_payload_timestamp(state, block)
+    yield None

@@ -1,0 +1,97 @@
+"""Bellatrix sanity: blocks around the merge transition (scenario
+parity: `test/bellatrix/sanity/test_blocks.py`)."""
+
+from consensus_specs_tpu.testlib.context import (
+    BELLATRIX,
+    spec_state_test,
+    with_all_phases_from,
+    with_phases,
+)
+from consensus_specs_tpu.testlib.helpers.block import (
+    build_empty_block_for_next_slot,
+)
+from consensus_specs_tpu.testlib.helpers.execution_payload import (
+    build_empty_execution_payload,
+    build_state_with_complete_transition,
+    build_state_with_incomplete_transition,
+)
+from consensus_specs_tpu.testlib.helpers.state import (
+    next_slot,
+    state_transition_and_sign_block,
+)
+
+with_bellatrix_and_later = with_all_phases_from(BELLATRIX)
+
+
+@with_bellatrix_and_later
+@spec_state_test
+def test_empty_block_transition_no_tx(spec, state):
+    yield "pre", state
+
+    block = build_empty_block_for_next_slot(spec, state)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+
+    yield "blocks", [signed_block]
+    yield "post", state
+
+    assert (state.latest_execution_payload_header.block_hash
+            == block.body.execution_payload.block_hash)
+
+
+# pre-merge scenarios are bellatrix-only: capella+ removed the
+# is_execution_enabled gate and always processes the payload
+@with_phases([BELLATRIX])
+@spec_state_test
+def test_empty_block_transition_pre_merge(spec, state):
+    state = build_state_with_incomplete_transition(spec, state)
+    assert not spec.is_merge_transition_complete(state)
+
+    yield "pre", state
+
+    block = build_empty_block_for_next_slot(spec, state)
+    # pre-merge blocks carry the default (empty) payload
+    block.body.execution_payload = spec.ExecutionPayload()
+    signed_block = state_transition_and_sign_block(spec, state, block)
+
+    yield "blocks", [signed_block]
+    yield "post", state
+
+    assert not spec.is_merge_transition_complete(state)
+
+
+@with_phases([BELLATRIX])
+@spec_state_test
+def test_block_transition_completes_merge(spec, state):
+    """The first non-empty payload flips is_merge_transition_complete."""
+    state = build_state_with_incomplete_transition(spec, state)
+    next_slot(spec, state)
+    assert not spec.is_merge_transition_complete(state)
+
+    yield "pre", state
+
+    # build_empty_block attaches a payload built at the block's slot
+    block = build_empty_block_for_next_slot(spec, state)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+
+    yield "blocks", [signed_block]
+    yield "post", state
+
+    assert spec.is_merge_transition_complete(state)
+
+
+@with_bellatrix_and_later
+@spec_state_test
+def test_multiple_blocks_post_merge(spec, state):
+    state = build_state_with_complete_transition(spec, state)
+
+    yield "pre", state
+
+    blocks = []
+    for _ in range(3):
+        block = build_empty_block_for_next_slot(spec, state)
+        blocks.append(state_transition_and_sign_block(spec, state, block))
+
+    yield "blocks", blocks
+    yield "post", state
+
+    assert spec.is_merge_transition_complete(state)

@@ -1,0 +1,31 @@
+"""Capella: process_historical_summaries_update (scenario parity:
+`test/capella/epoch_processing/test_process_historical_summaries_update.py`)."""
+
+from consensus_specs_tpu.testlib.context import (
+    CAPELLA,
+    spec_state_test,
+    with_all_phases_from,
+)
+from consensus_specs_tpu.testlib.helpers.epoch_processing import (
+    run_epoch_processing_with,
+)
+
+with_capella_and_later = with_all_phases_from(CAPELLA)
+
+
+@with_capella_and_later
+@spec_state_test
+def test_historical_summaries_accumulator(spec, state):
+    state.slot = spec.SLOTS_PER_HISTORICAL_ROOT - 1
+    pre_summaries = state.historical_summaries.copy()
+
+    yield from run_epoch_processing_with(
+        spec, state, "process_historical_summaries_update")
+
+    assert len(state.historical_summaries) == len(pre_summaries) + 1
+    summary = state.historical_summaries[
+        len(state.historical_summaries) - 1]
+    assert summary.block_summary_root == \
+        spec.hash_tree_root(state.block_roots)
+    assert summary.state_summary_root == \
+        spec.hash_tree_root(state.state_roots)

@@ -1,0 +1,114 @@
+"""Capella sanity: withdrawals + BLS-to-execution changes in blocks
+(scenario parity: `test/capella/sanity/test_blocks.py`)."""
+
+from consensus_specs_tpu.testlib.context import (
+    CAPELLA,
+    spec_state_test,
+    with_all_phases_from,
+)
+from consensus_specs_tpu.testlib.helpers.block import (
+    build_empty_block_for_next_slot,
+)
+from consensus_specs_tpu.testlib.helpers.bls_to_execution_changes import (
+    get_signed_address_change,
+)
+from consensus_specs_tpu.testlib.helpers.state import (
+    state_transition_and_sign_block,
+)
+from consensus_specs_tpu.testlib.helpers.withdrawals import (
+    set_validator_fully_withdrawable,
+    set_validator_partially_withdrawable,
+)
+
+with_capella_and_later = with_all_phases_from(CAPELLA)
+
+
+@with_capella_and_later
+@spec_state_test
+def test_successful_bls_change(spec, state):
+    index = 0
+    signed_address_change = get_signed_address_change(spec, state,
+                                                      validator_index=index)
+    pre_credentials = state.validators[index].withdrawal_credentials
+
+    yield "pre", state
+
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.bls_to_execution_changes.append(signed_address_change)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+
+    yield "blocks", [signed_block]
+    yield "post", state
+
+    post_credentials = state.validators[index].withdrawal_credentials
+    assert pre_credentials != post_credentials
+    assert post_credentials[:1] == spec.ETH1_ADDRESS_WITHDRAWAL_PREFIX
+    assert (post_credentials[12:]
+            == signed_address_change.message.to_execution_address)
+
+
+@with_capella_and_later
+@spec_state_test
+def test_full_withdrawal_in_block(spec, state):
+    index = 0
+    set_validator_fully_withdrawable(spec, state, index)
+    pre_balance = int(state.balances[index])
+    assert pre_balance > 0
+
+    yield "pre", state
+
+    block = build_empty_block_for_next_slot(spec, state)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+
+    yield "blocks", [signed_block]
+    yield "post", state
+
+    assert state.balances[index] == 0
+    assert len(block.body.execution_payload.withdrawals) >= 1
+    assert any(w.validator_index == index
+               for w in block.body.execution_payload.withdrawals)
+
+
+@with_capella_and_later
+@spec_state_test
+def test_partial_withdrawal_in_block(spec, state):
+    index = 0
+    excess = spec.EFFECTIVE_BALANCE_INCREMENT
+    set_validator_partially_withdrawable(spec, state, index,
+                                         excess_balance=excess)
+    pre_balance = int(state.balances[index])
+
+    yield "pre", state
+
+    block = build_empty_block_for_next_slot(spec, state)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+
+    yield "blocks", [signed_block]
+    yield "post", state
+
+    assert state.balances[index] < pre_balance
+    assert any(w.validator_index == index
+               for w in block.body.execution_payload.withdrawals)
+
+
+@with_capella_and_later
+@spec_state_test
+def test_bls_change_and_withdrawal_in_same_block(spec, state):
+    change_index = 1
+    withdraw_index = 0
+    set_validator_fully_withdrawable(spec, state, withdraw_index)
+    signed_address_change = get_signed_address_change(
+        spec, state, validator_index=change_index)
+
+    yield "pre", state
+
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.bls_to_execution_changes.append(signed_address_change)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+
+    yield "blocks", [signed_block]
+    yield "post", state
+
+    assert state.balances[withdraw_index] == 0
+    assert (state.validators[change_index].withdrawal_credentials[:1]
+            == spec.ETH1_ADDRESS_WITHDRAWAL_PREFIX)

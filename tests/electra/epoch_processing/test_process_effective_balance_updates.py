@@ -1,0 +1,72 @@
+"""Electra: process_effective_balance_updates with compounding
+credentials — hysteresis against MAX_EFFECTIVE_BALANCE_ELECTRA (scenario
+parity: `test/electra/epoch_processing/test_process_effective_balance_updates.py`)."""
+
+from consensus_specs_tpu.testlib.context import (
+    ELECTRA,
+    spec_state_test,
+    with_all_phases_from,
+)
+from consensus_specs_tpu.testlib.helpers.epoch_processing import (
+    run_epoch_processing_to,
+    run_process_slots_up_to_epoch_boundary,
+)
+from consensus_specs_tpu.testlib.helpers.withdrawals import (
+    set_compounding_withdrawal_credential,
+)
+
+with_electra_and_later = with_all_phases_from(ELECTRA)
+
+
+@with_electra_and_later
+@spec_state_test
+def test_effective_balance_hysteresis_with_compounding_credentials(
+        spec, state):
+    run_process_slots_up_to_epoch_boundary(spec, state)
+    yield "pre_epoch", state
+    run_epoch_processing_to(spec, state,
+                            "process_effective_balance_updates",
+                            enable_slots_processing=False)
+
+    top = int(spec.MAX_EFFECTIVE_BALANCE_ELECTRA)
+    low = int(spec.MIN_ACTIVATION_BALANCE)
+    inc = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    div = int(spec.HYSTERESIS_QUOTIENT)
+    hys_inc = inc // div
+    down = int(spec.HYSTERESIS_DOWNWARD_MULTIPLIER)
+    up = int(spec.HYSTERESIS_UPWARD_MULTIPLIER)
+    # (pre effective, balance, expected post effective, label)
+    cases = [
+        (top, top, top, "as-is"),
+        (top, top - 1, top, "round up"),
+        (top, top + 1, top, "round down"),
+        (top, top - down * hys_inc, top, "lower balance, not low enough"),
+        (top, top - down * hys_inc - 1, top - inc, "step down"),
+        (top, top + up * hys_inc + 1, top, "already at max, as is"),
+        (top, top - inc, top - inc, "exactly 1 step lower"),
+        (top, top - inc - 1, top - 2 * inc, "past 1 step, double step"),
+        (top, top - inc + 1, top - inc, "close to 1 step lower"),
+        (low, low + hys_inc * up, low, "bigger balance, not high enough"),
+        (low, low + hys_inc * up + 1, low + inc, "high enough, small step"),
+        (low, low + hys_inc * div * 2 - 1, low + inc,
+         "close to double step"),
+        (low, low + hys_inc * div * 2, low + 2 * inc, "exact two steps"),
+        (low, low + hys_inc * div * 2 + 1, low + 2 * inc,
+         "over two steps, round down"),
+        (low, low * 2 + 1, low * 2, "doubled balance (consolidation)"),
+        (low, low * 2 - 1, low * 2 - inc, "almost doubled balance"),
+    ]
+
+    current_epoch = spec.get_current_epoch(state)
+    for i, (pre_eff, bal, _, _) in enumerate(cases):
+        assert spec.is_active_validator(state.validators[i], current_epoch)
+        set_compounding_withdrawal_credential(spec, state, i)
+        state.validators[i].effective_balance = pre_eff
+        state.balances[i] = bal
+
+    yield "pre", state
+    spec.process_effective_balance_updates(state)
+    yield "post", state
+
+    for i, (_, _, post_eff, label) in enumerate(cases):
+        assert state.validators[i].effective_balance == post_eff, label

@@ -1,0 +1,264 @@
+"""Electra: process_pending_consolidations (scenario parity:
+`test/electra/epoch_processing/test_process_pending_consolidations.py`)."""
+
+from consensus_specs_tpu.testlib.context import (
+    ELECTRA,
+    spec_state_test,
+    with_all_phases_from,
+)
+from consensus_specs_tpu.testlib.helpers.epoch_processing import (
+    run_epoch_processing_to,
+    run_epoch_processing_with,
+)
+from consensus_specs_tpu.testlib.helpers.state import (
+    next_epoch_with_full_participation,
+)
+from consensus_specs_tpu.testlib.helpers.withdrawals import (
+    set_compounding_withdrawal_credential_with_balance,
+    set_eth1_withdrawal_credential_with_balance,
+)
+
+with_electra_and_later = with_all_phases_from(ELECTRA)
+
+ETH1_CREDENTIAL = None  # placeholder; computed per spec below
+
+
+def _eth1_credential(spec):
+    return (bytes(spec.ETH1_ADDRESS_WITHDRAWAL_PREFIX) + b"\x00" * 11
+            + b"\x11" * 20)
+
+
+@with_electra_and_later
+@spec_state_test
+def test_basic_pending_consolidation(spec, state):
+    current_epoch = spec.get_current_epoch(state)
+    source_index = spec.get_active_validator_indices(state, current_epoch)[0]
+    target_index = spec.get_active_validator_indices(state, current_epoch)[1]
+    state.pending_consolidations.append(spec.PendingConsolidation(
+        source_index=source_index, target_index=target_index))
+    # withdrawable now => consolidation can settle
+    state.validators[source_index].withdrawable_epoch = current_epoch
+    state.validators[target_index].withdrawal_credentials = \
+        _eth1_credential(spec)
+
+    yield from run_epoch_processing_with(
+        spec, state, "process_pending_consolidations")
+
+    assert state.balances[target_index] == 2 * spec.MIN_ACTIVATION_BALANCE
+    assert state.balances[source_index] == 0
+    assert state.pending_consolidations == []
+
+
+@with_electra_and_later
+@spec_state_test
+def test_consolidation_not_yet_withdrawable_validator(spec, state):
+    current_epoch = spec.get_current_epoch(state)
+    source_index = spec.get_active_validator_indices(state, current_epoch)[0]
+    target_index = spec.get_active_validator_indices(state, current_epoch)[1]
+    state.pending_consolidations.append(spec.PendingConsolidation(
+        source_index=source_index, target_index=target_index))
+    state.validators[target_index].withdrawal_credentials = \
+        _eth1_credential(spec)
+    spec.initiate_validator_exit(state, source_index)
+
+    pre_pending = state.pending_consolidations.copy()
+    pre_balances = state.balances.copy()
+
+    yield from run_epoch_processing_with(
+        spec, state, "process_pending_consolidations")
+
+    # queue blocked on the unwithdrawable source: nothing changed
+    assert state.balances[source_index] == pre_balances[0]
+    assert state.balances[target_index] == pre_balances[1]
+    assert state.pending_consolidations == pre_pending
+
+
+@with_electra_and_later
+@spec_state_test
+def test_skip_consolidation_when_source_slashed(spec, state):
+    current_epoch = spec.get_current_epoch(state)
+    active = spec.get_active_validator_indices(state, current_epoch)
+    source0, target0, source1, target1 = active[0], active[1], active[2], \
+        active[3]
+    state.pending_consolidations.append(spec.PendingConsolidation(
+        source_index=source0, target_index=target0))
+    state.pending_consolidations.append(spec.PendingConsolidation(
+        source_index=source1, target_index=target1))
+
+    for t in (target0, target1):
+        state.validators[t].withdrawal_credentials = _eth1_credential(spec)
+    for s in (source0, source1):
+        state.validators[s].withdrawable_epoch = current_epoch
+
+    # slashed source: its consolidation is skipped but doesn't block
+    state.validators[source0].slashed = True
+
+    yield from run_epoch_processing_with(
+        spec, state, "process_pending_consolidations")
+
+    assert state.balances[target0] == spec.MIN_ACTIVATION_BALANCE
+    assert state.balances[source0] == spec.MIN_ACTIVATION_BALANCE
+    assert state.balances[target1] == 2 * spec.MIN_ACTIVATION_BALANCE
+    assert state.balances[source1] == 0
+
+
+@with_electra_and_later
+@spec_state_test
+def test_all_consolidation_cases_together(spec, state):
+    current_epoch = spec.get_current_epoch(state)
+    active = spec.get_active_validator_indices(state, current_epoch)
+    sources = [active[i] for i in range(4)]
+    targets = [active[4 + i] for i in range(4)]
+    state.pending_consolidations = [
+        spec.PendingConsolidation(source_index=sources[i],
+                                  target_index=targets[i])
+        for i in range(4)]
+    # 0: settles; 1: slashed (skipped); 2: withdrawable but exiting;
+    # 3: still blocked behind 2
+    for i in (0, 2):
+        state.validators[sources[i]].withdrawable_epoch = current_epoch
+    state.validators[sources[1]].slashed = True
+    for i in range(4):
+        state.validators[targets[i]].withdrawal_credentials = \
+            _eth1_credential(spec)
+    spec.initiate_validator_exit(state, 2)
+
+    pre_balances = state.balances.copy()
+    pre_pending = state.pending_consolidations.copy()
+
+    yield from run_epoch_processing_with(
+        spec, state, "process_pending_consolidations")
+
+    assert state.balances[targets[0]] == 2 * spec.MIN_ACTIVATION_BALANCE
+    assert state.balances[sources[0]] == 0
+    for i in (1, 2, 3):
+        assert state.balances[sources[i]] == pre_balances[sources[i]]
+        assert state.balances[targets[i]] == pre_balances[targets[i]]
+    # processed: first; skipped: second; queued: last two
+    assert state.pending_consolidations == pre_pending[2:]
+
+
+@with_electra_and_later
+@spec_state_test
+def test_pending_consolidation_future_epoch(spec, state):
+    current_epoch = spec.get_current_epoch(state)
+    source_index = spec.get_active_validator_indices(state, current_epoch)[0]
+    target_index = spec.get_active_validator_indices(state, current_epoch)[1]
+    spec.initiate_validator_exit(state, source_index)
+    state.validators[source_index].withdrawable_epoch = \
+        state.validators[source_index].exit_epoch + spec.Epoch(1)
+    state.pending_consolidations.append(spec.PendingConsolidation(
+        source_index=source_index, target_index=target_index))
+    state.validators[target_index].withdrawal_credentials = \
+        _eth1_credential(spec)
+
+    # advance with full participation until the epoch the source becomes
+    # withdrawable
+    target_epoch = (state.validators[source_index].withdrawable_epoch
+                    - spec.Epoch(1))
+    while spec.get_current_epoch(state) < target_epoch:
+        next_epoch_with_full_participation(spec, state)
+
+    state_before = state.copy()
+    run_epoch_processing_to(spec, state_before,
+                            "process_pending_consolidations")
+
+    yield from run_epoch_processing_with(
+        spec, state, "process_pending_consolidations")
+
+    expected_source = (state_before.balances[source_index]
+                       - spec.MIN_ACTIVATION_BALANCE)
+    expected_target = (state_before.balances[target_index]
+                       + spec.MIN_ACTIVATION_BALANCE)
+    assert state.balances[source_index] == expected_source
+    assert state.balances[target_index] == expected_target
+    assert state.pending_consolidations == []
+
+
+@with_electra_and_later
+@spec_state_test
+def test_pending_consolidation_source_balance_less_than_max_effective(
+        spec, state):
+    current_epoch = spec.get_current_epoch(state)
+    source_index = spec.get_active_validator_indices(state, current_epoch)[0]
+    target_index = spec.get_active_validator_indices(state, current_epoch)[1]
+    state.pending_consolidations.append(spec.PendingConsolidation(
+        source_index=source_index, target_index=target_index))
+    state.validators[source_index].withdrawable_epoch = current_epoch
+
+    # source has LESS than its effective balance on the books: only the
+    # actual balance moves
+    source_effective = spec.MIN_ACTIVATION_BALANCE
+    source_balance = source_effective - spec.EFFECTIVE_BALANCE_INCREMENT
+    set_eth1_withdrawal_credential_with_balance(
+        spec, state, source_index,
+        balance=source_balance, effective_balance=source_effective)
+    set_eth1_withdrawal_credential_with_balance(spec, state, target_index)
+
+    pre_target_balance = int(state.balances[target_index])
+
+    yield from run_epoch_processing_with(
+        spec, state, "process_pending_consolidations")
+
+    assert state.balances[source_index] == 0
+    assert (state.balances[target_index]
+            == pre_target_balance + source_balance)
+    assert state.pending_consolidations == []
+
+
+@with_electra_and_later
+@spec_state_test
+def test_pending_consolidation_source_balance_greater_than_max_effective(
+        spec, state):
+    current_epoch = spec.get_current_epoch(state)
+    source_index = spec.get_active_validator_indices(state, current_epoch)[0]
+    target_index = spec.get_active_validator_indices(state, current_epoch)[1]
+    state.pending_consolidations.append(spec.PendingConsolidation(
+        source_index=source_index, target_index=target_index))
+    state.validators[source_index].withdrawable_epoch = current_epoch
+
+    # source holds MORE than max effective: only the effective part moves
+    source_effective = spec.MIN_ACTIVATION_BALANCE
+    source_balance = source_effective + spec.EFFECTIVE_BALANCE_INCREMENT
+    set_eth1_withdrawal_credential_with_balance(
+        spec, state, source_index,
+        balance=source_balance, effective_balance=source_effective)
+    set_eth1_withdrawal_credential_with_balance(spec, state, target_index)
+
+    pre_target_balance = int(state.balances[target_index])
+
+    yield from run_epoch_processing_with(
+        spec, state, "process_pending_consolidations")
+
+    assert state.balances[source_index] == \
+        source_balance - source_effective
+    assert (state.balances[target_index]
+            == pre_target_balance + source_effective)
+    assert state.pending_consolidations == []
+
+
+@with_electra_and_later
+@spec_state_test
+def test_pending_consolidation_compounding_creds(spec, state):
+    current_epoch = spec.get_current_epoch(state)
+    source_index = spec.get_active_validator_indices(state, current_epoch)[0]
+    target_index = spec.get_active_validator_indices(state, current_epoch)[1]
+    state.pending_consolidations.append(spec.PendingConsolidation(
+        source_index=source_index, target_index=target_index))
+    state.validators[source_index].withdrawable_epoch = current_epoch
+
+    set_compounding_withdrawal_credential_with_balance(
+        spec, state, source_index,
+        effective_balance=spec.MIN_ACTIVATION_BALANCE,
+        balance=spec.MIN_ACTIVATION_BALANCE, address=b"\x22" * 20)
+    set_compounding_withdrawal_credential_with_balance(
+        spec, state, target_index,
+        effective_balance=spec.MIN_ACTIVATION_BALANCE,
+        balance=spec.MIN_ACTIVATION_BALANCE, address=b"\x33" * 20)
+
+    yield from run_epoch_processing_with(
+        spec, state, "process_pending_consolidations")
+
+    assert state.balances[target_index] == 2 * spec.MIN_ACTIVATION_BALANCE
+    assert state.balances[source_index] == 0
+    assert state.pending_consolidations == []

@@ -1,0 +1,92 @@
+"""Electra: process_registry_updates — EIP-7251 activation-queue
+eligibility threshold (scenario parity:
+`test/electra/epoch_processing/test_process_registry_updates.py`)."""
+
+from consensus_specs_tpu.testlib.context import (
+    ELECTRA,
+    spec_state_test,
+    with_all_phases_from,
+)
+from consensus_specs_tpu.testlib.helpers.deposits import mock_deposit
+from consensus_specs_tpu.testlib.helpers.epoch_processing import (
+    run_epoch_processing_with,
+)
+from consensus_specs_tpu.testlib.helpers.state import next_epoch
+from consensus_specs_tpu.testlib.helpers.withdrawals import (
+    set_compounding_withdrawal_credential_with_balance,
+    set_eth1_withdrawal_credential_with_balance,
+)
+
+with_electra_and_later = with_all_phases_from(ELECTRA)
+
+
+def run_activation_queue_eligibility(spec, state, validator_index, balance):
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+
+    # freshly-deposited validator holding `balance`
+    mock_deposit(spec, state, validator_index)
+    state.balances[validator_index] = balance
+    state.validators[validator_index].effective_balance = (
+        balance - balance % spec.EFFECTIVE_BALANCE_INCREMENT)
+
+    yield from run_epoch_processing_with(
+        spec, state, "process_registry_updates")
+
+    validator = state.validators[validator_index]
+    if validator.effective_balance < spec.MIN_ACTIVATION_BALANCE:
+        assert validator.activation_eligibility_epoch \
+            == spec.FAR_FUTURE_EPOCH
+    else:
+        assert validator.activation_eligibility_epoch \
+            < spec.FAR_FUTURE_EPOCH
+
+
+@with_electra_and_later
+@spec_state_test
+def test_activation_queue_eligibility__less_than_min_activation_balance(
+        spec, state):
+    balance = spec.MIN_ACTIVATION_BALANCE - spec.EFFECTIVE_BALANCE_INCREMENT
+    yield from run_activation_queue_eligibility(spec, state, 3, balance)
+
+
+@with_electra_and_later
+@spec_state_test
+def test_activation_queue_eligibility__min_activation_balance(spec, state):
+    yield from run_activation_queue_eligibility(
+        spec, state, 5, spec.MIN_ACTIVATION_BALANCE)
+
+
+@with_electra_and_later
+@spec_state_test
+def test_activation_queue_eligibility__min_activation_balance_eth1_creds(
+        spec, state):
+    index = 7
+    set_eth1_withdrawal_credential_with_balance(spec, state, index)
+    yield from run_activation_queue_eligibility(
+        spec, state, index, spec.MIN_ACTIVATION_BALANCE)
+
+
+@with_electra_and_later
+@spec_state_test
+def test_activation_queue_eligibility__compounding_creds(spec, state):
+    index = 11
+    set_compounding_withdrawal_credential_with_balance(
+        spec, state, index,
+        effective_balance=spec.MIN_ACTIVATION_BALANCE,
+        balance=spec.MIN_ACTIVATION_BALANCE)
+    yield from run_activation_queue_eligibility(
+        spec, state, index, spec.MIN_ACTIVATION_BALANCE)
+
+
+@with_electra_and_later
+@spec_state_test
+def test_activation_queue_eligibility__greater_than_min_activation_balance(
+        spec, state):
+    index = 13
+    set_compounding_withdrawal_credential_with_balance(
+        spec, state, index,
+        effective_balance=spec.MIN_ACTIVATION_BALANCE,
+        balance=spec.MIN_ACTIVATION_BALANCE)
+    balance = spec.MIN_ACTIVATION_BALANCE + spec.EFFECTIVE_BALANCE_INCREMENT
+    yield from run_activation_queue_eligibility(spec, state, index, balance)

@@ -34,12 +34,21 @@ def test_attestation_gossip_single_committee_condition(spec, state):
     next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
     attestation = get_valid_attestation(spec, state,
                                         slot=state.slot - 1)
-    assert spec.is_valid_attestation_gossip_aggregation_bits(attestation)
+    assert spec.is_valid_attestation_gossip_aggregation_bits(
+        state, attestation)
 
     multi = attestation.copy()
     # set a second committee bit: gossip must reject
     free = next(i for i in range(len(multi.committee_bits))
                 if not multi.committee_bits[i])
     multi.committee_bits[free] = True
-    assert not spec.is_valid_attestation_gossip_aggregation_bits(multi)
+    assert not spec.is_valid_attestation_gossip_aggregation_bits(state, multi)
+
+    # over-sized aggregation bits for the selected committee: gossip must
+    # reject even with exactly one committee bit set
+    oversized = attestation.copy()
+    bits = list(oversized.aggregation_bits) + [False]
+    oversized.aggregation_bits = type(oversized.aggregation_bits)(*bits)
+    assert not spec.is_valid_attestation_gossip_aggregation_bits(
+        state, oversized)
     yield None

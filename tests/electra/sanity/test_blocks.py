@@ -1,0 +1,151 @@
+"""Electra sanity: blocks with execution requests and committee-bits
+attestations (scenario parity: `test/electra/sanity/blocks/test_blocks.py`)."""
+
+from consensus_specs_tpu.testlib.context import (
+    ELECTRA,
+    default_activation_threshold,
+    scaled_churn_balances_exceed_activation_exit_churn_limit,
+    single_phase,
+    spec_state_test,
+    spec_test,
+    with_all_phases_from,
+    with_custom_state,
+)
+from consensus_specs_tpu.testlib.helpers.attestations import (
+    get_valid_attestation,
+)
+from consensus_specs_tpu.testlib.helpers.block import (
+    build_empty_block_for_next_slot,
+)
+from consensus_specs_tpu.testlib.helpers.keys import privkeys, pubkeys
+from consensus_specs_tpu.testlib.helpers.state import (
+    next_slots,
+    state_transition_and_sign_block,
+)
+from consensus_specs_tpu.ops import bls
+
+with_electra_and_later = with_all_phases_from(ELECTRA)
+
+
+@with_electra_and_later
+@spec_state_test
+def test_block_with_deposit_request(spec, state):
+    """An EL deposit request queues a pending deposit."""
+    fresh_index = len(state.validators)
+    pk = pubkeys[fresh_index]
+    withdrawal_credentials = (
+        bytes(spec.BLS_WITHDRAWAL_PREFIX) + spec.hash(pk)[1:])
+    deposit_message = spec.DepositMessage(
+        pubkey=pk,
+        withdrawal_credentials=withdrawal_credentials,
+        amount=spec.MIN_ACTIVATION_BALANCE)
+    domain = spec.compute_domain(spec.DOMAIN_DEPOSIT)
+    signing_root = spec.compute_signing_root(deposit_message, domain)
+    deposit_request = spec.DepositRequest(
+        pubkey=pk,
+        withdrawal_credentials=withdrawal_credentials,
+        amount=spec.MIN_ACTIVATION_BALANCE,
+        signature=bls.Sign(privkeys[fresh_index], signing_root),
+        index=0)
+
+    pre_pending = len(state.pending_deposits)
+
+    yield "pre", state
+
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.execution_requests.deposits.append(deposit_request)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+
+    yield "blocks", [signed_block]
+    yield "post", state
+
+    assert len(state.pending_deposits) == pre_pending + 1
+    assert state.pending_deposits[pre_pending].pubkey == pk
+
+
+@with_electra_and_later
+@spec_state_test
+def test_block_with_withdrawal_request(spec, state):
+    """A full EL withdrawal request initiates the validator's exit."""
+    index = 0
+    address = b"\x11" * 20
+    state.validators[index].withdrawal_credentials = (
+        bytes(spec.ETH1_ADDRESS_WITHDRAWAL_PREFIX) + b"\x00" * 11 + address)
+    # eligible for exit only after the shard-committee period
+    next_slots(spec, state,
+               spec.config.SHARD_COMMITTEE_PERIOD * spec.SLOTS_PER_EPOCH)
+
+    withdrawal_request = spec.WithdrawalRequest(
+        source_address=address,
+        validator_pubkey=state.validators[index].pubkey,
+        amount=spec.FULL_EXIT_REQUEST_AMOUNT)
+
+    yield "pre", state
+
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.execution_requests.withdrawals.append(withdrawal_request)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+
+    yield "blocks", [signed_block]
+    yield "post", state
+
+    assert state.validators[index].exit_epoch < spec.FAR_FUTURE_EPOCH
+
+
+@with_electra_and_later
+@spec_test
+@with_custom_state(
+    balances_fn=scaled_churn_balances_exceed_activation_exit_churn_limit,
+    threshold_fn=default_activation_threshold)
+@single_phase
+def test_block_with_consolidation_request(spec, state):
+    """An EL consolidation request queues a pending consolidation.
+    Needs enough stake that the consolidation churn is non-zero."""
+    address = b"\x11" * 20
+    source_index, target_index = 0, 1
+    for index in (source_index, target_index):
+        state.validators[index].withdrawal_credentials = (
+            bytes(spec.COMPOUNDING_WITHDRAWAL_PREFIX) + b"\x00" * 11
+            + address)
+    next_slots(spec, state,
+               spec.config.SHARD_COMMITTEE_PERIOD * spec.SLOTS_PER_EPOCH)
+
+    consolidation_request = spec.ConsolidationRequest(
+        source_address=address,
+        source_pubkey=state.validators[source_index].pubkey,
+        target_pubkey=state.validators[target_index].pubkey)
+
+    yield "pre", state
+
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.execution_requests.consolidations.append(
+        consolidation_request)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+
+    yield "blocks", [signed_block]
+    yield "post", state
+
+    assert len(state.pending_consolidations) == 1
+    assert state.pending_consolidations[0].source_index == source_index
+    assert state.pending_consolidations[0].target_index == target_index
+
+
+@with_electra_and_later
+@spec_state_test
+def test_block_with_committee_bits_attestation(spec, state):
+    """EIP-7549 attestations (committee bits) flow through a block."""
+    next_slots(spec, state, spec.MIN_ATTESTATION_INCLUSION_DELAY)
+    attestation = get_valid_attestation(spec, state,
+                                        slot=state.slot - 1, signed=True)
+
+    yield "pre", state
+
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.attestations.append(attestation)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+
+    yield "blocks", [signed_block]
+    yield "post", state
+
+    assert len(spec.get_committee_indices(
+        attestation.committee_bits)) == 1

@@ -1,0 +1,68 @@
+"""Fulu sanity: blocks + proposer-lookahead rotation (scenario parity:
+`test/fulu/sanity/test_blocks.py`)."""
+
+from consensus_specs_tpu.testlib.context import (
+    FULU,
+    spec_state_test,
+    with_all_phases_from,
+)
+from consensus_specs_tpu.testlib.helpers.block import (
+    build_empty_block_for_next_slot,
+)
+from consensus_specs_tpu.testlib.helpers.state import (
+    next_epoch,
+    state_transition_and_sign_block,
+    transition_to,
+)
+
+with_fulu_and_later = with_all_phases_from(FULU)
+
+
+@with_fulu_and_later
+@spec_state_test
+def test_empty_block_transition(spec, state):
+    yield "pre", state
+
+    block = build_empty_block_for_next_slot(spec, state)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+
+    yield "blocks", [signed_block]
+    yield "post", state
+
+    assert state.slot == block.slot
+
+
+@with_fulu_and_later
+@spec_state_test
+def test_proposer_lookahead_matches_duties(spec, state):
+    """The lookahead vector's head entry is the actual proposer."""
+    yield "pre", state
+
+    blocks = []
+    epoch_start = spec.compute_start_slot_at_epoch(
+        spec.get_current_epoch(state))
+    for _ in range(3):
+        next_slot_index = int(state.slot + 1 - epoch_start)
+        expected_proposer = state.proposer_lookahead[next_slot_index]
+        block = build_empty_block_for_next_slot(spec, state)
+        assert block.proposer_index == expected_proposer
+        blocks.append(state_transition_and_sign_block(spec, state, block))
+
+    yield "blocks", blocks
+    yield "post", state
+
+
+@with_fulu_and_later
+@spec_state_test
+def test_proposer_lookahead_rotates_at_epoch(spec, state):
+    pre_lookahead = list(state.proposer_lookahead)
+
+    yield "pre", state
+    next_epoch(spec, state)
+    yield "post", state
+
+    post_lookahead = list(state.proposer_lookahead)
+    # the second epoch of the old lookahead becomes the first
+    slots = int(spec.SLOTS_PER_EPOCH)
+    assert post_lookahead[:slots * (len(pre_lookahead) // slots - 1)] == \
+        pre_lookahead[slots:]

@@ -1,0 +1,69 @@
+"""phase0: process_effective_balance_updates — hysteresis (scenario
+parity: `test/phase0/epoch_processing/test_process_effective_balance_updates.py`)."""
+
+from consensus_specs_tpu.testlib.context import (
+    spec_state_test,
+    with_all_phases,
+)
+from consensus_specs_tpu.testlib.helpers.epoch_processing import (
+    run_epoch_processing_from,
+    run_epoch_processing_to,
+    run_process_slots_up_to_epoch_boundary,
+)
+
+
+@with_all_phases
+@spec_state_test
+def test_effective_balance_hysteresis(spec, state):
+    yield from run_effective_balance_hysteresis(spec, state)
+
+
+def run_effective_balance_hysteresis(spec, state):
+    run_process_slots_up_to_epoch_boundary(spec, state)
+    yield "pre_epoch", state
+    run_epoch_processing_to(spec, state, "process_effective_balance_updates",
+                            enable_slots_processing=False)
+
+    top = int(spec.MAX_EFFECTIVE_BALANCE)
+    low = int(spec.config.EJECTION_BALANCE)
+    inc = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    hys_inc = inc // int(spec.HYSTERESIS_QUOTIENT)
+    down = int(spec.HYSTERESIS_DOWNWARD_MULTIPLIER)
+    up = int(spec.HYSTERESIS_UPWARD_MULTIPLIER)
+    div = int(spec.HYSTERESIS_QUOTIENT)
+    # (pre effective, balance, expected post effective, label)
+    cases = [
+        (top, top, top, "as-is"),
+        (top, top - 1, top, "round up"),
+        (top, top + 1, top, "round down"),
+        (top, top - down * hys_inc, top, "lower balance, not low enough"),
+        (top, top - down * hys_inc - 1, top - inc, "step down"),
+        (top, top + up * hys_inc + 1, top, "already at max, as is"),
+        (top, top - inc, top - inc, "exactly 1 step lower"),
+        (top, top - inc - 1, top - 2 * inc, "past 1 step, double step"),
+        (top, top - inc + 1, top - inc, "close to 1 step lower"),
+        (low, low + hys_inc * up, low, "bigger balance, not high enough"),
+        (low, low + hys_inc * up + 1, low + inc, "high enough, small step"),
+        (low, low + hys_inc * div * 2 - 1, low + inc,
+         "close to double step"),
+        (low, low + hys_inc * div * 2, low + 2 * inc, "exact two steps"),
+        (low, low + hys_inc * div * 2 + 1, low + 2 * inc,
+         "over two steps, round down"),
+    ]
+
+    current_epoch = spec.get_current_epoch(state)
+    for i, (pre_eff, bal, _, _) in enumerate(cases):
+        assert spec.is_active_validator(state.validators[i], current_epoch)
+        state.validators[i].effective_balance = pre_eff
+        state.balances[i] = bal
+
+    yield "pre", state
+    spec.process_effective_balance_updates(state)
+    yield "post", state
+
+    for i, (_, _, post_eff, label) in enumerate(cases):
+        assert state.validators[i].effective_balance == post_eff, label
+
+    run_epoch_processing_from(spec, state,
+                              "process_effective_balance_updates")
+    yield "post_epoch", state

@@ -1,0 +1,114 @@
+"""phase0: the small end-of-epoch sub-transitions — eth1-data reset,
+historical-roots accumulator, participation-record rotation, randao-mixes
+reset, slashings-vector flush (scenario parity:
+`test/phase0/epoch_processing/test_process_{eth1_data_reset,
+historical_roots_update,participation_record_updates,randao_mixes_reset,
+slashings_reset}.py`)."""
+
+from consensus_specs_tpu.testlib.context import (
+    ALTAIR,
+    BELLATRIX,
+    PHASE0,
+    spec_state_test,
+    with_all_phases,
+    with_phases,
+)
+from consensus_specs_tpu.testlib.helpers.epoch_processing import (
+    run_epoch_processing_with,
+)
+from consensus_specs_tpu.testlib.helpers.state import transition_to
+
+
+@with_all_phases
+@spec_state_test
+def test_eth1_vote_no_reset(spec, state):
+    assert spec.EPOCHS_PER_ETH1_VOTING_PERIOD > 1
+    transition_to(spec, state, spec.SLOTS_PER_EPOCH - 1)
+
+    for _ in range(state.slot + 1):
+        state.eth1_data_votes.append(spec.Eth1Data(
+            deposit_root=b"\xaa" * 32,
+            deposit_count=state.eth1_deposit_index,
+            block_hash=b"\xbb" * 32,
+        ))
+
+    yield from run_epoch_processing_with(
+        spec, state, "process_eth1_data_reset")
+
+    assert len(state.eth1_data_votes) == spec.SLOTS_PER_EPOCH
+
+
+@with_all_phases
+@spec_state_test
+def test_eth1_vote_reset(spec, state):
+    # at the end of a full voting period the vote list is flushed
+    state.slot = (spec.EPOCHS_PER_ETH1_VOTING_PERIOD
+                  * spec.SLOTS_PER_EPOCH) - 1
+    for _ in range(state.slot + 1):
+        state.eth1_data_votes.append(spec.Eth1Data(
+            deposit_root=b"\xaa" * 32,
+            deposit_count=state.eth1_deposit_index,
+            block_hash=b"\xbb" * 32,
+        ))
+
+    yield from run_epoch_processing_with(
+        spec, state, "process_eth1_data_reset")
+
+    assert len(state.eth1_data_votes) == 0
+
+
+@with_phases([PHASE0, ALTAIR, BELLATRIX])
+@spec_state_test
+def test_historical_root_accumulator(spec, state):
+    state.slot = spec.SLOTS_PER_HISTORICAL_ROOT - 1
+    history_len = len(state.historical_roots)
+
+    yield from run_epoch_processing_with(
+        spec, state, "process_historical_roots_update")
+
+    assert len(state.historical_roots) == history_len + 1
+
+
+@with_phases([PHASE0])
+@spec_state_test
+def test_updated_participation_record(spec, state):
+    state.previous_epoch_attestations = [
+        spec.PendingAttestation(proposer_index=100)]
+    current_epoch_attestations = [
+        spec.PendingAttestation(proposer_index=200)]
+    state.current_epoch_attestations = current_epoch_attestations
+
+    yield from run_epoch_processing_with(
+        spec, state, "process_participation_record_updates")
+
+    assert state.previous_epoch_attestations == current_epoch_attestations
+    assert state.current_epoch_attestations == []
+
+
+@with_all_phases
+@spec_state_test
+def test_updated_randao_mixes(spec, state):
+    next_epoch = spec.get_current_epoch(state) + 1
+    state.randao_mixes[next_epoch % spec.EPOCHS_PER_HISTORICAL_VECTOR] = \
+        b"\x56" * 32
+
+    yield from run_epoch_processing_with(
+        spec, state, "process_randao_mixes_reset")
+
+    assert (state.randao_mixes[next_epoch
+                               % spec.EPOCHS_PER_HISTORICAL_VECTOR]
+            == spec.get_randao_mix(state, spec.get_current_epoch(state)))
+
+
+@with_all_phases
+@spec_state_test
+def test_flush_slashings(spec, state):
+    next_epoch = spec.get_current_epoch(state) + 1
+    slot_index = next_epoch % spec.EPOCHS_PER_SLASHINGS_VECTOR
+    state.slashings[slot_index] = 100
+    assert state.slashings[slot_index] != 0
+
+    yield from run_epoch_processing_with(
+        spec, state, "process_slashings_reset")
+
+    assert state.slashings[slot_index] == 0

@@ -1,0 +1,254 @@
+"""phase0: process_justification_and_finalization — the four FFG finality
+rules (scenario parity:
+`test/phase0/epoch_processing/test_process_justification_and_finalization.py`).
+"""
+
+from random import Random
+
+from consensus_specs_tpu.testlib.context import (
+    spec_state_test,
+    with_all_phases,
+)
+from consensus_specs_tpu.testlib.helpers.epoch_processing import (
+    run_epoch_processing_with,
+)
+from consensus_specs_tpu.testlib.helpers.forks import is_post_altair
+from consensus_specs_tpu.testlib.helpers.justification import (
+    mock_checkpoints,
+    put_checkpoint_roots,
+    put_mock_attestations,
+)
+from consensus_specs_tpu.testlib.helpers.state import (
+    next_epoch_via_block,
+    next_slot,
+    transition_to,
+)
+from consensus_specs_tpu.testlib.helpers.voluntary_exits import (
+    get_unslashed_exited_validators,
+)
+
+
+def run_jf(spec, state):
+    yield from run_epoch_processing_with(
+        spec, state, "process_justification_and_finalization")
+
+
+def finalize_on_234(spec, state, epoch, sufficient_support):
+    """Rule 1: bits[1:4] all set after shift => finalize source 4 back.
+    Pre-shift bits 11_0, justifying 2-back with 4-back as source."""
+    assert epoch > 4
+    transition_to(spec, state, spec.SLOTS_PER_EPOCH * epoch - 1)
+
+    c1, c2, c3, c4, _ = mock_checkpoints(spec, epoch)
+    put_checkpoint_roots(spec, state, [c1, c2, c3, c4])
+
+    old_finalized = state.finalized_checkpoint
+    state.previous_justified_checkpoint = c4
+    state.current_justified_checkpoint = c3
+    state.justification_bits = spec.Bitvector[
+        spec.JUSTIFICATION_BITS_LENGTH]()
+    state.justification_bits[1:3] = [1, 1]
+    put_mock_attestations(spec, state, epoch - 2, source=c4, target=c2,
+                          sufficient_support=sufficient_support)
+
+    yield from run_jf(spec, state)
+
+    assert state.previous_justified_checkpoint == c3
+    if sufficient_support:
+        assert state.current_justified_checkpoint == c2
+        assert state.finalized_checkpoint == c4
+    else:
+        assert state.current_justified_checkpoint == c3
+        assert state.finalized_checkpoint == old_finalized
+
+
+def finalize_on_23(spec, state, epoch, sufficient_support):
+    """Rule 2: bits[1:3] set => finalize source 3 back."""
+    assert epoch > 3
+    transition_to(spec, state, spec.SLOTS_PER_EPOCH * epoch - 1)
+
+    c1, c2, c3, _, _ = mock_checkpoints(spec, epoch)
+    put_checkpoint_roots(spec, state, [c1, c2, c3])
+
+    old_finalized = state.finalized_checkpoint
+    state.previous_justified_checkpoint = c3
+    state.current_justified_checkpoint = c3
+    state.justification_bits = spec.Bitvector[
+        spec.JUSTIFICATION_BITS_LENGTH]()
+    state.justification_bits[1] = 1
+    put_mock_attestations(spec, state, epoch - 2, source=c3, target=c2,
+                          sufficient_support=sufficient_support)
+
+    yield from run_jf(spec, state)
+
+    assert state.previous_justified_checkpoint == c3
+    if sufficient_support:
+        assert state.current_justified_checkpoint == c2
+        assert state.finalized_checkpoint == c3
+    else:
+        assert state.current_justified_checkpoint == c3
+        assert state.finalized_checkpoint == old_finalized
+
+
+def finalize_on_123(spec, state, epoch, sufficient_support):
+    """Rule 3: bits[0:3] set after double justification => finalize old
+    current-justified (3 back at source distance)."""
+    assert epoch > 5
+    state.slot = spec.SLOTS_PER_EPOCH * epoch - 1
+
+    c1, c2, c3, c4, c5 = mock_checkpoints(spec, epoch)
+    put_checkpoint_roots(spec, state, [c1, c2, c3, c4, c5])
+
+    old_finalized = state.finalized_checkpoint
+    state.previous_justified_checkpoint = c5
+    state.current_justified_checkpoint = c3
+    state.justification_bits = spec.Bitvector[
+        spec.JUSTIFICATION_BITS_LENGTH]()
+    state.justification_bits[1] = 1
+    put_mock_attestations(spec, state, epoch - 2, source=c5, target=c2,
+                          sufficient_support=sufficient_support)
+    put_mock_attestations(spec, state, epoch - 1, source=c3, target=c1,
+                          sufficient_support=sufficient_support)
+
+    yield from run_jf(spec, state)
+
+    assert state.previous_justified_checkpoint == c3
+    if sufficient_support:
+        assert state.current_justified_checkpoint == c1
+        assert state.finalized_checkpoint == c3
+    else:
+        assert state.current_justified_checkpoint == c3
+        assert state.finalized_checkpoint == old_finalized
+
+
+def finalize_on_12(spec, state, epoch, sufficient_support,
+                   messed_up_target):
+    """Rule 4: bits[0:2] set => finalize previous-justified 2 back."""
+    assert epoch > 2
+    transition_to(spec, state, spec.SLOTS_PER_EPOCH * epoch - 1)
+
+    c1, c2, _, _, _ = mock_checkpoints(spec, epoch)
+    put_checkpoint_roots(spec, state, [c1, c2])
+
+    old_finalized = state.finalized_checkpoint
+    state.previous_justified_checkpoint = c2
+    state.current_justified_checkpoint = c2
+    state.justification_bits = spec.Bitvector[
+        spec.JUSTIFICATION_BITS_LENGTH]()
+    state.justification_bits[0] = 1
+    put_mock_attestations(spec, state, epoch - 1, source=c2, target=c1,
+                          sufficient_support=sufficient_support,
+                          messed_up_target=messed_up_target)
+
+    yield from run_jf(spec, state)
+
+    assert state.previous_justified_checkpoint == c2
+    if sufficient_support and not messed_up_target:
+        assert state.current_justified_checkpoint == c1
+        assert state.finalized_checkpoint == c2
+    else:
+        assert state.current_justified_checkpoint == c2
+        assert state.finalized_checkpoint == old_finalized
+
+
+@with_all_phases
+@spec_state_test
+def test_234_ok_support(spec, state):
+    yield from finalize_on_234(spec, state, 5, True)
+
+
+@with_all_phases
+@spec_state_test
+def test_234_poor_support(spec, state):
+    yield from finalize_on_234(spec, state, 5, False)
+
+
+@with_all_phases
+@spec_state_test
+def test_23_ok_support(spec, state):
+    yield from finalize_on_23(spec, state, 4, True)
+
+
+@with_all_phases
+@spec_state_test
+def test_23_poor_support(spec, state):
+    yield from finalize_on_23(spec, state, 4, False)
+
+
+@with_all_phases
+@spec_state_test
+def test_123_ok_support(spec, state):
+    yield from finalize_on_123(spec, state, 6, True)
+
+
+@with_all_phases
+@spec_state_test
+def test_123_poor_support(spec, state):
+    yield from finalize_on_123(spec, state, 6, False)
+
+
+@with_all_phases
+@spec_state_test
+def test_12_ok_support(spec, state):
+    yield from finalize_on_12(spec, state, 3, True, False)
+
+
+@with_all_phases
+@spec_state_test
+def test_12_ok_support_messed_target(spec, state):
+    yield from finalize_on_12(spec, state, 3, True, True)
+
+
+@with_all_phases
+@spec_state_test
+def test_12_poor_support(spec, state):
+    yield from finalize_on_12(spec, state, 3, False, False)
+
+
+@with_all_phases
+@spec_state_test
+def test_balance_threshold_with_exited_validators(spec, state):
+    """Exited validators must not count toward the justification balance
+    (regression shape for an exited-balance inclusion bug)."""
+    rng = Random(133333)
+    for _ in range(3):
+        next_epoch_via_block(spec, state)
+    for _ in range(spec.SLOTS_PER_EPOCH - 1):
+        next_slot(spec, state)
+
+    epoch = spec.get_current_epoch(state)
+    for index in spec.get_active_validator_indices(state, epoch):
+        if rng.choice([True, False]):
+            continue
+        validator = state.validators[index]
+        validator.exit_epoch = epoch
+        validator.withdrawable_epoch = (
+            validator.exit_epoch
+            + spec.config.MIN_VALIDATOR_WITHDRAWABILITY_DELAY)
+
+    exited = get_unslashed_exited_validators(spec, state)
+    assert len(exited) != 0
+
+    source = state.current_justified_checkpoint
+    target = spec.Checkpoint(epoch=epoch,
+                             root=spec.get_block_root(state, epoch))
+    put_mock_attestations(spec, state, epoch, source, target,
+                          sufficient_support=False)
+
+    total_active = int(spec.get_total_active_balance(state))
+    if not is_post_altair(spec):
+        atts = spec.get_matching_target_attestations(state, epoch)
+        target_balance = int(spec.get_attesting_balance(state, atts))
+    else:
+        indices = spec.get_unslashed_participating_indices(
+            state, spec.TIMELY_TARGET_FLAG_INDEX, epoch)
+        target_balance = int(spec.get_total_balance(state, indices))
+    # current support is below 2/3, but would cross it if exited balance
+    # were (incorrectly) counted
+    assert target_balance * 3 < total_active * 2
+    exited_balance = int(spec.get_total_balance(state, exited))
+    assert (target_balance + exited_balance) * 3 >= total_active * 2
+
+    yield from run_jf(spec, state)
+
+    assert state.current_justified_checkpoint.epoch != epoch

@@ -1,0 +1,362 @@
+"""phase0: process_registry_updates — activation queue + ejections
+(scenario parity:
+`test/phase0/epoch_processing/test_process_registry_updates.py`)."""
+
+from consensus_specs_tpu.testlib.context import (
+    MINIMAL,
+    scaled_churn_balances_min_churn_limit,
+    single_phase,
+    spec_state_test,
+    spec_test,
+    with_all_phases,
+    with_custom_state,
+    with_presets,
+)
+from consensus_specs_tpu.testlib.helpers.deposits import mock_deposit
+from consensus_specs_tpu.testlib.helpers.epoch_processing import (
+    run_epoch_processing_with,
+)
+from consensus_specs_tpu.testlib.helpers.forks import is_post_electra
+from consensus_specs_tpu.testlib.helpers.state import next_epoch, next_slots
+
+
+def run_process_registry_updates(spec, state):
+    yield from run_epoch_processing_with(
+        spec, state, "process_registry_updates")
+
+
+@with_all_phases
+@spec_state_test
+def test_add_to_activation_queue(spec, state):
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+
+    index = 0
+    mock_deposit(spec, state, index)
+
+    yield from run_process_registry_updates(spec, state)
+
+    validator = state.validators[index]
+    assert validator.activation_eligibility_epoch != spec.FAR_FUTURE_EPOCH
+    assert validator.activation_epoch == spec.FAR_FUTURE_EPOCH
+    assert not spec.is_active_validator(
+        validator, spec.get_current_epoch(state))
+
+
+@with_all_phases
+@spec_state_test
+def test_activation_queue_to_activated_if_finalized(spec, state):
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+
+    index = 0
+    mock_deposit(spec, state, index)
+
+    # queued since the latest finalized epoch -> eligible for activation
+    state.finalized_checkpoint.epoch = spec.get_current_epoch(state) - 1
+    state.validators[index].activation_eligibility_epoch = \
+        state.finalized_checkpoint.epoch
+
+    yield from run_process_registry_updates(spec, state)
+
+    validator = state.validators[index]
+    assert validator.activation_epoch != spec.FAR_FUTURE_EPOCH
+    assert not spec.is_active_validator(
+        validator, spec.get_current_epoch(state))
+    assert spec.is_active_validator(
+        validator,
+        spec.compute_activation_exit_epoch(spec.get_current_epoch(state)))
+
+
+@with_all_phases
+@spec_state_test
+def test_activation_queue_no_activation_no_finality(spec, state):
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+
+    index = 0
+    mock_deposit(spec, state, index)
+
+    # queued only AFTER the latest finalized epoch -> stays queued
+    state.finalized_checkpoint.epoch = spec.get_current_epoch(state) - 1
+    state.validators[index].activation_eligibility_epoch = \
+        state.finalized_checkpoint.epoch + 1
+
+    yield from run_process_registry_updates(spec, state)
+
+    validator = state.validators[index]
+    assert validator.activation_eligibility_epoch != spec.FAR_FUTURE_EPOCH
+    assert validator.activation_epoch == spec.FAR_FUTURE_EPOCH
+
+
+@with_all_phases
+@spec_state_test
+def test_activation_queue_sorting(spec, state):
+    churn_limit = int(spec.get_validator_churn_limit(state))
+    mock_activations = churn_limit * 2
+
+    epoch = spec.get_current_epoch(state)
+    for i in range(mock_activations):
+        mock_deposit(spec, state, i)
+        state.validators[i].activation_eligibility_epoch = epoch + 1
+    # give the last index priority over the rest
+    state.validators[mock_activations - 1].activation_eligibility_epoch = \
+        epoch
+
+    next_slots(spec, state, spec.SLOTS_PER_EPOCH * 3)
+    state.finalized_checkpoint.epoch = epoch + 1
+
+    yield from run_process_registry_updates(spec, state)
+
+    if is_post_electra(spec):
+        # EIP-7251 gates activation on finality only: everyone activates
+        assert all(state.validators[i].activation_epoch
+                   != spec.FAR_FUTURE_EPOCH
+                   for i in range(mock_activations))
+    else:
+        far = spec.FAR_FUTURE_EPOCH
+        # prioritized validator got in first, index 0 second
+        assert state.validators[mock_activations - 1].activation_epoch != far
+        assert state.validators[0].activation_epoch != far
+        # the churn boundary: one in, next out, tail out
+        assert state.validators[churn_limit - 1].activation_epoch != far
+        assert state.validators[churn_limit].activation_epoch == far
+        assert state.validators[mock_activations - 2].activation_epoch == far
+
+
+def run_activation_queue_efficiency(spec, state):
+    churn_limit = int(spec.get_validator_churn_limit(state))
+    mock_activations = churn_limit * 2
+
+    epoch = spec.get_current_epoch(state)
+    for i in range(mock_activations):
+        mock_deposit(spec, state, i)
+        state.validators[i].activation_eligibility_epoch = epoch + 1
+
+    next_slots(spec, state, spec.SLOTS_PER_EPOCH * 3)
+    state.finalized_checkpoint.epoch = epoch + 1
+
+    churn_limit_0 = int(spec.get_validator_churn_limit(state))
+    # first pass (not emitted as a vector part)
+    for _ in run_process_registry_updates(spec, state):
+        pass
+
+    for i in range(mock_activations):
+        if i < churn_limit_0 or is_post_electra(spec):
+            assert state.validators[i].activation_epoch \
+                < spec.FAR_FUTURE_EPOCH
+        else:
+            assert state.validators[i].activation_epoch \
+                == spec.FAR_FUTURE_EPOCH
+
+    churn_limit_1 = int(spec.get_validator_churn_limit(state))
+    yield from run_process_registry_updates(spec, state)
+    for i in range(churn_limit_0 + churn_limit_1):
+        assert state.validators[i].activation_epoch < spec.FAR_FUTURE_EPOCH
+
+
+@with_all_phases
+@spec_state_test
+def test_activation_queue_efficiency_min(spec, state):
+    assert (spec.get_validator_churn_limit(state)
+            == spec.config.MIN_PER_EPOCH_CHURN_LIMIT)
+    yield from run_activation_queue_efficiency(spec, state)
+
+
+@with_all_phases
+@with_presets([MINIMAL], reason="scaled validator set")
+@spec_test
+@with_custom_state(
+    balances_fn=scaled_churn_balances_min_churn_limit,
+    threshold_fn=lambda spec: spec.config.EJECTION_BALANCE)
+@single_phase
+def test_activation_queue_efficiency_scaled(spec, state):
+    assert (spec.get_validator_churn_limit(state)
+            > spec.config.MIN_PER_EPOCH_CHURN_LIMIT)
+    yield from run_activation_queue_efficiency(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+def test_ejection(spec, state):
+    index = 0
+    current_epoch = spec.get_current_epoch(state)
+    assert spec.is_active_validator(state.validators[index], current_epoch)
+    assert state.validators[index].exit_epoch == spec.FAR_FUTURE_EPOCH
+
+    state.validators[index].effective_balance = spec.config.EJECTION_BALANCE
+
+    yield from run_process_registry_updates(spec, state)
+
+    validator = state.validators[index]
+    assert validator.exit_epoch != spec.FAR_FUTURE_EPOCH
+    assert spec.is_active_validator(validator, spec.get_current_epoch(state))
+    assert not spec.is_active_validator(
+        validator,
+        spec.compute_activation_exit_epoch(spec.get_current_epoch(state)))
+
+
+def run_ejection_past_churn_limit(spec, state):
+    churn_limit = int(spec.get_validator_churn_limit(state))
+    mock_ejections = churn_limit * 3
+
+    for i in range(mock_ejections):
+        state.validators[i].effective_balance = spec.config.EJECTION_BALANCE
+
+    expected_epoch = spec.compute_activation_exit_epoch(
+        spec.get_current_epoch(state))
+
+    yield from run_process_registry_updates(spec, state)
+
+    if is_post_electra(spec):
+        per_epoch_churn = int(spec.get_activation_exit_churn_limit(state))
+
+        def exit_epoch_of(i):
+            balance_so_far = i * int(spec.config.EJECTION_BALANCE)
+            offset = balance_so_far // per_epoch_churn
+            if (int(spec.config.EJECTION_BALANCE)
+                    > per_epoch_churn - balance_so_far % per_epoch_churn):
+                offset += 1
+            return expected_epoch + offset
+    else:
+        def exit_epoch_of(i):
+            # thirds of the batch exit in consecutive epochs
+            return expected_epoch + i // churn_limit
+
+    for i in range(mock_ejections):
+        assert state.validators[i].exit_epoch == exit_epoch_of(i)
+
+
+@with_all_phases
+@spec_state_test
+def test_ejection_past_churn_limit_min(spec, state):
+    assert (spec.get_validator_churn_limit(state)
+            == spec.config.MIN_PER_EPOCH_CHURN_LIMIT)
+    yield from run_ejection_past_churn_limit(spec, state)
+
+
+@with_all_phases
+@with_presets([MINIMAL], reason="scaled validator set")
+@spec_test
+@with_custom_state(
+    balances_fn=scaled_churn_balances_min_churn_limit,
+    threshold_fn=lambda spec: spec.config.EJECTION_BALANCE)
+@single_phase
+def test_ejection_past_churn_limit_scaled(spec, state):
+    assert (spec.get_validator_churn_limit(state)
+            > spec.config.MIN_PER_EPOCH_CHURN_LIMIT)
+    yield from run_ejection_past_churn_limit(spec, state)
+
+
+def run_activation_and_ejection(spec, state, num_per_status):
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+
+    # group 1: fresh deposits entering the activation queue
+    queue_indices = list(range(num_per_status))
+    for index in queue_indices:
+        mock_deposit(spec, state, index)
+
+    # group 2: already queued since finality, ready to activate
+    state.finalized_checkpoint.epoch = spec.get_current_epoch(state) - 1
+    activation_indices = list(range(num_per_status, num_per_status * 2))
+    for index in activation_indices:
+        mock_deposit(spec, state, index)
+        state.validators[index].activation_eligibility_epoch = \
+            state.finalized_checkpoint.epoch
+
+    # group 3: balances at the ejection line
+    ejection_indices = list(range(num_per_status * 2, num_per_status * 3))
+    for index in ejection_indices:
+        state.validators[index].effective_balance = \
+            spec.config.EJECTION_BALANCE
+
+    churn_limit = int(spec.get_validator_churn_limit(state))
+    yield from run_process_registry_updates(spec, state)
+
+    for index in queue_indices:
+        validator = state.validators[index]
+        assert validator.activation_eligibility_epoch != spec.FAR_FUTURE_EPOCH
+        assert validator.activation_epoch == spec.FAR_FUTURE_EPOCH
+
+    for index in activation_indices[:churn_limit]:
+        validator = state.validators[index]
+        assert validator.activation_epoch != spec.FAR_FUTURE_EPOCH
+        assert not spec.is_active_validator(
+            validator, spec.get_current_epoch(state))
+        assert spec.is_active_validator(
+            validator,
+            spec.compute_activation_exit_epoch(spec.get_current_epoch(state)))
+
+    for index in activation_indices[churn_limit:]:
+        validator = state.validators[index]
+        assert validator.activation_eligibility_epoch != spec.FAR_FUTURE_EPOCH
+        if not is_post_electra(spec):
+            assert validator.activation_epoch == spec.FAR_FUTURE_EPOCH
+
+    for i, index in enumerate(ejection_indices):
+        validator = state.validators[index]
+        assert validator.exit_epoch != spec.FAR_FUTURE_EPOCH
+        assert spec.is_active_validator(
+            validator, spec.get_current_epoch(state))
+        queue_offset = i // churn_limit
+        assert not spec.is_active_validator(
+            validator,
+            spec.compute_activation_exit_epoch(spec.get_current_epoch(state))
+            + queue_offset)
+
+
+@with_all_phases
+@spec_state_test
+def test_activation_queue_activation_and_ejection__1(spec, state):
+    yield from run_activation_and_ejection(spec, state, 1)
+
+
+@with_all_phases
+@spec_state_test
+def test_activation_queue_activation_and_ejection__churn_limit(spec, state):
+    churn_limit = int(spec.get_validator_churn_limit(state))
+    assert churn_limit == spec.config.MIN_PER_EPOCH_CHURN_LIMIT
+    yield from run_activation_and_ejection(spec, state, churn_limit)
+
+
+@with_all_phases
+@spec_state_test
+def test_activation_queue_activation_and_ejection__exceed_churn_limit(
+        spec, state):
+    churn_limit = int(spec.get_validator_churn_limit(state))
+    assert churn_limit == spec.config.MIN_PER_EPOCH_CHURN_LIMIT
+    yield from run_activation_and_ejection(spec, state, churn_limit + 1)
+
+
+@with_all_phases
+@with_presets([MINIMAL], reason="scaled validator set")
+@spec_test
+@with_custom_state(
+    balances_fn=scaled_churn_balances_min_churn_limit,
+    threshold_fn=lambda spec: spec.config.EJECTION_BALANCE)
+@single_phase
+def test_activation_queue_activation_and_ejection__scaled_churn_limit(
+        spec, state):
+    churn_limit = int(spec.get_validator_churn_limit(state))
+    assert churn_limit > spec.config.MIN_PER_EPOCH_CHURN_LIMIT
+    yield from run_activation_and_ejection(spec, state, churn_limit)
+
+
+@with_all_phases
+@spec_state_test
+def test_invalid_large_withdrawable_epoch(spec, state):
+    """An exit epoch close to FAR_FUTURE_EPOCH must overflow the uint64
+    withdrawable-epoch computation and make the transition invalid."""
+    exit_epoch = spec.FAR_FUTURE_EPOCH - 1
+    state.validators[0].exit_epoch = exit_epoch
+    state.validators[1].effective_balance = spec.config.EJECTION_BALANCE
+    if is_post_electra(spec):
+        state.earliest_exit_epoch = exit_epoch
+
+    try:
+        yield from run_process_registry_updates(spec, state)
+    except ValueError:
+        yield "post", None
+        return
+    raise AssertionError("expected ValueError (uint64 overflow)")

@@ -1,0 +1,209 @@
+"""phase0: process_slashings — correlation penalties (scenario parity:
+`test/phase0/epoch_processing/test_process_slashings.py`)."""
+
+from random import Random
+
+from consensus_specs_tpu.testlib.context import (
+    spec_state_test,
+    with_all_phases,
+)
+from consensus_specs_tpu.testlib.helpers.epoch_processing import (
+    run_epoch_processing_to,
+    run_epoch_processing_with,
+)
+from consensus_specs_tpu.testlib.helpers.forks import (
+    is_post_altair,
+    is_post_bellatrix,
+    is_post_electra,
+)
+from consensus_specs_tpu.testlib.helpers.random import randomize_state
+from consensus_specs_tpu.testlib.helpers.state import (
+    has_active_balance_differential,
+    next_epoch,
+)
+from consensus_specs_tpu.testlib.helpers.voluntary_exits import (
+    get_unslashed_exited_validators,
+)
+
+
+def run_process_slashings(spec, state):
+    yield from run_epoch_processing_with(spec, state, "process_slashings")
+
+
+def slash_validators(spec, state, indices, out_epochs):
+    total_slashed_balance = 0
+    for i, out_epoch in zip(indices, out_epochs):
+        v = state.validators[i]
+        v.slashed = True
+        spec.initiate_validator_exit(state, i)
+        v.withdrawable_epoch = out_epoch
+        total_slashed_balance += int(v.effective_balance)
+
+    state.slashings[spec.get_current_epoch(state)
+                    % spec.EPOCHS_PER_SLASHINGS_VECTOR] = \
+        total_slashed_balance
+    assert total_slashed_balance != 0
+
+
+def get_slashing_multiplier(spec):
+    if is_post_bellatrix(spec):
+        return int(spec.PROPORTIONAL_SLASHING_MULTIPLIER_BELLATRIX)
+    if is_post_altair(spec):
+        return int(spec.PROPORTIONAL_SLASHING_MULTIPLIER_ALTAIR)
+    return int(spec.PROPORTIONAL_SLASHING_MULTIPLIER)
+
+
+def expected_correlation_penalty(spec, effective_balance,
+                                 total_slashed, total_balance):
+    inc = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    if is_post_electra(spec):
+        return ((get_slashing_multiplier(spec) * total_slashed)
+                // (total_balance // inc)
+                * (effective_balance // inc))
+    return (effective_balance // inc
+            * (get_slashing_multiplier(spec) * total_slashed)
+            // total_balance * inc)
+
+
+def setup_max_slashings(spec, state, not_slashable=()):
+    """Slash enough stake to drive the correlation penalty to its cap."""
+    slashed_count = min(
+        len(state.validators) // get_slashing_multiplier(spec) + 1,
+        len(state.validators))
+    out_epoch = spec.get_current_epoch(state) \
+        + spec.EPOCHS_PER_SLASHINGS_VECTOR // 2
+
+    slashed_indices = sorted(set(range(slashed_count)) - set(not_slashable))
+    slash_validators(spec, state, slashed_indices,
+                     [out_epoch] * len(slashed_indices))
+
+    total_balance = int(spec.get_total_active_balance(state))
+    total_penalties = sum(map(int, state.slashings))
+    assert total_balance // get_slashing_multiplier(spec) <= total_penalties
+    return slashed_indices
+
+
+@with_all_phases
+@spec_state_test
+def test_max_penalties(spec, state):
+    slashed_indices = setup_max_slashings(spec, state)
+    yield from run_process_slashings(spec, state)
+    for i in slashed_indices:
+        assert state.balances[i] == 0
+
+
+@with_all_phases
+@spec_state_test
+def test_low_penalty(spec, state):
+    slashed_count = len(state.validators) // 10 + 1
+    out_epoch = spec.get_current_epoch(state) \
+        + spec.EPOCHS_PER_SLASHINGS_VECTOR // 2
+    slashed_indices = list(range(slashed_count))
+    slash_validators(spec, state, slashed_indices,
+                     [out_epoch] * slashed_count)
+
+    pre_state = state.copy()
+    yield from run_process_slashings(spec, state)
+    for i in slashed_indices:
+        assert 0 < state.balances[i] < pre_state.balances[i]
+
+
+@with_all_phases
+@spec_state_test
+def test_minimal_penalty(spec, state):
+    """One tiny slashing: the quotient math must round the penalty to the
+    exact expected value (possibly zero)."""
+    state.balances[0] = state.validators[0].effective_balance = (
+        spec.config.EJECTION_BALANCE + spec.EFFECTIVE_BALANCE_INCREMENT)
+    for i in range(1, len(state.validators)):
+        state.validators[i].effective_balance = state.balances[i] = \
+            spec.MAX_EFFECTIVE_BALANCE
+
+    out_epoch = spec.get_current_epoch(state) \
+        + spec.EPOCHS_PER_SLASHINGS_VECTOR // 2
+    slash_validators(spec, state, [0], [out_epoch])
+
+    total_balance = int(spec.get_total_active_balance(state))
+    total_penalties = sum(map(int, state.slashings))
+    assert total_balance // 3 > total_penalties
+
+    run_epoch_processing_to(spec, state, "process_slashings")
+    pre_slash_balances = list(state.balances)
+    yield "pre", state
+    spec.process_slashings(state)
+    yield "post", state
+
+    penalty = expected_correlation_penalty(
+        spec, int(state.validators[0].effective_balance),
+        total_penalties, total_balance)
+    assert state.balances[0] == pre_slash_balances[0] - penalty
+
+
+@with_all_phases
+@spec_state_test
+def test_scaled_penalties(spec, state):
+    next_epoch(spec, state)
+
+    # prior slashings in the vector: the sum matters, not just this epoch
+    base = int(spec.config.EJECTION_BALANCE)
+    incr = int(spec.EFFECTIVE_BALANCE_INCREMENT)
+    state.slashings[0] = base + incr * 12
+    state.slashings[4] = base + incr * 3
+    state.slashings[5] = base + incr * 6
+    state.slashings[spec.EPOCHS_PER_SLASHINGS_VECTOR - 1] = base + incr * 7
+
+    slashed_count = len(state.validators) \
+        // (get_slashing_multiplier(spec) + 1)
+    assert slashed_count > 10
+
+    # non-uniform effective balances so the per-validator scaling shows
+    increments = (int(spec.MAX_EFFECTIVE_BALANCE) - base) // incr
+    for i in range(10):
+        state.validators[i].effective_balance = \
+            base + incr * (i % increments)
+        state.balances[i] = int(state.validators[i].effective_balance) + i - 5
+
+    total_balance = int(spec.get_total_active_balance(state))
+    out_epoch = spec.get_current_epoch(state) \
+        + spec.EPOCHS_PER_SLASHINGS_VECTOR // 2
+    slashed_indices = list(range(slashed_count))
+
+    run_epoch_processing_to(spec, state, "process_slashings")
+    pre_slash_balances = list(state.balances)
+    slash_validators(spec, state, slashed_indices,
+                     [out_epoch] * slashed_count)
+
+    yield "pre", state
+    spec.process_slashings(state)
+    yield "post", state
+
+    total_penalties = sum(map(int, state.slashings))
+    for i in slashed_indices:
+        penalty = expected_correlation_penalty(
+            spec, int(state.validators[i].effective_balance),
+            total_penalties, total_balance)
+        assert state.balances[i] == pre_slash_balances[i] - penalty
+
+
+@with_all_phases
+@spec_state_test
+def test_slashings_with_random_state(spec, state):
+    rng = Random(9998)
+    randomize_state(spec, state, rng)
+
+    pre_balances = state.balances.copy()
+
+    protected = get_unslashed_exited_validators(spec, state)
+    assert len(protected) != 0
+    assert has_active_balance_differential(spec, state)
+
+    slashed_indices = setup_max_slashings(spec, state,
+                                          not_slashable=protected)
+
+    # the protected set must still be exited-and-unslashed afterwards
+    assert get_unslashed_exited_validators(spec, state) == protected
+
+    yield from run_process_slashings(spec, state)
+
+    for i in slashed_indices:
+        assert state.balances[i] < pre_balances[i]

@@ -1,0 +1,179 @@
+"""phase0: genesis initialization (scenario parity:
+`test/phase0/genesis/test_initialization.py`)."""
+
+from consensus_specs_tpu.testlib.context import (
+    MINIMAL,
+    PHASE0,
+    single_phase,
+    spec_test,
+    with_phases,
+    with_presets,
+)
+from consensus_specs_tpu.testlib.helpers.deposits import (
+    prepare_full_genesis_deposits,
+    prepare_random_genesis_deposits,
+)
+
+
+def eth1_init_data(eth1_block_hash, eth1_timestamp):
+    yield "eth1", {
+        "eth1_block_hash": "0x" + eth1_block_hash.hex(),
+        "eth1_timestamp": int(eth1_timestamp),
+    }
+
+
+@with_phases([PHASE0])
+@spec_test
+@single_phase
+@with_presets([MINIMAL], reason="too slow")
+def test_initialize_beacon_state_from_eth1(spec):
+    deposit_count = spec.config.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT
+    deposits, deposit_root, _ = prepare_full_genesis_deposits(
+        spec, spec.MAX_EFFECTIVE_BALANCE, deposit_count, signed=True)
+
+    eth1_block_hash = b"\x12" * 32
+    eth1_timestamp = spec.config.MIN_GENESIS_TIME
+
+    yield from eth1_init_data(eth1_block_hash, eth1_timestamp)
+    yield "deposits", deposits
+
+    state = spec.initialize_beacon_state_from_eth1(
+        eth1_block_hash, eth1_timestamp, deposits)
+
+    assert state.genesis_time == \
+        eth1_timestamp + spec.config.GENESIS_DELAY
+    assert len(state.validators) == deposit_count
+    assert state.eth1_data.deposit_root == deposit_root
+    assert state.eth1_data.deposit_count == deposit_count
+    assert state.eth1_data.block_hash == eth1_block_hash
+    assert (spec.get_total_active_balance(state)
+            == deposit_count * spec.MAX_EFFECTIVE_BALANCE)
+
+    yield "state", state
+
+
+@with_phases([PHASE0])
+@spec_test
+@single_phase
+@with_presets([MINIMAL], reason="too slow")
+def test_initialize_beacon_state_some_small_balances(spec):
+    main_deposit_count = spec.config.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT
+    main_deposits, _, deposit_data_list = prepare_full_genesis_deposits(
+        spec, spec.MAX_EFFECTIVE_BALANCE,
+        deposit_count=main_deposit_count, signed=True)
+    # the same pubkeys and twice as many fresh ones deposit dust
+    small_deposit_count = main_deposit_count * 2
+    small_deposits, deposit_root, _ = prepare_full_genesis_deposits(
+        spec, spec.MIN_DEPOSIT_AMOUNT,
+        deposit_count=small_deposit_count, signed=True,
+        deposit_data_list=deposit_data_list)
+    deposits = main_deposits + small_deposits
+
+    eth1_block_hash = b"\x12" * 32
+    eth1_timestamp = spec.config.MIN_GENESIS_TIME
+
+    yield from eth1_init_data(eth1_block_hash, eth1_timestamp)
+    yield "deposits", deposits
+
+    state = spec.initialize_beacon_state_from_eth1(
+        eth1_block_hash, eth1_timestamp, deposits)
+
+    assert state.genesis_time == \
+        eth1_timestamp + spec.config.GENESIS_DELAY
+    assert len(state.validators) == small_deposit_count
+    assert state.eth1_data.deposit_root == deposit_root
+    assert state.eth1_data.deposit_count == len(deposits)
+    assert state.eth1_data.block_hash == eth1_block_hash
+    # only the full deposits contribute active balance
+    assert (spec.get_total_active_balance(state)
+            == main_deposit_count * spec.MAX_EFFECTIVE_BALANCE)
+
+    yield "state", state
+
+
+@with_phases([PHASE0])
+@spec_test
+@single_phase
+@with_presets([MINIMAL], reason="too slow")
+def test_initialize_beacon_state_one_topup_activation(spec):
+    # all but one validator deposit the full amount
+    main_deposit_count = spec.config.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT - 1
+    main_deposits, _, deposit_data_list = prepare_full_genesis_deposits(
+        spec, spec.MAX_EFFECTIVE_BALANCE,
+        deposit_count=main_deposit_count, signed=True)
+
+    # the last deposits partially, then tops up to the full amount
+    partial_deposits, _, deposit_data_list = prepare_full_genesis_deposits(
+        spec, spec.MAX_EFFECTIVE_BALANCE - spec.MIN_DEPOSIT_AMOUNT,
+        deposit_count=1, min_pubkey_index=main_deposit_count,
+        signed=True, deposit_data_list=deposit_data_list)
+    top_up_deposits, _, _ = prepare_full_genesis_deposits(
+        spec, spec.MIN_DEPOSIT_AMOUNT,
+        deposit_count=1, min_pubkey_index=main_deposit_count,
+        signed=True, deposit_data_list=deposit_data_list)
+
+    deposits = main_deposits + partial_deposits + top_up_deposits
+
+    eth1_block_hash = b"\x13" * 32
+    eth1_timestamp = spec.config.MIN_GENESIS_TIME
+
+    yield from eth1_init_data(eth1_block_hash, eth1_timestamp)
+    yield "deposits", deposits
+
+    state = spec.initialize_beacon_state_from_eth1(
+        eth1_block_hash, eth1_timestamp, deposits)
+    assert spec.is_valid_genesis_state(state)
+
+    yield "state", state
+
+
+@with_phases([PHASE0])
+@spec_test
+@single_phase
+@with_presets([MINIMAL], reason="too slow")
+def test_initialize_beacon_state_random_invalid_genesis(spec):
+    # a pile of random dust deposits cannot reach genesis validity
+    deposits, _, _ = prepare_random_genesis_deposits(
+        spec, deposit_count=20, max_pubkey_index=10)
+    eth1_block_hash = b"\x14" * 32
+    eth1_timestamp = spec.config.MIN_GENESIS_TIME + 1
+
+    yield from eth1_init_data(eth1_block_hash, eth1_timestamp)
+    yield "deposits", deposits
+
+    state = spec.initialize_beacon_state_from_eth1(
+        eth1_block_hash, eth1_timestamp, deposits)
+    assert not spec.is_valid_genesis_state(state)
+
+    yield "state", state
+
+
+@with_phases([PHASE0])
+@spec_test
+@single_phase
+@with_presets([MINIMAL], reason="too slow")
+def test_initialize_beacon_state_random_valid_genesis(spec):
+    # random deposits around the genesis threshold...
+    random_deposits, _, deposit_data_list = prepare_random_genesis_deposits(
+        spec, deposit_count=20,
+        min_pubkey_index=spec.config.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT - 5,
+        max_pubkey_index=spec.config.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT + 5)
+
+    # ...plus enough full deposits to cross it
+    full_deposits, _, _ = prepare_full_genesis_deposits(
+        spec, spec.MAX_EFFECTIVE_BALANCE,
+        deposit_count=spec.config.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT,
+        signed=True, deposit_data_list=deposit_data_list)
+
+    deposits = random_deposits + full_deposits
+    eth1_block_hash = b"\x15" * 32
+    eth1_timestamp = spec.config.MIN_GENESIS_TIME + 2
+
+    yield from eth1_init_data(eth1_block_hash, eth1_timestamp)
+    yield "deposits", deposits
+
+    state = spec.initialize_beacon_state_from_eth1(
+        eth1_block_hash, eth1_timestamp, deposits)
+    assert spec.is_valid_genesis_state(state)
+
+    yield "state", state

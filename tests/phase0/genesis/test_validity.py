@@ -1,0 +1,87 @@
+"""phase0: genesis validity predicate (scenario parity:
+`test/phase0/genesis/test_validity.py`)."""
+
+from consensus_specs_tpu.testlib.context import (
+    MINIMAL,
+    PHASE0,
+    single_phase,
+    spec_test,
+    with_phases,
+    with_presets,
+)
+from consensus_specs_tpu.testlib.helpers.deposits import (
+    prepare_full_genesis_deposits,
+)
+
+
+def create_valid_beacon_state(spec):
+    deposit_count = spec.config.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT
+    deposits, _, _ = prepare_full_genesis_deposits(
+        spec, amount=spec.MAX_EFFECTIVE_BALANCE,
+        deposit_count=deposit_count, signed=True)
+    return spec.initialize_beacon_state_from_eth1(
+        b"\x12" * 32, spec.config.MIN_GENESIS_TIME, deposits)
+
+
+def run_is_valid_genesis_state(spec, state, valid=True):
+    yield "genesis", state
+    is_valid = spec.is_valid_genesis_state(state)
+    yield "is_valid", is_valid
+    assert is_valid == valid
+
+
+@with_phases([PHASE0])
+@spec_test
+@single_phase
+@with_presets([MINIMAL], reason="too slow")
+def test_full_genesis_deposits(spec):
+    state = create_valid_beacon_state(spec)
+    yield from run_is_valid_genesis_state(spec, state)
+
+
+@with_phases([PHASE0])
+@spec_test
+@single_phase
+@with_presets([MINIMAL], reason="too slow")
+def test_invalid_invalid_timestamp(spec):
+    state = create_valid_beacon_state(spec)
+    state.genesis_time = spec.config.MIN_GENESIS_TIME - 1
+    yield from run_is_valid_genesis_state(spec, state, valid=False)
+
+
+@with_phases([PHASE0])
+@spec_test
+@single_phase
+@with_presets([MINIMAL], reason="too slow")
+def test_extra_balance(spec):
+    state = create_valid_beacon_state(spec)
+    state.validators[0].effective_balance = spec.MAX_EFFECTIVE_BALANCE + 1
+    yield from run_is_valid_genesis_state(spec, state)
+
+
+@with_phases([PHASE0])
+@spec_test
+@single_phase
+@with_presets([MINIMAL], reason="too slow")
+def test_one_more_validator(spec):
+    deposit_count = spec.config.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT + 1
+    deposits, _, _ = prepare_full_genesis_deposits(
+        spec, amount=spec.MAX_EFFECTIVE_BALANCE,
+        deposit_count=deposit_count, signed=True)
+    state = spec.initialize_beacon_state_from_eth1(
+        b"\x12" * 32, spec.config.MIN_GENESIS_TIME, deposits)
+    yield from run_is_valid_genesis_state(spec, state)
+
+
+@with_phases([PHASE0])
+@spec_test
+@single_phase
+@with_presets([MINIMAL], reason="too slow")
+def test_invalid_not_enough_validator_count(spec):
+    deposit_count = spec.config.MIN_GENESIS_ACTIVE_VALIDATOR_COUNT - 1
+    deposits, _, _ = prepare_full_genesis_deposits(
+        spec, amount=spec.MAX_EFFECTIVE_BALANCE,
+        deposit_count=deposit_count, signed=True)
+    state = spec.initialize_beacon_state_from_eth1(
+        b"\x12" * 32, spec.config.MIN_GENESIS_TIME, deposits)
+    yield from run_is_valid_genesis_state(spec, state, valid=False)
