@@ -187,6 +187,14 @@ def with_all_phases_except(excluded):
     return with_phases([f for f in ALL_FORKS if f not in excluded])
 
 
+def with_test_suite_name(suite_name: str):
+    """Override the generator output suite dir (default pyspec_tests)."""
+    def deco(fn):
+        fn.suite_name = suite_name
+        return fn
+    return deco
+
+
 def with_presets(presets, reason=None):
     """Skip unless the active preset is in `presets`."""
 

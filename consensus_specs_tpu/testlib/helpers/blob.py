@@ -24,7 +24,10 @@ def get_sample_blob(spec, rng=None, is_valid_blob=True):
 
 
 def get_sample_blob_tx(spec, blob_count=1, rng=None, is_valid_blob=True):
-    """(blobs, commitments, proofs) for `blob_count` sample blobs."""
+    """(opaque_tx, blobs, commitments, proofs) for `blob_count` sample
+    blobs — reference shape (`helpers/blob.py get_sample_blob_tx`).  The
+    opaque tx is a type-3 stub carrying the versioned hashes; the spec
+    never parses it (the engine stub validates out-of-band)."""
     if rng is None:
         # share one stream across the loop, or every blob is identical
         rng = random.Random(5566)
@@ -44,7 +47,11 @@ def get_sample_blob_tx(spec, blob_count=1, rng=None, is_valid_blob=True):
         blobs.append(blob)
         blob_kzg_commitments.append(blob_commitment)
         blob_kzg_proofs.append(blob_kzg_proof)
-    return blobs, blob_kzg_commitments, blob_kzg_proofs
+    versioned_hashes = [spec.kzg_commitment_to_versioned_hash(c)
+                        for c in blob_kzg_commitments]
+    opaque_tx = spec.Transaction(
+        b"\x03" + b"".join(bytes(h) for h in versioned_hashes))
+    return opaque_tx, blobs, blob_kzg_commitments, blob_kzg_proofs
 
 
 def get_max_blobs_per_block(spec):

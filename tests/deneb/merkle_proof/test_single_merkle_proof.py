@@ -1,0 +1,92 @@
+"""Deneb: blob-KZG-commitment inclusion proofs (scenario parity:
+`test/deneb/merkle_proof/test_single_merkle_proof.py`)."""
+
+import random
+
+import pytest
+
+from consensus_specs_tpu.debug.random_value import (
+    RandomizationMode,
+    get_random_ssz_object,
+)
+from consensus_specs_tpu.testlib.context import (
+    DENEB,
+    spec_state_test,
+    with_all_phases_from,
+    with_test_suite_name,
+)
+from consensus_specs_tpu.testlib.helpers.blob import get_sample_blob_tx
+from consensus_specs_tpu.testlib.helpers.block import (
+    build_empty_block_for_next_slot,
+    sign_block,
+)
+from consensus_specs_tpu.testlib.helpers.execution_payload import (
+    compute_el_block_hash,
+)
+
+with_deneb_and_later = with_all_phases_from(DENEB)
+
+
+def run_blob_kzg_commitment_merkle_proof_test(spec, state, rng=None):
+    opaque_tx, blobs, blob_kzg_commitments, proofs = get_sample_blob_tx(
+        spec, blob_count=1)
+    if rng is None:
+        block = build_empty_block_for_next_slot(spec, state)
+    else:
+        block = get_random_ssz_object(
+            rng, spec.BeaconBlock,
+            max_bytes_length=2000, max_list_length=2000,
+            mode=RandomizationMode, chaos=True)
+    block.body.blob_kzg_commitments = blob_kzg_commitments
+    block.body.execution_payload.transactions = [opaque_tx]
+    block.body.execution_payload.block_hash = compute_el_block_hash(
+        spec, block.body.execution_payload, state)
+
+    signed_block = sign_block(spec, state, block, proposer_index=0)
+    blob_sidecars = spec.get_blob_sidecars(signed_block, blobs, proofs)
+    blob_index = 0
+    blob_sidecar = blob_sidecars[blob_index]
+
+    yield "object", block.body
+
+    inclusion_proof = blob_sidecar.kzg_commitment_inclusion_proof
+    gindex = spec.get_generalized_index(
+        spec.BeaconBlockBody, "blob_kzg_commitments", blob_index)
+    yield "proof", {
+        "leaf": "0x" + spec.hash_tree_root(
+            blob_sidecar.kzg_commitment).hex(),
+        "leaf_index": int(gindex),
+        "branch": ["0x" + bytes(root).hex() for root in inclusion_proof],
+    }
+
+    assert spec.is_valid_merkle_branch(
+        leaf=spec.hash_tree_root(blob_sidecar.kzg_commitment),
+        branch=blob_sidecar.kzg_commitment_inclusion_proof,
+        depth=spec.floorlog2(gindex),
+        index=spec.get_subtree_index(gindex),
+        root=blob_sidecar.signed_block_header.message.body_root,
+    )
+    assert spec.verify_blob_sidecar_inclusion_proof(blob_sidecar)
+
+
+@with_test_suite_name("BeaconBlockBody")
+@with_deneb_and_later
+@spec_state_test
+def test_blob_kzg_commitment_merkle_proof__basic(spec, state):
+    yield from run_blob_kzg_commitment_merkle_proof_test(spec, state)
+
+
+@with_test_suite_name("BeaconBlockBody")
+@with_deneb_and_later
+@spec_state_test
+def test_blob_kzg_commitment_merkle_proof__random_block_1(spec, state):
+    yield from run_blob_kzg_commitment_merkle_proof_test(
+        spec, state, rng=random.Random(1111))
+
+
+@with_test_suite_name("BeaconBlockBody")
+@with_deneb_and_later
+@spec_state_test
+def test_blob_kzg_commitment_merkle_proof__random_block_2(spec, state):
+    yield from run_blob_kzg_commitment_merkle_proof_test(
+        spec, state, rng=random.Random(2222))

@@ -33,7 +33,7 @@ def run_block_with_blobs(spec, state, blob_count):
     yield "pre", state
 
     block = build_empty_block_for_next_slot(spec, state)
-    opaque_tx, _, blob_kzg_commitments, _ = get_sample_blob_tx_with_wrap(
+    opaque_tx, _, blob_kzg_commitments, _ = get_sample_blob_tx(
         spec, blob_count)
     block.body.blob_kzg_commitments = blob_kzg_commitments
     block.body.execution_payload.transactions = [opaque_tx]
@@ -43,17 +43,6 @@ def run_block_with_blobs(spec, state, blob_count):
 
     yield "blocks", [signed_block]
     yield "post", state
-
-
-def get_sample_blob_tx_with_wrap(spec, blob_count):
-    """Blob tx bytes + sidecar parts (versioned-hash prefixed tx stub)."""
-    blobs, commitments, proofs = get_sample_blob_tx(spec, blob_count)
-    versioned_hashes = [spec.kzg_commitment_to_versioned_hash(c)
-                        for c in commitments]
-    # opaque tx: type byte + concatenated versioned hashes (the spec never
-    # parses it; the engine stub validates out-of-band)
-    opaque_tx = b"\x03" + b"".join(versioned_hashes)
-    return spec.Transaction(opaque_tx), blobs, commitments, proofs
 
 
 @with_deneb_and_later
