@@ -1,38 +1,180 @@
 """Execution-payload construction for tests
 (mirrors `test/helpers/execution_payload.py`).
 
-Block hashes: the reference computes the real RLP header hash via an MPT
-(`compute_el_header_block_hash`).  The spec itself never recomputes the
-hash (`is_valid_block_hash` is a Noop stub), so this build derives a
-deterministic placeholder hash from the header contents; swap in an RLP
-encoder when emitting cross-client vectors.
+Block hashes are the REAL execution-layer hashes: the RLP-encoded EL
+header keccak-hashed, with transactions/withdrawals roots computed over
+`patriciaTrie(rlp(index) => data)` — the same scheme as the reference's
+`compute_el_header_block_hash`
+(`test/helpers/execution_payload.py:77-147`), built on this repo's own
+pure-Python keccak/RLP/MPT (`utils/eth1.py`).
 """
 
 from __future__ import annotations
 
 import hashlib
 
-from .forks import is_post_capella, is_post_deneb
+from ...utils.eth1 import indexed_data_trie_root, keccak256, rlp_encode
+from .forks import (
+    is_post_capella,
+    is_post_deneb,
+    is_post_eip7732,
+    is_post_electra,
+)
+
+OMMERS_HASH = bytes.fromhex(
+    "1dcc4de8dec75d7aab85b567b6ccd41ad312451b948a7413f0a142fd40d49347")
+EMPTY_NONCE = b"\x00" * 8
 
 
-def compute_el_header_hash_stub(spec, payload_header):
-    """Deterministic stand-in for the EL block hash: sha256 over the SSZ
-    of the header with a zeroed block_hash field.  Single definition —
-    genesis and block construction must agree on the scheme."""
-    from ...utils.ssz.ssz_impl import serialize
-
-    stub = payload_header.copy()
-    stub.block_hash = spec.Hash32()
-    return spec.Hash32(hashlib.sha256(b"el-block-hash:"
-                                      + serialize(stub)).digest())
+def compute_trie_root_from_indexed_data(data):
+    """Root of `patriciaTrie(rlp(Index) => Data)` (EIP-2718)."""
+    return indexed_data_trie_root(data)
 
 
-def compute_el_block_hash(spec, payload, pre_state=None):
-    header = get_execution_payload_header(spec, pre_state, payload)
-    return compute_el_header_hash_stub(spec, header)
+def compute_requests_hash(block_requests):
+    """EIP-7685 commitment: sha256 over the sha256 of each non-empty
+    request (type byte + payload)."""
+    m = hashlib.sha256()
+    for request in block_requests:
+        if len(request) > 1:
+            m.update(hashlib.sha256(bytes(request)).digest())
+    return m.digest()
+
+
+def compute_el_header_block_hash(spec, payload_header,
+                                 transactions_trie_root,
+                                 withdrawals_trie_root=None,
+                                 parent_beacon_block_root=None,
+                                 requests_hash=None):
+    """keccak-256 of the RLP execution block header described by an
+    `ExecutionPayloadHeader` (EIP-4895 / EIP-4844 / EIP-7685 layout)."""
+    if is_post_eip7732(spec):
+        # the bid header carries no EL fields to hash
+        return spec.Hash32()
+    fields = [
+        bytes(payload_header.parent_hash),
+        OMMERS_HASH,
+        bytes(payload_header.fee_recipient),
+        bytes(payload_header.state_root),
+        transactions_trie_root,
+        bytes(payload_header.receipts_root),
+        bytes(payload_header.logs_bloom),
+        0,  # difficulty is zero post-merge
+        int(payload_header.block_number),
+        int(payload_header.gas_limit),
+        int(payload_header.gas_used),
+        int(payload_header.timestamp),
+        bytes(payload_header.extra_data),
+        bytes(payload_header.prev_randao),
+        EMPTY_NONCE,
+        int(payload_header.base_fee_per_gas),
+    ]
+    if is_post_capella(spec):
+        fields.append(withdrawals_trie_root)
+    if is_post_deneb(spec):
+        fields.append(int(payload_header.blob_gas_used))
+        fields.append(int(payload_header.excess_blob_gas))
+        fields.append(bytes(parent_beacon_block_root))
+    if is_post_electra(spec):
+        fields.append(requests_hash)
+    return spec.Hash32(keccak256(rlp_encode(fields)))
+
+
+def get_withdrawal_rlp(withdrawal):
+    """EIP-4895 withdrawal encoding."""
+    return rlp_encode([
+        int(withdrawal.index),
+        int(withdrawal.validator_index),
+        bytes(withdrawal.address),
+        int(withdrawal.amount),
+    ])
+
+
+def get_deposit_request_rlp_bytes(deposit_request):
+    return b"\x00" + rlp_encode([
+        bytes(deposit_request.pubkey),
+        bytes(deposit_request.withdrawal_credentials),
+        int(deposit_request.amount),
+        bytes(deposit_request.signature),
+        int(deposit_request.index),
+    ])
+
+
+def get_withdrawal_request_rlp_bytes(withdrawal_request):
+    return b"\x01" + rlp_encode([
+        bytes(withdrawal_request.source_address),
+        bytes(withdrawal_request.validator_pubkey),
+    ])
+
+
+def get_consolidation_request_rlp_bytes(consolidation_request):
+    return b"\x02" + rlp_encode([
+        bytes(consolidation_request.source_address),
+        bytes(consolidation_request.source_pubkey),
+        bytes(consolidation_request.target_pubkey),
+    ])
+
+
+def compute_el_block_hash_with_new_fields(spec, payload,
+                                          parent_beacon_block_root,
+                                          requests_hash):
+    if payload == spec.ExecutionPayload():
+        return spec.Hash32()
+
+    transactions_trie_root = compute_trie_root_from_indexed_data(
+        payload.transactions)
+    withdrawals_trie_root = None
+    if is_post_capella(spec):
+        withdrawals_trie_root = compute_trie_root_from_indexed_data(
+            [get_withdrawal_rlp(w) for w in payload.withdrawals])
+    if not is_post_deneb(spec):
+        parent_beacon_block_root = None
+
+    payload_header = get_execution_payload_header(
+        spec, spec.BeaconState(), payload)
+    return compute_el_header_block_hash(
+        spec, payload_header, transactions_trie_root, withdrawals_trie_root,
+        parent_beacon_block_root, requests_hash)
+
+
+def compute_el_block_hash(spec, payload, pre_state):
+    parent_beacon_block_root = None
+    requests_hash = None
+    if is_post_deneb(spec):
+        previous_block_header = pre_state.latest_block_header.copy()
+        if previous_block_header.state_root == spec.Root():
+            previous_block_header.state_root = pre_state.hash_tree_root()
+        parent_beacon_block_root = previous_block_header.hash_tree_root()
+    if is_post_electra(spec):
+        requests_hash = compute_requests_hash([])
+    return compute_el_block_hash_with_new_fields(
+        spec, payload, parent_beacon_block_root, requests_hash)
+
+
+def compute_el_block_hash_for_block(spec, block):
+    requests_hash = None
+    if is_post_electra(spec):
+        requests_list = spec.get_execution_requests_list(
+            block.body.execution_requests)
+        requests_hash = compute_requests_hash(requests_list)
+    return compute_el_block_hash_with_new_fields(
+        spec, block.body.execution_payload, block.parent_root, requests_hash)
 
 
 def get_execution_payload_header(spec, state, execution_payload):
+    if is_post_eip7732(spec):
+        # the bid commits to the payload's hash, not its EL fields
+        return spec.ExecutionPayloadHeader(
+            parent_block_hash=execution_payload.parent_hash,
+            parent_block_root=spec.hash_tree_root(
+                state.latest_block_header),
+            block_hash=execution_payload.block_hash,
+            gas_limit=execution_payload.gas_limit,
+            slot=state.slot,
+            blob_kzg_commitments_root=spec.hash_tree_root(
+                spec.List[spec.KZGCommitment,
+                          spec.MAX_BLOB_COMMITMENTS_PER_BLOCK]()),
+        )
     payload_header = spec.ExecutionPayloadHeader(
         parent_hash=execution_payload.parent_hash,
         fee_recipient=execution_payload.fee_recipient,
@@ -58,6 +200,49 @@ def get_execution_payload_header(spec, state, execution_payload):
     return payload_header
 
 
+def build_empty_post_eip7732_execution_payload_header(spec, state):
+    """An empty self-built bid: the highest-index active non-slashed
+    validator acts as builder, zero value/gas (reference
+    `helpers/execution_payload.py:272-294`)."""
+    if not is_post_eip7732(spec):
+        return None
+    from .block import get_parent_root
+
+    epoch = spec.get_current_epoch(state)
+    builder_index = None
+    for index in spec.get_active_validator_indices(state, epoch):
+        if not state.validators[index].slashed:
+            builder_index = index
+    assert builder_index is not None
+    kzg_list = spec.List[spec.KZGCommitment,
+                         spec.MAX_BLOB_COMMITMENTS_PER_BLOCK]()
+    return spec.ExecutionPayloadHeader(
+        parent_block_hash=state.latest_block_hash,
+        parent_block_root=get_parent_root(spec, state),
+        block_hash=spec.Hash32(),
+        gas_limit=spec.uint64(0),
+        builder_index=builder_index,
+        slot=state.slot,
+        value=spec.Gwei(0),
+        blob_kzg_commitments_root=spec.hash_tree_root(kzg_list),
+    )
+
+
+def build_empty_signed_execution_payload_header(spec, state):
+    if not is_post_eip7732(spec):
+        return None
+    from .keys import privkeys
+
+    message = build_empty_post_eip7732_execution_payload_header(spec, state)
+    privkey = privkeys[message.builder_index]
+    signature = spec.get_execution_payload_header_signature(
+        state, message, privkey)
+    return spec.SignedExecutionPayloadHeader(
+        message=message,
+        signature=signature,
+    )
+
+
 def build_empty_execution_payload(spec, state, randao_mix=None):
     """Valid empty-transactions payload for a pre-state of the same
     slot."""
@@ -72,8 +257,7 @@ def build_empty_execution_payload(spec, state, randao_mix=None):
     payload = spec.ExecutionPayload(
         parent_hash=latest.block_hash,
         fee_recipient=spec.ExecutionAddress(),
-        receipts_root=spec.Bytes32(bytes.fromhex(
-            "1dcc4de8dec75d7aab85b567b6ccd41ad312451b948a7413f0a142fd40d49347")),
+        receipts_root=spec.Bytes32(OMMERS_HASH),
         logs_bloom=spec.ByteVector[spec.BYTES_PER_LOGS_BLOOM](),
         prev_randao=randao_mix,
         gas_used=0,
@@ -86,8 +270,6 @@ def build_empty_execution_payload(spec, state, randao_mix=None):
     payload.block_number = latest.block_number + 1
     payload.base_fee_per_gas = latest.base_fee_per_gas
     if is_post_capella(spec):
-        from .forks import is_post_electra
-
         if is_post_electra(spec):
             # electra returns (withdrawals, processed_partials_count)
             payload.withdrawals, _ = spec.get_expected_withdrawals(state)

@@ -11,12 +11,35 @@ from .keys import pubkey
 
 
 def get_sample_genesis_execution_payload_header(spec, eth1_block_hash=None):
-    """Mock post-merge EL header for genesis states
-    (`helpers/genesis.py get_sample_genesis_execution_payload_header`;
-    block_hash is this build's deterministic stand-in, see
-    helpers/execution_payload.py)."""
+    """Mock post-merge EL header for genesis states with a real RLP
+    block hash (`helpers/genesis.py
+    get_sample_genesis_execution_payload_header:75-121`)."""
+    from ...utils.eth1 import EMPTY_TRIE_ROOT
+    from .execution_payload import (
+        compute_el_header_block_hash,
+        compute_requests_hash,
+    )
+    from .forks import (
+        is_post_capella,
+        is_post_deneb,
+        is_post_eip7732,
+        is_post_electra,
+    )
+
     if eth1_block_hash is None:
         eth1_block_hash = b"\x55" * 32
+    if is_post_eip7732(spec):
+        # the post-ePBS header is a builder bid
+        kzgs = spec.List[spec.KZGCommitment,
+                         spec.MAX_BLOB_COMMITMENTS_PER_BLOCK]()
+        return spec.ExecutionPayloadHeader(
+            parent_block_hash=b"\x30" * 32,
+            parent_block_root=b"\x00" * 32,
+            block_hash=eth1_block_hash,
+            gas_limit=30000000,
+            slot=spec.Slot(0),
+            blob_kzg_commitments_root=spec.hash_tree_root(kzgs),
+        )
     payload_header = spec.ExecutionPayloadHeader(
         parent_hash=b"\x30" * 32,
         fee_recipient=b"\x42" * 20,
@@ -30,10 +53,13 @@ def get_sample_genesis_execution_payload_header(spec, eth1_block_hash=None):
         block_hash=eth1_block_hash,
         transactions_root=spec.Root(b"\x56" * 32),
     )
-    from .execution_payload import compute_el_header_hash_stub
-
-    payload_header.block_hash = compute_el_header_hash_stub(
-        spec, payload_header)
+    withdrawals_trie_root = EMPTY_TRIE_ROOT if is_post_capella(spec) else None
+    parent_beacon_block_root = b"\x00" * 32 if is_post_deneb(spec) else None
+    requests_hash = (compute_requests_hash([])
+                     if is_post_electra(spec) else None)
+    payload_header.block_hash = compute_el_header_block_hash(
+        spec, payload_header, EMPTY_TRIE_ROOT, withdrawals_trie_root,
+        parent_beacon_block_root, requests_hash)
     return payload_header
 
 
@@ -146,6 +172,16 @@ def create_genesis_state(spec, validator_balances, activation_threshold):
             spec.UNSET_DEPOSIT_REQUESTS_START_INDEX)
         state.earliest_exit_epoch = spec.GENESIS_EPOCH
         state.earliest_consolidation_epoch = 0
+
+    from .forks import is_post_eip7732
+
+    if is_post_eip7732(spec):
+        withdrawals = spec.List[spec.Withdrawal,
+                                spec.MAX_WITHDRAWALS_PER_PAYLOAD]()
+        state.latest_withdrawals_root = spec.hash_tree_root(withdrawals)
+        # last block is full
+        state.latest_block_hash = (
+            state.latest_execution_payload_header.block_hash)
 
     if is_post_fulu(spec):
         # pre-computed proposer lookahead (EIP-7917)

@@ -1,0 +1,194 @@
+"""Execution-layer hashing primitives: keccak-256, RLP, and the hexary
+Merkle-Patricia trie root.
+
+The reference computes real EL block hashes in its test helpers
+(`tests/core/pyspec/eth2spec/test/helpers/execution_payload.py:56-128`)
+via the `eth_hash`/`rlp`/`trie` packages.  None of those are available
+here, so this module provides original pure-Python equivalents.  Inputs
+are tiny (block headers, a handful of transactions), so clarity wins
+over throughput; the consensus hot path never touches this code.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Union
+
+# ---------------------------------------------------------------------------
+# keccak-256 (the pre-NIST Keccak padding, as used by Ethereum — NOT sha3_256)
+# ---------------------------------------------------------------------------
+
+_MASK = (1 << 64) - 1
+
+_ROUND_CONSTANTS = (
+    0x0000000000000001, 0x0000000000008082, 0x800000000000808A,
+    0x8000000080008000, 0x000000000000808B, 0x0000000080000001,
+    0x8000000080008081, 0x8000000000008009, 0x000000000000008A,
+    0x0000000000000088, 0x0000000080008009, 0x000000008000000A,
+    0x000000008000808B, 0x800000000000008B, 0x8000000000008089,
+    0x8000000000008003, 0x8000000000008002, 0x8000000000000080,
+    0x000000000000800A, 0x800000008000000A, 0x8000000080008081,
+    0x8000000000008080, 0x0000000080000001, 0x8000000080008008,
+)
+
+# Rotation offsets r[x][y] for lane (x, y).
+_ROTATIONS = (
+    (0, 36, 3, 41, 18),
+    (1, 44, 10, 45, 2),
+    (62, 6, 43, 15, 61),
+    (28, 55, 25, 21, 56),
+    (27, 20, 39, 8, 14),
+)
+
+_RATE = 136  # bytes; capacity 512 bits for a 256-bit digest
+
+
+def _rotl(v: int, n: int) -> int:
+    return ((v << n) | (v >> (64 - n))) & _MASK
+
+
+def _keccak_f1600(lanes):
+    """One permutation over the 5x5 lane state (lanes[x][y])."""
+    for rc in _ROUND_CONSTANTS:
+        # theta
+        c = [lanes[x][0] ^ lanes[x][1] ^ lanes[x][2] ^ lanes[x][3]
+             ^ lanes[x][4] for x in range(5)]
+        d = [c[(x - 1) % 5] ^ _rotl(c[(x + 1) % 5], 1) for x in range(5)]
+        lanes = [[lanes[x][y] ^ d[x] for y in range(5)] for x in range(5)]
+        # rho + pi
+        b = [[0] * 5 for _ in range(5)]
+        for x in range(5):
+            for y in range(5):
+                b[y][(2 * x + 3 * y) % 5] = _rotl(lanes[x][y],
+                                                  _ROTATIONS[x][y])
+        # chi
+        lanes = [[b[x][y] ^ ((~b[(x + 1) % 5][y]) & b[(x + 2) % 5][y]
+                             & _MASK) for y in range(5)] for x in range(5)]
+        # iota
+        lanes[0][0] ^= rc
+    return lanes
+
+
+def keccak256(data: bytes) -> bytes:
+    lanes = [[0] * 5 for _ in range(5)]
+    # multi-rate padding with the 0x01 domain byte (original Keccak)
+    padded = data + b"\x01" + b"\x00" * (_RATE - 1 - len(data) % _RATE)
+    padded = padded[:len(padded) - 1] + bytes([padded[-1] | 0x80])
+    for off in range(0, len(padded), _RATE):
+        block = padded[off:off + _RATE]
+        for i in range(_RATE // 8):
+            lane = int.from_bytes(block[8 * i:8 * i + 8], "little")
+            lanes[i % 5][i // 5] ^= lane
+        lanes = _keccak_f1600(lanes)
+    out = b"".join(lanes[i % 5][i // 5].to_bytes(8, "little")
+                   for i in range(4))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# RLP encoding (https://ethereum.org/en/developers/docs/data-structures-and-encoding/rlp/)
+# ---------------------------------------------------------------------------
+
+RLPItem = Union[bytes, int, Sequence["RLPItem"]]
+
+
+def _rlp_length(length: int, short_offset: int) -> bytes:
+    if length < 56:
+        return bytes([short_offset + length])
+    length_bytes = length.to_bytes((length.bit_length() + 7) // 8, "big")
+    return bytes([short_offset + 55 + len(length_bytes)]) + length_bytes
+
+
+def rlp_encode(item: RLPItem) -> bytes:
+    if isinstance(item, int):
+        # big-endian minimal encoding; zero is the empty byte string
+        item = item.to_bytes((item.bit_length() + 7) // 8, "big")
+    if isinstance(item, (bytes, bytearray, memoryview)):
+        item = bytes(item)
+        if len(item) == 1 and item[0] < 0x80:
+            return item
+        return _rlp_length(len(item), 0x80) + item
+    payload = b"".join(rlp_encode(sub) for sub in item)
+    return _rlp_length(len(payload), 0xC0) + payload
+
+
+# ---------------------------------------------------------------------------
+# Hexary Merkle-Patricia trie root
+# ---------------------------------------------------------------------------
+
+# Nodes are python structures: leaf/extension -> [hp_path, value_or_ref],
+# branch -> [ref0..ref15, value].  A reference is the node itself when its
+# RLP is short (<32 bytes), else its keccak-256 hash — the standard MPT
+# inlining rule.
+
+EMPTY_TRIE_ROOT = bytes.fromhex(
+    "56e81f171bcc55a6ff8345e692c0f86e5b48e01b996cadc001622fb5e363b421")
+
+
+def _hex_prefix(nibbles: Sequence[int], leaf: bool) -> bytes:
+    flag = 2 if leaf else 0
+    if len(nibbles) % 2:
+        head = bytes([(flag + 1) << 4 | nibbles[0]])
+        nibbles = nibbles[1:]
+    else:
+        head = bytes([flag << 4])
+    return head + bytes(nibbles[i] << 4 | nibbles[i + 1]
+                        for i in range(0, len(nibbles), 2))
+
+
+def _nibbles(key: bytes):
+    out = []
+    for byte in key:
+        out.append(byte >> 4)
+        out.append(byte & 0x0F)
+    return out
+
+
+def _node_ref(node):
+    encoded = rlp_encode(node)
+    return node if len(encoded) < 32 else keccak256(encoded)
+
+
+def _build_node(pairs):
+    """pairs: non-empty list of (nibble_list, value), all keys distinct and
+    prefix-free below this point except possibly one empty key."""
+    if len(pairs) == 1 and pairs[0][1] is not None:
+        nib, value = pairs[0]
+        return [_hex_prefix(nib, leaf=True), value]
+
+    # longest common nibble prefix
+    first = pairs[0][0]
+    prefix_len = 0
+    while (prefix_len < len(first)
+           and all(len(nib) > prefix_len and nib[prefix_len]
+                   == first[prefix_len] for nib, _ in pairs)):
+        prefix_len += 1
+    if prefix_len:
+        stripped = [(nib[prefix_len:], v) for nib, v in pairs]
+        return [_hex_prefix(first[:prefix_len], leaf=False),
+                _node_ref(_build_node(stripped))]
+
+    branch = [b""] * 17
+    for digit in range(16):
+        group = [(nib[1:], v) for nib, v in pairs if nib and nib[0] == digit]
+        if group:
+            branch[digit] = _node_ref(_build_node(group))
+    for nib, value in pairs:
+        if not nib:
+            branch[16] = value
+    return branch
+
+
+def trie_root(items: dict) -> bytes:
+    """Root hash of patriciaTrie(key_bytes => value_bytes).  Empty values
+    are skipped, matching HexaryTrie.set semantics for b''."""
+    pairs = [(_nibbles(k), v) for k, v in items.items() if v]
+    if not pairs:
+        return EMPTY_TRIE_ROOT
+    return keccak256(rlp_encode(_build_node(pairs)))
+
+
+def indexed_data_trie_root(data) -> bytes:
+    """Root of patriciaTrie(rlp(index) => data) — the EIP-2718 shape used
+    for transactions_root / withdrawals_root in EL block headers."""
+    return trie_root({rlp_encode(i): bytes(obj)
+                      for i, obj in enumerate(data)})

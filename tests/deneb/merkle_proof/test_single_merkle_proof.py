@@ -36,7 +36,7 @@ def run_blob_kzg_commitment_merkle_proof_test(spec, state, rng=None):
         block = get_random_ssz_object(
             rng, spec.BeaconBlock,
             max_bytes_length=2000, max_list_length=2000,
-            mode=RandomizationMode, chaos=True)
+            mode=RandomizationMode.mode_random, chaos=True)
     block.body.blob_kzg_commitments = blob_kzg_commitments
     block.body.execution_payload.transactions = [opaque_tx]
     block.body.execution_payload.block_hash = compute_el_block_hash(

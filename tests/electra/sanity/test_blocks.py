@@ -14,6 +14,9 @@ from consensus_specs_tpu.testlib.context import (
 from consensus_specs_tpu.testlib.helpers.attestations import (
     get_valid_attestation,
 )
+from consensus_specs_tpu.testlib.helpers.execution_payload import (
+    compute_el_block_hash_for_block,
+)
 from consensus_specs_tpu.testlib.helpers.block import (
     build_empty_block_for_next_slot,
 )
@@ -54,6 +57,8 @@ def test_block_with_deposit_request(spec, state):
 
     block = build_empty_block_for_next_slot(spec, state)
     block.body.execution_requests.deposits.append(deposit_request)
+    block.body.execution_payload.block_hash = (
+        compute_el_block_hash_for_block(spec, block))
     signed_block = state_transition_and_sign_block(spec, state, block)
 
     yield "blocks", [signed_block]
@@ -84,6 +89,8 @@ def test_block_with_withdrawal_request(spec, state):
 
     block = build_empty_block_for_next_slot(spec, state)
     block.body.execution_requests.withdrawals.append(withdrawal_request)
+    block.body.execution_payload.block_hash = (
+        compute_el_block_hash_for_block(spec, block))
     signed_block = state_transition_and_sign_block(spec, state, block)
 
     yield "blocks", [signed_block]
@@ -120,6 +127,8 @@ def test_block_with_consolidation_request(spec, state):
     block = build_empty_block_for_next_slot(spec, state)
     block.body.execution_requests.consolidations.append(
         consolidation_request)
+    block.body.execution_payload.block_hash = (
+        compute_el_block_hash_for_block(spec, block))
     signed_block = state_transition_and_sign_block(spec, state, block)
 
     yield "blocks", [signed_block]

@@ -1,0 +1,123 @@
+"""utils/eth1.py — keccak-256, RLP, and MPT root against published
+vectors (keccak known-answer tests; RLP examples from the Ethereum
+wiki; the `ethereum/tests` branching-trie vector)."""
+
+import hashlib
+
+from consensus_specs_tpu.utils.eth1 import (
+    EMPTY_TRIE_ROOT,
+    indexed_data_trie_root,
+    keccak256,
+    rlp_encode,
+    trie_root,
+)
+
+
+def test_keccak256_known_answers():
+    assert keccak256(b"").hex() == (
+        "c5d2460186f7233c927e7db2dcc703c0e500b653ca82273b7bfad8045d85a470")
+    assert keccak256(b"abc").hex() == (
+        "4e03657aea45a94fc7d47ba826c8d667c0d1e6e33a64a036ec44f58fa12d6c45")
+    assert keccak256(
+        b"The quick brown fox jumps over the lazy dog").hex() == (
+        "4d741b6f1eb29cb2a9b9911c82f56fa8d73b04959d3d9d222895df6c0b28aa15")
+
+
+def test_keccak256_is_not_sha3():
+    # NIST SHA3-256 pads with 0x06; Ethereum's keccak pads with 0x01.
+    assert keccak256(b"") != hashlib.sha3_256(b"").digest()
+
+
+def test_keccak256_multiblock():
+    # > rate (136 bytes) exercises multiple permutations; incremental
+    # self-consistency at the block boundary.
+    data = bytes(range(256)) * 3
+    assert len(keccak256(data)) == 32
+    assert keccak256(data[:136] + data[136:]) == keccak256(data)
+
+
+def test_rlp_scalars_and_strings():
+    assert rlp_encode(b"dog") == bytes.fromhex("83646f67")
+    assert rlp_encode(b"") == b"\x80"
+    assert rlp_encode(0) == b"\x80"
+    assert rlp_encode(15) == b"\x0f"
+    assert rlp_encode(1024) == bytes.fromhex("820400")
+    assert rlp_encode(b"\x00") == b"\x00"  # single byte < 0x80 is itself
+    long = b"a" * 56
+    assert rlp_encode(long) == bytes.fromhex("b838") + long
+
+
+def test_rlp_lists():
+    assert rlp_encode([]) == b"\xc0"
+    assert rlp_encode([b"cat", b"dog"]) == bytes.fromhex(
+        "c88363617483646f67")
+    # set-theoretic nesting [ [], [[]], [ [], [[]] ] ]
+    assert rlp_encode([[], [[]], [[], [[]]]]) == bytes.fromhex(
+        "c7c0c1c0c3c0c1c0")
+
+
+def test_empty_trie_root():
+    assert trie_root({}) == EMPTY_TRIE_ROOT
+    assert keccak256(rlp_encode(b"")) == EMPTY_TRIE_ROOT
+
+
+def test_trie_branching_vector():
+    # ethereum/tests TrieTests/trietest.json "branchingTests" family:
+    # well-known root for the {do,dog,doge,horse} fixture.
+    items = {b"do": b"verb", b"dog": b"puppy", b"doge": b"coin",
+             b"horse": b"stallion"}
+    assert trie_root(items).hex() == (
+        "5991bb8c6514148a29db676a14ac506cd2cd5775ace63c30a4fe457715e9ac84")
+
+
+def test_trie_insert_order_irrelevant():
+    items = [(b"abc", b"1"), (b"abd", b"2"), (b"ab", b"3"), (b"xyz", b"4")]
+    a = trie_root(dict(items))
+    b = trie_root(dict(reversed(items)))
+    assert a == b
+
+
+def test_trie_empty_values_skipped():
+    assert trie_root({b"k": b""}) == EMPTY_TRIE_ROOT
+    assert (trie_root({b"a": b"1", b"b": b""})
+            == trie_root({b"a": b"1"}))
+
+
+def test_indexed_data_trie_root():
+    assert indexed_data_trie_root([]) == EMPTY_TRIE_ROOT
+    # single tx under key rlp(0)=0x80
+    single = indexed_data_trie_root([b"\x01\x02\x03"])
+    assert single != EMPTY_TRIE_ROOT
+    # 200 entries exercises multi-nibble branching over rlp(i) keys
+    many = indexed_data_trie_root(
+        [bytes([i]) * (i % 40 + 1) for i in range(200)])
+    assert len(many) == 32
+    assert many != single
+
+
+def test_el_block_hash_changes_with_payload():
+    # the real check: bellatrix payload hash responds to content
+    from consensus_specs_tpu.models.builder import build_spec
+    from consensus_specs_tpu.testlib.helpers.execution_payload import (
+        compute_el_block_hash,
+    )
+    from consensus_specs_tpu.testlib.helpers.genesis import (
+        create_genesis_state,
+    )
+
+    spec = build_spec("bellatrix", "minimal")
+    state = create_genesis_state(
+        spec, [spec.MAX_EFFECTIVE_BALANCE] * 64,
+        spec.MAX_EFFECTIVE_BALANCE)
+    payload = spec.ExecutionPayload(
+        parent_hash=b"\x11" * 32,
+        gas_limit=30_000_000,
+        transactions=[b"\xaa" * 10],
+    )
+    h1 = compute_el_block_hash(spec, payload, state)
+    payload.gas_used = 5
+    h2 = compute_el_block_hash(spec, payload, state)
+    assert h1 != h2
+    # empty payload sentinel: zero hash
+    assert compute_el_block_hash(
+        spec, spec.ExecutionPayload(), state) == spec.Hash32()
