@@ -7,11 +7,14 @@ from importlib import import_module
 
 RUNNER_MODULES = [
     "bls",
+    "compliance",
     "epoch_processing",
     "finality",
     "fork_choice",
     "forks",
     "genesis",
+    "kzg_4844",
+    "kzg_7594",
     "light_client",
     "merkle_proof",
     "networking",

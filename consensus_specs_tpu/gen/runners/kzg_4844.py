@@ -1,0 +1,326 @@
+"""KZG (EIP-4844) test-vector factory — the deneb blob-commitment
+surface with valid / incorrect-proof / malformed-input matrices (the
+reference's `tests/generators/runners/kzg_4844.py:1-651`; same handler
+names, 'general' preset identity, `kzg-mainnet` suite).
+
+Vectors are produced by this repo's own KZG library
+(`models/deneb/polynomial_commitments.py`) over the embedded mainnet
+trusted setup.
+"""
+
+from __future__ import annotations
+
+from ...testlib.kzg_fixtures import (
+    bls_add_one,
+    cached_blob_to_kzg_commitment,
+    cached_compute_blob_kzg_proof,
+    cached_compute_kzg_proof,
+    encode_hex,
+    encode_hex_list,
+    invalid_blobs,
+    invalid_field_elements,
+    invalid_g1_points,
+    kzg_spec,
+    valid_blobs,
+    valid_field_elements,
+)
+from ..typing import TestCase
+
+G1_POINT_AT_INFINITY = b"\xc0" + b"\x00" * 47
+
+
+def _data_part(input_obj, output_obj):
+    return [("data", "data", {"input": input_obj, "output": output_obj})]
+
+
+def _try(fn, *args):
+    try:
+        return fn(*args)
+    except Exception:
+        return None
+
+
+def case_blob_to_kzg_commitment():
+    def runner(blob):
+        def _run():
+            out = _try(cached_blob_to_kzg_commitment, bytes(blob))
+            return _data_part(
+                {"blob": encode_hex(blob)},
+                encode_hex(out) if out is not None else None)
+        return _run
+
+    for i, blob in enumerate(valid_blobs()):
+        yield f"blob_to_kzg_commitment_case_valid_blob_{i}", runner(blob)
+    for i, blob in enumerate(invalid_blobs()):
+        yield f"blob_to_kzg_commitment_case_invalid_blob_{i}", runner(blob)
+
+
+def case_compute_kzg_proof():
+    def runner(blob, z):
+        def _run():
+            out = _try(cached_compute_kzg_proof, bytes(blob), z)
+            return _data_part(
+                {"blob": encode_hex(blob), "z": encode_hex(z)},
+                ((encode_hex(out[0]), encode_hex(out[1]))
+                 if out is not None else None))
+        return _run
+
+    for i, blob in enumerate(valid_blobs()):
+        for j, z in enumerate(valid_field_elements()):
+            yield f"compute_kzg_proof_case_valid_blob_{i}_{j}", \
+                runner(blob, z)
+    for i, blob in enumerate(invalid_blobs()):
+        yield f"compute_kzg_proof_case_invalid_blob_{i}", \
+            runner(blob, valid_field_elements()[0])
+    for i, z in enumerate(invalid_field_elements()):
+        yield f"compute_kzg_proof_case_invalid_z_{i}", \
+            runner(valid_blobs()[4], z)
+
+
+def case_verify_kzg_proof():
+    spec = kzg_spec()
+
+    def runner(get_inputs):
+        def _run():
+            commitment, z, y, proof = get_inputs()
+            ok = _try(spec.verify_kzg_proof, commitment, z, y, proof)
+            return _data_part(
+                {"commitment": encode_hex(commitment), "z": encode_hex(z),
+                 "y": encode_hex(y), "proof": encode_hex(proof)},
+                ok)
+        return _run
+
+    def proof_inputs(blob, z, mutate=None, proof_override=None):
+        def _get():
+            proof, y = cached_compute_kzg_proof(bytes(blob), z)
+            commitment = cached_blob_to_kzg_commitment(bytes(blob))
+            if proof_override is not None:
+                proof = proof_override
+            elif mutate is not None:
+                proof = mutate(proof)
+            return commitment, z, y, proof
+        return _get
+
+    blobs, zs = valid_blobs(), valid_field_elements()
+    for i, blob in enumerate(blobs):
+        for j, z in enumerate(zs):
+            yield (f"verify_kzg_proof_case_correct_proof_{i}_{j}",
+                   runner(proof_inputs(blob, z)))
+    for i, blob in enumerate(blobs):
+        for j, z in enumerate(zs):
+            yield (f"verify_kzg_proof_case_incorrect_proof_{i}_{j}",
+                   runner(proof_inputs(blob, z, mutate=bls_add_one)))
+    # proof == infinity: wrong for a random blob, right for constant polys
+    for j, z in enumerate(zs):
+        yield (f"verify_kzg_proof_case_incorrect_proof_point_at_infinity_{j}",
+               runner(proof_inputs(blobs[2], z,
+                                   proof_override=G1_POINT_AT_INFINITY)))
+    for j, z in enumerate(zs):
+        yield (("verify_kzg_proof_case_correct_proof_point_at_infinity_"
+                f"for_zero_poly_{j}"),
+               runner(proof_inputs(blobs[0], z,
+                                   proof_override=G1_POINT_AT_INFINITY)))
+    for j, z in enumerate(zs):
+        yield (("verify_kzg_proof_case_correct_proof_point_at_infinity_"
+                f"for_twos_poly_{j}"),
+               runner(proof_inputs(blobs[1], z,
+                                   proof_override=G1_POINT_AT_INFINITY)))
+
+    def bad_input(commitment=None, z=None, y=None, proof=None):
+        def _get():
+            blob, valid_z = blobs[2], zs[1]
+            real_proof, real_y = cached_compute_kzg_proof(bytes(blob),
+                                                          valid_z)
+            real_commitment = cached_blob_to_kzg_commitment(bytes(blob))
+            return (commitment if commitment is not None
+                    else real_commitment,
+                    z if z is not None else valid_z,
+                    y if y is not None else real_y,
+                    proof if proof is not None else real_proof)
+        return _get
+
+    for i, point in enumerate(invalid_g1_points()):
+        yield (f"verify_kzg_proof_case_invalid_commitment_{i}",
+               runner(bad_input(commitment=point)))
+    for i, z in enumerate(invalid_field_elements()):
+        yield f"verify_kzg_proof_case_invalid_z_{i}", runner(bad_input(z=z))
+    for i, y in enumerate(invalid_field_elements()):
+        yield f"verify_kzg_proof_case_invalid_y_{i}", runner(bad_input(y=y))
+    for i, point in enumerate(invalid_g1_points()):
+        yield (f"verify_kzg_proof_case_invalid_proof_{i}",
+               runner(bad_input(proof=point)))
+
+
+def case_compute_blob_kzg_proof():
+    def runner(get_inputs):
+        def _run():
+            blob, commitment = get_inputs()
+            out = _try(cached_compute_blob_kzg_proof, bytes(blob),
+                       bytes(commitment))
+            return _data_part(
+                {"blob": encode_hex(blob),
+                 "commitment": encode_hex(commitment)},
+                encode_hex(out) if out is not None else None)
+        return _run
+
+    for i, blob in enumerate(valid_blobs()):
+        yield (f"compute_blob_kzg_proof_case_valid_blob_{i}",
+               runner(lambda blob=blob: (
+                   blob, cached_blob_to_kzg_commitment(bytes(blob)))))
+    for i, blob in enumerate(invalid_blobs()):
+        yield (f"compute_blob_kzg_proof_case_invalid_blob_{i}",
+               runner(lambda blob=blob: (
+                   blob, cached_blob_to_kzg_commitment(
+                       bytes(valid_blobs()[1])))))
+    for i, commitment in enumerate(invalid_g1_points()):
+        yield (f"compute_blob_kzg_proof_case_invalid_commitment_{i}",
+               runner(lambda commitment=commitment: (
+                   valid_blobs()[1], commitment)))
+
+
+def case_verify_blob_kzg_proof():
+    spec = kzg_spec()
+
+    def runner(get_inputs):
+        def _run():
+            blob, commitment, proof = get_inputs()
+            ok = _try(spec.verify_blob_kzg_proof, blob, commitment, proof)
+            return _data_part(
+                {"blob": encode_hex(blob),
+                 "commitment": encode_hex(commitment),
+                 "proof": encode_hex(proof)},
+                ok)
+        return _run
+
+    def valid_inputs(blob, mutate=None):
+        def _get():
+            commitment = cached_blob_to_kzg_commitment(bytes(blob))
+            proof = cached_compute_blob_kzg_proof(bytes(blob),
+                                                  bytes(commitment))
+            if mutate is not None:
+                proof = mutate(proof)
+            return blob, commitment, proof
+        return _get
+
+    for i, blob in enumerate(valid_blobs()):
+        yield (f"verify_blob_kzg_proof_case_correct_proof_{i}",
+               runner(valid_inputs(blob)))
+    for i, blob in enumerate(valid_blobs()):
+        yield (f"verify_blob_kzg_proof_case_incorrect_proof_{i}",
+               runner(valid_inputs(blob, mutate=bls_add_one)))
+    yield ("verify_blob_kzg_proof_case_proof_point_at_infinity",
+           runner(valid_inputs(valid_blobs()[2],
+                               mutate=lambda _: G1_POINT_AT_INFINITY)))
+
+    def bad_input(blob=None, commitment=None, proof=None):
+        def _get():
+            good = valid_blobs()[2]
+            real_commitment = cached_blob_to_kzg_commitment(bytes(good))
+            real_proof = cached_compute_blob_kzg_proof(
+                bytes(good), bytes(real_commitment))
+            return (blob if blob is not None else good,
+                    commitment if commitment is not None
+                    else real_commitment,
+                    proof if proof is not None else real_proof)
+        return _get
+
+    for i, blob in enumerate(invalid_blobs()):
+        yield (f"verify_blob_kzg_proof_case_invalid_blob_{i}",
+               runner(bad_input(blob=blob)))
+    for i, point in enumerate(invalid_g1_points()):
+        yield (f"verify_blob_kzg_proof_case_invalid_commitment_{i}",
+               runner(bad_input(commitment=point)))
+    for i, point in enumerate(invalid_g1_points()):
+        yield (f"verify_blob_kzg_proof_case_invalid_proof_{i}",
+               runner(bad_input(proof=point)))
+
+
+def case_verify_blob_kzg_proof_batch():
+    spec = kzg_spec()
+
+    def runner(get_inputs):
+        def _run():
+            blobs, commitments, proofs = get_inputs()
+            ok = _try(spec.verify_blob_kzg_proof_batch, blobs, commitments,
+                      proofs)
+            return _data_part(
+                {"blobs": encode_hex_list(blobs),
+                 "commitments": encode_hex_list(commitments),
+                 "proofs": encode_hex_list(proofs)},
+                ok)
+        return _run
+
+    def batch(n, mutate=None):
+        def _get():
+            blobs = valid_blobs()[:n]
+            commitments = [cached_blob_to_kzg_commitment(bytes(b))
+                           for b in blobs]
+            proofs = [cached_compute_blob_kzg_proof(bytes(b), bytes(c))
+                      for b, c in zip(blobs, commitments)]
+            if mutate is not None:
+                blobs, commitments, proofs = mutate(blobs, commitments,
+                                                    proofs)
+            return blobs, commitments, proofs
+        return _get
+
+    for n in range(len(valid_blobs()) + 1):
+        yield (f"verify_blob_kzg_proof_batch_case_correct_{n}",
+               runner(batch(n)))
+
+    def swap_proofs(blobs, commitments, proofs):
+        return blobs, commitments, [proofs[1], proofs[0]] + proofs[2:]
+
+    yield ("verify_blob_kzg_proof_batch_case_incorrect_proof_add_one",
+           runner(batch(4, mutate=lambda b, c, p:
+                        (b, c, [bls_add_one(p[0])] + p[1:]))))
+    yield ("verify_blob_kzg_proof_batch_case_proofs_swapped",
+           runner(batch(4, mutate=swap_proofs)))
+    yield ("verify_blob_kzg_proof_batch_case_proof_point_at_infinity",
+           runner(batch(3, mutate=lambda b, c, p:
+                        (b, c, [G1_POINT_AT_INFINITY] + p[1:]))))
+    # malformed members
+    for i, blob in enumerate(invalid_blobs()):
+        yield (f"verify_blob_kzg_proof_batch_case_invalid_blob_{i}",
+               runner(batch(3, mutate=lambda b, c, p, blob=blob:
+                            ([b[0], blob, b[2]], c, p))))
+    for i, point in enumerate(invalid_g1_points()):
+        yield (f"verify_blob_kzg_proof_batch_case_invalid_commitment_{i}",
+               runner(batch(3, mutate=lambda b, c, p, pt=point:
+                            (b, [c[0], pt, c[2]], p))))
+    for i, point in enumerate(invalid_g1_points()):
+        yield (f"verify_blob_kzg_proof_batch_case_invalid_proof_{i}",
+               runner(batch(3, mutate=lambda b, c, p, pt=point:
+                            (b, c, [p[0], pt, p[2]]))))
+    # length mismatches
+    yield ("verify_blob_kzg_proof_batch_case_blob_length_different",
+           runner(batch(3, mutate=lambda b, c, p: (b[:-1], c, p))))
+    yield ("verify_blob_kzg_proof_batch_case_commitment_length_different",
+           runner(batch(3, mutate=lambda b, c, p: (b, c[:-1], p))))
+    yield ("verify_blob_kzg_proof_batch_case_proof_length_different",
+           runner(batch(3, mutate=lambda b, c, p: (b, c, p[:-1]))))
+
+
+CASE_FNS = [
+    ("blob_to_kzg_commitment", case_blob_to_kzg_commitment),
+    ("compute_kzg_proof", case_compute_kzg_proof),
+    ("verify_kzg_proof", case_verify_kzg_proof),
+    ("compute_blob_kzg_proof", case_compute_blob_kzg_proof),
+    ("verify_blob_kzg_proof", case_verify_blob_kzg_proof),
+    ("verify_blob_kzg_proof_batch", case_verify_blob_kzg_proof_batch),
+]
+
+
+def get_test_cases():
+    cases = []
+    for handler_name, case_fn in CASE_FNS:
+        for case_name, runner in case_fn():
+            cases.append(TestCase(
+                fork_name="deneb",
+                preset_name="general",
+                runner_name="kzg",
+                handler_name=handler_name,
+                suite_name="kzg-mainnet",
+                case_name=case_name,
+                case_fn=runner,
+            ))
+    return cases

@@ -1,0 +1,261 @@
+"""KZG cell/DAS (EIP-7594) test-vector factory — the fulu sampling
+surface: compute_cells, cell proofs, batched cell verification and
+recovery (the reference's `tests/generators/runners/kzg_7594.py:1-612`;
+same handler names, 'general' preset, `kzg-mainnet` suite).
+
+Heavy per-blob proof computation (128 multi-proofs, one MSM each) is
+cached per process; generation cost matches the reference's equally
+naive normative algorithms.
+"""
+
+from __future__ import annotations
+
+from ...testlib.kzg_fixtures import (
+    bls_add_one,
+    cached_blob_to_kzg_commitment,
+    cached_compute_cells_and_kzg_proofs,
+    encode_hex,
+    encode_hex_list,
+    invalid_blobs,
+    invalid_cells,
+    invalid_g1_points,
+    kzg_7594_spec,
+    valid_blobs,
+    valid_cells,
+)
+from ..typing import TestCase
+
+
+def _data_part(input_obj, output_obj):
+    return [("data", "data", {"input": input_obj, "output": output_obj})]
+
+
+def _try(fn, *args):
+    try:
+        return fn(*args)
+    except Exception:
+        return None
+
+
+def case_compute_cells():
+    spec = kzg_7594_spec()
+
+    def runner(blob):
+        def _run():
+            cells = _try(spec.compute_cells, blob)
+            return _data_part(
+                {"blob": encode_hex(blob)},
+                encode_hex_list(cells) if cells is not None else None)
+        return _run
+
+    for i, blob in enumerate(valid_blobs()):
+        yield f"compute_cells_case_valid_{i}", runner(blob)
+    for i, blob in enumerate(invalid_blobs()):
+        yield f"compute_cells_case_invalid_blob_{i}", runner(blob)
+
+
+def case_compute_cells_and_kzg_proofs():
+    def runner(blob):
+        def _run():
+            out = _try(cached_compute_cells_and_kzg_proofs, bytes(blob))
+            return _data_part(
+                {"blob": encode_hex(blob)},
+                ((encode_hex_list(out[0]), encode_hex_list(out[1]))
+                 if out is not None else None))
+        return _run
+
+    for i, blob in enumerate(valid_blobs()):
+        yield f"compute_cells_and_kzg_proofs_case_valid_{i}", runner(blob)
+    for i, blob in enumerate(invalid_blobs()):
+        yield (f"compute_cells_and_kzg_proofs_case_invalid_blob_{i}",
+               runner(blob))
+
+
+def _proven_blob(index: int):
+    """(blob_bytes, commitment, cells, proofs) for a valid blob; cached
+    per process by the fixture layer."""
+    blob = bytes(valid_blobs()[index])
+    commitment = cached_blob_to_kzg_commitment(blob)
+    cells, proofs = cached_compute_cells_and_kzg_proofs(blob)
+    return blob, commitment, cells, proofs
+
+
+def case_verify_cell_kzg_proof_batch():
+    spec = kzg_7594_spec()
+
+    def runner(get_inputs):
+        def _run():
+            commitments, cell_indices, cells, proofs = get_inputs()
+            ok = _try(spec.verify_cell_kzg_proof_batch, commitments,
+                      cell_indices, cells, proofs)
+            return _data_part(
+                {"commitments": encode_hex_list(commitments),
+                 "cell_indices": [int(i) for i in cell_indices],
+                 "cells": encode_hex_list(cells),
+                 "proofs": encode_hex_list(proofs)},
+                ok)
+        return _run
+
+    def subset(blob_index, indices, mutate=None):
+        def _get():
+            _, commitment, cells, proofs = _proven_blob(blob_index)
+            inputs = ([commitment] * len(indices), list(indices),
+                      [cells[i] for i in indices],
+                      [proofs[i] for i in indices])
+            if mutate is not None:
+                inputs = mutate(*inputs)
+            return inputs
+        return _get
+
+    # valid cases: different sizes and index patterns
+    yield ("verify_cell_kzg_proof_batch_case_valid_empty",
+           runner(subset(0, [])))
+    yield ("verify_cell_kzg_proof_batch_case_valid_single",
+           runner(subset(0, [3])))
+    yield ("verify_cell_kzg_proof_batch_case_valid_first_half",
+           runner(subset(1, list(range(64)))))
+    yield ("verify_cell_kzg_proof_batch_case_valid_every_other",
+           runner(subset(2, list(range(0, 128, 2)))))
+    yield ("verify_cell_kzg_proof_batch_case_valid_duplicate_indices",
+           runner(subset(0, [7, 7, 21])))
+
+    def two_blobs():
+        _, c0, cells0, proofs0 = _proven_blob(0)
+        _, c1, cells1, proofs1 = _proven_blob(1)
+        return ([c0, c1], [5, 9], [cells0[5], cells1[9]],
+                [proofs0[5], proofs1[9]])
+
+    yield ("verify_cell_kzg_proof_batch_case_valid_multiple_blobs",
+           runner(two_blobs))
+
+    # incorrect (well-formed but wrong) inputs
+    yield ("verify_cell_kzg_proof_batch_case_incorrect_proof_add_one",
+           runner(subset(0, [4, 5], mutate=lambda c, i, cl, p:
+                         (c, i, cl, [bls_add_one(p[0]), p[1]]))))
+    yield ("verify_cell_kzg_proof_batch_case_incorrect_commitment",
+           runner(subset(0, [4, 5], mutate=lambda c, i, cl, p:
+                         ([bls_add_one(c[0]), c[1]], i, cl, p))))
+    yield ("verify_cell_kzg_proof_batch_case_incorrect_cell",
+           runner(subset(1, [2], mutate=lambda c, i, cl, p:
+                         (c, i, [valid_cells()[0]], p))))
+    yield ("verify_cell_kzg_proof_batch_case_cells_swapped",
+           runner(subset(2, [1, 2], mutate=lambda c, i, cl, p:
+                         (c, i, [cl[1], cl[0]], p))))
+
+    # malformed members
+    for k, point in enumerate(invalid_g1_points()):
+        yield (f"verify_cell_kzg_proof_batch_case_invalid_commitment_{k}",
+               runner(subset(0, [0], mutate=lambda c, i, cl, p, pt=point:
+                             ([pt], i, cl, p))))
+    for k, cell in enumerate(invalid_cells()):
+        yield (f"verify_cell_kzg_proof_batch_case_invalid_cell_{k}",
+               runner(subset(0, [0], mutate=lambda c, i, cl, p, x=cell:
+                             (c, i, [x], p))))
+    for k, point in enumerate(invalid_g1_points()):
+        yield (f"verify_cell_kzg_proof_batch_case_invalid_proof_{k}",
+               runner(subset(0, [0], mutate=lambda c, i, cl, p, pt=point:
+                             (c, i, cl, [pt]))))
+    yield ("verify_cell_kzg_proof_batch_case_invalid_cell_index",
+           runner(subset(0, [0], mutate=lambda c, i, cl, p:
+                         (c, [int(kzg_7594_spec().CELLS_PER_EXT_BLOB)],
+                          cl, p))))
+    # length mismatches
+    yield ("verify_cell_kzg_proof_batch_case_commitment_length_different",
+           runner(subset(0, [1, 2], mutate=lambda c, i, cl, p:
+                         (c[:-1], i, cl, p))))
+    yield ("verify_cell_kzg_proof_batch_case_cell_length_different",
+           runner(subset(0, [1, 2], mutate=lambda c, i, cl, p:
+                         (c, i, cl[:-1], p))))
+    yield ("verify_cell_kzg_proof_batch_case_proof_length_different",
+           runner(subset(0, [1, 2], mutate=lambda c, i, cl, p:
+                         (c, i, cl, p[:-1]))))
+    yield ("verify_cell_kzg_proof_batch_case_index_length_different",
+           runner(subset(0, [1, 2], mutate=lambda c, i, cl, p:
+                         (c, i[:-1], cl, p))))
+
+
+def case_recover_cells_and_kzg_proofs():
+    spec = kzg_7594_spec()
+    n_cells = int(spec.CELLS_PER_EXT_BLOB)
+
+    def runner(get_inputs):
+        def _run():
+            cell_indices, cells = get_inputs()
+            out = _try(spec.recover_cells_and_kzg_proofs, cell_indices,
+                       cells)
+            return _data_part(
+                {"cell_indices": [int(i) for i in cell_indices],
+                 "cells": encode_hex_list(cells)},
+                ((encode_hex_list(out[0]), encode_hex_list(out[1]))
+                 if out is not None else None))
+        return _run
+
+    def available(blob_index, indices, mutate=None):
+        def _get():
+            _, _, cells, _ = _proven_blob(blob_index)
+            inputs = (list(indices), [cells[i] for i in indices])
+            if mutate is not None:
+                inputs = mutate(*inputs)
+            return inputs
+        return _get
+
+    yield ("recover_cells_and_kzg_proofs_case_valid_no_missing",
+           runner(available(0, list(range(n_cells)))))
+    yield ("recover_cells_and_kzg_proofs_case_valid_half_missing_every"
+           "_other_cell",
+           runner(available(1, list(range(0, n_cells, 2)))))
+    yield ("recover_cells_and_kzg_proofs_case_valid_half_missing_first"
+           "_half",
+           runner(available(2, list(range(n_cells // 2)))))
+    yield ("recover_cells_and_kzg_proofs_case_valid_half_missing_last"
+           "_half",
+           runner(available(0, list(range(n_cells // 2, n_cells)))))
+
+    # errors: not enough cells, malformed members, bad indices
+    yield ("recover_cells_and_kzg_proofs_case_invalid_more_than_half"
+           "_missing",
+           runner(available(0, list(range(n_cells // 2 - 1)))))
+    yield ("recover_cells_and_kzg_proofs_case_invalid_more_cells_than"
+           "_exist",
+           runner(available(0, list(range(n_cells)),
+                            mutate=lambda i, c: (i + [0], c + [c[0]]))))
+    for k, cell in enumerate(invalid_cells()):
+        yield (f"recover_cells_and_kzg_proofs_case_invalid_cell_{k}",
+               runner(available(0, list(range(0, n_cells, 2)),
+                                mutate=lambda i, c, x=cell:
+                                (i, [x] + c[1:]))))
+    yield ("recover_cells_and_kzg_proofs_case_invalid_duplicate_cell"
+           "_index",
+           runner(available(0, list(range(0, n_cells, 2)),
+                            mutate=lambda i, c: ([i[0], i[0]] + i[2:], c))))
+    yield ("recover_cells_and_kzg_proofs_case_invalid_cell_index_out"
+           "_of_range",
+           runner(available(0, list(range(0, n_cells, 2)),
+                            mutate=lambda i, c: ([n_cells] + i[1:], c))))
+    yield ("recover_cells_and_kzg_proofs_case_invalid_length_mismatch",
+           runner(available(0, list(range(0, n_cells, 2)),
+                            mutate=lambda i, c: (i, c[:-1]))))
+
+
+CASE_FNS = [
+    ("compute_cells", case_compute_cells),
+    ("compute_cells_and_kzg_proofs", case_compute_cells_and_kzg_proofs),
+    ("verify_cell_kzg_proof_batch", case_verify_cell_kzg_proof_batch),
+    ("recover_cells_and_kzg_proofs", case_recover_cells_and_kzg_proofs),
+]
+
+
+def get_test_cases():
+    cases = []
+    for handler_name, case_fn in CASE_FNS:
+        for case_name, runner in case_fn():
+            cases.append(TestCase(
+                fork_name="fulu",
+                preset_name="general",
+                runner_name="kzg",
+                handler_name=handler_name,
+                suite_name="kzg-mainnet",
+                case_name=case_name,
+                case_fn=runner,
+            ))
+    return cases

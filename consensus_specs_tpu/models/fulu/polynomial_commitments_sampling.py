@@ -370,19 +370,31 @@ def coset_for_cell(cell_index: CellIndex) -> Coset:
 
 def compute_cells(blob: Blob):
     """Extend a blob and return all cells of the extension.
-    Public method."""
+    Public method.
+
+    The normative definition (sampling.md:560-576) evaluates the
+    coefficient form at every coset point individually — O(n^2).  Every
+    cell coset is a contiguous slice of the bit-reversed extended
+    domain (`coset_for_cell`), so one size-2n FFT followed by the
+    bit-reversal permutation produces the identical evaluations; pinned
+    against the naive evaluator in
+    tests/fulu/unittests/test_polynomial_commitments.py."""
     assert len(blob) == BYTES_PER_BLOB
 
     polynomial = blob_to_polynomial(blob)
     polynomial_coeff = polynomial_eval_to_coeff(polynomial)
 
-    cells = []
-    for i in range(CELLS_PER_EXT_BLOB):
-        coset = coset_for_cell(CellIndex(i))
-        ys = CosetEvals([evaluate_polynomialcoeff(polynomial_coeff, z)
-                         for z in coset])
-        cells.append(coset_evals_to_cell(CosetEvals(ys)))
-    return cells
+    padded = list(polynomial_coeff) + [BLSFieldElement(0)] * (
+        int(FIELD_ELEMENTS_PER_EXT_BLOB) - len(polynomial_coeff))
+    extended = fft_field(
+        padded, compute_roots_of_unity(FIELD_ELEMENTS_PER_EXT_BLOB))
+    extended_brp = bit_reversal_permutation(extended)
+
+    n = int(FIELD_ELEMENTS_PER_CELL)
+    return [
+        coset_evals_to_cell(CosetEvals(extended_brp[i * n:(i + 1) * n]))
+        for i in range(CELLS_PER_EXT_BLOB)
+    ]
 
 
 def compute_cells_and_kzg_proofs_polynomialcoeff(

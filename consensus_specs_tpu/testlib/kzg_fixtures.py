@@ -1,0 +1,201 @@
+"""Shared KZG test inputs for the kzg_4844 / kzg_7594 vector factories
+and unit tests (role of the reference's `test/utils/kzg_tests.py:1-185`:
+deterministic valid/invalid blobs, field elements, points and cells).
+
+Everything is derived from the deneb mainnet spec at first use so import
+stays cheap; the heavy MSMs happen only when a factory actually runs.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+from ..models.builder import build_spec
+from ..ops import bls
+from ..ops.bls import ciphersuite
+
+
+@lru_cache(maxsize=1)
+def kzg_spec():
+    """Deneb/mainnet spec — the fork the 4844 vectors target."""
+    return build_spec("deneb", "mainnet")
+
+
+@lru_cache(maxsize=1)
+def kzg_7594_spec():
+    """Fulu/mainnet spec for the cell/DAS vectors."""
+    return build_spec("fulu", "mainnet")
+
+
+def encode_hex(b) -> str:
+    return "0x" + bytes(b).hex()
+
+
+def encode_hex_list(xs):
+    return [encode_hex(x) for x in xs]
+
+
+def field_element_bytes(x: int) -> bytes:
+    spec = kzg_spec()
+    assert x < spec.BLS_MODULUS
+    return int.to_bytes(x, 32, "big")
+
+
+def field_element_bytes_unchecked(x: int) -> bytes:
+    return int.to_bytes(x, 32, "big")
+
+
+def bls_add_one(x: bytes) -> bytes:
+    """Add the G1 generator to a compressed point — a definitely-wrong
+    proof/commitment that is still a valid curve point."""
+    return bls.G1_to_bytes48(
+        ciphersuite.add(bls.bytes48_to_G1(x), ciphersuite.G1()))
+
+
+@lru_cache(maxsize=1)
+def valid_field_elements():
+    spec = kzg_spec()
+    modulus = int(spec.BLS_MODULUS)
+    root_of_unity = int(spec.compute_roots_of_unity(
+        spec.FIELD_ELEMENTS_PER_BLOB)[1])
+    return [
+        field_element_bytes(0),
+        field_element_bytes(1),
+        field_element_bytes(2),
+        field_element_bytes(pow(5, 1235, modulus)),
+        field_element_bytes(modulus - 1),
+        field_element_bytes(root_of_unity),
+    ]
+
+
+@lru_cache(maxsize=1)
+def invalid_field_elements():
+    spec = kzg_spec()
+    modulus = int(spec.BLS_MODULUS)
+    valid0 = valid_field_elements()[0]
+    return [
+        field_element_bytes_unchecked(modulus),
+        field_element_bytes_unchecked(modulus + 1),
+        field_element_bytes_unchecked(2**256 - 1),
+        field_element_bytes_unchecked(2**256 - 2**128),
+        valid0 + b"\x00",
+        valid0[:-1],
+    ]
+
+
+def _blob_from_ints(ints):
+    spec = kzg_spec()
+    return spec.Blob(b"".join(field_element_bytes(i) for i in ints))
+
+
+@lru_cache(maxsize=1)
+def valid_blobs():
+    spec = kzg_spec()
+    n = int(spec.FIELD_ELEMENTS_PER_BLOB)
+    modulus = int(spec.BLS_MODULUS)
+    return [
+        spec.Blob(),                                      # all zeros
+        _blob_from_ints([2] * n),                         # all twos
+        _blob_from_ints([pow(2, i + 256, modulus) for i in range(n)]),
+        _blob_from_ints([pow(3, i + 256, modulus) for i in range(n)]),
+        _blob_from_ints([pow(5, i + 256, modulus) for i in range(n)]),
+        _blob_from_ints([modulus - 1] * n),
+        _blob_from_ints([1 if i == 3211 else 0 for i in range(n)]),
+    ]
+
+
+@lru_cache(maxsize=1)
+def invalid_blobs():
+    spec = kzg_spec()
+    n = int(spec.FIELD_ELEMENTS_PER_BLOB)
+    modulus = int(spec.BLS_MODULUS)
+    random_valid = bytes(valid_blobs()[2])
+    return [
+        b"\xff" * (n * 32),
+        b"".join(field_element_bytes_unchecked(modulus) if i == 2111
+                 else field_element_bytes(0) for i in range(n)),
+        random_valid + b"\x00",
+        random_valid[:-1],
+    ]
+
+
+@lru_cache(maxsize=1)
+def g1_generator_bytes():
+    return bls.G1_to_bytes48(bls.ciphersuite.G1())
+
+
+@lru_cache(maxsize=1)
+def invalid_g1_points():
+    gen = g1_generator_bytes()
+    return [
+        gen[:-1],         # too few bytes
+        gen + b"\x00",    # too many bytes
+        bytes.fromhex(    # on curve but not in the subgroup
+            "8123456789abcdef0123456789abcdef0123456789abcdef"
+            "0123456789abcdef0123456789abcdef0123456789abcdef"),
+        bytes.fromhex(    # not on the curve at all
+            "8123456789abcdef0123456789abcdef0123456789abcdef"
+            "0123456789abcdef0123456789abcdef0123456789abcde0"),
+    ]
+
+
+# --- 7594 cells ------------------------------------------------------------
+
+def _cell_from_fn(value_fn):
+    spec7 = kzg_7594_spec()
+    n = int(spec7.FIELD_ELEMENTS_PER_CELL)
+    return b"".join(value_fn(i) for i in range(n))
+
+
+@lru_cache(maxsize=1)
+def valid_cells():
+    spec = kzg_spec()
+    modulus = int(spec.BLS_MODULUS)
+    return [
+        _cell_from_fn(lambda i: field_element_bytes(
+            pow(2, i + 256, modulus))),
+        _cell_from_fn(lambda i: field_element_bytes(
+            pow(3, i + 256, modulus))),
+        _cell_from_fn(lambda i: field_element_bytes(
+            pow(5, i + 256, modulus))),
+    ]
+
+
+@lru_cache(maxsize=1)
+def invalid_cells():
+    spec = kzg_spec()
+    modulus = int(spec.BLS_MODULUS)
+    return [
+        _cell_from_fn(lambda i: field_element_bytes_unchecked(2**256 - 1)),
+        _cell_from_fn(lambda i: field_element_bytes_unchecked(
+            modulus if i == 7 else 0)),
+        valid_cells()[0][:-1],
+        valid_cells()[1] + b"\x00",
+    ]
+
+
+# Cached heavy ops shared across cases (mirrors the reference's @cache
+# wrappers, `runners/kzg_4844.py:32-39`).
+
+@lru_cache(maxsize=32)
+def cached_blob_to_kzg_commitment(blob_bytes: bytes):
+    spec = kzg_spec()
+    return spec.blob_to_kzg_commitment(spec.Blob(blob_bytes))
+
+
+@lru_cache(maxsize=64)
+def cached_compute_kzg_proof(blob_bytes: bytes, z: bytes):
+    spec = kzg_spec()
+    return spec.compute_kzg_proof(spec.Blob(blob_bytes), z)
+
+
+@lru_cache(maxsize=32)
+def cached_compute_blob_kzg_proof(blob_bytes: bytes, commitment: bytes):
+    spec = kzg_spec()
+    return spec.compute_blob_kzg_proof(spec.Blob(blob_bytes), commitment)
+
+
+@lru_cache(maxsize=16)
+def cached_compute_cells_and_kzg_proofs(blob_bytes: bytes):
+    spec7 = kzg_7594_spec()
+    return spec7.compute_cells_and_kzg_proofs(spec7.Blob(blob_bytes))

@@ -134,3 +134,73 @@ def test_ssz_generic_invalid_cases_reject(tmp_path):
             typ.decode_bytes(data)
         checked += 1
     assert checked >= 10
+
+
+def test_kzg_4844_vector_tree(tmp_path):
+    """kzg_4844 factory: tree layout + content pinned to the c-kzg
+    known-answer commitment for the all-twos blob."""
+    from consensus_specs_tpu.gen.runners import kzg_4844
+
+    cases = [tc for tc in kzg_4844.get_test_cases() if tc.case_name in (
+        "blob_to_kzg_commitment_case_valid_blob_1",   # all twos
+        "blob_to_kzg_commitment_case_invalid_blob_0",
+        "compute_kzg_proof_case_invalid_z_0",
+        "verify_kzg_proof_case_invalid_commitment_0",
+    )]
+    assert len(cases) == 4
+    for tc in cases:
+        assert tc.preset_name == "general"
+        assert tc.fork_name == "deneb"
+        assert tc.runner_name == "kzg"
+    rc = run_generator(cases, _args(tmp_path))
+    assert rc == 0
+
+    base = tmp_path / "general/deneb/kzg"
+    valid = yaml.safe_load(
+        (base / "blob_to_kzg_commitment/kzg-mainnet/"
+         "blob_to_kzg_commitment_case_valid_blob_1/data.yaml").read_text())
+    # the canonical all-twos commitment every KZG library pins
+    assert valid["output"].startswith("0xa572cbea904d6746")
+    assert valid["input"]["blob"].startswith("0x")
+
+    invalid = yaml.safe_load(
+        (base / "blob_to_kzg_commitment/kzg-mainnet/"
+         "blob_to_kzg_commitment_case_invalid_blob_0/data.yaml").read_text())
+    assert invalid["output"] is None
+
+    bad_z = yaml.safe_load(
+        (base / "compute_kzg_proof/kzg-mainnet/"
+         "compute_kzg_proof_case_invalid_z_0/data.yaml").read_text())
+    assert bad_z["output"] is None
+
+    bad_commitment = yaml.safe_load(
+        (base / "verify_kzg_proof/kzg-mainnet/"
+         "verify_kzg_proof_case_invalid_commitment_0/data.yaml").read_text())
+    assert bad_commitment["output"] is None
+
+
+def test_kzg_7594_vector_tree(tmp_path):
+    """kzg_7594 factory: compute_cells valid/invalid round-trip against
+    the in-tree sampling library."""
+    from consensus_specs_tpu.gen.runners import kzg_7594
+
+    cases = [tc for tc in kzg_7594.get_test_cases() if tc.case_name in (
+        "compute_cells_case_valid_0",
+        "compute_cells_case_invalid_blob_0",
+    )]
+    assert len(cases) == 2
+    assert all(tc.fork_name == "fulu" for tc in cases)
+    rc = run_generator(cases, _args(tmp_path))
+    assert rc == 0
+
+    base = tmp_path / "general/fulu/kzg/compute_cells/kzg-mainnet"
+    valid = yaml.safe_load(
+        (base / "compute_cells_case_valid_0/data.yaml").read_text())
+    spec = build_spec("fulu", "mainnet")
+    assert len(valid["output"]) == int(spec.CELLS_PER_EXT_BLOB)
+    # zero blob extends to all-zero cells
+    assert set(valid["output"]) == {"0x" + "00" * int(spec.BYTES_PER_CELL)}
+
+    invalid = yaml.safe_load(
+        (base / "compute_cells_case_invalid_blob_0/data.yaml").read_text())
+    assert invalid["output"] is None
