@@ -54,9 +54,18 @@ PREVIOUS_FORK_OF: dict[str, str | None] = {
     "deneb": "capella",
     "electra": "deneb",
     "fulu": "electra",
+    # feature forks (specs/_features/)
+    "eip7732": "electra",
 }
 
-ALL_FORKS = list(PREVIOUS_FORK_OF)
+# Mainline forks only — the default phase list for tests and generators;
+# feature forks build via `build_spec` but don't join @with_all_phases
+# (the reference's ALL_PHASES vs ALL_PHASES+features split,
+# `test/helpers/constants.py`).
+ALL_FORKS = ["phase0", "altair", "bellatrix", "capella", "deneb",
+             "electra", "fulu"]
+FEATURE_FORKS = ["eip7732"]
+BUILDABLE_FORKS = ALL_FORKS + FEATURE_FORKS
 
 # source files per fork, executed in order; later forks only list their own
 # delta files (ancestors' files run first)
@@ -77,6 +86,7 @@ SPEC_SOURCES: dict[str, list[str]] = {
     "fulu": ["polynomial_commitments_sampling.py", "das_core.py",
              "beacon_chain.py", "fork.py", "fork_choice.py", "p2p.py",
              "validator.py"],
+    "eip7732": ["beacon_chain.py", "fork.py", "validator.py", "p2p.py"],
 }
 
 

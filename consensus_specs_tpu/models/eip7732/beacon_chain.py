@@ -1,0 +1,546 @@
+# EIP-7732 (ePBS) -- The Beacon Chain (executable spec source, delta
+# over electra).
+#
+# Enshrined proposer-builder separation: the beacon block commits to a
+# signed builder bid (`SignedExecutionPayloadHeader`); the payload itself
+# arrives later as a `SignedExecutionPayloadEnvelope` processed by an
+# independent `process_execution_payload` transition, attested by the
+# new Payload Timeliness Committee.  Parity contract:
+# specs/_features/eip7732/beacon-chain.md (constants :94-125,
+# containers :127-300, helpers :303-440, block :462-653,
+# envelope :705-800).
+
+# ---------------------------------------------------------------------------
+# Constants (beacon-chain.md :94-125)
+# ---------------------------------------------------------------------------
+
+PAYLOAD_ABSENT = uint8(0)
+PAYLOAD_PRESENT = uint8(1)
+PAYLOAD_WITHHELD = uint8(2)
+PAYLOAD_INVALID_STATUS = uint8(3)
+
+DOMAIN_BEACON_BUILDER = DomainType("0x1B000000")
+DOMAIN_PTC_ATTESTER = DomainType("0x0C000000")
+
+
+# ---------------------------------------------------------------------------
+# New containers (beacon-chain.md :127-196)
+# ---------------------------------------------------------------------------
+
+
+class PayloadAttestationData(Container):
+    beacon_block_root: Root
+    slot: Slot
+    payload_status: uint8
+
+
+class PayloadAttestation(Container):
+    aggregation_bits: Bitvector[PTC_SIZE]
+    data: PayloadAttestationData
+    signature: BLSSignature
+
+
+class PayloadAttestationMessage(Container):
+    validator_index: ValidatorIndex
+    data: PayloadAttestationData
+    signature: BLSSignature
+
+
+class IndexedPayloadAttestation(Container):
+    attesting_indices: List[ValidatorIndex, PTC_SIZE]
+    data: PayloadAttestationData
+    signature: BLSSignature
+
+
+class ExecutionPayloadHeader(Container):
+    """[Modified in EIP7732] The builder's bid: block-hash commitment plus
+    payment, gas limit and the KZG commitments root."""
+    parent_block_hash: Hash32
+    parent_block_root: Root
+    block_hash: Hash32
+    gas_limit: uint64
+    builder_index: ValidatorIndex
+    slot: Slot
+    value: Gwei
+    blob_kzg_commitments_root: Root
+
+
+class SignedExecutionPayloadHeader(Container):
+    message: ExecutionPayloadHeader
+    signature: BLSSignature
+
+
+class ExecutionPayloadEnvelope(Container):
+    payload: ExecutionPayload
+    execution_requests: ExecutionRequests
+    builder_index: ValidatorIndex
+    beacon_block_root: Root
+    blob_kzg_commitments: List[KZGCommitment, MAX_BLOB_COMMITMENTS_PER_BLOCK]
+    payload_withheld: boolean
+    state_root: Root
+
+
+class SignedExecutionPayloadEnvelope(Container):
+    message: ExecutionPayloadEnvelope
+    signature: BLSSignature
+
+
+# ---------------------------------------------------------------------------
+# Modified containers (beacon-chain.md :198-300)
+# ---------------------------------------------------------------------------
+
+
+class BeaconBlockBody(Container):
+    randao_reveal: BLSSignature
+    eth1_data: Eth1Data
+    graffiti: Bytes32
+    proposer_slashings: List[ProposerSlashing, MAX_PROPOSER_SLASHINGS]
+    attester_slashings: List[AttesterSlashing, MAX_ATTESTER_SLASHINGS_ELECTRA]
+    attestations: List[Attestation, MAX_ATTESTATIONS_ELECTRA]
+    deposits: List[Deposit, MAX_DEPOSITS]
+    voluntary_exits: List[SignedVoluntaryExit, MAX_VOLUNTARY_EXITS]
+    sync_aggregate: SyncAggregate
+    bls_to_execution_changes: List[SignedBLSToExecutionChange, MAX_BLS_TO_EXECUTION_CHANGES]
+    # [New in EIP-7732] — execution_payload / blob_kzg_commitments /
+    # execution_requests moved into the envelope
+    signed_execution_payload_header: SignedExecutionPayloadHeader
+    # [New in EIP-7732]
+    payload_attestations: List[PayloadAttestation, MAX_PAYLOAD_ATTESTATIONS]
+
+
+class BeaconBlock(Container):
+    slot: Slot
+    proposer_index: ValidatorIndex
+    parent_root: Root
+    state_root: Root
+    body: BeaconBlockBody
+
+
+class SignedBeaconBlock(Container):
+    message: BeaconBlock
+    signature: BLSSignature
+
+
+class BeaconState(Container):
+    genesis_time: uint64
+    genesis_validators_root: Root
+    slot: Slot
+    fork: Fork
+    latest_block_header: BeaconBlockHeader
+    block_roots: Vector[Root, SLOTS_PER_HISTORICAL_ROOT]
+    state_roots: Vector[Root, SLOTS_PER_HISTORICAL_ROOT]
+    historical_roots: List[Root, HISTORICAL_ROOTS_LIMIT]
+    eth1_data: Eth1Data
+    eth1_data_votes: List[Eth1Data, EPOCHS_PER_ETH1_VOTING_PERIOD * SLOTS_PER_EPOCH]
+    eth1_deposit_index: uint64
+    validators: List[Validator, VALIDATOR_REGISTRY_LIMIT]
+    balances: List[Gwei, VALIDATOR_REGISTRY_LIMIT]
+    randao_mixes: Vector[Bytes32, EPOCHS_PER_HISTORICAL_VECTOR]
+    slashings: Vector[Gwei, EPOCHS_PER_SLASHINGS_VECTOR]
+    previous_epoch_participation: List[ParticipationFlags, VALIDATOR_REGISTRY_LIMIT]
+    current_epoch_participation: List[ParticipationFlags, VALIDATOR_REGISTRY_LIMIT]
+    justification_bits: Bitvector[JUSTIFICATION_BITS_LENGTH]
+    previous_justified_checkpoint: Checkpoint
+    current_justified_checkpoint: Checkpoint
+    finalized_checkpoint: Checkpoint
+    inactivity_scores: List[uint64, VALIDATOR_REGISTRY_LIMIT]
+    current_sync_committee: SyncCommittee
+    next_sync_committee: SyncCommittee
+    # [Modified in EIP-7732] now the latest committed builder bid
+    latest_execution_payload_header: ExecutionPayloadHeader
+    next_withdrawal_index: WithdrawalIndex
+    next_withdrawal_validator_index: ValidatorIndex
+    historical_summaries: List[HistoricalSummary, HISTORICAL_ROOTS_LIMIT]
+    deposit_requests_start_index: uint64
+    deposit_balance_to_consume: Gwei
+    exit_balance_to_consume: Gwei
+    earliest_exit_epoch: Epoch
+    consolidation_balance_to_consume: Gwei
+    earliest_consolidation_epoch: Epoch
+    pending_deposits: List[PendingDeposit, PENDING_DEPOSITS_LIMIT]
+    pending_partial_withdrawals: List[PendingPartialWithdrawal, PENDING_PARTIAL_WITHDRAWALS_LIMIT]
+    pending_consolidations: List[PendingConsolidation, PENDING_CONSOLIDATIONS_LIMIT]
+    # [New in EIP-7732]
+    latest_block_hash: Hash32
+    # [New in EIP-7732]
+    latest_full_slot: Slot
+    # [New in EIP-7732]
+    latest_withdrawals_root: Root
+
+
+# ---------------------------------------------------------------------------
+# Helpers (beacon-chain.md :303-440)
+# ---------------------------------------------------------------------------
+
+
+def bit_floor(n: uint64) -> uint64:
+    """If ``n`` is not zero, the largest power of 2 not greater than n."""
+    if n == 0:
+        return 0
+    return uint64(1) << (int(n).bit_length() - 1)
+
+
+def remove_flag(flags: ParticipationFlags, flag_index: int) -> ParticipationFlags:
+    flag = ParticipationFlags(2**flag_index)
+    return flags & ~flag
+
+
+def is_valid_indexed_payload_attestation(
+        state: BeaconState,
+        indexed_payload_attestation: IndexedPayloadAttestation) -> bool:
+    """Non-empty, sorted-unique indices, valid aggregate signature."""
+    if indexed_payload_attestation.data.payload_status >= PAYLOAD_INVALID_STATUS:
+        return False
+
+    indices = list(indexed_payload_attestation.attesting_indices)
+    if len(indices) == 0 or indices != sorted(set(indices)):
+        return False
+
+    pubkeys = [state.validators[i].pubkey for i in indices]
+    domain = get_domain(state, DOMAIN_PTC_ATTESTER, None)
+    signing_root = compute_signing_root(
+        indexed_payload_attestation.data, domain)
+    return bls.FastAggregateVerify(
+        pubkeys, signing_root, indexed_payload_attestation.signature)
+
+
+def is_parent_block_full(state: BeaconState) -> bool:
+    """True iff the last committed bid was fulfilled with a payload; must
+    be called before `process_execution_payload_header`."""
+    return state.latest_execution_payload_header.block_hash == state.latest_block_hash
+
+
+def get_ptc(state: BeaconState, slot: Slot):
+    """The Payload Timeliness Committee for ``slot``."""
+    epoch = compute_epoch_at_slot(slot)
+    committees_per_slot = bit_floor(
+        min(get_committee_count_per_slot(state, epoch), PTC_SIZE))
+    members_per_committee = PTC_SIZE // committees_per_slot
+
+    validator_indices = []
+    for idx in range(committees_per_slot):
+        beacon_committee = get_beacon_committee(state, slot,
+                                                CommitteeIndex(idx))
+        validator_indices += list(beacon_committee)[:members_per_committee]
+    return validator_indices
+
+
+def get_attesting_indices(state: BeaconState, attestation: Attestation):
+    """[Modified in EIP7732] PTC members' votes are ignored."""
+    output = set()
+    committee_indices = get_committee_indices(attestation.committee_bits)
+    committee_offset = 0
+    for index in committee_indices:
+        committee = get_beacon_committee(state, attestation.data.slot, index)
+        committee_attesters = set(
+            vi for i, vi in enumerate(committee)
+            if attestation.aggregation_bits[committee_offset + i])
+        output = output.union(committee_attesters)
+        committee_offset += len(committee)
+
+    if compute_epoch_at_slot(attestation.data.slot) < config.EIP7732_FORK_EPOCH:
+        return output
+    ptc = get_ptc(state, attestation.data.slot)
+    return set(i for i in output if i not in ptc)
+
+
+def get_payload_attesting_indices(
+        state: BeaconState, slot: Slot,
+        payload_attestation: PayloadAttestation):
+    ptc = get_ptc(state, slot)
+    return set(index for i, index in enumerate(ptc)
+               if payload_attestation.aggregation_bits[i])
+
+
+def get_indexed_payload_attestation(
+        state: BeaconState, slot: Slot,
+        payload_attestation: PayloadAttestation) -> IndexedPayloadAttestation:
+    attesting_indices = get_payload_attesting_indices(
+        state, slot, payload_attestation)
+    return IndexedPayloadAttestation(
+        attesting_indices=sorted(attesting_indices),
+        data=payload_attestation.data,
+        signature=payload_attestation.signature,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Block processing (beacon-chain.md :462-653)
+# ---------------------------------------------------------------------------
+
+
+def process_block(state: BeaconState, block: BeaconBlock) -> None:
+    process_block_header(state, block)
+    process_withdrawals(state)  # [Modified in EIP-7732]
+    # Removed `process_execution_payload` in EIP-7732
+    process_execution_payload_header(state, block)  # [New in EIP-7732]
+    process_randao(state, block.body)
+    process_eth1_data(state, block.body)
+    process_operations(state, block.body)  # [Modified in EIP-7732]
+    process_sync_aggregate(state, block.body.sync_aggregate)
+
+
+def process_withdrawals(state: BeaconState) -> None:
+    """[Modified in EIP7732] Deterministic from the state alone; any
+    payload building on this block must honor them in the EL."""
+    # return early if the parent block was empty
+    if not is_parent_block_full(state):
+        return
+
+    withdrawals, partial_withdrawals_count = get_expected_withdrawals(state)
+    withdrawals_list = List[Withdrawal, MAX_WITHDRAWALS_PER_PAYLOAD](
+        *withdrawals)
+    state.latest_withdrawals_root = hash_tree_root(withdrawals_list)
+    for withdrawal in withdrawals:
+        decrease_balance(state, withdrawal.validator_index, withdrawal.amount)
+
+    state.pending_partial_withdrawals = list(
+        state.pending_partial_withdrawals)[partial_withdrawals_count:]
+
+    if len(withdrawals) != 0:
+        latest_withdrawal = withdrawals[-1]
+        state.next_withdrawal_index = WithdrawalIndex(
+            latest_withdrawal.index + 1)
+
+    if len(withdrawals) == MAX_WITHDRAWALS_PER_PAYLOAD:
+        next_validator_index = ValidatorIndex(
+            (withdrawals[-1].validator_index + 1) % len(state.validators))
+        state.next_withdrawal_validator_index = next_validator_index
+    else:
+        next_index = (state.next_withdrawal_validator_index
+                      + MAX_VALIDATORS_PER_WITHDRAWALS_SWEEP)
+        next_validator_index = ValidatorIndex(
+            next_index % len(state.validators))
+        state.next_withdrawal_validator_index = next_validator_index
+
+
+def verify_execution_payload_header_signature(
+        state: BeaconState,
+        signed_header: SignedExecutionPayloadHeader) -> bool:
+    builder = state.validators[signed_header.message.builder_index]
+    signing_root = compute_signing_root(
+        signed_header.message, get_domain(state, DOMAIN_BEACON_BUILDER))
+    return bls.Verify(builder.pubkey, signing_root, signed_header.signature)
+
+
+def process_execution_payload_header(state: BeaconState,
+                                     block: BeaconBlock) -> None:
+    # Verify the header signature
+    signed_header = block.body.signed_execution_payload_header
+    assert verify_execution_payload_header_signature(state, signed_header)
+
+    # Check that the builder is active, non-slashed, and can cover the bid
+    header = signed_header.message
+    builder_index = header.builder_index
+    builder = state.validators[builder_index]
+    assert is_active_validator(builder, get_current_epoch(state))
+    assert not builder.slashed
+    amount = header.value
+    assert state.balances[builder_index] >= amount
+
+    # Verify that the bid is for the current slot and right parent block
+    assert header.slot == block.slot
+    assert header.parent_block_hash == state.latest_block_hash
+    assert header.parent_block_root == block.parent_root
+
+    # Transfer the funds from the builder to the proposer
+    decrease_balance(state, builder_index, amount)
+    increase_balance(state, block.proposer_index, amount)
+
+    # Cache the signed execution payload header
+    state.latest_execution_payload_header = header
+
+
+def process_operations(state: BeaconState, body: BeaconBlockBody) -> None:
+    # [Modified in EIP7732] requests moved into the payload envelope
+    assert len(body.deposits) == min(
+        MAX_DEPOSITS,
+        state.eth1_data.deposit_count - state.eth1_deposit_index)
+
+    def for_ops(operations, fn):
+        for operation in operations:
+            fn(state, operation)
+
+    for_ops(body.proposer_slashings, process_proposer_slashing)
+    for_ops(body.attester_slashings, process_attester_slashing)
+    for_ops(body.attestations, process_attestation)
+    for_ops(body.deposits, process_deposit)
+    for_ops(body.voluntary_exits, process_voluntary_exit)
+    for_ops(body.bls_to_execution_changes, process_bls_to_execution_change)
+    # Removed `process_*_request` in EIP-7732 (moved to the envelope)
+    # [New in EIP-7732]
+    for_ops(body.payload_attestations, process_payload_attestation)
+
+
+def process_payload_attestation(
+        state: BeaconState,
+        payload_attestation: PayloadAttestation) -> None:
+    # For the parent beacon block, from the previous slot
+    data = payload_attestation.data
+    assert data.beacon_block_root == state.latest_block_header.parent_root
+    assert data.slot + 1 == state.slot
+
+    # Verify signature
+    indexed_payload_attestation = get_indexed_payload_attestation(
+        state, data.slot, payload_attestation)
+    assert is_valid_indexed_payload_attestation(
+        state, indexed_payload_attestation)
+
+    if state.slot % SLOTS_PER_EPOCH == 0:
+        epoch_participation = state.previous_epoch_participation
+    else:
+        epoch_participation = state.current_epoch_participation
+
+    payload_was_present = data.slot == state.latest_full_slot
+    voted_present = data.payload_status == PAYLOAD_PRESENT
+    proposer_reward_denominator = (
+        (WEIGHT_DENOMINATOR - PROPOSER_WEIGHT)
+        * WEIGHT_DENOMINATOR // PROPOSER_WEIGHT)
+    proposer_index = get_beacon_proposer_index(state)
+    if voted_present != payload_was_present:
+        # Unset flags in case they were set by an equivocating attestation
+        proposer_penalty_numerator = 0
+        for index in indexed_payload_attestation.attesting_indices:
+            for flag_index, weight in enumerate(PARTICIPATION_FLAG_WEIGHTS):
+                if has_flag(epoch_participation[index], flag_index):
+                    epoch_participation[index] = remove_flag(
+                        epoch_participation[index], flag_index)
+                    proposer_penalty_numerator += (
+                        get_base_reward(state, index) * weight)
+        # Penalize the proposer
+        proposer_penalty = Gwei(
+            2 * proposer_penalty_numerator // proposer_reward_denominator)
+        decrease_balance(state, proposer_index, proposer_penalty)
+        return
+
+    # Reward the proposer and set the participation flags
+    proposer_reward_numerator = 0
+    for index in indexed_payload_attestation.attesting_indices:
+        for flag_index, weight in enumerate(PARTICIPATION_FLAG_WEIGHTS):
+            if not has_flag(epoch_participation[index], flag_index):
+                epoch_participation[index] = add_flag(
+                    epoch_participation[index], flag_index)
+                proposer_reward_numerator += (
+                    get_base_reward(state, index) * weight)
+
+    proposer_reward = Gwei(
+        proposer_reward_numerator // proposer_reward_denominator)
+    increase_balance(state, proposer_index, proposer_reward)
+
+
+def is_merge_transition_complete(state: BeaconState) -> bool:
+    """[Modified in EIP7732] compares against the empty bid with the
+    empty-list KZG commitments root."""
+    header = ExecutionPayloadHeader()
+    kzgs = List[KZGCommitment, MAX_BLOB_COMMITMENTS_PER_BLOCK]()
+    header.blob_kzg_commitments_root = hash_tree_root(kzgs)
+
+    return state.latest_execution_payload_header != header
+
+
+def validate_merge_block(block: BeaconBlock) -> None:
+    """[Modified in EIP7732] reads the parent hash from the committed
+    bid."""
+    if config.TERMINAL_BLOCK_HASH != Hash32():
+        assert (compute_epoch_at_slot(block.slot)
+                >= config.TERMINAL_BLOCK_HASH_ACTIVATION_EPOCH)
+        assert (block.body.signed_execution_payload_header.message
+                .parent_block_hash == config.TERMINAL_BLOCK_HASH)
+        return
+
+    pow_block = get_pow_block(
+        block.body.signed_execution_payload_header.message.parent_block_hash)
+    assert pow_block is not None
+    pow_parent = get_pow_block(pow_block.parent_hash)
+    assert pow_parent is not None
+    assert is_valid_terminal_pow_block(pow_block, pow_parent)
+
+
+# ---------------------------------------------------------------------------
+# Execution payload processing (beacon-chain.md :705-800)
+# ---------------------------------------------------------------------------
+
+
+def verify_execution_payload_envelope_signature(
+        state: BeaconState,
+        signed_envelope: SignedExecutionPayloadEnvelope) -> bool:
+    builder = state.validators[signed_envelope.message.builder_index]
+    signing_root = compute_signing_root(
+        signed_envelope.message,
+        get_domain(state, DOMAIN_BEACON_BUILDER))
+    return bls.Verify(builder.pubkey, signing_root,
+                      signed_envelope.signature)
+
+
+def process_execution_payload(
+        state: BeaconState,
+        signed_envelope: SignedExecutionPayloadEnvelope,
+        execution_engine: ExecutionEngine,
+        verify: bool = True) -> None:
+    """[Modified in EIP7732] An independent state transition, applied
+    when the builder's envelope arrives."""
+    # Verify signature
+    if verify:
+        assert verify_execution_payload_envelope_signature(
+            state, signed_envelope)
+    envelope = signed_envelope.message
+    payload = envelope.payload
+    # Cache latest block header state root
+    previous_state_root = hash_tree_root(state)
+    if state.latest_block_header.state_root == Root():
+        state.latest_block_header.state_root = previous_state_root
+
+    # Verify consistency with the beacon block
+    assert envelope.beacon_block_root == hash_tree_root(
+        state.latest_block_header)
+
+    # Verify consistency with the committed header
+    committed_header = state.latest_execution_payload_header
+    assert envelope.builder_index == committed_header.builder_index
+    assert committed_header.blob_kzg_commitments_root == hash_tree_root(
+        envelope.blob_kzg_commitments)
+
+    if not envelope.payload_withheld:
+        # Verify the withdrawals root
+        assert (hash_tree_root(payload.withdrawals)
+                == state.latest_withdrawals_root)
+
+        # Verify the gas limit and block-hash commitment
+        assert committed_header.gas_limit == payload.gas_limit
+        assert committed_header.block_hash == payload.block_hash
+        # Consistency with the previous execution payload
+        assert payload.parent_hash == state.latest_block_hash
+        assert payload.prev_randao == get_randao_mix(
+            state, get_current_epoch(state))
+        assert payload.timestamp == compute_time_at_slot(state, state.slot)
+        assert (len(envelope.blob_kzg_commitments)
+                <= config.MAX_BLOBS_PER_BLOCK)
+        # Verify the execution payload is valid
+        versioned_hashes = [
+            kzg_commitment_to_versioned_hash(commitment)
+            for commitment in envelope.blob_kzg_commitments]
+        requests = envelope.execution_requests
+        assert execution_engine.verify_and_notify_new_payload(
+            NewPayloadRequest(
+                execution_payload=payload,
+                versioned_hashes=versioned_hashes,
+                parent_beacon_block_root=state.latest_block_header.parent_root,
+                execution_requests=requests,
+            ))
+
+        # Process Electra operations
+        def for_ops(operations, fn):
+            for operation in operations:
+                fn(state, operation)
+
+        for_ops(requests.deposits, process_deposit_request)
+        for_ops(requests.withdrawals, process_withdrawal_request)
+        for_ops(requests.consolidations, process_consolidation_request)
+
+        # Cache the execution payload header and full slot
+        state.latest_block_hash = payload.block_hash
+        state.latest_full_slot = state.slot
+
+    # Verify the state root
+    if verify:
+        assert envelope.state_root == hash_tree_root(state)

@@ -1,0 +1,62 @@
+# EIP-7732 (ePBS) -- p2p deltas: three new global gossip topics
+# (`execution_payload`, `payload_attestation_message`,
+# `execution_payload_header`) and the modified blob-sidecar inclusion
+# proof rooted in the envelope's commitments list
+# (specs/_features/eip7732/p2p-interface.md :83-260).
+
+
+def is_valid_payload_envelope_gossip(
+        state: BeaconState,
+        signed_envelope: SignedExecutionPayloadEnvelope) -> bool:
+    """`execution_payload` topic REJECT conditions against the committed
+    bid (p2p-interface.md :173-199)."""
+    envelope = signed_envelope.message
+    header = state.latest_execution_payload_header
+    if envelope.builder_index != header.builder_index:
+        return False
+    if not envelope.payload_withheld:
+        if envelope.payload.block_hash != header.block_hash:
+            return False
+    return verify_execution_payload_envelope_signature(
+        state, signed_envelope)
+
+
+def is_valid_payload_attestation_message_gossip(
+        state: BeaconState,
+        message: PayloadAttestationMessage) -> bool:
+    """`payload_attestation_message` topic REJECT conditions: status in
+    range, index in the slot's PTC, valid signature
+    (p2p-interface.md :201-225)."""
+    data = message.data
+    if data.payload_status >= PAYLOAD_INVALID_STATUS:
+        return False
+    ptc = get_ptc(state, data.slot)
+    if message.validator_index not in ptc:
+        return False
+    domain = get_domain(state, DOMAIN_PTC_ATTESTER,
+                        compute_epoch_at_slot(data.slot))
+    signing_root = compute_signing_root(data, domain)
+    pubkey = state.validators[message.validator_index].pubkey
+    return bls.Verify(pubkey, signing_root, message.signature)
+
+
+def is_valid_execution_payload_header_gossip(
+        state: BeaconState,
+        signed_header: SignedExecutionPayloadHeader,
+        current_slot: Slot) -> bool:
+    """`execution_payload_header` topic conditions: active non-slashed
+    builder with funds, bid for the current or next slot, valid
+    signature (p2p-interface.md :227-253)."""
+    header = signed_header.message
+    if header.builder_index >= len(state.validators):
+        return False
+    builder = state.validators[header.builder_index]
+    if not is_active_validator(builder, get_current_epoch(state)):
+        return False
+    if builder.slashed:
+        return False
+    if header.value > state.balances[header.builder_index]:
+        return False
+    if header.slot not in (current_slot, current_slot + 1):
+        return False
+    return verify_execution_payload_header_signature(state, signed_header)

@@ -1,0 +1,50 @@
+# EIP-7732 (ePBS) -- Honest Validator + Builder duties (executable spec
+# source).  Parity contract: specs/_features/eip7732/validator.md and
+# builder.md (signature helpers :72-94, :172-190).
+
+
+def get_ptc_assignment(state: BeaconState, epoch: Epoch,
+                       validator_index: ValidatorIndex):
+    """The slot in `epoch` where `validator_index` sits on the PTC, or
+    None (validator.md `get_ptc_assignment`)."""
+    next_epoch = Epoch(get_current_epoch(state) + 1)
+    assert epoch <= next_epoch
+
+    start_slot = compute_start_slot_at_epoch(epoch)
+    for slot in range(start_slot, start_slot + SLOTS_PER_EPOCH):
+        if validator_index in get_ptc(state, Slot(slot)):
+            return Slot(slot)
+    return None
+
+
+def get_payload_attestation_message_signature(
+        state: BeaconState, attestation: PayloadAttestationMessage,
+        privkey: int) -> BLSSignature:
+    """Sign only the PayloadAttestationData (validator.md)."""
+    domain = get_domain(state, DOMAIN_PTC_ATTESTER,
+                        compute_epoch_at_slot(attestation.data.slot))
+    signing_root = compute_signing_root(attestation.data, domain)
+    return bls.Sign(privkey, signing_root)
+
+
+# --- Builder duties (builder.md) -------------------------------------------
+
+
+def get_execution_payload_header_signature(
+        state: BeaconState, header: ExecutionPayloadHeader,
+        privkey: int) -> BLSSignature:
+    """Builder signs its bid (builder.md :72-80)."""
+    domain = get_domain(state, DOMAIN_BEACON_BUILDER,
+                        compute_epoch_at_slot(header.slot))
+    signing_root = compute_signing_root(header, domain)
+    return bls.Sign(privkey, signing_root)
+
+
+def get_execution_payload_envelope_signature(
+        state: BeaconState, envelope: ExecutionPayloadEnvelope,
+        privkey: int) -> BLSSignature:
+    """Builder signs the revealed envelope (builder.md :172-180)."""
+    domain = get_domain(state, DOMAIN_BEACON_BUILDER,
+                        get_current_epoch(state))
+    signing_root = compute_signing_root(envelope, domain)
+    return bls.Sign(privkey, signing_root)

@@ -30,13 +30,14 @@ CAPELLA = "capella"
 DENEB = "deneb"
 ELECTRA = "electra"
 FULU = "fulu"
+EIP7732 = "eip7732"  # feature fork (not in ALL_FORKS / @with_all_phases)
 
 
 def _implemented_forks() -> list[str]:
-    from ..models.builder import PKG_ROOT, SPEC_SOURCES
+    from ..models.builder import BUILDABLE_FORKS, PKG_ROOT, SPEC_SOURCES
 
     out = []
-    for fork in ALL_FORKS:
+    for fork in BUILDABLE_FORKS:
         files = SPEC_SOURCES.get(fork, [])
         if files and any((PKG_ROOT / "models" / fork / f).exists()
                          for f in files):

@@ -49,6 +49,17 @@ def build_empty_block(spec, state, slot=None, proposer_index=None):
         # infinity, which eth_fast_aggregate_verify accepts
         block.body.sync_aggregate.sync_committee_signature = (
             spec.G2_POINT_AT_INFINITY)
+    from .forks import is_post_eip7732
+
+    if is_post_eip7732(spec):
+        from .execution_payload import (
+            build_empty_signed_execution_payload_header,
+        )
+
+        block.body.signed_execution_payload_header = (
+            build_empty_signed_execution_payload_header(spec, state_at))
+        return block
+
     if is_post_bellatrix(spec):
         from .execution_payload import build_empty_execution_payload
 

@@ -1,0 +1,101 @@
+"""EIP-7732 (ePBS) test construction: payload envelopes and payload
+attestations (no reference corpus exists; shapes follow
+specs/_features/eip7732/beacon-chain.md and builder.md)."""
+
+from __future__ import annotations
+
+from ...ops import bls
+from .keys import privkeys
+
+
+def build_payload_envelope(spec, state, payload_withheld=False):
+    """An envelope consistent with the committed bid in `state` (call
+    after importing the block carrying the bid).  For a zero-value
+    self-bid the payload is the empty-hash payload the bid committed
+    to."""
+    committed = state.latest_execution_payload_header
+
+    payload = spec.ExecutionPayload(
+        parent_hash=state.latest_block_hash,
+        prev_randao=spec.get_randao_mix(state,
+                                        spec.get_current_epoch(state)),
+        gas_limit=committed.gas_limit,
+        timestamp=spec.compute_time_at_slot(state, state.slot),
+        block_hash=committed.block_hash,
+    )
+    # honor the withdrawals committed by process_withdrawals
+    header = state.latest_block_header.copy()
+    if header.state_root == spec.Root():
+        header.state_root = spec.hash_tree_root(state)
+
+    envelope = spec.ExecutionPayloadEnvelope(
+        payload=payload,
+        execution_requests=spec.ExecutionRequests(),
+        builder_index=committed.builder_index,
+        beacon_block_root=spec.hash_tree_root(header),
+        blob_kzg_commitments=[],
+        payload_withheld=payload_withheld,
+        state_root=spec.Root(),
+    )
+    return envelope
+
+
+def sign_payload_envelope(spec, state, envelope):
+    privkey = privkeys[envelope.builder_index]
+    signature = spec.get_execution_payload_envelope_signature(
+        state, envelope, privkey)
+    return spec.SignedExecutionPayloadEnvelope(
+        message=envelope, signature=signature)
+
+
+def run_envelope_processing(spec, state, signed_envelope, valid=True):
+    """Apply `process_execution_payload`, filling the envelope's
+    state_root with the correct post-root first (the builder's job)."""
+    from ..utils import expect_assertion_error
+
+    if not valid:
+        expect_assertion_error(
+            lambda: spec.process_execution_payload(
+                state, signed_envelope, spec.EXECUTION_ENGINE))
+        return
+
+    # compute the post state root on a throwaway copy, then re-sign
+    trial = state.copy()
+    spec.process_execution_payload(trial, signed_envelope,
+                                   spec.EXECUTION_ENGINE, verify=False)
+    signed_envelope.message.state_root = spec.hash_tree_root(trial)
+    signed_envelope = sign_payload_envelope(
+        spec, state, signed_envelope.message)
+    spec.process_execution_payload(state, signed_envelope,
+                                   spec.EXECUTION_ENGINE)
+    return signed_envelope
+
+
+def make_payload_attestation(spec, state, payload_status,
+                             beacon_block_root=None, slot=None,
+                             participation=None):
+    """A PTC attestation for the previous slot's payload status, signed
+    by every participating committee member."""
+    if slot is None:
+        slot = spec.Slot(state.slot - 1)
+    if beacon_block_root is None:
+        beacon_block_root = state.latest_block_header.parent_root
+    data = spec.PayloadAttestationData(
+        beacon_block_root=beacon_block_root,
+        slot=slot,
+        payload_status=payload_status,
+    )
+    ptc = spec.get_ptc(state, slot)
+    if participation is None:
+        participation = [True] * len(ptc)
+    attestation = spec.PayloadAttestation(data=data)
+    sigs = []
+    domain = spec.get_domain(state, spec.DOMAIN_PTC_ATTESTER, None)
+    signing_root = spec.compute_signing_root(data, domain)
+    for i, member in enumerate(ptc):
+        if participation[i]:
+            attestation.aggregation_bits[i] = True
+            sigs.append(bls.Sign(privkeys[member], signing_root))
+    attestation.signature = bls.Aggregate(sigs) if sigs else \
+        spec.BLSSignature()
+    return attestation

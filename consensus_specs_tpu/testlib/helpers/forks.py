@@ -39,6 +39,10 @@ def is_post_fulu(spec) -> bool:
     return is_post_fork(spec.fork, "fulu")
 
 
+def is_post_eip7732(spec) -> bool:
+    return is_post_fork(spec.fork, "eip7732")
+
+
 def get_spec_for_fork_version(spec, fork_version):
     """Name of the fork whose version equals `fork_version` in config."""
     for fork in ALL_FORKS:

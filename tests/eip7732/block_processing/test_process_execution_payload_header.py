@@ -1,0 +1,110 @@
+"""EIP-7732: `process_execution_payload_header` — bid validation and
+the builder→proposer payment
+(specs/_features/eip7732/beacon-chain.md :525-560)."""
+
+from consensus_specs_tpu.testlib.context import (
+    EIP7732,
+    always_bls,
+    spec_state_test,
+    with_phases,
+)
+from consensus_specs_tpu.testlib.helpers.block import (
+    build_empty_block_for_next_slot,
+)
+from consensus_specs_tpu.testlib.helpers.keys import privkeys
+from consensus_specs_tpu.testlib.utils import expect_assertion_error
+
+
+def _resign_bid(spec, state, block):
+    header = block.body.signed_execution_payload_header.message
+    block.body.signed_execution_payload_header.signature = (
+        spec.get_execution_payload_header_signature(
+            state, header, privkeys[header.builder_index]))
+
+
+def run_header_processing(spec, state, block, valid=True):
+    yield "pre", state
+    yield "block", block
+    if not valid:
+        expect_assertion_error(
+            lambda: spec.process_execution_payload_header(state, block))
+        yield "post", None
+        return
+    spec.process_execution_payload_header(state, block)
+    yield "post", state
+
+
+def _prepared_block(spec, state):
+    block = build_empty_block_for_next_slot(spec, state)
+    spec.process_slots(state, block.slot)
+    spec.process_withdrawals(state)
+    return block
+
+
+@with_phases([EIP7732])
+@spec_state_test
+def test_valid_zero_bid(spec, state):
+    block = _prepared_block(spec, state)
+    yield from run_header_processing(spec, state, block)
+    assert (state.latest_execution_payload_header
+            == block.body.signed_execution_payload_header.message)
+
+
+@with_phases([EIP7732])
+@spec_state_test
+def test_invalid_bid_exceeds_balance(spec, state):
+    block = _prepared_block(spec, state)
+    header = block.body.signed_execution_payload_header.message
+    header.value = spec.Gwei(
+        int(state.balances[header.builder_index]) + 1)
+    _resign_bid(spec, state, block)
+    yield from run_header_processing(spec, state, block, valid=False)
+
+
+@with_phases([EIP7732])
+@spec_state_test
+def test_invalid_bid_wrong_slot(spec, state):
+    block = _prepared_block(spec, state)
+    header = block.body.signed_execution_payload_header.message
+    header.slot = block.slot + 1
+    _resign_bid(spec, state, block)
+    yield from run_header_processing(spec, state, block, valid=False)
+
+
+@with_phases([EIP7732])
+@spec_state_test
+def test_invalid_bid_wrong_parent_block_hash(spec, state):
+    block = _prepared_block(spec, state)
+    header = block.body.signed_execution_payload_header.message
+    header.parent_block_hash = b"\x42" * 32
+    _resign_bid(spec, state, block)
+    yield from run_header_processing(spec, state, block, valid=False)
+
+
+@with_phases([EIP7732])
+@spec_state_test
+def test_invalid_bid_wrong_parent_block_root(spec, state):
+    block = _prepared_block(spec, state)
+    header = block.body.signed_execution_payload_header.message
+    header.parent_block_root = b"\x42" * 32
+    _resign_bid(spec, state, block)
+    yield from run_header_processing(spec, state, block, valid=False)
+
+
+@with_phases([EIP7732])
+@spec_state_test
+def test_invalid_slashed_builder(spec, state):
+    block = _prepared_block(spec, state)
+    header = block.body.signed_execution_payload_header.message
+    state.validators[header.builder_index].slashed = True
+    _resign_bid(spec, state, block)
+    yield from run_header_processing(spec, state, block, valid=False)
+
+
+@with_phases([EIP7732])
+@spec_state_test
+@always_bls
+def test_invalid_bid_signature(spec, state):
+    block = _prepared_block(spec, state)
+    block.body.signed_execution_payload_header.signature = b"\x42" * 96
+    yield from run_header_processing(spec, state, block, valid=False)

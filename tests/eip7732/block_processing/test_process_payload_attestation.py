@@ -1,0 +1,136 @@
+"""EIP-7732: `process_payload_attestation` — PTC vote accounting,
+proposer rewards/penalties
+(specs/_features/eip7732/beacon-chain.md :592-653)."""
+
+from consensus_specs_tpu.testlib.context import (
+    EIP7732,
+    always_bls,
+    spec_state_test,
+    with_phases,
+)
+from consensus_specs_tpu.testlib.helpers.block import (
+    build_empty_block_for_next_slot,
+)
+from consensus_specs_tpu.testlib.helpers.epbs import (
+    make_payload_attestation,
+)
+from consensus_specs_tpu.testlib.helpers.state import (
+    state_transition_and_sign_block,
+)
+from consensus_specs_tpu.testlib.utils import expect_assertion_error
+
+
+def _advance_two_blocks(spec, state):
+    """Two imported blocks so payload attestations for slot-1 have a
+    parent-root target."""
+    for _ in range(2):
+        block = build_empty_block_for_next_slot(spec, state)
+        state_transition_and_sign_block(spec, state, block)
+
+
+def run_payload_attestation_processing(spec, state, attestation,
+                                       valid=True):
+    yield "pre", state
+    yield "payload_attestation", attestation
+    if not valid:
+        expect_assertion_error(
+            lambda: spec.process_payload_attestation(state, attestation))
+        yield "post", None
+        return
+    spec.process_payload_attestation(state, attestation)
+    yield "post", state
+
+
+@with_phases([EIP7732])
+@spec_state_test
+def test_valid_payload_absent_vote(spec, state):
+    """No envelope was imported, so PAYLOAD_ABSENT is the correct vote —
+    proposer is rewarded."""
+    _advance_two_blocks(spec, state)
+    proposer = spec.get_beacon_proposer_index(state)
+    pre_balance = int(state.balances[proposer])
+    attestation = make_payload_attestation(spec, state,
+                                           spec.PAYLOAD_ABSENT)
+    yield from run_payload_attestation_processing(spec, state, attestation)
+    assert int(state.balances[proposer]) >= pre_balance
+
+
+@with_phases([EIP7732])
+@spec_state_test
+def test_wrong_status_vote_penalizes(spec, state):
+    """Voting PRESENT when the payload was absent clears flags and
+    penalizes the proposer (after a prior correct vote set flags)."""
+    _advance_two_blocks(spec, state)
+    correct = make_payload_attestation(spec, state, spec.PAYLOAD_ABSENT)
+    spec.process_payload_attestation(state, correct)
+    ptc = spec.get_ptc(state, spec.Slot(state.slot - 1))
+    flagged = [i for i in ptc
+               if int(state.current_epoch_participation[i]) != 0]
+    assert flagged, "correct vote should set participation flags"
+
+    proposer = spec.get_beacon_proposer_index(state)
+    pre_balance = int(state.balances[proposer])
+    wrong = make_payload_attestation(spec, state, spec.PAYLOAD_PRESENT)
+    yield "pre", state
+    yield "payload_attestation", wrong
+    spec.process_payload_attestation(state, wrong)
+    yield "post", state
+    # flags cleared again, proposer penalized
+    assert all(int(state.current_epoch_participation[i]) == 0
+               for i in flagged)
+    assert int(state.balances[proposer]) < pre_balance
+
+
+@with_phases([EIP7732])
+@spec_state_test
+def test_invalid_wrong_block_root(spec, state):
+    _advance_two_blocks(spec, state)
+    attestation = make_payload_attestation(
+        spec, state, spec.PAYLOAD_ABSENT, beacon_block_root=b"\x42" * 32)
+    yield from run_payload_attestation_processing(
+        spec, state, attestation, valid=False)
+
+
+@with_phases([EIP7732])
+@spec_state_test
+def test_invalid_wrong_slot(spec, state):
+    _advance_two_blocks(spec, state)
+    attestation = make_payload_attestation(
+        spec, state, spec.PAYLOAD_ABSENT,
+        slot=spec.Slot(state.slot))  # must be previous slot
+    yield from run_payload_attestation_processing(
+        spec, state, attestation, valid=False)
+
+
+@with_phases([EIP7732])
+@spec_state_test
+def test_invalid_status_out_of_range(spec, state):
+    _advance_two_blocks(spec, state)
+    attestation = make_payload_attestation(
+        spec, state, spec.PAYLOAD_INVALID_STATUS)
+    yield from run_payload_attestation_processing(
+        spec, state, attestation, valid=False)
+
+
+@with_phases([EIP7732])
+@spec_state_test
+def test_invalid_empty_participation(spec, state):
+    _advance_two_blocks(spec, state)
+    ptc = spec.get_ptc(state, spec.Slot(state.slot - 1))
+    attestation = make_payload_attestation(
+        spec, state, spec.PAYLOAD_ABSENT,
+        participation=[False] * len(ptc))
+    yield from run_payload_attestation_processing(
+        spec, state, attestation, valid=False)
+
+
+@with_phases([EIP7732])
+@spec_state_test
+@always_bls
+def test_invalid_signature(spec, state):
+    _advance_two_blocks(spec, state)
+    attestation = make_payload_attestation(spec, state,
+                                           spec.PAYLOAD_ABSENT)
+    attestation.signature = b"\x42" * 96
+    yield from run_payload_attestation_processing(
+        spec, state, attestation, valid=False)

@@ -1,0 +1,121 @@
+"""EIP-7732 envelope processing: the independent
+`process_execution_payload(state, signed_envelope, engine)` transition
+(specs/_features/eip7732/beacon-chain.md :705-800)."""
+
+from consensus_specs_tpu.testlib.context import (
+    EIP7732,
+    always_bls,
+    spec_state_test,
+    with_phases,
+)
+from consensus_specs_tpu.testlib.helpers.block import (
+    build_empty_block_for_next_slot,
+)
+from consensus_specs_tpu.testlib.helpers.epbs import (
+    build_payload_envelope,
+    run_envelope_processing,
+    sign_payload_envelope,
+)
+from consensus_specs_tpu.testlib.helpers.state import (
+    state_transition_and_sign_block,
+)
+
+
+def _import_block(spec, state):
+    block = build_empty_block_for_next_slot(spec, state)
+    state_transition_and_sign_block(spec, state, block)
+
+
+@with_phases([EIP7732])
+@spec_state_test
+def test_process_valid_envelope(spec, state):
+    _import_block(spec, state)
+    envelope = build_payload_envelope(spec, state)
+    signed = sign_payload_envelope(spec, state, envelope)
+    yield "pre", state
+    signed = run_envelope_processing(spec, state, signed)
+    yield "envelope", signed
+    yield "post", state
+
+    # the slot became full
+    assert state.latest_full_slot == state.slot
+    assert spec.is_parent_block_full(state)
+
+
+@with_phases([EIP7732])
+@spec_state_test
+def test_process_withheld_envelope(spec, state):
+    """A withheld payload leaves the slot empty but is a valid import."""
+    _import_block(spec, state)
+    envelope = build_payload_envelope(spec, state, payload_withheld=True)
+    signed = sign_payload_envelope(spec, state, envelope)
+    yield "pre", state
+    signed = run_envelope_processing(spec, state, signed)
+    yield "envelope", signed
+    yield "post", state
+
+    assert state.latest_full_slot != state.slot
+    assert not spec.is_parent_block_full(state)
+
+
+@with_phases([EIP7732])
+@spec_state_test
+def test_invalid_wrong_builder_index(spec, state):
+    _import_block(spec, state)
+    envelope = build_payload_envelope(spec, state)
+    envelope.builder_index = (envelope.builder_index + 1) % len(
+        state.validators)
+    signed = sign_payload_envelope(spec, state, envelope)
+    yield "pre", state
+    run_envelope_processing(spec, state, signed, valid=False)
+    yield "post", None
+
+
+@with_phases([EIP7732])
+@spec_state_test
+def test_invalid_wrong_beacon_block_root(spec, state):
+    _import_block(spec, state)
+    envelope = build_payload_envelope(spec, state)
+    envelope.beacon_block_root = b"\x42" * 32
+    signed = sign_payload_envelope(spec, state, envelope)
+    yield "pre", state
+    run_envelope_processing(spec, state, signed, valid=False)
+    yield "post", None
+
+
+@with_phases([EIP7732])
+@spec_state_test
+def test_invalid_wrong_block_hash(spec, state):
+    """payload.block_hash must match the committed bid."""
+    _import_block(spec, state)
+    envelope = build_payload_envelope(spec, state)
+    envelope.payload.block_hash = b"\x13" * 32
+    signed = sign_payload_envelope(spec, state, envelope)
+    yield "pre", state
+    run_envelope_processing(spec, state, signed, valid=False)
+    yield "post", None
+
+
+@with_phases([EIP7732])
+@spec_state_test
+def test_invalid_wrong_withdrawals_root(spec, state):
+    _import_block(spec, state)
+    envelope = build_payload_envelope(spec, state)
+    envelope.payload.withdrawals.append(spec.Withdrawal(index=0))
+    signed = sign_payload_envelope(spec, state, envelope)
+    yield "pre", state
+    run_envelope_processing(spec, state, signed, valid=False)
+    yield "post", None
+
+
+@with_phases([EIP7732])
+@spec_state_test
+@always_bls
+def test_invalid_envelope_signature(spec, state):
+    _import_block(spec, state)
+    envelope = build_payload_envelope(spec, state)
+    signed = sign_payload_envelope(spec, state, envelope)
+    signed.signature = b"\x42" * 96
+    yield "pre", state
+    run_envelope_processing(spec, state, signed, valid=False)
+    yield "post", None

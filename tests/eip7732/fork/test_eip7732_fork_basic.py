@@ -1,0 +1,49 @@
+"""EIP-7732 fork: `upgrade_to_eip7732` from electra
+(specs/_features/eip7732/fork.md :63-127)."""
+
+from consensus_specs_tpu.models.builder import build_spec
+from consensus_specs_tpu.testlib.context import (
+    ELECTRA,
+    spec_state_test,
+    with_phases,
+)
+
+
+@with_phases([ELECTRA])
+@spec_state_test
+def test_fork_base_state(spec, state):
+    post_spec = build_spec("eip7732", spec.preset_name)
+    post = post_spec.upgrade_to_eip7732(state)
+    yield "pre", state
+    yield "post", post
+
+    assert post.fork.previous_version == state.fork.current_version
+    assert post.fork.current_version == \
+        post_spec.config.EIP7732_FORK_VERSION
+    # the committed bid resets to the empty header
+    assert post.latest_execution_payload_header == \
+        post_spec.ExecutionPayloadHeader()
+    # ePBS bookkeeping seeds from the pre-state
+    assert post.latest_block_hash == \
+        state.latest_execution_payload_header.block_hash
+    assert post.latest_full_slot == state.slot
+    assert post.latest_withdrawals_root == post_spec.Root()
+    # registry carried over
+    assert len(post.validators) == len(state.validators)
+    assert post.hash_tree_root() != state.hash_tree_root()
+
+
+@with_phases([ELECTRA])
+@spec_state_test
+def test_fork_preserves_pending_queues(spec, state):
+    state.pending_deposits.append(spec.PendingDeposit(
+        pubkey=b"\xaa" * 48, amount=spec.Gwei(32 * 10**9)))
+    state.pending_consolidations.append(spec.PendingConsolidation(
+        source_index=1, target_index=2))
+    post_spec = build_spec("eip7732", spec.preset_name)
+    post = post_spec.upgrade_to_eip7732(state)
+    yield "pre", state
+    yield "post", post
+    assert len(post.pending_deposits) == len(state.pending_deposits)
+    assert len(post.pending_consolidations) == \
+        len(state.pending_consolidations)

@@ -1,0 +1,98 @@
+"""EIP-7732 sanity: the two-phase block/envelope import
+(no reference test corpus exists for ePBS yet; scenarios follow
+specs/_features/eip7732/beacon-chain.md :462-800)."""
+
+from consensus_specs_tpu.testlib.context import (
+    EIP7732,
+    spec_state_test,
+    with_phases,
+)
+from consensus_specs_tpu.testlib.helpers.block import (
+    build_empty_block_for_next_slot,
+)
+from consensus_specs_tpu.testlib.helpers.state import (
+    next_epoch,
+    next_slots,
+    state_transition_and_sign_block,
+)
+
+
+@with_phases([EIP7732])
+@spec_state_test
+def test_empty_block_transition(spec, state):
+    """An empty block with a self-built zero-value bid applies."""
+    pre_slot = state.slot
+    yield "pre", state
+    block = build_empty_block_for_next_slot(spec, state)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    yield "blocks", [signed_block]
+    yield "post", state
+
+    assert state.slot == pre_slot + 1
+    # the bid was cached as the committed header
+    assert state.latest_execution_payload_header.slot == block.slot
+    # no envelope arrived: the parent block is not full
+    assert not spec.is_parent_block_full(state)
+
+
+@with_phases([EIP7732])
+@spec_state_test
+def test_multiple_empty_blocks(spec, state):
+    yield "pre", state
+    blocks = []
+    for _ in range(3):
+        block = build_empty_block_for_next_slot(spec, state)
+        blocks.append(state_transition_and_sign_block(spec, state, block))
+    yield "blocks", blocks
+    yield "post", state
+    assert state.latest_full_slot < state.slot
+
+
+@with_phases([EIP7732])
+@spec_state_test
+def test_empty_epoch_transition(spec, state):
+    yield "pre", state
+    next_epoch(spec, state)
+    block = build_empty_block_for_next_slot(spec, state)
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    yield "blocks", [signed_block]
+    yield "post", state
+    assert spec.compute_epoch_at_slot(state.slot) == 1
+
+
+@with_phases([EIP7732])
+@spec_state_test
+def test_proposer_receives_bid_value(spec, state):
+    """A non-zero bid moves the bid value from builder to proposer."""
+    next_slots(spec, state, 1)
+    block = build_empty_block_for_next_slot(spec, state)
+    header = block.body.signed_execution_payload_header.message
+    builder_index = int(header.builder_index)
+    amount = spec.Gwei(1_000_000)
+    header.value = amount
+
+    from consensus_specs_tpu.testlib.helpers.execution_payload import (
+        build_empty_signed_execution_payload_header,
+    )
+    from consensus_specs_tpu.testlib.helpers.keys import privkeys
+
+    # re-sign the modified bid
+    signature = spec.get_execution_payload_header_signature(
+        state, header, privkeys[builder_index])
+    block.body.signed_execution_payload_header.signature = signature
+
+    proposer_index = int(block.proposer_index)
+    pre_builder = int(state.balances[builder_index])
+    pre_proposer = int(state.balances[proposer_index])
+
+    yield "pre", state
+    signed_block = state_transition_and_sign_block(spec, state, block)
+    yield "blocks", [signed_block]
+    yield "post", state
+
+    if builder_index != proposer_index:
+        assert int(state.balances[builder_index]) == pre_builder - amount
+        assert int(state.balances[proposer_index]) \
+            == pre_proposer + amount
+    else:
+        assert int(state.balances[builder_index]) == pre_builder
