@@ -207,35 +207,33 @@ def g1_multi_exp_device(points, scalars):
     return cj.g1_limbs_to_oracle(tuple(np.asarray(c) for c in out))
 
 
-def batch_verify(tasks, rng=None) -> bool:
-    """tasks: [(g1_pubkey_jacobian, message_bytes, g2_sig_jacobian)].
+def _prepare_rlc_inputs(tasks, rand, lanes: int):
+    """Host-side prep shared by the single-device and sharded RLC paths:
+    hash messages, drop trivial pairs, build limb arrays padded to
+    `lanes` (or the power-of-two bucket when `lanes` is None).
 
-    Verifies all FastAggregateVerify-style statements
-    e(PK_i, H(m_i)) == e(G1, S_i) at once: random 128-bit coefficients
-    r_i collapse them into   prod e(r_i PK_i, H_i) · e(-G1, Σ r_i S_i) == 1.
-    Host does hashing/aggregation; device does everything elliptic."""
-    if not tasks:
-        return True
-    rand = rng if rng is not None else secrets.SystemRandom()
+    Returns (arrays, n_live) with arrays None when a degenerate path
+    already decided the answer (n_live then carries the bool)."""
     live = []
     for pk, msg, sig in tasks:
         if _pycurve.g1.is_inf(pk) and _pycurve.g2.is_inf(sig):
             continue          # 1 == 1 trivially; mirrors oracle skip
         live.append((pk, hash_to_g2(bytes(msg), DST_G2), sig))
     if not live:
-        return True
+        return None, True
 
-    jnp = _jnp()
-    B = _bucket(len(live))
     # infinity on only one side cannot go through the affine kernels —
     # fall back to per-task device checks (rare, adversarial-only)
     if any(_pycurve.g1.is_inf(pk) or _pycurve.g2.is_inf(sig)
            for pk, _, sig in live):
-        return all(
+        ok = all(
             pairing_check_device([(pk, h),
                                   (_pycurve.g1.neg(_pycurve.G1_GEN), s)])
             for pk, h, s in live)
+        return None, ok
 
+    B = _bucket(len(live)) if lanes is None else lanes
+    assert B >= len(live)
     pk_x, pk_y = cj.g1_affine_to_limbs([t[0] for t in live])
     h_x, h_y = cj.g2_affine_to_limbs([t[1] for t in live])
     sig_x, sig_y = cj.g2_affine_to_limbs([t[2] for t in live])
@@ -253,9 +251,122 @@ def batch_verify(tasks, rng=None) -> bool:
         r_bits = np.concatenate(
             [r_bits, np.zeros((pad, RLC_SCALAR_BITS), np.int32)])
     mask = np.arange(B) < len(live)
+    return (pk_x, pk_y, sig_x, sig_y, h_x, h_y, r_bits, mask), len(live)
 
-    out = _rlc_kernel(B)(
+
+def batch_verify(tasks, rng=None) -> bool:
+    """tasks: [(g1_pubkey_jacobian, message_bytes, g2_sig_jacobian)].
+
+    Verifies all FastAggregateVerify-style statements
+    e(PK_i, H(m_i)) == e(G1, S_i) at once: random 128-bit coefficients
+    r_i collapse them into   prod e(r_i PK_i, H_i) · e(-G1, Σ r_i S_i) == 1.
+    Host does hashing/aggregation; device does everything elliptic."""
+    if not tasks:
+        return True
+    rand = rng if rng is not None else secrets.SystemRandom()
+    arrays, n = _prepare_rlc_inputs(tasks, rand, None)
+    if arrays is None:
+        return bool(n)
+    jnp = _jnp()
+    pk_x, pk_y, sig_x, sig_y, h_x, h_y, r_bits, mask = arrays
+    out = _rlc_kernel(pk_x.shape[0])(
         jnp.asarray(pk_x), jnp.asarray(pk_y), jnp.asarray(sig_x),
         jnp.asarray(sig_y), jnp.asarray(h_x), jnp.asarray(h_y),
         jnp.asarray(r_bits), jnp.asarray(mask))
+    return bool(out)
+
+
+@functools.lru_cache(maxsize=16)
+def _rlc_kernel_sharded(n_devices: int, per_shard: int, axis: str):
+    """shard_map'd RLC batch over a `Mesh`: every device scalar-muls and
+    Miller-loops its own lane shard, partial signature sums and partial
+    Miller products ride one `all_gather` each across the mesh (ICI, not
+    host), and the single final exponentiation runs replicated.  The
+    multi-chip form of `_rlc_kernel` — same predicate, same soundness."""
+    import jax
+    from jax.sharding import Mesh, PartitionSpec as P
+    jnp = _jnp()
+
+    mesh_devs = jax.devices()[:n_devices]
+    mesh = Mesh(np.array(mesh_devs), (axis,))
+    neg_g1 = cj.g1_affine_to_limbs([_pycurve.g1.neg(_pycurve.G1_GEN)])
+
+    def local(pk_x, pk_y, sig_x, sig_y, h_x, h_y, r_bits, mask):
+        B = pk_x.shape[0]   # per-shard lanes
+        one1 = jnp.broadcast_to(jnp.asarray(_fq.ONE_MONT),
+                                pk_x.shape).astype(jnp.int32)
+        one2 = jnp.broadcast_to(jnp.asarray(tw.FQ2_ONE_L),
+                                sig_x.shape).astype(jnp.int32)
+
+        r_pk = cj.pt_scalar_mul(cj.F1, (pk_x, pk_y, one1), r_bits)
+        r_sig = cj.pt_scalar_mul(cj.F2, (sig_x, sig_y, one2), r_bits)
+        r_sig = cj.pt_select(cj.F2, mask, r_sig,
+                             cj.pt_infinity(cj.F2, r_sig))
+        # local signature partial sum, then combine shards' partials
+        local_sum = cj.pt_sum(cj.F2, r_sig, B)
+        gathered = jax.tree_util.tree_map(
+            lambda c: jax.lax.all_gather(c, axis), local_sum)
+        sum_sig = cj.pt_sum(cj.F2, gathered, n_devices)
+
+        # local pairing lanes (r_i PK_i, H_i)
+        apx, apy, a_inf = g1_to_affine_dev(r_pk)
+        f_local = pj.miller_batch(apx, apy, h_x, h_y)
+        one12 = jnp.broadcast_to(jnp.asarray(tw.FQ12_ONE_L),
+                                 f_local.shape).astype(jnp.int32)
+        live = mask & ~a_inf
+        f_local = jnp.where(live[:, None, None, None, None], f_local,
+                            one12)
+        partial = pj._product_tree(f_local, B)          # unbatched <fq12>
+        partials = jax.lax.all_gather(partial, axis)    # (D, <fq12>)
+        total = pj._product_tree(partials, n_devices)
+
+        # the shared (-G1, Σ r_i S_i) lane, multiplied in exactly once
+        sx, sy, s_inf = g2_to_affine_dev(
+            tuple(c[None] for c in sum_sig))
+        f_extra = pj.miller_batch(
+            jnp.asarray(neg_g1[0]), jnp.asarray(neg_g1[1]), sx, sy)
+        one_extra = jnp.broadcast_to(
+            jnp.asarray(tw.FQ12_ONE_L), f_extra.shape).astype(jnp.int32)
+        f_extra = jnp.where((~s_inf)[:, None, None, None, None],
+                            f_extra, one_extra)
+        total = tw.fq12_mul(total, f_extra[0])
+        return tw.fq12_is_one(pj.final_exponentiate(total))
+
+    sharded = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(P(axis), P(axis), P(axis), P(axis), P(axis), P(axis),
+                  P(axis), P(axis)),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return jax.jit(sharded)
+
+
+def batch_verify_sharded(tasks, n_devices: int | None = None,
+                         rng=None, axis: str = "data") -> bool:
+    """`batch_verify` distributed over the device mesh: lanes shard
+    across `n_devices`, cross-device combination is two all_gathers
+    (partial G2 sums, partial Miller products), one replicated final
+    exponentiation.  Accept/reject is bit-identical to `batch_verify`."""
+    import jax
+
+    if not tasks:
+        return True
+    available = len(jax.devices())
+    if n_devices is None:
+        n_devices = available
+    n_devices = min(n_devices, available)
+    if n_devices <= 1:
+        return batch_verify(tasks, rng=rng)
+    rand = rng if rng is not None else secrets.SystemRandom()
+    # pad lanes to devices x power-of-two per-shard bucket
+    n_tasks = len(tasks)
+    per_shard = _bucket((n_tasks + n_devices - 1) // n_devices)
+    arrays, n = _prepare_rlc_inputs(tasks, rand,
+                                    n_devices * per_shard)
+    if arrays is None:
+        return bool(n)
+    jnp = _jnp()
+    out = _rlc_kernel_sharded(n_devices, per_shard, axis)(
+        *(jnp.asarray(a) for a in arrays))
     return bool(out)

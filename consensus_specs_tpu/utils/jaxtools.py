@@ -53,6 +53,13 @@ def enable_compile_cache(cache_dir: Path | None = None) -> None:
         jax.config.update("jax_compilation_cache_dir", str(d))
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        # XLA:CPU AOT kernel caches are machine-feature sensitive beyond
+        # what /proc/cpuinfo exposes (e.g. +prefer-no-scatter target
+        # tuning): excluding them keeps cached entries loadable across
+        # toolchain tweaks and silences the cpu_aot_loader SIGILL-hazard
+        # warnings the round-4 multichip log was full of
+        jax.config.update("jax_persistent_cache_enable_xla_caches",
+                          "none")
     except Exception:
         pass
 

@@ -129,3 +129,50 @@ def test_batched_executor_device_path():
                                      validate_result=False, device=True)
     finally:
         bls.bls_active = prev_active
+
+
+@pytest.mark.slow
+def test_invalid_aggregate_with_later_mutation_never_accepted():
+    """VERDICT r4 weak #7: the deferred batch changes the failure
+    boundary — pairings settle after `process_block` has mutated the
+    state.  Pin the mixed case: a block whose FIRST attestation carries a
+    tampered aggregate while LATER operations keep mutating the state
+    must still raise, and the caller-held pre-state must be untouched
+    (the executor contract: run on a copy, as `on_block` does)."""
+    spec = build_spec("phase0", "minimal")
+    prev_active = bls.bls_active
+    bls.bls_active = True
+    try:
+        state = _cached_genesis(spec, default_balances,
+                                default_activation_threshold)
+        state = state.copy()
+        next_slots(spec, state,
+                   spec.MIN_ATTESTATION_INCLUSION_DELAY + 2)
+        att_slot = state.slot - spec.MIN_ATTESTATION_INCLUSION_DELAY
+        bad_att = get_valid_attestation(spec, state, slot=att_slot - 1,
+                                        signed=True)
+        bad_att.signature = bls.Sign(999, b"\x13" * 32)  # tampered
+        good_att = get_valid_attestation(spec, state, slot=att_slot,
+                                         signed=True)
+        block = build_empty_block_for_next_slot(spec, state)
+        block.body.attestations.append(bad_att)   # settles in the batch
+        block.body.attestations.append(good_att)  # later state mutation
+
+        shadow = state.copy()
+        # inline path: the spec rejects at the bad attestation
+        with pytest.raises(AssertionError):
+            transition_unsigned_block(spec, shadow, block)
+
+        block.state_root = spec.hash_tree_root(shadow)
+        signed = sign_block(spec, state.copy(), block)
+
+        pre_root = spec.hash_tree_root(state)
+        working = state.copy()
+        with pytest.raises(AssertionError):
+            state_transition_batched(spec, working, signed,
+                                     validate_result=False)
+        # the caller-held state is untouched; only the working copy is
+        # half-applied, and it was never reported as valid
+        assert spec.hash_tree_root(state) == pre_root
+    finally:
+        bls.bls_active = prev_active
