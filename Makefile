@@ -7,25 +7,33 @@ PYTHON ?= python
 CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 VECTOR_OUT ?= out/vectors
 
-.PHONY: test test-fast test-all lint vectors kzg_setups bench multichip help
+.PHONY: test test-fast test-all test-bls lint vectors kzg_setups bench \
+	multichip help
 
 help:
 	@echo "targets: test (fast suite) | test-all (incl. slow crypto) |"
-	@echo "  lint (compile + build all specs) | vectors [VECTOR_OUT=dir] |"
+	@echo "  test-bls (operation suites with real signatures, jax backend) |"
+	@echo "  lint (compile + spec static checks) | vectors [VECTOR_OUT=dir] |"
 	@echo "  kzg_setups | bench (real TPU) | multichip (8-dev CPU dryrun)"
 
 test:
 	$(PYTHON) -m pytest tests/ -q -m "not slow"
+
+# the reference's default is BLS ON (`Makefile:105` bls=fastest); this
+# lane runs the signature-sensitive suites with real crypto on the jax
+# backend so invalid-signature rejection paths execute every round
+test-bls:
+	$(CPU_ENV) $(PYTHON) -m pytest \
+		tests/phase0/block_processing tests/electra/block_processing \
+		tests/eip7732 tests/test_executor.py \
+		-q --enable-bls --bls-type=jax
 
 test-all:
 	$(PYTHON) -m pytest tests/ -q
 
 lint:
 	$(PYTHON) -m compileall -q consensus_specs_tpu tests bench.py __graft_entry__.py
-	$(CPU_ENV) $(PYTHON) -c "\
-	from consensus_specs_tpu.models.builder import build_spec, ALL_FORKS; \
-	[build_spec(f, p) for f in ALL_FORKS for p in ('minimal', 'mainnet')]; \
-	print('all fork x preset specs build clean')"
+	$(CPU_ENV) $(PYTHON) -m consensus_specs_tpu.lint
 
 vectors:
 	$(CPU_ENV) $(PYTHON) -m consensus_specs_tpu.gen --output $(VECTOR_OUT) \

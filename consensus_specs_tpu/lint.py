@@ -1,0 +1,186 @@
+"""Static checks over the built specs — the role of the reference's
+mypy/pylint pass (`/root/reference/Makefile:183-189`), adapted to the
+flat exec'd-namespace architecture where import-based type checkers
+cannot resolve names.
+
+Two checks per fork x preset:
+
+1. **Undefined names**: every `Name` load inside every spec function
+   must resolve in the built namespace, builtins, or a local binding.
+   This statically catches the NameError class of spec bug (a call to a
+   helper that no fork in the chain defines).
+2. **config-attribute discipline**: every `config.X` attribute access
+   must exist in the loaded Configuration for that preset.
+
+Run via `python -m consensus_specs_tpu.lint` (wired into `make lint`).
+"""
+
+from __future__ import annotations
+
+import ast
+import builtins
+import sys
+
+from .models.builder import (
+    BUILDABLE_FORKS,
+    PKG_ROOT,
+    SPEC_SOURCES,
+    build_spec,
+    fork_chain,
+)
+
+
+class _LocalBindings(ast.NodeVisitor):
+    """Names bound inside one function scope (params, assignments,
+    targets, comprehensions, nested defs, imports, exception aliases)."""
+
+    def __init__(self):
+        self.bound: set[str] = set()
+
+    def _bind_target(self, node):
+        for child in ast.walk(node):
+            if isinstance(child, ast.Name) and isinstance(
+                    child.ctx, (ast.Store, ast.Del)):
+                self.bound.add(child.id)
+
+    def visit_arguments(self, node):
+        for arg in (list(node.posonlyargs) + list(node.args)
+                    + list(node.kwonlyargs)):
+            self.bound.add(arg.arg)
+        if node.vararg:
+            self.bound.add(node.vararg.arg)
+        if node.kwarg:
+            self.bound.add(node.kwarg.arg)
+        self.generic_visit(node)
+
+    def visit_Assign(self, node):
+        for target in node.targets:
+            self._bind_target(target)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node):
+        self._bind_target(node.target)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        self._bind_target(node.target)
+        self.generic_visit(node)
+
+    def visit_For(self, node):
+        self._bind_target(node.target)
+        self.generic_visit(node)
+
+    def visit_withitem(self, node):
+        if node.optional_vars is not None:
+            self._bind_target(node.optional_vars)
+        self.generic_visit(node)
+
+    def visit_comprehension(self, node):
+        self._bind_target(node.target)
+        self.generic_visit(node)
+
+    def visit_NamedExpr(self, node):
+        self._bind_target(node.target)
+        self.generic_visit(node)
+
+    def visit_ExceptHandler(self, node):
+        if node.name:
+            self.bound.add(node.name)
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):
+        self.bound.add(node.name)
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        self.visit_arguments(node.args)
+        self.generic_visit(node)
+
+    def visit_ClassDef(self, node):
+        self.bound.add(node.name)
+        self.generic_visit(node)
+
+    def visit_Import(self, node):
+        for alias in node.names:
+            self.bound.add((alias.asname or alias.name).split(".")[0])
+
+    visit_ImportFrom = visit_Import
+
+    def visit_Global(self, node):
+        self.bound.update(node.names)
+
+    visit_Nonlocal = visit_Global
+
+
+def _function_findings(fn_node, known: set[str], config_keys: set[str],
+                       path: str):
+    locals_visitor = _LocalBindings()
+    locals_visitor.visit(fn_node)
+    bound = locals_visitor.bound | known
+
+    findings = []
+    for node in ast.walk(fn_node):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+            if node.id not in bound:
+                findings.append(
+                    f"{path}:{node.lineno}: undefined name "
+                    f"'{node.id}' in {fn_node.name}()")
+        elif (isinstance(node, ast.Attribute)
+              and isinstance(node.value, ast.Name)
+              and node.value.id == "config"
+              and isinstance(node.ctx, ast.Load)):
+            if node.attr not in config_keys:
+                findings.append(
+                    f"{path}:{node.lineno}: unknown config attribute "
+                    f"'config.{node.attr}' in {fn_node.name}()")
+    return findings
+
+
+def lint_spec(fork: str, preset: str) -> list[str]:
+    spec = build_spec(fork, preset)
+    known = set(spec._namespace) | set(vars(builtins))
+    config_keys = set(spec.config.to_dict())
+
+    findings = []
+    for chain_fork in fork_chain(fork):
+        for source in SPEC_SOURCES[chain_fork]:
+            path = PKG_ROOT / "models" / chain_fork / source
+            tree = ast.parse(path.read_text())
+            rel = str(path.relative_to(PKG_ROOT.parent))
+            # top-level functions and methods only: nested defs are
+            # checked inside their parent's scope walk
+            tops = list(tree.body)
+            for node in tree.body:
+                if isinstance(node, ast.ClassDef):
+                    tops.extend(node.body)
+            for node in tops:
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    findings.extend(_function_findings(
+                        node, known, config_keys, rel))
+    return findings
+
+
+def main(argv=None) -> int:
+    presets = ("minimal", "mainnet")
+    total = 0
+    seen: set[str] = set()
+    for fork in BUILDABLE_FORKS:
+        for preset in presets:
+            for finding in lint_spec(fork, preset):
+                if finding not in seen:
+                    seen.add(finding)
+                    print(finding)
+                    total += 1
+    if total:
+        print(f"spec lint: {total} finding(s)", file=sys.stderr)
+        return 1
+    print(f"spec lint: {len(BUILDABLE_FORKS) * len(presets)} "
+          "spec builds clean (undefined-name + config-attribute checks)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,68 @@
+"""The static spec linter (`consensus_specs_tpu/lint.py`): catches
+undefined names and unknown config attributes, stays quiet on the real
+spec tree."""
+
+import ast
+import builtins
+
+from consensus_specs_tpu.lint import _function_findings, lint_spec
+
+
+def _findings(src, known=frozenset(), config_keys=frozenset()):
+    tree = ast.parse(src)
+    out = []
+    for node in tree.body:
+        if isinstance(node, ast.FunctionDef):
+            out.extend(_function_findings(
+                node,
+                set(known) | {"config"} | set(vars(builtins)),
+                set(config_keys), "x.py"))
+    return out
+
+
+def test_catches_undefined_helper_call():
+    # the advisor's round-4 bug class: a helper name no fork defines
+    src = ("def f(state, block):\n"
+           "    return compute_timestamp_at_slot(state, block.slot)\n")
+    found = _findings(src, known={"compute_time_at_slot"})
+    assert len(found) == 1
+    assert "compute_timestamp_at_slot" in found[0]
+
+
+def test_accepts_known_and_local_names():
+    src = ("def f(state):\n"
+           "    x = helper(state)\n"
+           "    items = [y for y in x]\n"
+           "    with open('f') as fh:\n"
+           "        pass\n"
+           "    try:\n"
+           "        pass\n"
+           "    except ValueError as err:\n"
+           "        return err\n"
+           "    return items\n")
+    assert _findings(src, known={"helper"}) == []
+
+
+def test_nested_closure_uses_enclosing_scope():
+    src = ("def outer(state, body):\n"
+           "    def for_ops(operations, fn):\n"
+           "        for operation in operations:\n"
+           "            fn(state, operation)\n"
+           "    for_ops(body.deposits, process_deposit)\n")
+    assert _findings(src, known={"process_deposit"}) == []
+
+
+def test_catches_unknown_config_attribute():
+    src = ("def f(epoch):\n"
+           "    return config.NO_SUCH_KNOB + epoch\n")
+    found = _findings(src, config_keys={"REAL_KNOB"})
+    assert len(found) == 1
+    assert "config.NO_SUCH_KNOB" in found[0]
+
+
+def test_real_spec_tree_is_clean_minimal_phase0():
+    assert lint_spec("phase0", "minimal") == []
+
+
+def test_real_spec_tree_is_clean_minimal_electra():
+    assert lint_spec("electra", "minimal") == []
