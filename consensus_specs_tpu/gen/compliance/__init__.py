@@ -1,0 +1,16 @@
+"""Fork-choice compliance test generation — the Python edition of the
+reference's `tests/generators/compliance_runners/fork_choice/` (MiniZinc
+`Block_tree.mzn` model + `instantiators/block_tree.py`).
+
+The reference enumerates abstract (block_parents, sm_links) instances
+with a constraint solver, then instantiates each into a concrete chain
+driven through the standard fork-choice step format.  At tiny scale a
+direct Python enumerator covers the same instance space, so the solver
+dependency disappears; the instantiation and the on-disk step format
+are unchanged (`tests/formats/fork_choice/README.md`).
+"""
+
+from .enumerator import enumerate_block_trees
+from .block_tree import instantiate_block_tree_test
+
+__all__ = ["enumerate_block_trees", "instantiate_block_tree_test"]

@@ -1,0 +1,88 @@
+"""Instantiate an abstract (parents, votes) instance into a concrete
+fork-choice vector (the role of `instantiators/block_tree.py`): build the
+block tree slot by slot, apply the vote loads as attestations, emit the
+standard step sequence with head/store checks after every event.
+
+The expected head in every check comes from this repo's own
+`get_head` — the vector asserts spec conformance, not a particular
+implementation's answer.
+"""
+
+from __future__ import annotations
+
+from ...testlib.helpers.attestations import get_valid_attestation
+from ...testlib.helpers.block import build_empty_block, sign_block
+from ...testlib.helpers.fork_choice import (
+    add_attestation,
+    add_block,
+    get_anchor_root,
+    on_tick_and_append_step,
+    output_head_check,
+)
+from ...testlib.helpers.state import state_transition_and_sign_block
+
+
+def instantiate_block_tree_test(parents, votes):
+    """A dual-mode test function for one abstract instance.
+
+    parents: canonical parent vector (parents[0] == 0 is the anchor).
+    votes: [(block_index, committee_fraction_percent)] attestation loads.
+    """
+
+    def case(spec, state):
+        test_steps = []
+        yield "anchor_state", state
+        anchor_block = spec.BeaconBlock(
+            state_root=spec.hash_tree_root(state))
+        yield "anchor_block", anchor_block
+        store = spec.get_forkchoice_store(state, anchor_block)
+
+        anchor_root = get_anchor_root(spec, state)
+        post_states = {0: state.copy()}
+        signed_blocks = {0: None}
+        roots = {0: anchor_root}
+
+        # blocks 1..n-1: block i sits at slot anchor+i on top of parent
+        for i in range(1, len(parents)):
+            parent_state = post_states[parents[i]]
+            block = build_empty_block(spec, parent_state,
+                                      slot=state.slot + i)
+            st = parent_state.copy()
+            signed = state_transition_and_sign_block(spec, st, block)
+            post_states[i] = st
+            signed_blocks[i] = signed
+            roots[i] = spec.hash_tree_root(block)
+
+            time = (store.genesis_time
+                    + block.slot * spec.config.SECONDS_PER_SLOT)
+            on_tick_and_append_step(spec, store, time, test_steps)
+            yield from add_block(spec, store, signed, test_steps)
+
+        # vote loads: committee-fraction attestations to chosen targets
+        for block_index, fraction in votes:
+            if block_index == 0:
+                continue  # votes for the anchor do not move weights
+            target_state = post_states[block_index]
+            att_slot = target_state.slot - 1
+
+            def participants(committee, fraction=fraction):
+                k = max(1, len(committee) * fraction // 100)
+                return set(list(committee)[:k])
+
+            attestation = get_valid_attestation(
+                spec, target_state, slot=att_slot,
+                filter_participant_set=participants, signed=True)
+            # attestations are valid from the next slot
+            next_time = (store.genesis_time
+                         + (attestation.data.slot + 1)
+                         * spec.config.SECONDS_PER_SLOT)
+            if next_time > store.time:
+                on_tick_and_append_step(spec, store, next_time,
+                                        test_steps)
+            yield from add_attestation(spec, store, attestation,
+                                       test_steps)
+
+        output_head_check(spec, store, test_steps)
+        yield "steps", test_steps
+
+    return case

@@ -1,0 +1,81 @@
+"""Abstract instance enumeration (the role of `model/Block_tree.mzn`).
+
+A block-tree instance is a parent vector: `parents[i]` is the parent of
+block `i`, `parents[0] == 0` (the anchor).  The constraint model:
+
+- connectivity/topology: `parents[i] < i` (parents precede children)
+- canonical form: the parent vector is non-decreasing, which picks one
+  labeled representative per unordered tree shape (children of earlier
+  nodes are numbered first) — the dedup the MiniZinc symmetry-breaking
+  constraints perform
+- shape bounds: max branching factor and leaf count keep tiny configs
+  tiny, mirroring the .mzn parameters
+"""
+
+from __future__ import annotations
+
+
+def enumerate_block_trees(n_blocks: int, max_branching: int = 3,
+                          min_leaves: int = 1, max_leaves: int | None = None):
+    """All canonical parent vectors for trees of `n_blocks` nodes
+    (anchor included)."""
+    if max_leaves is None:
+        max_leaves = n_blocks
+
+    out: list[list[int]] = []
+    parents = [0] * n_blocks
+
+    def children(upto: int, node: int) -> int:
+        return sum(1 for i in range(1, upto) if parents[i] == node)
+
+    def rec(i: int):
+        if i == n_blocks:
+            leaves = sum(1 for node in range(n_blocks)
+                         if children(n_blocks, node) == 0)
+            if min_leaves <= leaves <= max_leaves:
+                out.append(parents[:])
+            return
+        lo = parents[i - 1] if i > 1 else 0
+        for p in range(lo, i):
+            if children(i, p) >= max_branching:
+                continue
+            parents[i] = p
+            rec(i + 1)
+
+    rec(1)
+
+    # the ordering constraint leaves a few isomorphic duplicates (e.g.
+    # [0,0,0,1] vs [0,0,0,2]); dedup by the AHU canonical form
+    def canonical(parents):
+        kids: dict[int, list[int]] = {i: [] for i in range(len(parents))}
+        for i in range(1, len(parents)):
+            kids[parents[i]].append(i)
+
+        def shape(node):
+            return tuple(sorted(shape(c) for c in kids[node]))
+
+        return shape(0)
+
+    seen: set = set()
+    unique = []
+    for parents in out:
+        key = canonical(parents)
+        if key not in seen:
+            seen.add(key)
+            unique.append(parents)
+    return unique
+
+
+def attestation_variations(rng, n_blocks: int, n_variations: int,
+                           max_attesting: int = 6):
+    """Seeded per-instance vote patterns (the `nr_variations` axis of the
+    reference's test_gen.yaml): each variation is a list of
+    (block_index, committee_fraction_percent) vote loads."""
+    variations = []
+    for _ in range(n_variations):
+        n_votes = rng.randint(1, max_attesting)
+        variations.append([
+            (rng.randrange(n_blocks), rng.choice([25, 50, 100]))
+            for _ in range(n_votes)
+        ])
+    return variations

@@ -1,0 +1,52 @@
+"""Fork-choice compliance vector factory (the reference's
+`compliance_runners/fork_choice/test_gen.py`, tiny config): enumerated
+block-tree instances x seeded vote variations, emitted in the standard
+fork_choice step format under runner name `fork_choice_compliance`."""
+
+from __future__ import annotations
+
+import random
+
+from ...testlib.context import spec_state_test, with_phases
+from ..compliance import enumerate_block_trees, instantiate_block_tree_test
+from ..compliance.enumerator import attestation_variations
+from ..from_tests import generate_case_fn
+from ..typing import TestCase
+
+# tiny configuration (the reference's tiny/test_gen.yaml knobs)
+TINY = {
+    "n_blocks": 5,
+    "max_branching": 2,
+    "seed": 123,
+    "nr_variations": 2,
+}
+
+
+def iter_tiny_cases():
+    rng = random.Random(TINY["seed"])
+    trees = enumerate_block_trees(TINY["n_blocks"],
+                                  max_branching=TINY["max_branching"])
+    for tree_index, parents in enumerate(trees):
+        variations = attestation_variations(
+            rng, len(parents), TINY["nr_variations"])
+        for var_index, votes in enumerate(variations):
+            name = f"block_tree_{tree_index}_var_{var_index}"
+            yield name, parents, votes
+
+
+def get_test_cases():
+    cases = []
+    for name, parents, votes in iter_tiny_cases():
+        tfn = with_phases(["phase0"])(spec_state_test(
+            instantiate_block_tree_test(parents, votes)))
+        cases.append(TestCase(
+            fork_name="phase0",
+            preset_name="minimal",
+            runner_name="fork_choice_compliance",
+            handler_name="block_tree",
+            suite_name="compliance",
+            case_name=name,
+            case_fn=generate_case_fn(tfn, phase="phase0",
+                                     preset="minimal", bls_active=False),
+        ))
+    return cases

@@ -1,0 +1,90 @@
+"""Fork-choice compliance generator: the enumerator's constraint model,
+and an end-to-end replay of emitted vectors through a fresh store (the
+consumer side of `tests/formats/fork_choice/README.md`)."""
+
+import yaml
+
+from consensus_specs_tpu.gen.compliance import enumerate_block_trees
+from consensus_specs_tpu.gen.runner import run_generator
+from consensus_specs_tpu.models.builder import build_spec
+from consensus_specs_tpu.utils.snappy import decompress
+from consensus_specs_tpu.utils.ssz.ssz_impl import hash_tree_root
+
+
+def test_enumerator_canonical_trees():
+    trees = enumerate_block_trees(4, max_branching=3)
+    # every parent vector is canonical: parents precede children and the
+    # vector is non-decreasing (one representative per shape)
+    for parents in trees:
+        assert parents[0] == 0
+        assert all(parents[i] < i for i in range(1, len(parents)))
+        assert all(parents[i] <= parents[i + 1]
+                   for i in range(1, len(parents) - 1))
+    # n=4 unordered rooted trees with ≤3 branching: chain, fork at root
+    # (2+1, 1+1+1), fork at child — exactly 4 shapes
+    assert len(trees) == 4
+    assert [0, 0, 1, 2] in trees  # chain
+    assert [0, 0, 0, 0] in trees  # star
+
+
+def test_branching_bound_respected():
+    for parents in enumerate_block_trees(5, max_branching=2):
+        for node in range(5):
+            assert sum(1 for p in parents[1:] if p == node) <= 2
+
+
+def test_compliance_vectors_replay(tmp_path):
+    """Generate two tiny vectors, then replay them: parse the steps,
+    drive a fresh store with on_tick/on_block/on_attestation, and verify
+    every head check against get_head."""
+    from consensus_specs_tpu.gen.runners import compliance
+
+    import argparse
+
+    cases = compliance.get_test_cases()[:2]
+    assert cases
+    args = argparse.Namespace(
+        output=str(tmp_path), runners=[], presets=[], forks=[], cases=[],
+        threads=1, disable_bls=True, modcheck=False, verbose=False)
+    assert run_generator(cases, args) == 0
+
+    spec = build_spec("phase0", "minimal")
+    replayed = 0
+    base = (tmp_path / "minimal/phase0/fork_choice_compliance/block_tree"
+            / "compliance")
+    for case_dir in sorted(base.iterdir()):
+        anchor_state = spec.BeaconState.decode_bytes(decompress(
+            (case_dir / "anchor_state.ssz_snappy").read_bytes()))
+        anchor_block = spec.BeaconBlock.decode_bytes(decompress(
+            (case_dir / "anchor_block.ssz_snappy").read_bytes()))
+        store = spec.get_forkchoice_store(anchor_state, anchor_block)
+        steps = yaml.safe_load((case_dir / "steps.yaml").read_text())
+        checks_seen = 0
+        for step in steps:
+            if "tick" in step:
+                spec.on_tick(store, step["tick"])
+            elif "block" in step:
+                block = spec.SignedBeaconBlock.decode_bytes(decompress(
+                    (case_dir / f"{step['block']}.ssz_snappy")
+                    .read_bytes()))
+                spec.on_block(store, block)
+                for attestation in block.message.body.attestations:
+                    spec.on_attestation(store, attestation,
+                                        is_from_block=True)
+            elif "attestation" in step:
+                attestation = spec.Attestation.decode_bytes(decompress(
+                    (case_dir / f"{step['attestation']}.ssz_snappy")
+                    .read_bytes()))
+                spec.on_attestation(store, attestation)
+            if "checks" in step:
+                checks = step["checks"]
+                if "head" in checks:
+                    head = spec.get_head(store)
+                    assert checks["head"]["root"] == \
+                        "0x" + bytes(head).hex()
+                    assert checks["head"]["slot"] == \
+                        int(store.blocks[head].slot)
+                    checks_seen += 1
+        assert checks_seen > 0
+        replayed += 1
+    assert replayed == 2
