@@ -1,0 +1,83 @@
+"""Light-client test construction: `create_update` and friends — the
+core of the reference's `test/helpers/light_client.py:60-121` used by the
+update-ranking and data-collection suites."""
+
+from __future__ import annotations
+
+from math import floor
+
+from .sync_committee import (
+    compute_aggregate_sync_committee_signature,
+    compute_committee_indices,
+)
+
+
+def latest_finalized_root_gindex(spec):
+    return spec.finalized_root_gindex_at_slot(spec.Slot(2**62))
+
+
+def latest_next_sync_committee_gindex(spec):
+    return spec.next_sync_committee_gindex_at_slot(spec.Slot(2**62))
+
+
+def latest_current_sync_committee_gindex(spec):
+    return spec.current_sync_committee_gindex_at_slot(spec.Slot(2**62))
+
+
+def get_sync_aggregate(spec, state, num_participants=None,
+                       signature_slot=None):
+    """(SyncAggregate, signature_slot) signing the latest block root —
+    the reference's LC-flavored helper (signature_slot defaults to the
+    slot after the attested state's)."""
+    if signature_slot is None:
+        signature_slot = state.slot + 1
+    assert signature_slot > state.slot
+    signature_state = state.copy()
+    spec.process_slots(signature_state, spec.Slot(signature_slot))
+
+    committee_indices = compute_committee_indices(state)
+    if num_participants is None:
+        num_participants = len(committee_indices)
+    assert 0 <= num_participants <= len(committee_indices)
+    participants = committee_indices[:num_participants]
+    bits = [i < num_participants for i in range(len(committee_indices))]
+
+    signed_slot = spec.Slot(int(signature_slot) - 1)
+    signature = compute_aggregate_sync_committee_signature(
+        spec, signature_state, signed_slot, participants,
+        block_root=spec.get_block_root_at_slot(signature_state,
+                                               signed_slot))
+    aggregate = spec.SyncAggregate(
+        sync_committee_bits=bits,
+        sync_committee_signature=signature,
+    )
+    return aggregate, spec.Slot(signature_slot)
+
+
+def create_update(spec, attested_state, attested_block, finalized_block,
+                  with_next, with_finality, participation_rate,
+                  signature_slot=None):
+    """A LightClientUpdate with selectable quality attributes
+    (`helpers/light_client.py:88-120`)."""
+    num_participants = floor(
+        int(spec.SYNC_COMMITTEE_SIZE) * participation_rate)
+
+    update = spec.LightClientUpdate()
+    update.attested_header = spec.block_to_light_client_header(
+        attested_block)
+
+    if with_next:
+        update.next_sync_committee = attested_state.next_sync_committee
+        update.next_sync_committee_branch = spec.compute_merkle_proof(
+            attested_state, latest_next_sync_committee_gindex(spec))
+
+    if with_finality:
+        update.finalized_header = spec.block_to_light_client_header(
+            finalized_block)
+        update.finality_branch = spec.compute_merkle_proof(
+            attested_state, latest_finalized_root_gindex(spec))
+
+    update.sync_aggregate, update.signature_slot = get_sync_aggregate(
+        spec, attested_state, num_participants,
+        signature_slot=signature_slot)
+    return update

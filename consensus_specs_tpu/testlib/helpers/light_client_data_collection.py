@@ -1,0 +1,200 @@
+"""Light-client data collection: a full node's LC data store simulated
+over an explicit block DAG — bootstraps for finalized roots, the best
+`LightClientUpdate` per sync-committee period, and the latest
+finality/optimistic updates, all recomputed on head changes.
+
+Condensed single-spec edition of the reference's
+`test/helpers/light_client_data_collection.py:1-998` (the Forked*
+cross-fork wrappers are dropped: tests here run within one fork; the
+derivation itself rides the spec's own full-node.md functions —
+`create_light_client_bootstrap/update/finality_update/optimistic_update`).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from .block import build_empty_block
+from .state import state_transition_and_sign_block
+from .sync_committee import (
+    compute_aggregate_sync_committee_signature,
+    compute_committee_indices,
+)
+
+
+@dataclass(frozen=True)
+class BlockID:
+    slot: int
+    root: bytes
+
+
+def _block_to_block_id(spec, block):
+    return BlockID(slot=int(block.message.slot),
+                   root=bytes(spec.hash_tree_root(block.message)))
+
+
+def get_lc_bootstrap_block_id(spec, bootstrap) -> BlockID:
+    header = bootstrap.header.beacon
+    return BlockID(slot=int(header.slot),
+                   root=bytes(spec.hash_tree_root(header)))
+
+
+def get_lc_update_attested_block_id(spec, update) -> BlockID:
+    header = update.attested_header.beacon
+    return BlockID(slot=int(header.slot),
+                   root=bytes(spec.hash_tree_root(header)))
+
+
+@dataclass
+class LightClientDataCollectionTest:
+    spec: object
+    anchor_bid: BlockID
+    blocks: dict = field(default_factory=dict)        # root -> signed block
+    post_states: dict = field(default_factory=dict)   # root -> BeaconState
+    finalized_bid: BlockID = None
+    head_bid: BlockID = None
+    best_updates: dict = field(default_factory=dict)  # period -> update
+    latest_finality_update: object = None
+    latest_optimistic_update: object = None
+
+
+def setup_lc_data_collection_test(spec, state):
+    """Register the (finalized) anchor block/state."""
+    anchor_block = spec.SignedBeaconBlock(message=spec.BeaconBlock(
+        state_root=spec.hash_tree_root(state)))
+    anchor_bid = _block_to_block_id(spec, anchor_block)
+    test = LightClientDataCollectionTest(spec=spec, anchor_bid=anchor_bid)
+    test.blocks[anchor_bid.root] = anchor_block
+    test.post_states[anchor_bid.root] = state.copy()
+    test.finalized_bid = anchor_bid
+    test.head_bid = anchor_bid
+    return test
+
+
+def add_new_block(test, spec, state, slot=None, num_sync_participants=0):
+    """Build + import a block on `state` whose sync aggregate carries
+    `num_sync_participants` votes for its parent.  Returns
+    (post_state, BlockID)."""
+    if slot is None:
+        slot = state.slot + 1
+    block = build_empty_block(spec, state, slot=slot)
+
+    committee_indices = compute_committee_indices(state)
+    participants = committee_indices[:num_sync_participants]
+    bits = [i < num_sync_participants
+            for i in range(len(committee_indices))]
+    signing_state = state.copy()
+    spec.process_slots(signing_state, block.slot)
+    signature = compute_aggregate_sync_committee_signature(
+        spec, signing_state, block.slot - 1, participants,
+        block_root=block.parent_root)
+    block.body.sync_aggregate = spec.SyncAggregate(
+        sync_committee_bits=bits,
+        sync_committee_signature=signature,
+    )
+
+    post_state = state.copy()
+    signed_block = state_transition_and_sign_block(spec, post_state, block)
+    bid = _block_to_block_id(spec, signed_block)
+    test.blocks[bid.root] = signed_block
+    test.post_states[bid.root] = post_state.copy()
+    return post_state, bid
+
+
+def _chain_to_anchor(test, bid):
+    """Blocks from (excluding) the anchor to `bid`, oldest first."""
+    chain = []
+    while bid.root != test.anchor_bid.root:
+        block = test.blocks.get(bid.root)
+        if block is None:
+            break
+        chain.append(bid)
+        parent_root = bytes(block.message.parent_root)
+        parent = test.blocks[parent_root]
+        bid = _block_to_block_id(test.spec, parent)
+    return list(reversed(chain))
+
+
+def _finalized_block_for(test, attested_state):
+    root = bytes(attested_state.finalized_checkpoint.root)
+    if root == b"\x00" * 32:
+        return test.blocks[test.anchor_bid.root]  # genesis finality
+    return test.blocks.get(root)
+
+
+def select_new_head(test, spec, head_bid):
+    """Recompute the head-dependent LC data (the reference's
+    `_process_head_change_for_light_client`): walk the new head chain,
+    derive an update from every block with sync participation, keep the
+    per-period best and the latest finality/optimistic updates."""
+    test.head_bid = head_bid
+    test.best_updates = {}
+    test.latest_finality_update = None
+    test.latest_optimistic_update = None
+
+    for bid in _chain_to_anchor(test, head_bid):
+        block = test.blocks[bid.root]
+        participation = sum(
+            block.message.body.sync_aggregate.sync_committee_bits)
+        if participation < spec.MIN_SYNC_COMMITTEE_PARTICIPANTS:
+            continue
+        parent_root = bytes(block.message.parent_root)
+        attested_block = test.blocks[parent_root]
+        attested_state = test.post_states[parent_root]
+        update = spec.create_light_client_update(
+            test.post_states[bid.root], block, attested_state,
+            attested_block, _finalized_block_for(test, attested_state))
+
+        period = int(spec.compute_sync_committee_period_at_slot(
+            attested_block.message.slot))
+        best = test.best_updates.get(period)
+        if best is None or spec.is_better_update(update, best):
+            test.best_updates[period] = update
+
+        test.latest_optimistic_update = \
+            spec.create_light_client_optimistic_update(update)
+        if spec.is_finality_update(update):
+            test.latest_finality_update = \
+                spec.create_light_client_finality_update(update)
+
+
+def finalize_block(test, spec, finalized_bid):
+    """Advance finality (the reference's
+    `_process_finalization_for_light_client`): prune pre-finalized
+    branches from the block index."""
+    test.finalized_bid = finalized_bid
+    keep = {test.anchor_bid.root}
+    keep.update(b.root for b in _chain_to_anchor(test, test.head_bid))
+    keep.add(finalized_bid.root)
+    for root in list(test.blocks):
+        block = test.blocks[root]
+        if (int(block.message.slot) < finalized_bid.slot
+                and root not in keep):
+            del test.blocks[root]
+            del test.post_states[root]
+
+
+# --- queries (the reference's :537-578) ------------------------------------
+
+
+def get_light_client_bootstrap(test, block_root):
+    """Bootstrap for a finalized block root, or None."""
+    block = test.blocks.get(bytes(block_root))
+    if block is None:
+        return None
+    if int(block.message.slot) > test.finalized_bid.slot:
+        return None
+    state = test.post_states[bytes(block_root)]
+    return test.spec.create_light_client_bootstrap(state, block)
+
+
+def get_light_client_update_for_period(test, period):
+    return test.best_updates.get(int(period))
+
+
+def get_light_client_finality_update(test):
+    return test.latest_finality_update
+
+
+def get_light_client_optimistic_update(test):
+    return test.latest_optimistic_update

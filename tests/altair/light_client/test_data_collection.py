@@ -1,0 +1,124 @@
+"""Light client data collection: bootstraps, per-period best updates,
+and latest finality/optimistic updates across competing branches
+(scenario parity: `test/altair/light_client/test_data_collection.py`)."""
+
+from consensus_specs_tpu.testlib.context import (
+    ALTAIR,
+    spec_state_test_with_matching_config,
+    with_all_phases_from,
+    with_presets,
+)
+from consensus_specs_tpu.testlib.helpers.light_client_data_collection import (
+    BlockID,
+    add_new_block,
+    get_lc_bootstrap_block_id,
+    get_lc_update_attested_block_id,
+    get_light_client_bootstrap,
+    get_light_client_finality_update,
+    get_light_client_optimistic_update,
+    get_light_client_update_for_period,
+    select_new_head,
+    setup_lc_data_collection_test,
+)
+
+with_light_client = with_all_phases_from(ALTAIR)
+
+
+@with_light_client
+@spec_state_test_with_matching_config
+@with_presets(["minimal"], reason="too slow")
+def test_light_client_data_collection(spec, state):
+    test = setup_lc_data_collection_test(spec, state)
+    yield "anchor_state", state
+
+    # the genesis block is finalized: it can serve as a bootstrap
+    genesis_bid = BlockID(
+        slot=int(state.slot),
+        root=bytes(spec.hash_tree_root(spec.BeaconBlock(
+            state_root=spec.hash_tree_root(state)))))
+    bootstrap = get_light_client_bootstrap(test, genesis_bid.root)
+    assert bootstrap is not None
+    assert get_lc_bootstrap_block_id(spec, bootstrap) == genesis_bid
+
+    # nothing imported yet: no updates of any kind
+    period = int(spec.compute_sync_committee_period_at_slot(state.slot))
+    assert get_light_client_update_for_period(test, period) is None
+    assert get_light_client_finality_update(test) is None
+    assert get_light_client_optimistic_update(test) is None
+
+    # branch A: a block with an empty sync aggregate
+    state_a, bid_1 = add_new_block(test, spec, state, slot=1)
+    select_new_head(test, spec, bid_1)
+    assert get_light_client_update_for_period(test, period) is None
+    assert get_light_client_finality_update(test) is None
+    assert get_light_client_optimistic_update(test) is None
+
+    # branch B: a block with one participant -> updates appear, attested
+    # header is the genesis block
+    state_b, bid_2 = add_new_block(test, spec, state, slot=2,
+                                   num_sync_participants=1)
+    select_new_head(test, spec, bid_2)
+    update = get_light_client_update_for_period(test, period)
+    assert update is not None
+    assert get_lc_update_attested_block_id(spec, update) == genesis_bid
+    assert get_lc_update_attested_block_id(
+        spec, get_light_client_finality_update(test)) == genesis_bid
+    assert get_lc_update_attested_block_id(
+        spec, get_light_client_optimistic_update(test)) == genesis_bid
+
+    # back to branch A (still no participation): data disappears
+    state_a, bid_3 = add_new_block(test, spec, state_a, slot=3)
+    select_new_head(test, spec, bid_3)
+    assert get_light_client_update_for_period(test, period) is None
+    assert get_light_client_finality_update(test) is None
+    assert get_light_client_optimistic_update(test) is None
+
+    # extend branch B with an empty aggregate: branch B data persists
+    state_b, bid_4 = add_new_block(test, spec, state_b, slot=4)
+    select_new_head(test, spec, bid_4)
+    update = get_light_client_update_for_period(test, period)
+    assert get_lc_update_attested_block_id(spec, update) == genesis_bid
+    assert get_lc_update_attested_block_id(
+        spec, get_light_client_finality_update(test)) == genesis_bid
+
+    # extend branch B with more participants: the better update and the
+    # later optimistic update win; attested header advances to bid_4
+    bid_4_id = bid_4
+    state_b, bid_5 = add_new_block(test, spec, state_b, slot=5,
+                                   num_sync_participants=2)
+    select_new_head(test, spec, bid_5)
+    update = get_light_client_update_for_period(test, period)
+    assert get_lc_update_attested_block_id(spec, update) == bid_4_id
+    assert get_lc_update_attested_block_id(
+        spec, get_light_client_optimistic_update(test)) == bid_4_id
+    assert sum(update.sync_aggregate.sync_committee_bits) == 2
+
+    # bootstraps only for finalized roots: bid_5 is not finalized
+    assert get_light_client_bootstrap(test, bid_5.root) is None
+
+    yield "steps", [{"head": "0x" + test.head_bid.root.hex()}]
+
+
+@with_light_client
+@spec_state_test_with_matching_config
+@with_presets(["minimal"], reason="too slow")
+def test_update_quality_across_periods(spec, state):
+    """Updates land in their attested period's slot; a supermajority
+    update replaces a weaker one within the period."""
+    test = setup_lc_data_collection_test(spec, state)
+    yield "anchor_state", state
+
+    committee_size = int(spec.SYNC_COMMITTEE_SIZE)
+    st, bid_a = add_new_block(test, spec, state, slot=1,
+                              num_sync_participants=1)
+    st, bid_b = add_new_block(test, spec, st, slot=2,
+                              num_sync_participants=committee_size)
+    select_new_head(test, spec, bid_b)
+
+    period = int(spec.compute_sync_committee_period_at_slot(state.slot))
+    update = get_light_client_update_for_period(test, period)
+    # the supermajority update (attested = bid_a) beats the 1-vote one
+    assert get_lc_update_attested_block_id(spec, update) == bid_a
+    assert (sum(update.sync_aggregate.sync_committee_bits)
+            == committee_size)
+    yield "steps", [{"head": "0x" + test.head_bid.root.hex()}]

@@ -1,0 +1,110 @@
+"""Light client: `is_better_update` total ordering over update quality
+tiers (scenario parity:
+`test/altair/light_client/test_update_ranking.py:1-150`)."""
+
+from consensus_specs_tpu.testlib.context import (
+    ALTAIR,
+    spec_state_test_with_matching_config,
+    with_all_phases_from,
+    with_presets,
+)
+from consensus_specs_tpu.testlib.helpers.attestations import (
+    next_slots_with_attestations,
+    state_transition_with_full_block,
+)
+from consensus_specs_tpu.testlib.helpers.light_client import create_update
+from consensus_specs_tpu.testlib.helpers.state import next_slots
+
+with_light_client = with_all_phases_from(ALTAIR)
+
+
+@with_light_client
+@spec_state_test_with_matching_config
+@with_presets(["minimal"], reason="too slow")
+def test_update_ranking(spec, state):
+    # Chain layout (as in the reference):
+    # - sig_*: only the signature is in the next sync-committee period
+    # - att_*: the attested header is also in the next period
+    # - fin_*: the finalized header is also in the next period
+    # - lat_*: like fin, at a later attested slot
+    next_slots(spec, state, spec.compute_start_slot_at_epoch(
+        spec.EPOCHS_PER_SYNC_COMMITTEE_PERIOD - 3) - 1)
+    sig_finalized_block = state_transition_with_full_block(
+        spec, state, True, True)
+    _, _, state = next_slots_with_attestations(
+        spec, state, spec.SLOTS_PER_EPOCH - 1, True, True)
+    att_finalized_block = state_transition_with_full_block(
+        spec, state, True, True)
+    _, _, state = next_slots_with_attestations(
+        spec, state, 2 * spec.SLOTS_PER_EPOCH - 2, True, True)
+    sig_attested_block = state_transition_with_full_block(
+        spec, state, True, True)
+    sig_attested_state = state.copy()
+    att_attested_block = state_transition_with_full_block(
+        spec, state, True, True)
+    att_attested_state = state.copy()
+    fin_finalized_block = att_attested_block
+    _, _, state = next_slots_with_attestations(
+        spec, state, 2 * spec.SLOTS_PER_EPOCH - 1, True, True)
+    fin_attested_block = state_transition_with_full_block(
+        spec, state, True, True)
+    fin_attested_state = state.copy()
+    lat_finalized_block = fin_finalized_block
+    lat_attested_block = state_transition_with_full_block(
+        spec, state, True, True)
+    lat_attested_state = state.copy()
+
+    chains = {
+        "sig": (sig_attested_state, sig_attested_block,
+                sig_finalized_block),
+        "att": (att_attested_state, att_attested_block,
+                att_finalized_block),
+        "fin": (fin_attested_state, fin_attested_block,
+                fin_finalized_block),
+        "lat": (lat_attested_state, lat_attested_block,
+                lat_finalized_block),
+    }
+
+    def mk(chain, with_next, with_finality, rate, signature_slot=None):
+        attested_state, attested_block, finalized_block = chains[chain]
+        return create_update(
+            spec, attested_state, attested_block, finalized_block,
+            with_next, with_finality, rate,
+            signature_slot=signature_slot)
+
+    # quality tiers in descending order — the reference's explicit list,
+    # expressed as (with_next, with_finality, [chains]) per supermajority
+    # rate band
+    supermajority_tiers = [
+        (1, 1, ["fin", "lat"]),           # sync-committee finality
+        (1, 1, ["att"]),                  # finality w/o sc-finality
+        (1, 0, ["att", "fin", "lat"]),    # no finality indication
+        (0, 1, ["sig", "fin", "lat"]),    # sc finality, no next committee
+        (0, 1, ["att"]),
+        (0, 0, ["sig", "att", "fin", "lat"]),
+    ]
+    low_tiers = [
+        (1, 1, ["fin", "lat", "att"]),
+        (1, 0, ["att", "fin", "lat"]),
+        (0, 1, ["sig", "fin", "lat", "att"]),
+        (0, 0, ["sig", "att", "fin", "lat"]),
+    ]
+
+    updates = []
+    for with_next, with_finality, names in supermajority_tiers:
+        for rate in (1.0, 0.8):
+            updates.extend(mk(c, with_next, with_finality, rate)
+                           for c in names)
+    for rate in (0.4, 0.2):                  # below-supermajority bands
+        for with_next, with_finality, names in low_tiers:
+            updates.extend(mk(c, with_next, with_finality, rate)
+                           for c in names)
+    # signature_slot tiebreaker: identical update, later signature slot
+    updates.append(mk("lat", 0, 0, 0.2,
+                      signature_slot=lat_attested_state.slot + 2))
+
+    yield "updates", updates
+
+    for i in range(len(updates) - 1):
+        assert spec.is_better_update(updates[i], updates[i + 1]), \
+            f"update {i} should rank above update {i + 1}"
