@@ -47,8 +47,9 @@ def get_random_attester_slashings(spec, state, rng, slashed_indices=()):
     sample_upper_bound = 4
     if len(indices) < num_slashings * sample_upper_bound - 1:
         return []
-    slot_range = list(range(state.slot - spec.SLOTS_PER_HISTORICAL_ROOT + 1,
-                            state.slot))
+    slot_range = list(range(
+        max(1, state.slot - spec.SLOTS_PER_HISTORICAL_ROOT + 1),
+        state.slot))
     return [
         get_valid_attester_slashing_by_indices(
             spec, state,
@@ -65,8 +66,9 @@ def get_random_attestations(spec, state, rng):
     return [
         get_valid_attestation(
             spec, state,
-            slot=rng.randrange(state.slot - spec.SLOTS_PER_EPOCH + 1,
-                               state.slot),
+            slot=rng.randrange(
+                max(1, state.slot - spec.SLOTS_PER_EPOCH + 1),
+                state.slot),
             signed=True)
         for _ in range(num_attestations)
     ]

@@ -1,0 +1,185 @@
+"""Randomized block-test scenario DSL (the role of the reference's
+`test/utils/randomized_block_tests.py:1-476`): deterministic scenario
+matrices combining state randomization, leak setup, epoch/slot
+transitions, and per-fork random block content, executed through the
+dual-mode yield protocol.
+
+A scenario is a list of steps:
+
+    ("randomize",)              heavy state randomization
+    ("leak",)                   put the state into an inactivity leak
+    ("epochs", n)               n empty epoch transitions
+    ("slots", n)                n empty slot transitions
+    ("block", kind)             produce+apply a block; kind in
+                                {"empty", "random"}
+    ("no_op",)                  nothing (scenario spacing)
+
+`standard_scenarios` builds the deterministic matrix used by each
+fork's `random/test_random.py`.
+"""
+
+from __future__ import annotations
+
+from random import Random
+
+from .helpers.block import build_empty_block_for_next_slot
+from .helpers.forks import is_post_altair, is_post_capella
+from .helpers.multi_operations import (
+    build_random_block_from_state_for_next_slot,
+    prepare_state_and_get_random_deposits,
+)
+from .helpers.random import (
+    patch_state_to_non_leaking,
+    randomize_state,
+)
+from .helpers.rewards import transition_state_to_leak
+from .helpers.state import (
+    next_epoch,
+    next_slot,
+    state_transition_and_sign_block,
+)
+from .helpers.sync_committee import (
+    compute_aggregate_sync_committee_signature,
+    compute_committee_indices,
+)
+
+
+def _random_sync_aggregate(spec, state, block, rng):
+    """Random sync participation for the block being built (altair+)."""
+    signing_state = state.copy()
+    spec.process_slots(signing_state, block.slot)
+    committee_indices = compute_committee_indices(signing_state)
+    participation = [rng.random() < 0.8 for _ in committee_indices]
+    participants = [i for i, bit in zip(committee_indices, participation)
+                    if bit]
+    if not participants:
+        return  # keep the (valid) empty infinity aggregate
+    block.body.sync_aggregate = spec.SyncAggregate(
+        sync_committee_bits=participation,
+        sync_committee_signature=compute_aggregate_sync_committee_signature(
+            spec, signing_state, block.slot - 1, participants,
+            block_root=block.parent_root),
+    )
+
+
+def _skip_slashed_proposers(spec, state):
+    """Advance until the next slot's proposer is unslashed (randomized
+    registries can slash the scheduled proposer; producing there would
+    be an invalid block)."""
+    for _ in range(2 * int(spec.SLOTS_PER_EPOCH)):
+        lookahead = state.copy()
+        spec.process_slots(lookahead, state.slot + 1)
+        proposer = spec.get_beacon_proposer_index(lookahead)
+        if not state.validators[proposer].slashed:
+            return
+        next_slot(spec, state)
+    raise AssertionError("no unslashed proposer found in two epochs")
+
+
+def _produce_block(spec, state, kind, rng, deposits=None):
+    _skip_slashed_proposers(spec, state)
+    if kind == "random":
+        block = build_random_block_from_state_for_next_slot(
+            spec, state, rng=rng, deposits=deposits)
+    else:
+        block = build_empty_block_for_next_slot(spec, state)
+    if is_post_altair(spec):
+        _random_sync_aggregate(spec, state, block, rng)
+    return state_transition_and_sign_block(spec, state, block)
+
+
+def run_scenario(spec, state, scenario, seed):
+    """Execute a scenario; yields the dual-mode vector parts.
+
+    The "pre" part is captured AFTER the setup steps (randomize/leak/
+    advance) AND after the deposit/eth1 preparation for every upcoming
+    random block — none of those mutations are expressible as block
+    transitions, so a consumer replaying pre + blocks must start from
+    the fully set-up state (same contract as
+    `helpers/multi_operations.run_test_full_random_operations`)."""
+    rng = Random(seed)
+
+    setup_steps = [s for s in scenario if s[0] != "block"]
+    block_steps = [s for s in scenario if s[0] == "block"]
+    assert scenario == setup_steps + block_steps, \
+        "setup steps must precede block production"
+
+    for step in setup_steps:
+        op = step[0]
+        if op == "randomize":
+            randomize_state(spec, state, rng, exit_fraction=0.1,
+                            slash_fraction=0.1)
+            patch_state_to_non_leaking(spec, state)
+        elif op == "leak":
+            transition_state_to_leak(spec, state)
+        elif op == "epochs":
+            for _ in range(step[1]):
+                next_epoch(spec, state)
+        elif op == "slots":
+            for _ in range(step[1]):
+                next_slot(spec, state)
+        elif op == "no_op":
+            pass
+        else:
+            raise ValueError(f"unknown scenario step {step!r}")
+
+    # deposits mutate eth1_data on the state: prepare them all pre-"pre"
+    deposit_queue = [
+        prepare_state_and_get_random_deposits(spec, state, rng)
+        if kind == "random" else None
+        for _, kind in block_steps
+    ]
+
+    yield "pre", state
+    signed_blocks = [
+        _produce_block(spec, state, kind, rng, deposits=deposits)
+        for (_, kind), deposits in zip(block_steps, deposit_queue)
+    ]
+    yield "blocks", signed_blocks
+    yield "post", state
+    assert state.slot < 2**32  # the state survived
+
+
+def standard_scenarios():
+    """The deterministic scenario matrix: {name: scenario} — normal and
+    leaking states crossed with epoch/slot offsets and block kinds (the
+    reference's generated module enumerates the same axes)."""
+    out = {}
+    for leak in (False, True):
+        leak_tag = "leak_" if leak else ""
+        # non-leak states still advance past genesis so random blocks
+        # have an attestable history (leak setup advances 6+ epochs)
+        setup = [("randomize",)] + ([("leak",)] if leak
+                                    else [("epochs", 2)])
+        for epochs, slots, tag in (
+                (0, 0, "last_slot"),
+                (0, 1, "slot_offset"),
+                (1, 0, "next_epoch"),
+                (2, 3, "deep_offset")):
+            advance = ([("epochs", epochs)] if epochs else []) \
+                + ([("slots", slots)] if slots else [])
+            out[f"random_{leak_tag}{tag}_empty_blocks"] = (
+                setup + advance + [("block", "empty"), ("block", "empty")])
+            out[f"random_{leak_tag}{tag}_random_block"] = (
+                setup + advance + [("block", "random")])
+    return out
+
+
+def register_random_tests(module_globals, fork: str, seed_base: int):
+    """Materialize the scenario matrix as pytest test functions in a
+    fork's `random/test_random.py` module (the reference generates such
+    modules as files; dynamic registration keeps one source of truth)."""
+    from .context import spec_state_test, with_phases
+
+    for offset, (name, scenario) in enumerate(
+            sorted(standard_scenarios().items())):
+        def make(scenario=scenario, seed=seed_base + offset):
+            @with_phases([fork])
+            @spec_state_test
+            def test_fn(spec, state):
+                yield from run_scenario(spec, state, scenario, seed)
+            return test_fn
+
+        fn = make()
+        fn.__name__ = f"test_{name}"
+        module_globals[f"test_{name}"] = fn

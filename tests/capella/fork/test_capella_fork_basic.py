@@ -1,0 +1,68 @@
+"""Capella fork upgrade: bellatrix state -> capella state
+(parity: `test/capella/fork/test_capella_fork_basic.py`)."""
+
+from consensus_specs_tpu.models.builder import build_spec
+from consensus_specs_tpu.testlib.context import (
+    CAPELLA,
+    spec_state_test,
+    with_phases,
+)
+from consensus_specs_tpu.testlib.helpers.genesis import create_genesis_state
+from consensus_specs_tpu.testlib.helpers.state import next_epoch
+
+
+def _bellatrix_state_for(spec, state):
+    pre_spec = build_spec("bellatrix", spec.preset_name)
+    balances = [int(b) for b in state.balances]
+    return pre_spec, create_genesis_state(
+        pre_spec, balances, pre_spec.MAX_EFFECTIVE_BALANCE)
+
+
+def _check_upgrade(spec, pre, post):
+    assert post.fork.previous_version == pre.fork.current_version
+    assert post.fork.current_version == spec.config.CAPELLA_FORK_VERSION
+    assert post.slot == pre.slot
+    assert len(post.validators) == len(pre.validators)
+    assert list(post.balances) == list(pre.balances)
+    # fresh capella withdrawal bookkeeping
+    assert post.next_withdrawal_index == 0
+    assert post.next_withdrawal_validator_index == 0
+    assert len(post.historical_summaries) == 0
+    # the EL header gains a withdrawals_root field (defaulted)
+    assert post.latest_execution_payload_header.withdrawals_root == \
+        spec.Root()
+
+
+@with_phases([CAPELLA])
+@spec_state_test
+def test_fork_base_state(spec, state):
+    pre_spec, pre = _bellatrix_state_for(spec, state)
+    yield "pre", pre
+    post = spec.upgrade_to_capella(pre)
+    yield "post", post
+    _check_upgrade(spec, pre, post)
+
+
+@with_phases([CAPELLA])
+@spec_state_test
+def test_fork_next_epoch(spec, state):
+    pre_spec, pre = _bellatrix_state_for(spec, state)
+    next_epoch(pre_spec, pre)
+    yield "pre", pre
+    post = spec.upgrade_to_capella(pre)
+    yield "post", post
+    _check_upgrade(spec, pre, post)
+
+
+@with_phases([CAPELLA])
+@spec_state_test
+def test_fork_preserves_history(spec, state):
+    pre_spec, pre = _bellatrix_state_for(spec, state)
+    next_epoch(pre_spec, pre)
+    next_epoch(pre_spec, pre)
+    yield "pre", pre
+    post = spec.upgrade_to_capella(pre)
+    yield "post", post
+    assert list(post.block_roots) == list(pre.block_roots)
+    assert list(post.state_roots) == list(pre.state_roots)
+    assert list(post.historical_roots) == list(pre.historical_roots)

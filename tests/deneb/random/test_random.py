@@ -1,0 +1,9 @@
+"""deneb: randomized state/block scenarios (the reference's generated
+`test/deneb/random/test_random.py`, driven by this repo's scenario DSL
+`testlib/randomized_block_tests.py`)."""
+
+from consensus_specs_tpu.testlib.randomized_block_tests import (
+    register_random_tests,
+)
+
+register_random_tests(globals(), "deneb", seed_base=4000)

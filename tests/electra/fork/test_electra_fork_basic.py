@@ -1,0 +1,94 @@
+"""Electra fork upgrade: deneb state -> electra state — churn
+initialization and pending-deposit re-queueing
+(parity: `test/electra/fork/test_electra_fork_basic.py`)."""
+
+from consensus_specs_tpu.models.builder import build_spec
+from consensus_specs_tpu.testlib.context import (
+    ELECTRA,
+    spec_state_test,
+    with_phases,
+)
+from consensus_specs_tpu.testlib.helpers.genesis import create_genesis_state
+from consensus_specs_tpu.testlib.helpers.state import next_epoch
+
+
+def _deneb_state_for(spec, state):
+    pre_spec = build_spec("deneb", spec.preset_name)
+    balances = [int(b) for b in state.balances]
+    return pre_spec, create_genesis_state(
+        pre_spec, balances, pre_spec.MAX_EFFECTIVE_BALANCE)
+
+
+def _check_upgrade(spec, pre, post):
+    assert post.fork.previous_version == pre.fork.current_version
+    assert post.fork.current_version == spec.config.ELECTRA_FORK_VERSION
+    assert len(post.validators) == len(pre.validators)
+    # EIP-6110/7251 bookkeeping freshly initialized
+    assert post.deposit_requests_start_index == \
+        spec.UNSET_DEPOSIT_REQUESTS_START_INDEX
+    assert post.deposit_balance_to_consume == 0
+    assert post.consolidation_balance_to_consume == 0
+    assert len(post.pending_partial_withdrawals) == 0
+    assert len(post.pending_consolidations) == 0
+    assert post.exit_balance_to_consume == \
+        spec.get_activation_exit_churn_limit(post)
+    # exit epochs: earliest exit beyond every existing exit
+    for v in post.validators:
+        if v.exit_epoch != spec.FAR_FUTURE_EPOCH:
+            assert post.earliest_exit_epoch > v.exit_epoch
+
+
+@with_phases([ELECTRA])
+@spec_state_test
+def test_fork_base_state(spec, state):
+    pre_spec, pre = _deneb_state_for(spec, state)
+    yield "pre", pre
+    post = spec.upgrade_to_electra(pre)
+    yield "post", post
+    _check_upgrade(spec, pre, post)
+
+
+@with_phases([ELECTRA])
+@spec_state_test
+def test_fork_next_epoch(spec, state):
+    pre_spec, pre = _deneb_state_for(spec, state)
+    next_epoch(pre_spec, pre)
+    yield "pre", pre
+    post = spec.upgrade_to_electra(pre)
+    yield "post", post
+    _check_upgrade(spec, pre, post)
+
+
+@with_phases([ELECTRA])
+@spec_state_test
+def test_fork_requeues_pending_activation(spec, state):
+    """Validators not yet active have their balance re-queued as a
+    pending deposit (EIP-7251 upgrade semantics)."""
+    pre_spec, pre = _deneb_state_for(spec, state)
+    # make validator 0 pending: not yet activation-eligible
+    pre.validators[0].activation_eligibility_epoch = \
+        pre_spec.FAR_FUTURE_EPOCH
+    pre.validators[0].activation_epoch = pre_spec.FAR_FUTURE_EPOCH
+    balance = int(pre.balances[0])
+
+    yield "pre", pre
+    post = spec.upgrade_to_electra(pre)
+    yield "post", post
+
+    queued = [d for d in post.pending_deposits
+              if bytes(d.pubkey) == bytes(pre.validators[0].pubkey)]
+    assert len(queued) == 1
+    assert int(queued[0].amount) == balance
+    assert int(post.balances[0]) == 0
+
+
+@with_phases([ELECTRA])
+@spec_state_test
+def test_fork_exited_validator_pushes_earliest_exit(spec, state):
+    pre_spec, pre = _deneb_state_for(spec, state)
+    exit_epoch = 7
+    pre.validators[3].exit_epoch = exit_epoch
+    yield "pre", pre
+    post = spec.upgrade_to_electra(pre)
+    yield "post", post
+    assert post.earliest_exit_epoch == exit_epoch + 1

@@ -1,0 +1,66 @@
+"""Fulu fork upgrade: electra state -> fulu state — proposer lookahead
+initialization (EIP-7917)
+(parity: `test/fulu/fork/test_fulu_fork_basic.py`)."""
+
+from consensus_specs_tpu.models.builder import build_spec
+from consensus_specs_tpu.testlib.context import (
+    FULU,
+    spec_state_test,
+    with_phases,
+)
+from consensus_specs_tpu.testlib.helpers.genesis import create_genesis_state
+from consensus_specs_tpu.testlib.helpers.state import next_epoch
+
+
+def _electra_state_for(spec, state):
+    pre_spec = build_spec("electra", spec.preset_name)
+    balances = [int(b) for b in state.balances]
+    return pre_spec, create_genesis_state(
+        pre_spec, balances, pre_spec.MIN_ACTIVATION_BALANCE)
+
+
+def _check_upgrade(spec, pre, post):
+    assert post.fork.previous_version == pre.fork.current_version
+    assert post.fork.current_version == spec.config.FULU_FORK_VERSION
+    assert len(post.validators) == len(pre.validators)
+    # EIP-7917: the lookahead vector is fully populated with valid
+    # proposer indices
+    lookahead = list(post.proposer_lookahead)
+    assert len(lookahead) == int(
+        (spec.MIN_SEED_LOOKAHEAD + 1) * spec.SLOTS_PER_EPOCH)
+    assert all(0 <= int(i) < len(post.validators) for i in lookahead)
+
+
+@with_phases([FULU])
+@spec_state_test
+def test_fork_base_state(spec, state):
+    pre_spec, pre = _electra_state_for(spec, state)
+    yield "pre", pre
+    post = spec.upgrade_to_fulu(pre)
+    yield "post", post
+    _check_upgrade(spec, pre, post)
+
+
+@with_phases([FULU])
+@spec_state_test
+def test_fork_next_epoch(spec, state):
+    pre_spec, pre = _electra_state_for(spec, state)
+    next_epoch(pre_spec, pre)
+    yield "pre", pre
+    post = spec.upgrade_to_fulu(pre)
+    yield "post", post
+    _check_upgrade(spec, pre, post)
+
+
+@with_phases([FULU])
+@spec_state_test
+def test_fork_lookahead_matches_computation(spec, state):
+    """The upgrade's lookahead equals recomputing it on the post
+    state."""
+    pre_spec, pre = _electra_state_for(spec, state)
+    next_epoch(pre_spec, pre)
+    yield "pre", pre
+    post = spec.upgrade_to_fulu(pre)
+    yield "post", post
+    assert list(post.proposer_lookahead) == \
+        list(spec.initialize_proposer_lookahead(post))

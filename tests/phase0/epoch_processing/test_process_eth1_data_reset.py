@@ -1,0 +1,41 @@
+"""phase0: process_eth1_data_reset — votes clear at voting-period
+boundaries (scenario parity:
+`test/phase0/epoch_processing/test_process_eth1_data_reset.py`)."""
+
+from consensus_specs_tpu.testlib.context import (
+    spec_state_test,
+    with_all_phases,
+)
+from consensus_specs_tpu.testlib.helpers.epoch_processing import (
+    run_epoch_processing_with,
+)
+from consensus_specs_tpu.testlib.helpers.state import next_epoch
+
+
+@with_all_phases
+@spec_state_test
+def test_eth1_vote_no_reset(spec, state):
+    assert spec.EPOCHS_PER_ETH1_VOTING_PERIOD > 1
+    # skip ahead to the second epoch of the voting period
+    next_epoch(spec, state)
+    for i in range(int(spec.SLOTS_PER_EPOCH)):
+        state.eth1_data_votes.append(spec.Eth1Data(deposit_count=i))
+
+    yield from run_epoch_processing_with(spec, state,
+                                         "process_eth1_data_reset")
+    # mid-period: the accumulated votes survive
+    assert len(state.eth1_data_votes) == int(spec.SLOTS_PER_EPOCH)
+
+
+@with_all_phases
+@spec_state_test
+def test_eth1_vote_reset(spec, state):
+    # move to the last epoch of a voting period
+    for _ in range(int(spec.EPOCHS_PER_ETH1_VOTING_PERIOD) - 1):
+        next_epoch(spec, state)
+    for i in range(int(spec.SLOTS_PER_EPOCH)):
+        state.eth1_data_votes.append(spec.Eth1Data(deposit_count=i))
+
+    yield from run_epoch_processing_with(spec, state,
+                                         "process_eth1_data_reset")
+    assert len(state.eth1_data_votes) == 0

@@ -1,0 +1,42 @@
+"""phase0: process_historical_roots_update — batch accumulator appends at
+SLOTS_PER_HISTORICAL_ROOT boundaries (scenario parity:
+`test/phase0/epoch_processing/test_process_historical_roots_update.py`).
+Pre-capella only: capella+ replaces this with historical summaries."""
+
+from consensus_specs_tpu.testlib.context import (
+    spec_state_test,
+    with_phases,
+)
+from consensus_specs_tpu.testlib.helpers.epoch_processing import (
+    run_epoch_processing_with,
+)
+
+PRE_CAPELLA = ["phase0", "altair", "bellatrix"]
+
+
+@with_phases(PRE_CAPELLA)
+@spec_state_test
+def test_historical_root_accumulator(spec, state):
+    # advance to the epoch before a historical-batch boundary
+    state.slot = spec.SLOTS_PER_HISTORICAL_ROOT - spec.SLOTS_PER_EPOCH
+    history_len = len(state.historical_roots)
+
+    yield from run_epoch_processing_with(
+        spec, state, "process_historical_roots_update")
+    assert len(state.historical_roots) == history_len + 1
+    batch = spec.HistoricalBatch(
+        block_roots=state.block_roots,
+        state_roots=state.state_roots,
+    )
+    assert state.historical_roots[
+        len(state.historical_roots) - 1] == spec.hash_tree_root(batch)
+
+
+@with_phases(PRE_CAPELLA)
+@spec_state_test
+def test_no_op_mid_period(spec, state):
+    # not at a boundary: nothing appends
+    history_len = len(state.historical_roots)
+    yield from run_epoch_processing_with(
+        spec, state, "process_historical_roots_update")
+    assert len(state.historical_roots) == history_len

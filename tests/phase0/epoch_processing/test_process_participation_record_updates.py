@@ -1,0 +1,50 @@
+"""phase0: process_participation_record_updates — pending attestation
+rotation (scenario parity:
+`test/phase0/epoch_processing/test_process_participation_record_updates.py`).
+phase0 only: altair+ replaces records with participation flags."""
+
+from consensus_specs_tpu.testlib.context import (
+    spec_state_test,
+    with_phases,
+)
+from consensus_specs_tpu.testlib.helpers.epoch_processing import (
+    run_epoch_processing_with,
+)
+from consensus_specs_tpu.testlib.helpers.state import next_epoch
+
+
+def _mock_pending(spec, state, slot, epoch):
+    committee = spec.get_beacon_committee(state, spec.Slot(slot), 0)
+    return spec.PendingAttestation(
+        aggregation_bits=[False] * len(committee),
+        data=spec.AttestationData(
+            slot=slot,
+            target=spec.Checkpoint(epoch=epoch)),
+        inclusion_delay=1)
+
+
+def _add_mock_attestations(spec, state):
+    prev_slot = state.slot - spec.SLOTS_PER_EPOCH
+    for _ in range(2):
+        state.previous_epoch_attestations.append(_mock_pending(
+            spec, state, prev_slot, spec.get_previous_epoch(state)))
+    for _ in range(3):
+        state.current_epoch_attestations.append(_mock_pending(
+            spec, state, state.slot - 1,
+            spec.get_current_epoch(state)))
+
+
+@with_phases(["phase0"])
+@spec_state_test
+def test_updated_participation_record(spec, state):
+    next_epoch(spec, state)  # a previous epoch must exist
+    _add_mock_attestations(spec, state)
+    current = [spec.hash_tree_root(a)
+               for a in state.current_epoch_attestations]
+
+    yield from run_epoch_processing_with(
+        spec, state, "process_participation_record_updates")
+    # current rotates into previous; current clears
+    assert [spec.hash_tree_root(a)
+            for a in state.previous_epoch_attestations] == current
+    assert len(state.current_epoch_attestations) == 0

@@ -1,0 +1,25 @@
+"""phase0: process_randao_mixes_reset — next epoch's mix seeds from the
+current one (scenario parity:
+`test/phase0/epoch_processing/test_process_randao_mixes_reset.py`)."""
+
+from consensus_specs_tpu.testlib.context import (
+    spec_state_test,
+    with_all_phases,
+)
+from consensus_specs_tpu.testlib.helpers.epoch_processing import (
+    run_epoch_processing_with,
+)
+
+
+@with_all_phases
+@spec_state_test
+def test_updated_randao_mixes(spec, state):
+    next_epoch = spec.get_current_epoch(state) + 1
+    state.randao_mixes[next_epoch % spec.EPOCHS_PER_HISTORICAL_VECTOR] = \
+        b"\x56" * 32
+
+    yield from run_epoch_processing_with(spec, state,
+                                         "process_randao_mixes_reset")
+    assert state.randao_mixes[
+        next_epoch % spec.EPOCHS_PER_HISTORICAL_VECTOR] == \
+        spec.get_randao_mix(state, spec.get_current_epoch(state))

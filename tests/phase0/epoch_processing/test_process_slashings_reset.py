@@ -1,0 +1,26 @@
+"""phase0: process_slashings_reset — the circular slashings accumulator
+clears its next-epoch slot (scenario parity:
+`test/phase0/epoch_processing/test_process_slashings_reset.py`)."""
+
+from consensus_specs_tpu.testlib.context import (
+    spec_state_test,
+    with_all_phases,
+)
+from consensus_specs_tpu.testlib.helpers.epoch_processing import (
+    run_epoch_processing_with,
+)
+
+
+@with_all_phases
+@spec_state_test
+def test_flush_slashings(spec, state):
+    next_epoch = spec.get_current_epoch(state) + 1
+    state.slashings[next_epoch % spec.EPOCHS_PER_SLASHINGS_VECTOR] = \
+        spec.Gwei(100)
+    assert state.slashings[
+        next_epoch % spec.EPOCHS_PER_SLASHINGS_VECTOR] != 0
+
+    yield from run_epoch_processing_with(spec, state,
+                                         "process_slashings_reset")
+    assert state.slashings[
+        next_epoch % spec.EPOCHS_PER_SLASHINGS_VECTOR] == 0

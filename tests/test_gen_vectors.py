@@ -204,3 +204,32 @@ def test_kzg_7594_vector_tree(tmp_path):
     invalid = yaml.safe_load(
         (base / "compute_cells_case_invalid_blob_0/data.yaml").read_text())
     assert invalid["output"] is None
+
+
+def test_random_scenario_vector_replays(tmp_path):
+    """A randomized-scenario vector must replay: pre + blocks -> post
+    (pins the DSL's contract that "pre" captures the post-setup state)."""
+    from consensus_specs_tpu.gen.runners import random as random_runner
+
+    # every fork's random module is offered to every target fork; only
+    # the altair-gated module emits for fork=altair
+    cases = [tc for tc in random_runner.get_test_cases()
+             if tc.preset_name == "minimal" and tc.fork_name == "altair"
+             and tc.case_name == "random_next_epoch_random_block"]
+    assert cases
+    rc = run_generator(cases, _args(tmp_path))
+    assert rc == 0
+
+    case = (tmp_path / "minimal/altair/random/random/pyspec_tests"
+            / "random_next_epoch_random_block")
+    spec = build_spec("altair", "minimal")
+    state = spec.BeaconState.decode_bytes(
+        decompress((case / "pre.ssz_snappy").read_bytes()))
+    post = spec.BeaconState.decode_bytes(
+        decompress((case / "post.ssz_snappy").read_bytes()))
+    meta = yaml.safe_load((case / "meta.yaml").read_text())
+    for i in range(meta["blocks_count"]):
+        block = spec.SignedBeaconBlock.decode_bytes(
+            decompress((case / f"blocks_{i}.ssz_snappy").read_bytes()))
+        spec.state_transition(state, block, validate_result=False)
+    assert hash_tree_root(state) == hash_tree_root(post)
