@@ -56,6 +56,7 @@ PREVIOUS_FORK_OF: dict[str, str | None] = {
     "fulu": "electra",
     # feature forks (specs/_features/)
     "eip7732": "electra",
+    "eip7805": "electra",
 }
 
 # Mainline forks only — the default phase list for tests and generators;
@@ -64,7 +65,7 @@ PREVIOUS_FORK_OF: dict[str, str | None] = {
 # `test/helpers/constants.py`).
 ALL_FORKS = ["phase0", "altair", "bellatrix", "capella", "deneb",
              "electra", "fulu"]
-FEATURE_FORKS = ["eip7732"]
+FEATURE_FORKS = ["eip7732", "eip7805"]
 BUILDABLE_FORKS = ALL_FORKS + FEATURE_FORKS
 
 # source files per fork, executed in order; later forks only list their own
@@ -87,6 +88,8 @@ SPEC_SOURCES: dict[str, list[str]] = {
              "beacon_chain.py", "fork.py", "fork_choice.py", "p2p.py",
              "validator.py"],
     "eip7732": ["beacon_chain.py", "fork.py", "validator.py", "p2p.py"],
+    "eip7805": ["beacon_chain.py", "fork.py", "fork_choice.py",
+                "validator.py", "p2p.py"],
 }
 
 

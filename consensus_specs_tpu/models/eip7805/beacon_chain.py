@@ -1,0 +1,204 @@
+# EIP-7805 (FOCIL) -- The Beacon Chain (executable spec source, delta
+# over electra).
+#
+# Fork-choice enforced, committee-based inclusion lists: a 16-member
+# per-slot Inclusion List Committee signs transaction lists the next
+# payload must honor.  Parity contract:
+# specs/_features/eip7805/beacon-chain.md (constants :41-57,
+# containers :59-80, predicates :82-100, accessors :102-117,
+# engine :119-273).
+
+DOMAIN_INCLUSION_LIST_COMMITTEE = DomainType("0x0C000000")
+
+
+class InclusionList(Container):
+    slot: Slot
+    validator_index: ValidatorIndex
+    inclusion_list_committee_root: Root
+    transactions: List[Transaction, MAX_TRANSACTIONS_PER_PAYLOAD]
+
+
+class SignedInclusionList(Container):
+    message: InclusionList
+    signature: BLSSignature
+
+
+def is_valid_inclusion_list_signature(
+        state: BeaconState,
+        signed_inclusion_list: SignedInclusionList) -> bool:
+    """Check if ``signed_inclusion_list`` has a valid signature."""
+    message = signed_inclusion_list.message
+    index = message.validator_index
+    pubkey = state.validators[index].pubkey
+    domain = get_domain(state, DOMAIN_INCLUSION_LIST_COMMITTEE,
+                        compute_epoch_at_slot(message.slot))
+    signing_root = compute_signing_root(message, domain)
+    return bls.Verify(pubkey, signing_root,
+                      signed_inclusion_list.signature)
+
+
+def get_inclusion_list_committee(state: BeaconState, slot: Slot):
+    """The slot's 16-member ILC, sampled from the shuffled active set."""
+    epoch = compute_epoch_at_slot(slot)
+    seed = get_seed(state, epoch, DOMAIN_INCLUSION_LIST_COMMITTEE)
+    indices = get_active_validator_indices(state, epoch)
+    start = (slot % SLOTS_PER_EPOCH) * INCLUSION_LIST_COMMITTEE_SIZE
+    end = start + INCLUSION_LIST_COMMITTEE_SIZE
+    return [
+        indices[compute_shuffled_index(
+            uint64(i % len(indices)), uint64(len(indices)), seed)]
+        for i in range(start, end)
+    ]
+
+
+# ---------------------------------------------------------------------------
+# Execution engine (beacon-chain.md :119-273)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class NewPayloadRequest(object):
+    execution_payload: ExecutionPayload
+    versioned_hashes: Sequence[VersionedHash]
+    parent_beacon_block_root: Root
+    execution_requests: ExecutionRequests
+    # [New in EIP-7805]
+    inclusion_list_transactions: Sequence[Transaction] = ()
+
+
+class ExecutionEngine:
+    """EL protocol, extended with inclusion-list awareness."""
+
+    def notify_new_payload(self, execution_payload: ExecutionPayload,
+                           parent_beacon_block_root: Root,
+                           execution_requests_list: Sequence[bytes],
+                           inclusion_list_transactions) -> bool:
+        """[Modified in EIP7805] also receives the aggregated inclusion
+        list transactions; an unsatisfying payload is cached in
+        `store.unsatisfied_inclusion_list_blocks`."""
+        ...
+
+    def is_valid_block_hash(self, execution_payload: ExecutionPayload,
+                            parent_beacon_block_root: Root,
+                            execution_requests_list: Sequence[bytes],
+                            inclusion_list_transactions) -> bool:
+        ...
+
+    def is_valid_versioned_hashes(self, new_payload_request) -> bool:
+        ...
+
+    def verify_and_notify_new_payload(self, new_payload_request) -> bool:
+        ...
+
+    def notify_forkchoice_updated(self, head_block_hash, safe_block_hash,
+                                  finalized_block_hash,
+                                  payload_attributes):
+        ...
+
+
+def verify_and_notify_new_payload(self: ExecutionEngine,
+                                  new_payload_request) -> bool:
+    """[Modified in EIP7805] threads inclusion_list_transactions through
+    to notify_new_payload."""
+    execution_payload = new_payload_request.execution_payload
+    parent_beacon_block_root = new_payload_request.parent_beacon_block_root
+    execution_requests_list = get_execution_requests_list(
+        new_payload_request.execution_requests)
+    # [New in EIP-7805]
+    inclusion_list_transactions = \
+        new_payload_request.inclusion_list_transactions
+
+    if b"" in execution_payload.transactions:
+        return False
+    if not self.is_valid_block_hash(
+            execution_payload, parent_beacon_block_root,
+            execution_requests_list, inclusion_list_transactions):
+        return False
+    if not self.is_valid_versioned_hashes(new_payload_request):
+        return False
+    # [Modified in EIP-7805]
+    if not self.notify_new_payload(
+            execution_payload, parent_beacon_block_root,
+            execution_requests_list, inclusion_list_transactions):
+        return False
+    return True
+
+
+class NoopExecutionEngine(ExecutionEngine):
+    """Accept-everything EL stub with the FOCIL-extended signatures."""
+
+    def notify_new_payload(self, execution_payload,
+                           parent_beacon_block_root,
+                           execution_requests_list,
+                           inclusion_list_transactions) -> bool:
+        return True
+
+    def notify_forkchoice_updated(self, head_block_hash, safe_block_hash,
+                                  finalized_block_hash,
+                                  payload_attributes):
+        pass
+
+    def get_payload(self, payload_id):
+        raise NotImplementedError("no default block production")
+
+    def is_valid_block_hash(self, execution_payload,
+                            parent_beacon_block_root,
+                            execution_requests_list,
+                            inclusion_list_transactions) -> bool:
+        return True
+
+    def is_valid_versioned_hashes(self, new_payload_request) -> bool:
+        return True
+
+    def verify_and_notify_new_payload(self, new_payload_request) -> bool:
+        return True
+
+
+EXECUTION_ENGINE = NoopExecutionEngine()
+
+
+def process_execution_payload(state: BeaconState, body: BeaconBlockBody,
+                              execution_engine: ExecutionEngine) -> None:
+    """[Modified in EIP7805] the new-payload request carries the slot's
+    aggregated inclusion-list transactions."""
+    payload = body.execution_payload
+
+    assert (payload.parent_hash
+            == state.latest_execution_payload_header.block_hash)
+    assert payload.prev_randao == get_randao_mix(
+        state, get_current_epoch(state))
+    assert payload.timestamp == compute_time_at_slot(state, state.slot)
+    assert (len(body.blob_kzg_commitments)
+            <= config.MAX_BLOBS_PER_BLOCK_ELECTRA)
+    versioned_hashes = [kzg_commitment_to_versioned_hash(commitment)
+                       for commitment in body.blob_kzg_commitments]
+    # the spec leaves sourcing these to the fork-choice/engine plumbing
+    inclusion_list_transactions = []
+    assert execution_engine.verify_and_notify_new_payload(
+        NewPayloadRequest(
+            execution_payload=payload,
+            versioned_hashes=versioned_hashes,
+            parent_beacon_block_root=state.latest_block_header.parent_root,
+            execution_requests=body.execution_requests,
+            # [New in EIP-7805]
+            inclusion_list_transactions=inclusion_list_transactions,
+        ))
+    state.latest_execution_payload_header = ExecutionPayloadHeader(
+        parent_hash=payload.parent_hash,
+        fee_recipient=payload.fee_recipient,
+        state_root=payload.state_root,
+        receipts_root=payload.receipts_root,
+        logs_bloom=payload.logs_bloom,
+        prev_randao=payload.prev_randao,
+        block_number=payload.block_number,
+        gas_limit=payload.gas_limit,
+        gas_used=payload.gas_used,
+        timestamp=payload.timestamp,
+        extra_data=payload.extra_data,
+        base_fee_per_gas=payload.base_fee_per_gas,
+        block_hash=payload.block_hash,
+        transactions_root=hash_tree_root(payload.transactions),
+        withdrawals_root=hash_tree_root(payload.withdrawals),
+        blob_gas_used=payload.blob_gas_used,
+        excess_blob_gas=payload.excess_blob_gas,
+    )

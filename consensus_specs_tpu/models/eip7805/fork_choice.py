@@ -1,0 +1,214 @@
+# EIP-7805 (FOCIL) -- Fork Choice (executable spec source, delta over
+# electra's store).  Parity contract:
+# specs/_features/eip7805/fork-choice.md (store :36-90,
+# validation :96-117, heads :119-186, on_inclusion_list :194-249).
+
+VIEW_FREEZE_DEADLINE = uint64(
+    int(config.SECONDS_PER_SLOT) * 2 // 3 + 1)  # seconds
+
+
+@dataclass
+class Store(object):
+    """[Modified in EIP7805] tracks seen inclusion lists, inclusion-list
+    equivocators, and payloads that failed inclusion-list checks."""
+    time: uint64
+    genesis_time: uint64
+    justified_checkpoint: Checkpoint
+    finalized_checkpoint: Checkpoint
+    unrealized_justified_checkpoint: Checkpoint
+    unrealized_finalized_checkpoint: Checkpoint
+    proposer_boost_root: Root
+    equivocating_indices: Set[ValidatorIndex]
+    blocks: Dict[Root, BeaconBlock] = field(default_factory=dict)
+    block_states: Dict[Root, BeaconState] = field(default_factory=dict)
+    block_timeliness: Dict[Root, bool] = field(default_factory=dict)
+    checkpoint_states: Dict[Checkpoint, BeaconState] = field(
+        default_factory=dict)
+    latest_messages: Dict[ValidatorIndex, LatestMessage] = field(
+        default_factory=dict)
+    unrealized_justifications: Dict[Root, Checkpoint] = field(
+        default_factory=dict)
+    # [New in EIP-7805]
+    inclusion_lists: Dict[Tuple[Slot, Root], Set] = field(
+        default_factory=dict)
+    inclusion_list_equivocators: Dict[Tuple[Slot, Root],
+                                      Set[ValidatorIndex]] = field(
+        default_factory=dict)
+    unsatisfied_inclusion_list_blocks: Set[Root] = field(
+        default_factory=set)
+
+
+def get_forkchoice_store(anchor_state: BeaconState,
+                         anchor_block: BeaconBlock) -> Store:
+    assert anchor_block.state_root == hash_tree_root(anchor_state)
+    anchor_root = hash_tree_root(anchor_block)
+    anchor_epoch = get_current_epoch(anchor_state)
+    justified_checkpoint = Checkpoint(epoch=anchor_epoch,
+                                      root=anchor_root)
+    finalized_checkpoint = Checkpoint(epoch=anchor_epoch,
+                                      root=anchor_root)
+    return Store(
+        time=uint64(anchor_state.genesis_time
+                    + config.SECONDS_PER_SLOT * anchor_state.slot),
+        genesis_time=anchor_state.genesis_time,
+        justified_checkpoint=justified_checkpoint,
+        finalized_checkpoint=finalized_checkpoint,
+        unrealized_justified_checkpoint=justified_checkpoint,
+        unrealized_finalized_checkpoint=finalized_checkpoint,
+        proposer_boost_root=Root(),
+        equivocating_indices=set(),
+        blocks={anchor_root: copy(anchor_block)},
+        block_states={anchor_root: copy(anchor_state)},
+        checkpoint_states={justified_checkpoint: copy(anchor_state)},
+        unrealized_justifications={anchor_root: justified_checkpoint},
+        # [New in EIP-7805]
+        unsatisfied_inclusion_list_blocks=set(),
+    )
+
+
+def get_inclusion_list_store_key(message: InclusionList):
+    return (message.slot, message.inclusion_list_committee_root)
+
+
+def validate_inclusion_lists(_store: Store, inclusion_list_transactions,
+                             execution_payload: ExecutionPayload) -> bool:
+    """True when the payload satisfies the inclusion lists: every
+    transaction present (the remaining exemptions — invalid-on-append,
+    full block — are EL-side checks and accepted here)."""
+    return all(tx in execution_payload.transactions
+               for tx in inclusion_list_transactions)
+
+
+def process_inclusion_list_satisfaction(store: Store, block_root: Root,
+                                        execution_payload) -> None:
+    """Record an imported block whose payload fails its slot's
+    aggregated inclusion lists — feeds the `get_attester_head` /
+    `get_proposer_head` overrides (the role the reference leaves to
+    `notify_new_payload`'s store side-channel)."""
+    block = store.blocks[block_root]
+    state = store.block_states[block_root]
+    # the payload must satisfy the lists the previous slot's ILC froze
+    il_slot = Slot(int(block.slot) - 1)
+    committee = get_inclusion_list_committee(state, il_slot)
+    committee_root = hash_tree_root(
+        List[ValidatorIndex, INCLUSION_LIST_COMMITTEE_SIZE](*committee))
+    transactions = get_inclusion_list_transactions(
+        store, il_slot, committee_root)
+    if not validate_inclusion_lists(store, transactions,
+                                    execution_payload):
+        store.unsatisfied_inclusion_list_blocks.add(block_root)
+
+
+def get_attester_head(store: Store, head_root: Root) -> Root:
+    """[New in EIP7805] attesters vote for the parent of a head whose
+    payload did not satisfy the inclusion lists."""
+    head_block = store.blocks[head_root]
+    if head_root in store.unsatisfied_inclusion_list_blocks:
+        return head_block.parent_root
+    return head_root
+
+
+def get_proposer_head(store: Store, head_root: Root, slot: Slot) -> Root:
+    """[Modified in EIP7805] also re-orgs heads that failed their
+    inclusion lists."""
+    head_block = store.blocks[head_root]
+    parent_root = head_block.parent_root
+    parent_block = store.blocks[parent_root]
+
+    head_late = is_head_late(store, head_root)
+    shuffling_stable = is_shuffling_stable(slot)
+    ffg_competitive = is_ffg_competitive(store, head_root, parent_root)
+    finalization_ok = is_finalization_ok(store, slot)
+    proposing_on_time = is_proposing_on_time(store)
+
+    parent_slot_ok = parent_block.slot + 1 == head_block.slot
+    current_time_ok = head_block.slot + 1 == slot
+    single_slot_reorg = parent_slot_ok and current_time_ok
+
+    assert store.proposer_boost_root != head_root  # boost has worn off
+    head_weak = is_head_weak(store, head_root)
+    parent_strong = is_parent_strong(store, parent_root)
+
+    reorg_prerequisites = all([
+        shuffling_stable, ffg_competitive, finalization_ok,
+        proposing_on_time, single_slot_reorg, head_weak, parent_strong,
+    ])
+
+    # [New in EIP-7805]
+    inclusion_list_not_satisfied = (
+        head_root in store.unsatisfied_inclusion_list_blocks)
+
+    if reorg_prerequisites and (head_late
+                                or inclusion_list_not_satisfied):
+        return parent_root
+    return head_root
+
+
+def on_inclusion_list(store: Store, state: BeaconState,
+                      signed_inclusion_list: SignedInclusionList,
+                      inclusion_list_committee) -> None:
+    """Verify and import an inclusion list; a second, different list
+    from the same (slot, validator) marks the validator an
+    equivocator."""
+    message = signed_inclusion_list.message
+
+    # current or previous slot only
+    assert get_current_slot(store) in (message.slot, message.slot + 1)
+
+    time_into_slot = ((store.time - store.genesis_time)
+                      % config.SECONDS_PER_SLOT)
+    is_before_attesting_interval = (
+        time_into_slot < config.SECONDS_PER_SLOT // INTERVALS_PER_SLOT)
+    # previous-slot lists are ignored past the attestation deadline
+    if get_current_slot(store) == message.slot + 1:
+        assert is_before_attesting_interval
+
+    root = message.inclusion_list_committee_root
+    assert hash_tree_root(
+        List[ValidatorIndex, INCLUSION_LIST_COMMITTEE_SIZE](
+            *inclusion_list_committee)) == root
+
+    validator_index = message.validator_index
+    assert validator_index in inclusion_list_committee
+
+    assert is_valid_inclusion_list_signature(state, signed_inclusion_list)
+
+    is_before_freeze_deadline = (
+        get_current_slot(store) == message.slot
+        and time_into_slot < VIEW_FREEZE_DEADLINE)
+
+    key = get_inclusion_list_store_key(message)
+    store.inclusion_lists.setdefault(key, set())
+    store.inclusion_list_equivocators.setdefault(key, set())
+
+    # ignore known equivocators
+    if validator_index in store.inclusion_list_equivocators[key]:
+        return
+    existing = [il for il in store.inclusion_lists[key]
+                if il.validator_index == validator_index]
+    if existing:
+        if existing[0] != message:
+            # equivocation evidence
+            store.inclusion_list_equivocators[key].add(validator_index)
+    elif is_before_freeze_deadline:
+        store.inclusion_lists[key].add(message)
+
+
+def get_inclusion_list_transactions(store: Store, slot: Slot,
+                                    committee_root: Root):
+    """Deduplicated union of transactions across the slot's stored
+    inclusion lists (the aggregate the next payload must satisfy)."""
+    key = (slot, committee_root)
+    equivocators = store.inclusion_list_equivocators.get(key, set())
+    out = []
+    seen = set()
+    for il in sorted(store.inclusion_lists.get(key, set()),
+                     key=lambda il: int(il.validator_index)):
+        if il.validator_index in equivocators:
+            continue  # equivocators cannot constrain the payload
+        for tx in il.transactions:
+            marker = bytes(tx)
+            if marker not in seen:
+                seen.add(marker)
+                out.append(tx)
+    return out

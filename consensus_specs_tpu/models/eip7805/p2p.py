@@ -1,0 +1,25 @@
+# EIP-7805 (FOCIL) -- p2p delta: the new `inclusion_list` global gossip
+# topic (specs/_features/eip7805/p2p-interface.md :44-70).
+
+
+def is_valid_inclusion_list_gossip(
+        state: BeaconState,
+        signed_inclusion_list: SignedInclusionList,
+        current_slot: Slot) -> bool:
+    """REJECT conditions for the `inclusion_list` topic: transactions
+    byte-size bound, current/previous slot, committee membership, valid
+    signature."""
+    message = signed_inclusion_list.message
+    if (sum(len(tx) for tx in message.transactions)
+            > config.MAX_BYTES_PER_INCLUSION_LIST):
+        return False
+    if message.slot not in (current_slot, current_slot - 1):
+        return False
+    committee = get_inclusion_list_committee(state, message.slot)
+    if message.inclusion_list_committee_root != hash_tree_root(
+            List[ValidatorIndex, INCLUSION_LIST_COMMITTEE_SIZE](
+                *committee)):
+        return False
+    if message.validator_index not in committee:
+        return False
+    return is_valid_inclusion_list_signature(state, signed_inclusion_list)

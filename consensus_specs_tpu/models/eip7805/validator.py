@@ -1,0 +1,54 @@
+# EIP-7805 (FOCIL) -- Honest Validator duties (executable spec source).
+# Parity contract: specs/_features/eip7805/validator.md (assignment
+# :71-96, signatures :138-150, sync message :159-177).
+
+PROPOSER_INCLUSION_LIST_CUT_OFF = uint64(
+    int(config.SECONDS_PER_SLOT) - 1)  # seconds
+
+
+def get_inclusion_committee_assignment(
+        state: BeaconState, epoch: Epoch,
+        validator_index: ValidatorIndex):
+    """The slot in `epoch` where `validator_index` sits on the ILC, or
+    None (validator.md `get_inclusion_committee_assignment`)."""
+    next_epoch = Epoch(get_current_epoch(state) + 1)
+    assert epoch <= next_epoch
+
+    start_slot = compute_start_slot_at_epoch(epoch)
+    for slot in range(start_slot, start_slot + SLOTS_PER_EPOCH):
+        if validator_index in get_inclusion_list_committee(state,
+                                                          Slot(slot)):
+            return Slot(slot)
+    return None
+
+
+def get_inclusion_list_signature(state: BeaconState,
+                                 inclusion_list: InclusionList,
+                                 privkey: int) -> BLSSignature:
+    domain = get_domain(state, DOMAIN_INCLUSION_LIST_COMMITTEE,
+                        compute_epoch_at_slot(inclusion_list.slot))
+    signing_root = compute_signing_root(inclusion_list, domain)
+    return bls.Sign(privkey, signing_root)
+
+
+def get_sync_committee_message(state: BeaconState, block_root: Root,
+                               validator_index: ValidatorIndex,
+                               privkey: int, store) -> SyncCommitteeMessage:
+    """[Modified in EIP7805] sync messages vote for the attester head
+    (skipping inclusion-list-unsatisfied blocks).
+
+    The substitution happens BEFORE signing so the signature covers the
+    root the message carries.  (The upstream draft's literal text signs
+    the pre-substitution root, which no verifier could accept — an
+    acknowledged editorial slip in the WIP spec.)"""
+    attester_head = get_attester_head(store, block_root)
+    epoch = get_current_epoch(state)
+    domain = get_domain(state, DOMAIN_SYNC_COMMITTEE, epoch)
+    signing_root = compute_signing_root(attester_head, domain)
+    signature = bls.Sign(privkey, signing_root)
+    return SyncCommitteeMessage(
+        slot=state.slot,
+        beacon_block_root=attester_head,
+        validator_index=validator_index,
+        signature=signature,
+    )

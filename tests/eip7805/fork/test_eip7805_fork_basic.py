@@ -1,0 +1,27 @@
+"""EIP-7805 fork: `upgrade_to_eip7805` from electra — a pure version
+bump (specs/_features/eip7805/fork.md)."""
+
+from consensus_specs_tpu.models.builder import build_spec
+from consensus_specs_tpu.testlib.context import (
+    ELECTRA,
+    spec_state_test,
+    with_phases,
+)
+
+
+@with_phases([ELECTRA])
+@spec_state_test
+def test_fork_base_state(spec, state):
+    post_spec = build_spec("eip7805", spec.preset_name)
+    post = post_spec.upgrade_to_eip7805(state)
+    yield "pre", state
+    yield "post", post
+
+    assert post.fork.previous_version == state.fork.current_version
+    assert post.fork.current_version == \
+        post_spec.config.EIP7805_FORK_VERSION
+    # the state shape is unchanged: everything else carries over
+    assert post.latest_execution_payload_header == \
+        state.latest_execution_payload_header
+    assert len(post.validators) == len(state.validators)
+    assert list(post.balances) == list(state.balances)

@@ -1,0 +1,187 @@
+"""EIP-7805: `on_inclusion_list` store handler — import, equivocation
+detection, freeze deadline, attester/proposer head overrides
+(specs/_features/eip7805/fork-choice.md :96-249)."""
+
+from consensus_specs_tpu.testlib.context import (
+    spec_state_test,
+    with_phases,
+)
+from consensus_specs_tpu.testlib.helpers.fork_choice import (
+    get_genesis_forkchoice_store,
+)
+from consensus_specs_tpu.testlib.helpers.keys import privkeys
+from consensus_specs_tpu.testlib.utils import expect_assertion_error
+
+EIP7805 = "eip7805"
+
+
+def _signed_il(spec, state, member, transactions):
+    committee = spec.get_inclusion_list_committee(state, state.slot)
+    message = spec.InclusionList(
+        slot=state.slot,
+        validator_index=member,
+        inclusion_list_committee_root=spec.hash_tree_root(
+            spec.List[spec.ValidatorIndex,
+                      spec.INCLUSION_LIST_COMMITTEE_SIZE](*committee)),
+        transactions=list(transactions),
+    )
+    signature = spec.get_inclusion_list_signature(
+        state, message, privkeys[member])
+    return (spec.SignedInclusionList(message=message,
+                                     signature=signature), committee)
+
+
+@with_phases([EIP7805])
+@spec_state_test
+def test_on_inclusion_list_accepts_and_stores(spec, state):
+    store = get_genesis_forkchoice_store(spec, state)
+    signed, committee = _signed_il(spec, state, committee_member(spec, state, 0),
+                                   [b"\x01" * 20])
+    spec.on_inclusion_list(store, state, signed, committee)
+    key = (signed.message.slot,
+           signed.message.inclusion_list_committee_root)
+    assert signed.message in store.inclusion_lists[key]
+    # aggregation: the stored list's transactions surface
+    txs = spec.get_inclusion_list_transactions(
+        store, signed.message.slot,
+        signed.message.inclusion_list_committee_root)
+    assert [bytes(t) for t in txs] == [b"\x01" * 20]
+    yield "pre", state
+    yield "post", None
+
+
+def committee_member(spec, state, i):
+    return spec.get_inclusion_list_committee(state, state.slot)[i]
+
+
+@with_phases([EIP7805])
+@spec_state_test
+def test_on_inclusion_list_equivocation_detected(spec, state):
+    store = get_genesis_forkchoice_store(spec, state)
+    member = committee_member(spec, state, 0)
+    first, committee = _signed_il(spec, state, member, [b"\x01" * 20])
+    second, _ = _signed_il(spec, state, member, [b"\x02" * 20])
+    spec.on_inclusion_list(store, state, first, committee)
+    spec.on_inclusion_list(store, state, second, committee)
+    key = (first.message.slot,
+           first.message.inclusion_list_committee_root)
+    assert member in store.inclusion_list_equivocators[key]
+    # identical re-broadcast is NOT equivocation
+    store2 = get_genesis_forkchoice_store(spec, state)
+    spec.on_inclusion_list(store2, state, first, committee)
+    spec.on_inclusion_list(store2, state, first, committee)
+    assert member not in store2.inclusion_list_equivocators[key]
+    yield "pre", state
+    yield "post", None
+
+
+@with_phases([EIP7805])
+@spec_state_test
+def test_on_inclusion_list_rejects_non_member(spec, state):
+    store = get_genesis_forkchoice_store(spec, state)
+    committee = spec.get_inclusion_list_committee(state, state.slot)
+    outsider = next(i for i in range(len(state.validators))
+                    if i not in committee)
+    signed, _ = _signed_il(spec, state, outsider, [b"\x01" * 20])
+    expect_assertion_error(
+        lambda: spec.on_inclusion_list(store, state, signed, committee))
+    yield "pre", state
+    yield "post", None
+
+
+@with_phases([EIP7805])
+@spec_state_test
+def test_on_inclusion_list_rejects_stale_slot(spec, state):
+    store = get_genesis_forkchoice_store(spec, state)
+    signed, committee = _signed_il(spec, state,
+                                   committee_member(spec, state, 0),
+                                   [b"\x01" * 20])
+    # two slots later the list is out of the accept window
+    spec.on_tick(store, store.time + 2 * spec.config.SECONDS_PER_SLOT)
+    expect_assertion_error(
+        lambda: spec.on_inclusion_list(store, state, signed, committee))
+    yield "pre", state
+    yield "post", None
+
+
+@with_phases([EIP7805])
+@spec_state_test
+def test_attester_head_skips_unsatisfied_block(spec, state):
+    from consensus_specs_tpu.testlib.helpers.block import (
+        build_empty_block_for_next_slot,
+    )
+    from consensus_specs_tpu.testlib.helpers.fork_choice import (
+        tick_and_add_block,
+    )
+    from consensus_specs_tpu.testlib.helpers.state import (
+        state_transition_and_sign_block,
+    )
+
+    store = get_genesis_forkchoice_store(spec, state)
+    anchor_root = spec.get_head(store)
+    block = build_empty_block_for_next_slot(spec, state)
+    signed = state_transition_and_sign_block(spec, state, block)
+    test_steps = []
+    for _ in tick_and_add_block(spec, store, signed, test_steps):
+        pass
+    head = spec.get_head(store)
+    assert head == spec.hash_tree_root(block)
+    assert spec.get_attester_head(store, head) == head
+    # flag the head's payload as inclusion-list-unsatisfied
+    store.unsatisfied_inclusion_list_blocks.add(head)
+    assert spec.get_attester_head(store, head) == block.parent_root
+    assert spec.get_attester_head(store, head) == anchor_root
+    yield "pre", state
+    yield "post", None
+
+
+@with_phases([EIP7805])
+@spec_state_test
+def test_unsatisfied_payload_flagged_through_model_flow(spec, state):
+    """End-to-end: stored inclusion lists -> block import whose payload
+    omits the transactions -> process_inclusion_list_satisfaction flags
+    the block -> attester head reverts to the parent."""
+    from consensus_specs_tpu.testlib.helpers.block import (
+        build_empty_block_for_next_slot,
+    )
+    from consensus_specs_tpu.testlib.helpers.fork_choice import (
+        tick_and_add_block,
+    )
+    from consensus_specs_tpu.testlib.helpers.state import (
+        state_transition_and_sign_block,
+    )
+
+    store = get_genesis_forkchoice_store(spec, state)
+    anchor_root = spec.get_head(store)
+
+    # an ILC member freezes a list for the current slot
+    member = committee_member(spec, state, 0)
+    signed_il, committee = _signed_il(spec, state, member,
+                                      [b"\x99" * 24])
+    spec.on_inclusion_list(store, state, signed_il, committee)
+
+    # next slot's block carries an empty payload (misses the tx)
+    block = build_empty_block_for_next_slot(spec, state)
+    signed = state_transition_and_sign_block(spec, state, block)
+    test_steps = []
+    for _ in tick_and_add_block(spec, store, signed, test_steps):
+        pass
+    head = spec.get_head(store)
+    assert head == spec.hash_tree_root(block)
+
+    spec.process_inclusion_list_satisfaction(
+        store, head, block.body.execution_payload)
+    assert head in store.unsatisfied_inclusion_list_blocks
+    assert spec.get_attester_head(store, head) == anchor_root
+
+    # equivocators cannot constrain the payload: with the only list
+    # coming from an equivocator, a fresh identical block is satisfied
+    key = (signed_il.message.slot,
+           signed_il.message.inclusion_list_committee_root)
+    store.inclusion_list_equivocators[key].add(member)
+    store.unsatisfied_inclusion_list_blocks.discard(head)
+    spec.process_inclusion_list_satisfaction(
+        store, head, block.body.execution_payload)
+    assert head not in store.unsatisfied_inclusion_list_blocks
+    yield "pre", state
+    yield "post", None

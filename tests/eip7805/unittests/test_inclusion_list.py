@@ -1,0 +1,89 @@
+"""EIP-7805: inclusion-list committee sampling, signatures, and gossip
+conditions (specs/_features/eip7805/beacon-chain.md :82-117,
+p2p-interface.md :44-70)."""
+
+from consensus_specs_tpu.testlib.context import (
+    always_bls,
+    spec_state_test,
+    with_phases,
+)
+from consensus_specs_tpu.testlib.helpers.keys import privkeys
+
+EIP7805 = "eip7805"
+
+
+def _make_signed_inclusion_list(spec, state, slot=None, member=None,
+                                transactions=()):
+    if slot is None:
+        slot = state.slot
+    committee = spec.get_inclusion_list_committee(state, slot)
+    if member is None:
+        member = committee[0]
+    message = spec.InclusionList(
+        slot=slot,
+        validator_index=member,
+        inclusion_list_committee_root=spec.hash_tree_root(
+            spec.List[spec.ValidatorIndex,
+                      spec.INCLUSION_LIST_COMMITTEE_SIZE](*committee)),
+        transactions=list(transactions),
+    )
+    signature = spec.get_inclusion_list_signature(
+        state, message, privkeys[member])
+    return spec.SignedInclusionList(message=message, signature=signature), \
+        committee
+
+
+@with_phases([EIP7805])
+@spec_state_test
+def test_committee_size_and_membership(spec, state):
+    committee = spec.get_inclusion_list_committee(state, state.slot)
+    assert len(committee) == int(spec.INCLUSION_LIST_COMMITTEE_SIZE)
+    active = set(spec.get_active_validator_indices(
+        state, spec.get_current_epoch(state)))
+    assert all(int(i) in active for i in committee)
+    yield "pre", state
+    yield "post", None
+
+
+@with_phases([EIP7805])
+@spec_state_test
+def test_committee_rotates_by_slot(spec, state):
+    a = spec.get_inclusion_list_committee(state, state.slot)
+    b = spec.get_inclusion_list_committee(state, state.slot + 1)
+    assert a != b  # distinct slot windows over the shuffled set
+    yield "pre", state
+    yield "post", None
+
+
+@with_phases([EIP7805])
+@spec_state_test
+@always_bls
+def test_inclusion_list_signature_roundtrip(spec, state):
+    signed, _ = _make_signed_inclusion_list(
+        spec, state, transactions=[b"\x01" * 20])
+    assert spec.is_valid_inclusion_list_signature(state, signed)
+    bad = signed.copy()
+    bad.signature = b"\x42" * 96
+    assert not spec.is_valid_inclusion_list_signature(state, bad)
+    yield "pre", state
+    yield "post", None
+
+
+@with_phases([EIP7805])
+@spec_state_test
+def test_gossip_conditions(spec, state):
+    signed, committee = _make_signed_inclusion_list(
+        spec, state, transactions=[b"\x01" * 20])
+    assert spec.is_valid_inclusion_list_gossip(state, signed, state.slot)
+    # wrong slot window
+    assert not spec.is_valid_inclusion_list_gossip(
+        state, signed, state.slot + 2)
+    # non-member validator
+    non_member = next(i for i in range(len(state.validators))
+                      if i not in committee)
+    impostor = signed.copy()
+    impostor.message.validator_index = non_member
+    assert not spec.is_valid_inclusion_list_gossip(
+        state, impostor, state.slot)
+    yield "pre", state
+    yield "post", None
