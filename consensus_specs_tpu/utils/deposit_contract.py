@@ -1,0 +1,135 @@
+"""The EL deposit contract, modeled in Python — the incremental sparse
+Merkle tree, deposit validation, event log, and root/count views of
+`solidity_deposit_contract/deposit_contract.sol:64-161` (no solidity
+toolchain ships in this environment, so the observable behavior is
+ported; tree parity with the consensus spec's `DepositData` list root is
+pinned by tests/test_deposit_contract.py)."""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+
+DEPOSIT_CONTRACT_TREE_DEPTH = 32
+MAX_DEPOSIT_COUNT = 2**DEPOSIT_CONTRACT_TREE_DEPTH - 1
+GWEI = 10**9
+ETHER = 10**18
+
+
+def _sha256(data: bytes) -> bytes:
+    return hashlib.sha256(data).digest()
+
+
+def _to_little_endian_64(value: int) -> bytes:
+    return int(value).to_bytes(8, "little")
+
+
+ZERO_HASHES = [b"\x00" * 32]
+for _ in range(DEPOSIT_CONTRACT_TREE_DEPTH - 1):
+    ZERO_HASHES.append(_sha256(ZERO_HASHES[-1] + ZERO_HASHES[-1]))
+
+
+class DepositContractError(Exception):
+    """A `require(...)` failure — the deposit reverts."""
+
+
+@dataclass
+class DepositEvent:
+    pubkey: bytes
+    withdrawal_credentials: bytes
+    amount: bytes           # little-endian uint64 gwei
+    signature: bytes
+    index: bytes            # little-endian uint64
+
+
+def compute_deposit_data_root(pubkey: bytes,
+                              withdrawal_credentials: bytes,
+                              amount_gwei: int,
+                              signature: bytes) -> bytes:
+    """The contract's inlined `DepositData` hash-tree-root
+    (deposit_contract.sol:128-138)."""
+    amount = _to_little_endian_64(amount_gwei)
+    pubkey_root = _sha256(pubkey + b"\x00" * 16)
+    signature_root = _sha256(
+        _sha256(signature[:64]) + _sha256(signature[64:] + b"\x00" * 32))
+    return _sha256(
+        _sha256(pubkey_root + withdrawal_credentials)
+        + _sha256(amount + b"\x00" * 24 + signature_root))
+
+
+@dataclass
+class DepositContract:
+    """State of the deposit contract: 32 branch nodes + a counter."""
+
+    branch: list = field(default_factory=lambda:
+                         [b"\x00" * 32] * DEPOSIT_CONTRACT_TREE_DEPTH)
+    deposit_count: int = 0
+    events: list = field(default_factory=list)
+
+    def get_deposit_root(self) -> bytes:
+        """Incremental-tree root mixed with the little-endian count
+        (deposit_contract.sol:80-95)."""
+        node = b"\x00" * 32
+        size = self.deposit_count
+        for height in range(DEPOSIT_CONTRACT_TREE_DEPTH):
+            if size & 1:
+                node = _sha256(self.branch[height] + node)
+            else:
+                node = _sha256(node + ZERO_HASHES[height])
+            size //= 2
+        return _sha256(node + _to_little_endian_64(self.deposit_count)
+                       + b"\x00" * 24)
+
+    def get_deposit_count(self) -> bytes:
+        return _to_little_endian_64(self.deposit_count)
+
+    def deposit(self, pubkey: bytes, withdrawal_credentials: bytes,
+                signature: bytes, deposit_data_root: bytes,
+                value_wei: int) -> None:
+        """`deposit(...)` with msg.value = value_wei
+        (deposit_contract.sol:101-158)."""
+        if len(pubkey) != 48:
+            raise DepositContractError("invalid pubkey length")
+        if len(withdrawal_credentials) != 32:
+            raise DepositContractError(
+                "invalid withdrawal_credentials length")
+        if len(signature) != 96:
+            raise DepositContractError("invalid signature length")
+
+        if value_wei < ETHER:
+            raise DepositContractError("deposit value too low")
+        if value_wei % GWEI != 0:
+            raise DepositContractError(
+                "deposit value not multiple of gwei")
+        deposit_amount = value_wei // GWEI
+        if deposit_amount > 2**64 - 1:
+            raise DepositContractError("deposit value too high")
+
+        self.events.append(DepositEvent(
+            pubkey=bytes(pubkey),
+            withdrawal_credentials=bytes(withdrawal_credentials),
+            amount=_to_little_endian_64(deposit_amount),
+            signature=bytes(signature),
+            index=_to_little_endian_64(self.deposit_count),
+        ))
+
+        node = compute_deposit_data_root(
+            pubkey, withdrawal_credentials, deposit_amount, signature)
+        if node != bytes(deposit_data_root):
+            raise DepositContractError(
+                "reconstructed DepositData does not match supplied "
+                "deposit_data_root")
+
+        if self.deposit_count >= MAX_DEPOSIT_COUNT:
+            raise DepositContractError("merkle tree full")
+
+        # update a single branch node
+        self.deposit_count += 1
+        size = self.deposit_count
+        for height in range(DEPOSIT_CONTRACT_TREE_DEPTH):
+            if size & 1:
+                self.branch[height] = node
+                return
+            node = _sha256(self.branch[height] + node)
+            size //= 2
+        raise AssertionError("unreachable")  # loop always returns

@@ -1,0 +1,155 @@
+"""Deposit contract model (`utils/deposit_contract.py`): require()
+semantics, event log, and — the load-bearing property — incremental-tree
+root parity with the consensus spec's `DepositData` list hash-tree-root
+(the equivalence `process_deposit` relies on).
+
+Scenario parity: `solidity_deposit_contract/web3_tester/tests/
+test_deposit.py`."""
+
+import pytest
+
+from consensus_specs_tpu.models.builder import build_spec
+from consensus_specs_tpu.utils.deposit_contract import (
+    DepositContract,
+    DepositContractError,
+    ETHER,
+    GWEI,
+    compute_deposit_data_root,
+)
+
+
+@pytest.fixture(scope="module")
+def spec():
+    return build_spec("phase0", "minimal")
+
+
+def _sample(i):
+    return (bytes([i + 1]) * 48, bytes([i + 2]) * 32, bytes([i + 3]) * 96)
+
+
+def _deposit(contract, spec, i, amount_gwei=32 * 10**9):
+    pubkey, credentials, signature = _sample(i)
+    root = compute_deposit_data_root(pubkey, credentials, amount_gwei,
+                                     signature)
+    contract.deposit(pubkey, credentials, signature, root,
+                     amount_gwei * GWEI)
+    return spec.DepositData(pubkey=pubkey,
+                            withdrawal_credentials=credentials,
+                            amount=amount_gwei, signature=signature)
+
+
+def test_deposit_data_root_matches_ssz(spec):
+    pubkey, credentials, signature = _sample(0)
+    amount = 32 * 10**9
+    manual = compute_deposit_data_root(pubkey, credentials, amount,
+                                       signature)
+    ssz = spec.hash_tree_root(spec.DepositData(
+        pubkey=pubkey, withdrawal_credentials=credentials,
+        amount=amount, signature=signature))
+    assert manual == bytes(ssz)
+
+
+def test_empty_contract_root_matches_empty_list(spec):
+    contract = DepositContract()
+    empty = spec.List[spec.DepositData, 2**32]()
+    assert contract.get_deposit_root() == bytes(spec.hash_tree_root(empty))
+    assert contract.get_deposit_count() == (0).to_bytes(8, "little")
+
+
+def test_incremental_root_matches_ssz_list(spec):
+    """After every deposit the contract's O(log n) incremental root
+    equals the SSZ list root over all deposit data — the invariant that
+    lets `state.eth1_data.deposit_root` verify `process_deposit`
+    branches."""
+    contract = DepositContract()
+    datas = []
+    for i in range(10):
+        datas.append(_deposit(contract, spec, i,
+                              amount_gwei=(1 + i) * 10**9))
+        ssz_root = spec.hash_tree_root(
+            spec.List[spec.DepositData, 2**32](*datas))
+        assert contract.get_deposit_root() == bytes(ssz_root), i
+        assert contract.get_deposit_count() == \
+            (i + 1).to_bytes(8, "little")
+
+
+def test_event_log(spec):
+    contract = DepositContract()
+    _deposit(contract, spec, 0)
+    _deposit(contract, spec, 1)
+    assert len(contract.events) == 2
+    assert contract.events[0].index == (0).to_bytes(8, "little")
+    assert contract.events[1].index == (1).to_bytes(8, "little")
+    assert contract.events[1].pubkey == _sample(1)[0]
+
+
+def test_require_conditions(spec):
+    contract = DepositContract()
+    pubkey, credentials, signature = _sample(0)
+    amount = 32 * 10**9
+    root = compute_deposit_data_root(pubkey, credentials, amount,
+                                     signature)
+
+    with pytest.raises(DepositContractError, match="pubkey length"):
+        contract.deposit(pubkey[:-1], credentials, signature, root,
+                         amount * GWEI)
+    with pytest.raises(DepositContractError,
+                       match="withdrawal_credentials length"):
+        contract.deposit(pubkey, credentials + b"\x00", signature, root,
+                         amount * GWEI)
+    with pytest.raises(DepositContractError, match="signature length"):
+        contract.deposit(pubkey, credentials, signature[:-1], root,
+                         amount * GWEI)
+    with pytest.raises(DepositContractError, match="too low"):
+        contract.deposit(pubkey, credentials, signature, root,
+                         ETHER - 1)
+    with pytest.raises(DepositContractError, match="not multiple"):
+        contract.deposit(pubkey, credentials, signature, root,
+                         ETHER + 1)
+    with pytest.raises(DepositContractError, match="does not match"):
+        contract.deposit(pubkey, credentials, signature, b"\x13" * 32,
+                         amount * GWEI)
+    # nothing was recorded in the tree
+    assert contract.deposit_count == 0
+
+
+def test_contract_proofs_feed_process_deposit(spec):
+    """Full-circle: deposits made through the contract model produce a
+    root the spec verifies deposit proofs against."""
+    from consensus_specs_tpu.testlib.helpers.deposits import (
+        build_deposit,
+    )
+    from consensus_specs_tpu.testlib.helpers.genesis import (
+        create_genesis_state,
+    )
+    from consensus_specs_tpu.testlib.helpers.keys import privkeys, pubkeys
+
+    state = create_genesis_state(
+        spec, [spec.MAX_EFFECTIVE_BALANCE] * 64,
+        spec.MAX_EFFECTIVE_BALANCE)
+    contract = DepositContract()
+
+    deposit_data_list = []
+    index = len(state.validators)
+    deposit, root, deposit_data_list = build_deposit(
+        spec, deposit_data_list, pubkeys[index], privkeys[index],
+        spec.MAX_EFFECTIVE_BALANCE,
+        spec.BLS_WITHDRAWAL_PREFIX + spec.hash(pubkeys[index])[1:],
+        signed=True)
+
+    # replay the same deposit through the contract: identical root
+    data = deposit_data_list[0]
+    contract.deposit(bytes(data.pubkey),
+                     bytes(data.withdrawal_credentials),
+                     bytes(data.signature),
+                     bytes(spec.hash_tree_root(data)),
+                     int(data.amount) * GWEI)
+    assert contract.get_deposit_root() == bytes(root)
+
+    # and the spec accepts the proof against that root
+    state.eth1_data.deposit_root = root
+    state.eth1_data.deposit_count = contract.deposit_count
+    state.eth1_deposit_index = 0
+    pre_count = len(state.validators)
+    spec.process_deposit(state, deposit)
+    assert len(state.validators) == pre_count + 1
