@@ -57,6 +57,8 @@ PREVIOUS_FORK_OF: dict[str, str | None] = {
     # feature forks (specs/_features/)
     "eip7732": "electra",
     "eip7805": "electra",
+    "eip6800": "deneb",
+    "eip7441": "capella",
 }
 
 # Mainline forks only — the default phase list for tests and generators;
@@ -65,7 +67,7 @@ PREVIOUS_FORK_OF: dict[str, str | None] = {
 # `test/helpers/constants.py`).
 ALL_FORKS = ["phase0", "altair", "bellatrix", "capella", "deneb",
              "electra", "fulu"]
-FEATURE_FORKS = ["eip7732", "eip7805"]
+FEATURE_FORKS = ["eip7732", "eip7805", "eip6800", "eip7441"]
 BUILDABLE_FORKS = ALL_FORKS + FEATURE_FORKS
 
 # source files per fork, executed in order; later forks only list their own
@@ -90,6 +92,8 @@ SPEC_SOURCES: dict[str, list[str]] = {
     "eip7732": ["beacon_chain.py", "fork.py", "validator.py", "p2p.py"],
     "eip7805": ["beacon_chain.py", "fork.py", "fork_choice.py",
                 "validator.py", "p2p.py"],
+    "eip6800": ["beacon_chain.py", "fork.py"],
+    "eip7441": ["beacon_chain.py", "fork.py"],
 }
 
 

@@ -1,0 +1,204 @@
+# EIP-6800 (Verkle) -- The Beacon Chain (executable spec source, delta
+# over deneb).
+#
+# Stateless-Ethereum witness types: execution payloads carry an
+# `ExecutionWitness` (verkle state diff + IPA multiproof) whose root the
+# header commits to.  Verification of the witness happens in the
+# execution layer; the CL carries and commits to it.  Parity contract:
+# specs/_features/eip6800/beacon-chain.md (custom types :30-41,
+# preset :43-52, containers :54-166, block :167-220).
+
+# Custom types (beacon-chain.md :30-41)
+BanderwagonGroupElement = Bytes32
+BanderwagonFieldElement = Bytes32
+Stem = Bytes31
+
+
+class SuffixStateDiff(Container):
+    suffix: Bytes1
+    # the md's `Optional[T]` is SSZ Union[None, T]
+    current_value: Union[None, Bytes32]
+    new_value: Union[None, Bytes32]
+
+
+class StemStateDiff(Container):
+    """`suffix_diffs` is only valid if sorted by suffixes."""
+    stem: Stem
+    suffix_diffs: List[SuffixStateDiff, VERKLE_WIDTH]
+
+
+class IPAProof(Container):
+    cl: Vector[BanderwagonGroupElement, IPA_PROOF_DEPTH]
+    cr: Vector[BanderwagonGroupElement, IPA_PROOF_DEPTH]
+    final_evaluation: BanderwagonFieldElement
+
+
+class VerkleProof(Container):
+    other_stems: List[Bytes31, MAX_STEMS]
+    depth_extension_present: ByteList[MAX_STEMS]
+    commitments_by_path: List[BanderwagonGroupElement,
+                              MAX_STEMS * MAX_COMMITMENTS_PER_STEM]
+    d: BanderwagonGroupElement
+    ipa_proof: IPAProof
+
+
+class ExecutionWitness(Container):
+    state_diff: List[StemStateDiff, MAX_STEMS]
+    verkle_proof: VerkleProof
+
+
+class ExecutionPayload(Container):
+    parent_hash: Hash32
+    fee_recipient: ExecutionAddress
+    state_root: Bytes32
+    receipts_root: Bytes32
+    logs_bloom: ByteVector[BYTES_PER_LOGS_BLOOM]
+    prev_randao: Bytes32
+    block_number: uint64
+    gas_limit: uint64
+    gas_used: uint64
+    timestamp: uint64
+    extra_data: ByteList[MAX_EXTRA_DATA_BYTES]
+    base_fee_per_gas: uint256
+    block_hash: Hash32
+    transactions: List[Transaction, MAX_TRANSACTIONS_PER_PAYLOAD]
+    withdrawals: List[Withdrawal, MAX_WITHDRAWALS_PER_PAYLOAD]
+    blob_gas_used: uint64
+    excess_blob_gas: uint64
+    # [New in EIP6800]
+    execution_witness: ExecutionWitness
+
+
+class ExecutionPayloadHeader(Container):
+    # field set as the feature spec writes it (the stale
+    # `excess_data_gas` name included, beacon-chain.md :85-106)
+    parent_hash: Hash32
+    fee_recipient: ExecutionAddress
+    state_root: Bytes32
+    receipts_root: Bytes32
+    logs_bloom: ByteVector[BYTES_PER_LOGS_BLOOM]
+    prev_randao: Bytes32
+    block_number: uint64
+    gas_limit: uint64
+    gas_used: uint64
+    timestamp: uint64
+    extra_data: ByteList[MAX_EXTRA_DATA_BYTES]
+    base_fee_per_gas: uint256
+    block_hash: Hash32
+    transactions_root: Root
+    withdrawals_root: Root
+    blob_gas_used: uint64
+    excess_data_gas: uint64
+    # [New in EIP6800]
+    execution_witness_root: Root
+
+
+# Re-bound containers: the exec'd namespace binds field types at class
+# creation, so the deneb-defined body/state would still carry deneb's
+# payload classes — re-declare them against the witness-bearing types
+# (the reference's generated module has the same ordering property).
+
+
+class BeaconBlockBody(Container):
+    randao_reveal: BLSSignature
+    eth1_data: Eth1Data
+    graffiti: Bytes32
+    proposer_slashings: List[ProposerSlashing, MAX_PROPOSER_SLASHINGS]
+    attester_slashings: List[AttesterSlashing, MAX_ATTESTER_SLASHINGS]
+    attestations: List[Attestation, MAX_ATTESTATIONS]
+    deposits: List[Deposit, MAX_DEPOSITS]
+    voluntary_exits: List[SignedVoluntaryExit, MAX_VOLUNTARY_EXITS]
+    sync_aggregate: SyncAggregate
+    # [Modified in EIP6800]
+    execution_payload: ExecutionPayload
+    bls_to_execution_changes: List[SignedBLSToExecutionChange, MAX_BLS_TO_EXECUTION_CHANGES]
+    blob_kzg_commitments: List[KZGCommitment, MAX_BLOB_COMMITMENTS_PER_BLOCK]
+
+
+class BeaconBlock(Container):
+    slot: Slot
+    proposer_index: ValidatorIndex
+    parent_root: Root
+    state_root: Root
+    body: BeaconBlockBody
+
+
+class SignedBeaconBlock(Container):
+    message: BeaconBlock
+    signature: BLSSignature
+
+
+class BeaconState(Container):
+    genesis_time: uint64
+    genesis_validators_root: Root
+    slot: Slot
+    fork: Fork
+    latest_block_header: BeaconBlockHeader
+    block_roots: Vector[Root, SLOTS_PER_HISTORICAL_ROOT]
+    state_roots: Vector[Root, SLOTS_PER_HISTORICAL_ROOT]
+    historical_roots: List[Root, HISTORICAL_ROOTS_LIMIT]
+    eth1_data: Eth1Data
+    eth1_data_votes: List[Eth1Data, EPOCHS_PER_ETH1_VOTING_PERIOD * SLOTS_PER_EPOCH]
+    eth1_deposit_index: uint64
+    validators: List[Validator, VALIDATOR_REGISTRY_LIMIT]
+    balances: List[Gwei, VALIDATOR_REGISTRY_LIMIT]
+    randao_mixes: Vector[Bytes32, EPOCHS_PER_HISTORICAL_VECTOR]
+    slashings: Vector[Gwei, EPOCHS_PER_SLASHINGS_VECTOR]
+    previous_epoch_participation: List[ParticipationFlags, VALIDATOR_REGISTRY_LIMIT]
+    current_epoch_participation: List[ParticipationFlags, VALIDATOR_REGISTRY_LIMIT]
+    justification_bits: Bitvector[JUSTIFICATION_BITS_LENGTH]
+    previous_justified_checkpoint: Checkpoint
+    current_justified_checkpoint: Checkpoint
+    finalized_checkpoint: Checkpoint
+    inactivity_scores: List[uint64, VALIDATOR_REGISTRY_LIMIT]
+    current_sync_committee: SyncCommittee
+    next_sync_committee: SyncCommittee
+    # [Modified in EIP6800]
+    latest_execution_payload_header: ExecutionPayloadHeader
+    next_withdrawal_index: WithdrawalIndex
+    next_withdrawal_validator_index: ValidatorIndex
+    historical_summaries: List[HistoricalSummary, HISTORICAL_ROOTS_LIMIT]
+
+
+def process_execution_payload(state: BeaconState, body: BeaconBlockBody,
+                              execution_engine: ExecutionEngine) -> None:
+    """[Modified in EIP6800] the cached header commits to the payload's
+    execution witness root."""
+    payload = body.execution_payload
+
+    assert (payload.parent_hash
+            == state.latest_execution_payload_header.block_hash)
+    assert payload.prev_randao == get_randao_mix(
+        state, get_current_epoch(state))
+    assert payload.timestamp == compute_time_at_slot(state, state.slot)
+    assert len(body.blob_kzg_commitments) <= config.MAX_BLOBS_PER_BLOCK
+    versioned_hashes = [kzg_commitment_to_versioned_hash(commitment)
+                        for commitment in body.blob_kzg_commitments]
+    assert execution_engine.verify_and_notify_new_payload(
+        NewPayloadRequest(
+            execution_payload=payload,
+            versioned_hashes=versioned_hashes,
+            parent_beacon_block_root=state.latest_block_header.parent_root,
+        ))
+    state.latest_execution_payload_header = ExecutionPayloadHeader(
+        parent_hash=payload.parent_hash,
+        fee_recipient=payload.fee_recipient,
+        state_root=payload.state_root,
+        receipts_root=payload.receipts_root,
+        logs_bloom=payload.logs_bloom,
+        prev_randao=payload.prev_randao,
+        block_number=payload.block_number,
+        gas_limit=payload.gas_limit,
+        gas_used=payload.gas_used,
+        timestamp=payload.timestamp,
+        extra_data=payload.extra_data,
+        base_fee_per_gas=payload.base_fee_per_gas,
+        block_hash=payload.block_hash,
+        transactions_root=hash_tree_root(payload.transactions),
+        withdrawals_root=hash_tree_root(payload.withdrawals),
+        blob_gas_used=payload.blob_gas_used,
+        excess_data_gas=payload.excess_blob_gas,
+        # [New in EIP6800]
+        execution_witness_root=hash_tree_root(
+            payload.execution_witness),
+    )

@@ -1,0 +1,83 @@
+# EIP-6800 (Verkle) -- Fork Logic (executable spec source).
+# Parity contract: specs/_features/eip6800/fork.md.
+
+
+def compute_fork_version(epoch: Epoch) -> Version:
+    """Fork version at `epoch`."""
+    if epoch >= config.EIP6800_FORK_EPOCH:
+        return config.EIP6800_FORK_VERSION
+    if epoch >= config.DENEB_FORK_EPOCH:
+        return config.DENEB_FORK_VERSION
+    if epoch >= config.CAPELLA_FORK_EPOCH:
+        return config.CAPELLA_FORK_VERSION
+    if epoch >= config.BELLATRIX_FORK_EPOCH:
+        return config.BELLATRIX_FORK_VERSION
+    if epoch >= config.ALTAIR_FORK_EPOCH:
+        return config.ALTAIR_FORK_VERSION
+    return config.GENESIS_FORK_VERSION
+
+
+def upgrade_to_eip6800(pre) -> BeaconState:
+    """deneb -> eip6800 state upgrade: the committed header gains an
+    (empty) execution-witness root (fork.md `upgrade_to_eip6800`)."""
+    epoch = compute_epoch_at_slot(pre.slot)
+    latest_execution_payload_header = ExecutionPayloadHeader(
+        parent_hash=pre.latest_execution_payload_header.parent_hash,
+        fee_recipient=pre.latest_execution_payload_header.fee_recipient,
+        state_root=pre.latest_execution_payload_header.state_root,
+        receipts_root=pre.latest_execution_payload_header.receipts_root,
+        logs_bloom=pre.latest_execution_payload_header.logs_bloom,
+        prev_randao=pre.latest_execution_payload_header.prev_randao,
+        block_number=pre.latest_execution_payload_header.block_number,
+        gas_limit=pre.latest_execution_payload_header.gas_limit,
+        gas_used=pre.latest_execution_payload_header.gas_used,
+        timestamp=pre.latest_execution_payload_header.timestamp,
+        extra_data=pre.latest_execution_payload_header.extra_data,
+        base_fee_per_gas=pre.latest_execution_payload_header.base_fee_per_gas,
+        blob_gas_used=pre.latest_execution_payload_header.blob_gas_used,
+        # zeroed at the fork, as the feature spec writes it (the pre
+        # state's excess_blob_gas is NOT carried into the renamed field)
+        excess_data_gas=0,
+        block_hash=pre.latest_execution_payload_header.block_hash,
+        transactions_root=pre.latest_execution_payload_header.transactions_root,
+        withdrawals_root=pre.latest_execution_payload_header.withdrawals_root,
+        # [New in EIP6800]
+        execution_witness_root=hash_tree_root(ExecutionWitness()),
+    )
+    post = BeaconState(
+        genesis_time=pre.genesis_time,
+        genesis_validators_root=pre.genesis_validators_root,
+        slot=pre.slot,
+        fork=Fork(
+            previous_version=pre.fork.current_version,
+            # [Modified in EIP6800]
+            current_version=config.EIP6800_FORK_VERSION,
+            epoch=epoch,
+        ),
+        latest_block_header=pre.latest_block_header,
+        block_roots=pre.block_roots,
+        state_roots=pre.state_roots,
+        historical_roots=pre.historical_roots,
+        eth1_data=pre.eth1_data,
+        eth1_data_votes=pre.eth1_data_votes,
+        eth1_deposit_index=pre.eth1_deposit_index,
+        validators=pre.validators,
+        balances=pre.balances,
+        randao_mixes=pre.randao_mixes,
+        slashings=pre.slashings,
+        previous_epoch_participation=pre.previous_epoch_participation,
+        current_epoch_participation=pre.current_epoch_participation,
+        justification_bits=pre.justification_bits,
+        previous_justified_checkpoint=pre.previous_justified_checkpoint,
+        current_justified_checkpoint=pre.current_justified_checkpoint,
+        finalized_checkpoint=pre.finalized_checkpoint,
+        inactivity_scores=pre.inactivity_scores,
+        current_sync_committee=pre.current_sync_committee,
+        next_sync_committee=pre.next_sync_committee,
+        latest_execution_payload_header=latest_execution_payload_header,
+        next_withdrawal_index=pre.next_withdrawal_index,
+        next_withdrawal_validator_index=pre.next_withdrawal_validator_index,
+        historical_summaries=pre.historical_summaries,
+    )
+
+    return post

@@ -1,0 +1,84 @@
+# EIP-7441 (Whisk) -- Fork Logic (executable spec source).
+# Parity contract: specs/_features/eip7441/fork.md.
+
+
+def compute_fork_version(epoch: Epoch) -> Version:
+    """Fork version at `epoch`."""
+    if epoch >= config.EIP7441_FORK_EPOCH:
+        return config.EIP7441_FORK_VERSION
+    if epoch >= config.CAPELLA_FORK_EPOCH:
+        return config.CAPELLA_FORK_VERSION
+    if epoch >= config.BELLATRIX_FORK_EPOCH:
+        return config.BELLATRIX_FORK_VERSION
+    if epoch >= config.ALTAIR_FORK_EPOCH:
+        return config.ALTAIR_FORK_VERSION
+    return config.GENESIS_FORK_VERSION
+
+
+def upgrade_to_eip7441(pre) -> BeaconState:
+    """capella -> eip7441 state upgrade: every validator receives a
+    deterministic initial tracker/commitment; candidate and proposer
+    trackers seed from them (fork.md `upgrade_to_eip7441`; the md's
+    `validators=[]` is an obvious editorial slip — the registry carries
+    over)."""
+    # Compute initial unsafe trackers for all validators
+    ks = [get_initial_whisk_k(ValidatorIndex(validator_index), 0)
+          for validator_index in range(len(pre.validators))]
+    whisk_k_commitments = [get_k_commitment(k) for k in ks]
+    whisk_trackers = [get_initial_tracker(k) for k in ks]
+
+    epoch = compute_epoch_at_slot(pre.slot)
+
+    post = BeaconState(
+        genesis_time=pre.genesis_time,
+        genesis_validators_root=pre.genesis_validators_root,
+        slot=pre.slot,
+        fork=Fork(
+            previous_version=pre.fork.current_version,
+            # [Modified in EIP7441]
+            current_version=config.EIP7441_FORK_VERSION,
+            epoch=epoch,
+        ),
+        latest_block_header=pre.latest_block_header,
+        block_roots=pre.block_roots,
+        state_roots=pre.state_roots,
+        historical_roots=pre.historical_roots,
+        eth1_data=pre.eth1_data,
+        eth1_data_votes=pre.eth1_data_votes,
+        eth1_deposit_index=pre.eth1_deposit_index,
+        validators=pre.validators,
+        balances=pre.balances,
+        randao_mixes=pre.randao_mixes,
+        slashings=pre.slashings,
+        previous_epoch_participation=pre.previous_epoch_participation,
+        current_epoch_participation=pre.current_epoch_participation,
+        justification_bits=pre.justification_bits,
+        previous_justified_checkpoint=pre.previous_justified_checkpoint,
+        current_justified_checkpoint=pre.current_justified_checkpoint,
+        finalized_checkpoint=pre.finalized_checkpoint,
+        inactivity_scores=pre.inactivity_scores,
+        current_sync_committee=pre.current_sync_committee,
+        next_sync_committee=pre.next_sync_committee,
+        latest_execution_payload_header=pre.latest_execution_payload_header,
+        next_withdrawal_index=pre.next_withdrawal_index,
+        next_withdrawal_validator_index=pre.next_withdrawal_validator_index,
+        historical_summaries=pre.historical_summaries,
+        # [New in EIP7441]
+        whisk_proposer_trackers=[WhiskTracker()
+                                 for _ in range(PROPOSER_TRACKERS_COUNT)],
+        whisk_candidate_trackers=[
+            WhiskTracker() for _ in range(CANDIDATE_TRACKERS_COUNT)],
+        whisk_trackers=whisk_trackers,
+        whisk_k_commitments=whisk_k_commitments,
+    )
+
+    # Candidate selection with an old epoch (avoids reusing the next
+    # selection's seed), proposer selection for the upcoming day, then a
+    # final candidate round to shuffle over during the upcoming phase
+    select_whisk_candidate_trackers(
+        post, Epoch(max(int(epoch)
+                        - int(config.PROPOSER_SELECTION_GAP) - 1, 0)))
+    select_whisk_proposer_trackers(post, epoch)
+    select_whisk_candidate_trackers(post, epoch)
+
+    return post

@@ -1,0 +1,154 @@
+"""Whisk (EIP-7441) proof backends.
+
+The reference delegates both proof systems to the external
+`curdleproofs` package (`specs/_features/eip7441/beacon-chain.md:98-133`).
+This module provides self-contained equivalents over this repo's own
+BLS12-381 G1 arithmetic:
+
+- **Tracker (opening) proofs** — a REAL Chaum-Pedersen discrete-log
+  equality proof, Fiat-Shamir transformed: prove knowledge of `k` with
+  `k_r_G == k * r_G` and `k_commitment == k * G` without revealing `k`.
+  Same security claim as the curdleproofs tracker proof.
+
+- **Shuffle proofs** — a TRANSPARENT (non-zero-knowledge) shuffle
+  argument: the proof reveals the permutation and the rerandomization
+  scalar, and the verifier recomputes the shuffle.  The verified
+  relation is exactly curdleproofs' (post is a rerandomized permutation
+  of pre); what is deliberately dropped is the hiding property, which
+  only matters for live privacy, not for spec state-transition
+  correctness.  The wire format is versioned so a hiding backend can
+  slot in.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+from .bls import ciphersuite as cs
+from .bls.curve import g1
+
+SHUFFLE_PROOF_VERSION = b"\x01"  # transparent argument
+
+
+def _order() -> int:
+    from .bls import curve
+
+    return curve.R
+
+
+def _point(b: bytes):
+    """Deserialize + subgroup-check a compressed G1 point."""
+    return cs.g1_from_bytes(bytes(b))
+
+
+def _scalar_from_hash(*parts: bytes) -> int:
+    h = hashlib.sha256()
+    for part in parts:
+        h.update(part)
+    return int.from_bytes(h.digest(), "big") % _order()
+
+
+# --- tracker (opening) proofs ----------------------------------------------
+
+
+def generate_whisk_tracker_proof(tracker_r_g: bytes, tracker_k_r_g: bytes,
+                                 k_commitment: bytes, k: int,
+                                 nonce: bytes = b"") -> bytes:
+    """Chaum-Pedersen DLEQ proof for (G, k_commitment) ~ (r_G, k_r_G)."""
+    order = _order()
+    r_g = _point(tracker_r_g)
+    u = _scalar_from_hash(b"whisk-nonce", int(k).to_bytes(32, "big"),
+                          bytes(tracker_r_g), nonce) or 1
+    a1 = cs.g1_to_bytes(g1.mul(cs.G1_GEN, u))
+    a2 = cs.g1_to_bytes(g1.mul(r_g, u))
+    c = _scalar_from_hash(b"whisk-dleq", bytes(tracker_r_g),
+                          bytes(tracker_k_r_g), bytes(k_commitment),
+                          a1, a2)
+    z = (u + c * int(k)) % order
+    return a1 + a2 + z.to_bytes(32, "big")
+
+
+def is_valid_whisk_tracker_proof(tracker_r_g: bytes, tracker_k_r_g: bytes,
+                                 k_commitment: bytes,
+                                 proof: bytes) -> bool:
+    """Verify the DLEQ proof: z*G == A1 + c*k_commitment and
+    z*r_G == A2 + c*k_r_G."""
+    try:
+        proof = bytes(proof)
+        if len(proof) != 128:
+            return False
+        a1_b, a2_b, z_b = proof[:48], proof[48:96], proof[96:]
+        a1, a2 = _point(a1_b), _point(a2_b)
+        r_g = _point(tracker_r_g)
+        k_r_g = _point(tracker_k_r_g)
+        commitment = _point(k_commitment)
+    except Exception:
+        return False
+    z = int.from_bytes(z_b, "big")
+    if z >= _order():
+        return False
+    c = _scalar_from_hash(b"whisk-dleq", bytes(tracker_r_g),
+                          bytes(tracker_k_r_g), bytes(k_commitment),
+                          a1_b, a2_b)
+    lhs1 = g1.mul(cs.G1_GEN, z)
+    rhs1 = g1.add(a1, g1.mul(commitment, c))
+    if not g1.eq_points(lhs1, rhs1):
+        return False
+    lhs2 = g1.mul(r_g, z)
+    rhs2 = g1.add(a2, g1.mul(k_r_g, c))
+    return g1.eq_points(lhs2, rhs2)
+
+
+# --- shuffle proofs ---------------------------------------------------------
+
+
+def generate_whisk_shuffle_proof(pre_trackers, permutation, r: int):
+    """Shuffle + transparent proof.  Returns (post_trackers, proof);
+    trackers are (r_G_bytes, k_r_G_bytes) pairs."""
+    order = _order()
+    r = int(r) % order
+    assert r > 1
+    assert sorted(permutation) == list(range(len(pre_trackers)))
+    post = []
+    for src in permutation:
+        r_g, k_r_g = pre_trackers[src]
+        post.append((cs.g1_to_bytes(g1.mul(_point(r_g), r)),
+                     cs.g1_to_bytes(g1.mul(_point(k_r_g), r))))
+    proof = (SHUFFLE_PROOF_VERSION
+             + len(permutation).to_bytes(2, "big")
+             + b"".join(int(i).to_bytes(2, "big") for i in permutation)
+             + r.to_bytes(32, "big"))
+    return post, proof
+
+
+def is_valid_whisk_shuffle_proof(pre_trackers, post_trackers,
+                                 proof: bytes) -> bool:
+    """Verify post == rerandomized permutation of pre under the revealed
+    (permutation, r)."""
+    try:
+        proof = bytes(proof)
+        if len(proof) < 3 or proof[0:1] != SHUFFLE_PROOF_VERSION:
+            return False
+        n = int.from_bytes(proof[1:3], "big")
+        if n != len(pre_trackers) or n != len(post_trackers):
+            return False
+        if len(proof) != 3 + 2 * n + 32:
+            return False
+        permutation = [int.from_bytes(proof[3 + 2 * i:5 + 2 * i], "big")
+                       for i in range(n)]
+        r = int.from_bytes(proof[3 + 2 * n:], "big")
+        if sorted(permutation) != list(range(n)):
+            return False
+        if not 1 < r < _order():
+            return False
+        for (post_r_g, post_k_r_g), src in zip(post_trackers, permutation):
+            pre_r_g, pre_k_r_g = pre_trackers[src]
+            if bytes(post_r_g) != cs.g1_to_bytes(
+                    g1.mul(_point(pre_r_g), r)):
+                return False
+            if bytes(post_k_r_g) != cs.g1_to_bytes(
+                    g1.mul(_point(pre_k_r_g), r)):
+                return False
+        return True
+    except Exception:
+        return False

@@ -17,6 +17,7 @@ from ...utils.eth1 import indexed_data_trie_root, keccak256, rlp_encode
 from .forks import (
     is_post_capella,
     is_post_deneb,
+    is_post_eip6800,
     is_post_eip7732,
     is_post_electra,
 )
@@ -73,7 +74,10 @@ def compute_el_header_block_hash(spec, payload_header,
         fields.append(withdrawals_trie_root)
     if is_post_deneb(spec):
         fields.append(int(payload_header.blob_gas_used))
-        fields.append(int(payload_header.excess_blob_gas))
+        # eip6800 keeps the pre-rename `excess_data_gas` field name
+        fields.append(int(payload_header.excess_blob_gas)
+                      if hasattr(payload_header, "excess_blob_gas")
+                      else int(payload_header.excess_data_gas))
         fields.append(bytes(parent_beacon_block_root))
     if is_post_electra(spec):
         fields.append(requests_hash)
@@ -196,7 +200,14 @@ def get_execution_payload_header(spec, state, execution_payload):
             execution_payload.withdrawals)
     if is_post_deneb(spec):
         payload_header.blob_gas_used = execution_payload.blob_gas_used
-        payload_header.excess_blob_gas = execution_payload.excess_blob_gas
+        if is_post_eip6800(spec):
+            payload_header.excess_data_gas = \
+                execution_payload.excess_blob_gas
+            payload_header.execution_witness_root = spec.hash_tree_root(
+                execution_payload.execution_witness)
+        else:
+            payload_header.excess_blob_gas = \
+                execution_payload.excess_blob_gas
     return payload_header
 
 

@@ -64,3 +64,7 @@ def all_pre_post_forks():
 
 
 ALL_PRE_POST_FORKS = all_pre_post_forks()
+
+
+def is_post_eip6800(spec) -> bool:
+    return is_post_fork(spec.fork, "eip6800")

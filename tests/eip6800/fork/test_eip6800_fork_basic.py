@@ -1,0 +1,29 @@
+"""EIP-6800 fork: `upgrade_to_eip6800` from deneb
+(specs/_features/eip6800/fork.md :60-140)."""
+
+from consensus_specs_tpu.models.builder import build_spec
+from consensus_specs_tpu.testlib.context import (
+    DENEB,
+    spec_state_test,
+    with_phases,
+)
+
+
+@with_phases([DENEB])
+@spec_state_test
+def test_fork_base_state(spec, state):
+    post_spec = build_spec("eip6800", spec.preset_name)
+    post = post_spec.upgrade_to_eip6800(state)
+    yield "pre", state
+    yield "post", post
+
+    assert post.fork.previous_version == state.fork.current_version
+    assert post.fork.current_version == \
+        post_spec.config.EIP6800_FORK_VERSION
+    header = post.latest_execution_payload_header
+    # EL identity carries over; the witness root commits to emptiness
+    assert header.block_hash == \
+        state.latest_execution_payload_header.block_hash
+    assert header.execution_witness_root == post_spec.hash_tree_root(
+        post_spec.ExecutionWitness())
+    assert len(post.validators) == len(state.validators)

@@ -1,0 +1,65 @@
+"""EIP-6800: verkle witness containers and the witness-committing
+payload header (specs/_features/eip6800/beacon-chain.md :54-220)."""
+
+from consensus_specs_tpu.testlib.context import (
+    spec_state_test,
+    with_phases,
+)
+
+EIP6800 = "eip6800"
+
+
+@with_phases([EIP6800])
+@spec_state_test
+def test_witness_containers_roundtrip(spec, state):
+    diff = spec.SuffixStateDiff(
+        suffix=b"\x07",
+        current_value=spec.Union[None, spec.Bytes32](
+            selector=1, value=b"\x11" * 32),
+        new_value=spec.Union[None, spec.Bytes32](selector=0),
+    )
+    stem_diff = spec.StemStateDiff(stem=b"\x22" * 31,
+                                   suffix_diffs=[diff])
+    witness = spec.ExecutionWitness(
+        state_diff=[stem_diff],
+        verkle_proof=spec.VerkleProof(
+            other_stems=[b"\x33" * 31],
+            depth_extension_present=b"\x01\x02",
+            commitments_by_path=[b"\x44" * 32],
+            d=b"\x55" * 32,
+            ipa_proof=spec.IPAProof(
+                cl=[b"\x66" * 32] * int(spec.IPA_PROOF_DEPTH),
+                cr=[b"\x77" * 32] * int(spec.IPA_PROOF_DEPTH),
+                final_evaluation=b"\x88" * 32,
+            ),
+        ),
+    )
+    data = spec.ssz_serialize(witness)
+    back = spec.ExecutionWitness.decode_bytes(data)
+    assert spec.hash_tree_root(back) == spec.hash_tree_root(witness)
+    # optional (union) selectors survive
+    got = back.state_diff[0].suffix_diffs[0]
+    assert int(got.current_value.selector) == 1
+    assert bytes(got.current_value.value) == b"\x11" * 32
+    assert int(got.new_value.selector) == 0
+    yield "pre", state
+    yield "post", None
+
+
+@with_phases([EIP6800])
+@spec_state_test
+def test_payload_header_commits_to_witness(spec, state):
+    payload = spec.ExecutionPayload(
+        parent_hash=state.latest_execution_payload_header.block_hash,
+        prev_randao=spec.get_randao_mix(state,
+                                        spec.get_current_epoch(state)),
+        timestamp=spec.compute_time_at_slot(state, state.slot),
+        execution_witness=spec.ExecutionWitness(),
+    )
+    body = spec.BeaconBlockBody(execution_payload=payload)
+    yield "pre", state
+    spec.process_execution_payload(state, body, spec.EXECUTION_ENGINE)
+    yield "post", state
+    header = state.latest_execution_payload_header
+    assert header.execution_witness_root == spec.hash_tree_root(
+        payload.execution_witness)

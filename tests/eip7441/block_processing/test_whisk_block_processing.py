@@ -1,0 +1,198 @@
+"""EIP-7441: whisk block transitions — opening proofs at the header,
+candidate shuffles, first-proposal registration
+(specs/_features/eip7441/beacon-chain.md :238-443)."""
+
+import random
+
+from consensus_specs_tpu.models.builder import build_spec
+from consensus_specs_tpu.testlib.context import (
+    CAPELLA,
+    spec_state_test,
+    with_phases,
+)
+from consensus_specs_tpu.testlib.utils import expect_assertion_error
+
+EIP7441 = "eip7441"
+
+
+def _whisk_state(spec, capella_state):
+    post_spec = build_spec("eip7441", spec.preset_name)
+    return post_spec, post_spec.upgrade_to_eip7441(capella_state)
+
+
+def _proposer_for_slot(spec, state, slot):
+    """(proposer_index, k) able to open the slot's proposer tracker:
+    initial trackers are (G, k_i*G), so the tracker's owner is found by
+    matching k_r_G against the deterministic initial ks."""
+    tracker = state.whisk_proposer_trackers[
+        int(slot) % int(spec.PROPOSER_TRACKERS_COUNT)]
+    for index in range(len(state.validators)):
+        k = spec.get_initial_whisk_k(spec.ValidatorIndex(index), 0)
+        if spec.get_initial_tracker(k) == tracker:
+            return index, int(k)
+    raise AssertionError("no owner found for proposer tracker")
+
+
+@with_phases([CAPELLA])
+@spec_state_test
+def test_whisk_opening_proof_gates_header(spec, state):
+    wspec, wstate = _whisk_state(spec, state)
+    from consensus_specs_tpu.ops.whisk import (
+        generate_whisk_tracker_proof,
+    )
+
+    next_slot = wstate.slot + 1
+    proposer_index, k = _proposer_for_slot(wspec, wstate, next_slot)
+    wspec.process_slots(wstate, next_slot)
+
+    tracker = wstate.whisk_proposer_trackers[
+        int(next_slot) % int(wspec.PROPOSER_TRACKERS_COUNT)]
+    proof = generate_whisk_tracker_proof(
+        bytes(tracker.r_G), bytes(tracker.k_r_G),
+        bytes(wstate.whisk_k_commitments[proposer_index]), k)
+
+    block = wspec.BeaconBlock(
+        slot=next_slot,
+        proposer_index=proposer_index,
+        parent_root=wspec.hash_tree_root(
+            _patched_header(wspec, wstate)),
+        body=wspec.BeaconBlockBody(whisk_opening_proof=proof),
+    )
+    pre_header_slot = wstate.latest_block_header.slot
+    wspec.process_block_header(wstate, block)
+    assert wstate.latest_block_header.slot == next_slot
+    # proposer self-identifies: get_beacon_proposer_index reads the header
+    assert wspec.get_beacon_proposer_index(wstate) == proposer_index
+
+    yield "pre", state
+    yield "post", None
+
+
+def _patched_header(spec, state):
+    header = state.latest_block_header.copy()
+    if header.state_root == spec.Root():
+        header.state_root = spec.hash_tree_root(state)
+    return header
+
+
+@with_phases([CAPELLA])
+@spec_state_test
+def test_whisk_opening_proof_wrong_proposer_rejected(spec, state):
+    wspec, wstate = _whisk_state(spec, state)
+    from consensus_specs_tpu.ops.whisk import (
+        generate_whisk_tracker_proof,
+    )
+
+    next_slot = wstate.slot + 1
+    proposer_index, k = _proposer_for_slot(wspec, wstate, next_slot)
+    impostor = (proposer_index + 1) % len(wstate.validators)
+    wspec.process_slots(wstate, next_slot)
+    tracker = wstate.whisk_proposer_trackers[
+        int(next_slot) % int(wspec.PROPOSER_TRACKERS_COUNT)]
+    proof = generate_whisk_tracker_proof(
+        bytes(tracker.r_G), bytes(tracker.k_r_G),
+        bytes(wstate.whisk_k_commitments[impostor]), k)
+    block = wspec.BeaconBlock(
+        slot=next_slot,
+        proposer_index=impostor,
+        parent_root=wspec.hash_tree_root(_patched_header(wspec, wstate)),
+        body=wspec.BeaconBlockBody(whisk_opening_proof=proof),
+    )
+    expect_assertion_error(
+        lambda: wspec.process_block_header(wstate, block))
+    yield "pre", state
+    yield "post", None
+
+
+@with_phases([CAPELLA])
+@spec_state_test
+def test_whisk_shuffled_trackers_applied(spec, state):
+    wspec, wstate = _whisk_state(spec, state)
+    from consensus_specs_tpu.ops.whisk import (
+        generate_whisk_shuffle_proof,
+    )
+
+    rng = random.Random(11)
+    body = wspec.BeaconBlockBody(randao_reveal=b"\x25" * 96)
+    shuffle_indices = wspec.get_shuffle_indices(body.randao_reveal)
+    pre_trackers = [wstate.whisk_candidate_trackers[i]
+                    for i in shuffle_indices]
+    n = len(pre_trackers)
+    permutation = list(range(n))
+    rng.shuffle(permutation)
+    r = rng.randrange(2, 2**200)
+    post, proof = generate_whisk_shuffle_proof(
+        [(bytes(t.r_G), bytes(t.k_r_G)) for t in pre_trackers],
+        permutation, r)
+    body.whisk_post_shuffle_trackers = [
+        wspec.WhiskTracker(r_G=a, k_r_G=b) for a, b in post]
+    body.whisk_shuffle_proof = proof
+
+    wspec.process_shuffled_trackers(wstate, body)
+    for i, idx in enumerate(shuffle_indices):
+        assert wstate.whisk_candidate_trackers[idx] == \
+            body.whisk_post_shuffle_trackers[i]
+
+    # an invalid proof rejects
+    body.whisk_shuffle_proof = wspec.WhiskShuffleProof(b"\x00" * 10)
+    expect_assertion_error(
+        lambda: wspec.process_shuffled_trackers(wstate, body))
+    yield "pre", state
+    yield "post", None
+
+
+@with_phases([CAPELLA])
+@spec_state_test
+def test_whisk_registration(spec, state):
+    wspec, wstate = _whisk_state(spec, state)
+    from consensus_specs_tpu.ops.bls import ciphersuite as cs
+    from consensus_specs_tpu.ops.bls.curve import g1
+    from consensus_specs_tpu.ops.whisk import (
+        generate_whisk_tracker_proof,
+    )
+
+    # make the next-slot proposer processable: build the header first
+    next_slot = wstate.slot + 1
+    proposer_index, k0 = _proposer_for_slot(wspec, wstate, next_slot)
+    wspec.process_slots(wstate, next_slot)
+    tracker = wstate.whisk_proposer_trackers[
+        int(next_slot) % int(wspec.PROPOSER_TRACKERS_COUNT)]
+    opening = generate_whisk_tracker_proof(
+        bytes(tracker.r_G), bytes(tracker.k_r_G),
+        bytes(wstate.whisk_k_commitments[proposer_index]), k0)
+    block = wspec.BeaconBlock(
+        slot=next_slot, proposer_index=proposer_index,
+        parent_root=wspec.hash_tree_root(_patched_header(wspec, wstate)),
+        body=wspec.BeaconBlockBody(whisk_opening_proof=opening),
+    )
+    wspec.process_block_header(wstate, block)
+
+    # first proposal: register a fresh (r != 1) tracker + commitment
+    rng = random.Random(21)
+    k_new, r_new = rng.randrange(2, 2**200), rng.randrange(2, 2**200)
+    r_g = g1.mul(cs.G1_GEN, r_new)
+    new_tracker = wspec.WhiskTracker(
+        r_G=cs.g1_to_bytes(r_g),
+        k_r_G=cs.g1_to_bytes(g1.mul(r_g, k_new)))
+    commitment = cs.g1_to_bytes(g1.mul(cs.G1_GEN, k_new))
+    registration = generate_whisk_tracker_proof(
+        bytes(new_tracker.r_G), bytes(new_tracker.k_r_G), commitment,
+        k_new)
+    body = wspec.BeaconBlockBody(
+        whisk_registration_proof=registration,
+        whisk_tracker=new_tracker,
+        whisk_k_commitment=commitment,
+    )
+    wspec.process_whisk_registration(wstate, body)
+    assert wstate.whisk_trackers[proposer_index] == new_tracker
+    assert bytes(wstate.whisk_k_commitments[proposer_index]) == \
+        bytes(commitment)
+
+    # subsequent proposals must carry empty registration fields
+    body_second = wspec.BeaconBlockBody()
+    wspec.process_whisk_registration(wstate, body_second)  # no-op ok
+    body_bad = wspec.BeaconBlockBody(whisk_k_commitment=commitment)
+    expect_assertion_error(
+        lambda: wspec.process_whisk_registration(wstate, body_bad))
+    yield "pre", state
+    yield "post", None

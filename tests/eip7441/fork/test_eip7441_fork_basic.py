@@ -1,0 +1,38 @@
+"""EIP-7441 fork: `upgrade_to_eip7441` from capella — initial trackers,
+commitments, and the three-round candidate/proposer seeding
+(specs/_features/eip7441/fork.md :55-119)."""
+
+from consensus_specs_tpu.models.builder import build_spec
+from consensus_specs_tpu.testlib.context import (
+    CAPELLA,
+    spec_state_test,
+    with_phases,
+)
+
+
+@with_phases([CAPELLA])
+@spec_state_test
+def test_fork_base_state(spec, state):
+    post_spec = build_spec("eip7441", spec.preset_name)
+    post = post_spec.upgrade_to_eip7441(state)
+    yield "pre", state
+    yield "post", post
+
+    assert post.fork.current_version == \
+        post_spec.config.EIP7441_FORK_VERSION
+    n = len(state.validators)
+    assert len(post.whisk_trackers) == n
+    assert len(post.whisk_k_commitments) == n
+    # every initial tracker is (G, k*G) with the deterministic k
+    for index in range(n):
+        k = post_spec.get_initial_whisk_k(
+            post_spec.ValidatorIndex(index), 0)
+        assert post.whisk_trackers[index] == \
+            post_spec.get_initial_tracker(k)
+        assert post.whisk_k_commitments[index] == \
+            post_spec.get_k_commitment(k)
+    # candidate + proposer trackers fully seeded (no zero trackers)
+    assert all(bytes(t.r_G) != b"\x00" * 48
+               for t in post.whisk_candidate_trackers)
+    assert all(bytes(t.r_G) != b"\x00" * 48
+               for t in post.whisk_proposer_trackers)

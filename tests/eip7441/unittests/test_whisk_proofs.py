@@ -1,0 +1,76 @@
+"""EIP-7441: whisk proof backends — DLEQ tracker proofs and the
+shuffle argument (ops/whisk.py; relation parity with
+specs/_features/eip7441/beacon-chain.md :98-133)."""
+
+import random
+
+from consensus_specs_tpu.ops.bls import ciphersuite as cs
+from consensus_specs_tpu.ops.bls.curve import R as CURVE_ORDER, g1
+from consensus_specs_tpu.ops.whisk import (
+    generate_whisk_shuffle_proof,
+    generate_whisk_tracker_proof,
+    is_valid_whisk_shuffle_proof,
+    is_valid_whisk_tracker_proof,
+)
+
+
+def _tracker(k, r):
+    r_g = g1.mul(cs.G1_GEN, r)
+    k_r_g = g1.mul(r_g, k)
+    return cs.g1_to_bytes(r_g), cs.g1_to_bytes(k_r_g)
+
+
+def test_tracker_proof_roundtrip():
+    rng = random.Random(5)
+    k = rng.randrange(2, CURVE_ORDER)
+    r = rng.randrange(2, CURVE_ORDER)
+    r_g, k_r_g = _tracker(k, r)
+    commitment = cs.g1_to_bytes(g1.mul(cs.G1_GEN, k))
+    proof = generate_whisk_tracker_proof(r_g, k_r_g, commitment, k)
+    assert is_valid_whisk_tracker_proof(r_g, k_r_g, commitment, proof)
+
+    # wrong k: proof for k' fails against k's commitment
+    other = generate_whisk_tracker_proof(r_g, k_r_g, commitment, k + 1)
+    assert not is_valid_whisk_tracker_proof(r_g, k_r_g, commitment,
+                                            other)
+    # tampered proof bytes fail
+    bad = bytearray(proof)
+    bad[100] ^= 1
+    assert not is_valid_whisk_tracker_proof(r_g, k_r_g, commitment,
+                                            bytes(bad))
+    # malformed length fails closed
+    assert not is_valid_whisk_tracker_proof(r_g, k_r_g, commitment,
+                                            proof[:-1])
+
+
+def test_tracker_proof_binds_commitment():
+    rng = random.Random(6)
+    k = rng.randrange(2, CURVE_ORDER)
+    r = rng.randrange(2, CURVE_ORDER)
+    r_g, k_r_g = _tracker(k, r)
+    commitment = cs.g1_to_bytes(g1.mul(cs.G1_GEN, k))
+    wrong_commitment = cs.g1_to_bytes(g1.mul(cs.G1_GEN, k + 1))
+    proof = generate_whisk_tracker_proof(r_g, k_r_g, commitment, k)
+    assert not is_valid_whisk_tracker_proof(r_g, k_r_g,
+                                            wrong_commitment, proof)
+
+
+def test_shuffle_proof_roundtrip():
+    rng = random.Random(7)
+    trackers = [_tracker(rng.randrange(2, CURVE_ORDER),
+                         rng.randrange(2, CURVE_ORDER))
+                for _ in range(4)]
+    permutation = [2, 0, 3, 1]
+    r = rng.randrange(2, CURVE_ORDER)
+    post, proof = generate_whisk_shuffle_proof(trackers, permutation, r)
+    assert is_valid_whisk_shuffle_proof(trackers, post, proof)
+
+    # a substituted tracker breaks verification
+    fake = list(post)
+    fake[0] = trackers[0]
+    assert not is_valid_whisk_shuffle_proof(trackers, fake, proof)
+    # truncated / non-permutation proofs fail closed
+    assert not is_valid_whisk_shuffle_proof(trackers, post, proof[:-1])
+    bad = bytearray(proof)
+    bad[4] = bad[6]  # duplicate permutation entry
+    assert not is_valid_whisk_shuffle_proof(trackers, post, bytes(bad))
