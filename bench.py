@@ -246,6 +246,104 @@ def worker_bls() -> None:
     }), flush=True)
 
 
+def worker_kzg() -> None:
+    """Config #5: deneb `verify_blob_kzg_proof_batch` over 6 mainnet
+    blobs — KZG pairings/MSM on device (jax backend) vs the pure-python
+    oracle."""
+    _worker_setup_jax()
+
+    from consensus_specs_tpu.models.builder import build_spec
+    from consensus_specs_tpu.ops import bls
+
+    spec = build_spec("deneb", "mainnet")
+    modulus = int(spec.BLS_MODULUS)
+    n_fe = int(spec.FIELD_ELEMENTS_PER_BLOB)
+    blobs = [
+        spec.Blob(b"".join(
+            int.to_bytes(pow(2 + i, j + 256, modulus), 32, "big")
+            for j in range(n_fe)))
+        for i in range(6)
+    ]
+    t0 = time.perf_counter()
+    commitments = [spec.blob_to_kzg_commitment(b) for b in blobs]
+    proofs = [spec.compute_blob_kzg_proof(b, c)
+              for b, c in zip(blobs, commitments)]
+    log(f"kzg setup (6 commitments+proofs): "
+        f"{time.perf_counter() - t0:.1f}s")
+
+    def measure(iters=3):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            assert spec.verify_blob_kzg_proof_batch(blobs, commitments,
+                                                    proofs)
+        return (time.perf_counter() - t0) / iters
+
+    bls.use_backend("py")
+    py_dt = measure(iters=1)
+    log(f"kzg batch py oracle: {py_dt:.2f}s")
+    bls.use_backend("jax")
+    first = time.perf_counter()
+    assert spec.verify_blob_kzg_proof_batch(blobs, commitments, proofs)
+    log(f"kzg batch device compile+first: "
+        f"{time.perf_counter() - first:.1f}s")
+    dev_dt = measure()
+
+    print(json.dumps({
+        "blob_kzg_proof_batch_6_verify_wall":
+            {"value": round(dev_dt, 4), "unit": "s",
+             "vs_baseline": round(py_dt / dev_dt, 1)},
+    }), flush=True)
+
+
+def worker_spec() -> None:
+    """Config #1: minimal-preset phase0 `state_transition` on 64
+    validators with signatures ON — full-spec wall per signed block,
+    device (jax) backend vs the pure-python oracle."""
+    _worker_setup_jax()
+
+    from consensus_specs_tpu.models.builder import build_spec
+    from consensus_specs_tpu.ops import bls
+    from consensus_specs_tpu.testlib.helpers.block import (
+        build_empty_block_for_next_slot, sign_block)
+    from consensus_specs_tpu.testlib.helpers.genesis import (
+        create_genesis_state)
+
+    spec = build_spec("phase0", "minimal")
+    bls.bls_active = True
+    state = create_genesis_state(
+        spec, [int(spec.MAX_EFFECTIVE_BALANCE)] * 64,
+        int(spec.MAX_EFFECTIVE_BALANCE))
+
+    def transition_one(st):
+        block = build_empty_block_for_next_slot(spec, st)
+        shadow = st.copy()
+        spec.process_slots(shadow, block.slot)
+        spec.process_block(shadow, block)
+        block.state_root = spec.hash_tree_root(shadow)
+        signed = sign_block(spec, st.copy(), block)
+        spec.state_transition(st, signed)
+
+    def measure(iters=3):
+        st = state.copy()
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            transition_one(st)
+        return (time.perf_counter() - t0) / iters
+
+    bls.use_backend("py")
+    py_dt = measure()
+    log(f"state_transition py oracle: {py_dt:.2f}s/block")
+    bls.use_backend("jax")
+    transition_one(state.copy())  # compile
+    dev_dt = measure()
+
+    print(json.dumps({
+        "minimal_phase0_state_transition_signed_block_wall":
+            {"value": round(dev_dt, 4), "unit": "s",
+             "vs_baseline": round(py_dt / dev_dt, 1)},
+    }), flush=True)
+
+
 # ---------------------------------------------------------------------------
 # driver (parent process: never initializes a jax backend)
 # ---------------------------------------------------------------------------
@@ -332,16 +430,20 @@ def main():
     # and only when the flagship ran on the real chip; on success a second,
     # superset JSON line is printed (drivers parsing either the first or
     # the last line both see the flagship metric)
-    elapsed = time.time() - start
-    if (result is not None and platform is None
-            and elapsed < EXTRAS_DEADLINE):
-        log(f"--- bls extras (elapsed {elapsed:.0f}s) ---")
-        extras, err = _run_worker("bls", ATTEMPT_TIMEOUT)
+    # BASELINE configs #2/#3 (bls), #5 (kzg blob batch), #1 (minimal
+    # full transition): each prints a superset JSON line on success
+    for mode in ("bls", "kzg", "spec"):
+        elapsed = time.time() - start
+        if (result is None or platform is not None
+                or elapsed >= EXTRAS_DEADLINE):
+            break
+        log(f"--- {mode} extras (elapsed {elapsed:.0f}s) ---")
+        extras, err = _run_worker(mode, ATTEMPT_TIMEOUT)
         if extras is not None:
-            out["extra"] = extras
+            out.setdefault("extra", {}).update(extras)
             print(json.dumps(out), flush=True)
         else:
-            log(f"bls extras skipped: {err}")
+            log(f"{mode} extras skipped: {err}")
 
     sys.exit(0 if result is not None else 1)
 
@@ -352,6 +454,10 @@ if __name__ == "__main__":
             worker_epoch(N_VALIDATORS)
         elif sys.argv[2] == "bls":
             worker_bls()
+        elif sys.argv[2] == "kzg":
+            worker_kzg()
+        elif sys.argv[2] == "spec":
+            worker_spec()
         else:
             raise SystemExit(f"unknown worker {sys.argv[2]!r}")
     else:

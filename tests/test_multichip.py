@@ -97,21 +97,27 @@ def test_sharded_bls_batch_matches_single_device():
 
     assert len(jax.devices()) >= 8
 
-    rng = random.Random(7)
-    tasks = []
-    for i in range(8):
-        sk = rng.randrange(1, 2**200)
-        pk = bls.SkToPk(sk)
-        msg = bytes([i]) * 32
-        sig = bls.Sign(sk, msg)
-        tasks.append((_pk_to_point(pk), msg, _sig_to_point(sig)))
+    prev_active = bls.bls_active
+    bls.bls_active = True  # the suite default is stubbed crypto
+    try:
+        rng = random.Random(7)
+        tasks = []
+        for i in range(8):
+            sk = rng.randrange(1, 2**200)
+            pk = bls.SkToPk(sk)
+            msg = bytes([i]) * 32
+            sig = bls.Sign(sk, msg)
+            tasks.append((_pk_to_point(pk), msg, _sig_to_point(sig)))
 
-    assert batch_verify(tasks, rng=random.Random(1))
-    assert batch_verify_sharded(tasks, n_devices=8, rng=random.Random(1))
+        assert batch_verify(tasks, rng=random.Random(1))
+        assert batch_verify_sharded(tasks, n_devices=8,
+                                    rng=random.Random(1))
 
-    # tampered signature rejected on both paths
-    bad = list(tasks)
-    bad[3] = (bad[3][0], bad[3][1], bad[0][2])
-    assert not batch_verify(bad, rng=random.Random(2))
-    assert not batch_verify_sharded(bad, n_devices=8,
-                                    rng=random.Random(2))
+        # tampered signature rejected on both paths
+        bad = list(tasks)
+        bad[3] = (bad[3][0], bad[3][1], bad[0][2])
+        assert not batch_verify(bad, rng=random.Random(2))
+        assert not batch_verify_sharded(bad, n_devices=8,
+                                        rng=random.Random(2))
+    finally:
+        bls.bls_active = prev_active
