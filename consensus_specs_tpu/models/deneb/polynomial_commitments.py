@@ -221,6 +221,16 @@ def evaluate_polynomial_in_evaluation_form(
         eval_index = roots_of_unity_brp.index(z)
         return polynomial[eval_index]
 
+    if bls.backend_name() == "jax" and width >= 256:
+        # device path: all `width` denominators invert at once via
+        # batched Fermat exponentiation (`ops/fr_batch.py`); bit-exact
+        # with the loop below (pinned by tests/test_fr_batch.py)
+        from consensus_specs_tpu.ops.fr_batch import barycentric_eval
+
+        return BLSFieldElement(barycentric_eval(
+            [int(v) for v in polynomial],
+            [int(r) for r in roots_of_unity_brp], int(z)))
+
     result = BLSFieldElement(0)
     for i in range(width):
         a = polynomial[i] * roots_of_unity_brp[i]
