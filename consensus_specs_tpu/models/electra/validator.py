@@ -163,3 +163,14 @@ def get_execution_requests(execution_requests_list) -> ExecutionRequests:
 def compute_subnet_for_blob_sidecar(blob_index: BlobIndex) -> SubnetID:
     # [Modified in Electra:EIP7691]
     return SubnetID(blob_index % config.BLOB_SIDECAR_SUBNET_COUNT_ELECTRA)
+
+
+def compute_weak_subjectivity_period(state: BeaconState) -> uint64:
+    """[Modified in Electra:EIP7251] churn is balance-denominated
+    (specs/electra/weak-subjectivity.md :32-45): the period accounts for
+    validator-set churn bounded by get_balance_churn_limit per epoch."""
+    t = get_total_active_balance(state)
+    delta = get_balance_churn_limit(state)
+    epochs_for_validator_set_churn = SAFETY_DECAY * t // (2 * delta * 100)
+    return (config.MIN_VALIDATOR_WITHDRAWABILITY_DELAY
+            + epochs_for_validator_set_churn)

@@ -1,0 +1,143 @@
+"""Electra: `process_consolidation_request` matrix — ignore conditions,
+churn gating, and compounding-switch routing (scenario parity:
+`test/electra/block_processing/test_process_consolidation_request.py`)."""
+
+import functools
+
+from consensus_specs_tpu.testlib.context import (
+    with_presets,
+    ELECTRA,
+    default_activation_threshold,
+    scaled_churn_balances_exceed_activation_exit_churn_limit,
+    with_all_phases_from,
+    with_custom_state,
+)
+from consensus_specs_tpu.testlib.utils import vector_test
+
+with_electra_and_later = with_all_phases_from(ELECTRA)
+ADDRESS = b"\x42" * 20
+
+
+def spec_state_test_scaled_churn(fn):
+    inner = with_custom_state(
+        scaled_churn_balances_exceed_activation_exit_churn_limit,
+        default_activation_threshold)(fn)
+
+    @functools.wraps(fn)
+    def wrapper(*args, spec, generator_mode=False, **kwargs):
+        return vector_test(inner)(*args, spec=spec,
+                                  generator_mode=generator_mode, **kwargs)
+
+    return wrapper
+
+
+def _prepare(spec, state, source, target):
+    state.validators[source].withdrawal_credentials = (
+        bytes(spec.ETH1_ADDRESS_WITHDRAWAL_PREFIX) + b"\x00" * 11
+        + ADDRESS)
+    state.validators[target].withdrawal_credentials = (
+        bytes(spec.COMPOUNDING_WITHDRAWAL_PREFIX) + b"\x00" * 11
+        + ADDRESS)
+    state.slot += (int(spec.config.SHARD_COMMITTEE_PERIOD)
+                   * int(spec.SLOTS_PER_EPOCH))
+
+
+def _request(spec, state, source, target, address=ADDRESS):
+    return spec.ConsolidationRequest(
+        source_address=address,
+        source_pubkey=state.validators[source].pubkey,
+        target_pubkey=state.validators[target].pubkey)
+
+
+def _run_ignored(spec, state, request):
+    """Process and assert nothing was queued / exited."""
+    pre_pending = len(state.pending_consolidations)
+    yield "pre", state
+    yield "consolidation_request", request
+    spec.process_consolidation_request(state, request)
+    yield "post", state
+    assert len(state.pending_consolidations) == pre_pending
+
+
+@with_electra_and_later
+@spec_state_test_scaled_churn
+def test_source_equals_target_ignored(spec, state):
+    _prepare(spec, state, 3, 3)
+    request = _request(spec, state, 3, 3)
+    yield from _run_ignored(spec, state, request)
+    # and the source was NOT exited (cannot be used as an exit)
+    assert state.validators[3].exit_epoch == spec.FAR_FUTURE_EPOCH
+
+
+@with_electra_and_later
+@spec_state_test_scaled_churn
+def test_unknown_source_pubkey_ignored(spec, state):
+    _prepare(spec, state, 3, 5)
+    request = spec.ConsolidationRequest(
+        source_address=ADDRESS,
+        source_pubkey=b"\xee" * 48,
+        target_pubkey=state.validators[5].pubkey)
+    yield from _run_ignored(spec, state, request)
+
+
+@with_electra_and_later
+@spec_state_test_scaled_churn
+def test_wrong_source_address_ignored(spec, state):
+    _prepare(spec, state, 3, 5)
+    request = _request(spec, state, 3, 5, address=b"\x99" * 20)
+    yield from _run_ignored(spec, state, request)
+
+
+@with_electra_and_later
+@spec_state_test_scaled_churn
+def test_non_compounding_target_ignored(spec, state):
+    _prepare(spec, state, 3, 5)
+    state.validators[5].withdrawal_credentials = (
+        bytes(spec.ETH1_ADDRESS_WITHDRAWAL_PREFIX) + b"\x00" * 11
+        + ADDRESS)
+    request = _request(spec, state, 3, 5)
+    yield from _run_ignored(spec, state, request)
+
+
+@with_electra_and_later
+@spec_state_test_scaled_churn
+def test_exiting_source_ignored(spec, state):
+    _prepare(spec, state, 3, 5)
+    spec.initiate_validator_exit(state, 3)
+    request = _request(spec, state, 3, 5)
+    yield from _run_ignored(spec, state, request)
+
+
+@with_electra_and_later
+@with_presets(["minimal"], reason="queue fill is preset-limit sized")
+@spec_state_test_scaled_churn
+def test_pending_queue_full_ignored(spec, state):
+    _prepare(spec, state, 3, 5)
+    limit = int(spec.PENDING_CONSOLIDATIONS_LIMIT)
+    for _ in range(limit):
+        state.pending_consolidations.append(
+            spec.PendingConsolidation(source_index=0, target_index=1))
+    request = _request(spec, state, 3, 5)
+    pre = len(state.pending_consolidations)
+    yield "pre", state
+    yield "consolidation_request", request
+    spec.process_consolidation_request(state, request)
+    yield "post", state
+    assert len(state.pending_consolidations) == pre
+    assert state.validators[3].exit_epoch == spec.FAR_FUTURE_EPOCH
+
+
+@with_electra_and_later
+@spec_state_test_scaled_churn
+def test_source_exit_epoch_set_by_consolidation(spec, state):
+    _prepare(spec, state, 3, 5)
+    request = _request(spec, state, 3, 5)
+    yield "pre", state
+    yield "consolidation_request", request
+    spec.process_consolidation_request(state, request)
+    yield "post", state
+    source = state.validators[3]
+    assert source.exit_epoch != spec.FAR_FUTURE_EPOCH
+    assert source.withdrawable_epoch == spec.Epoch(
+        source.exit_epoch + spec.config.MIN_VALIDATOR_WITHDRAWABILITY_DELAY)
+    assert len(state.pending_consolidations) == 1

@@ -1,0 +1,182 @@
+"""Electra: `process_withdrawal_request` matrix — ignore conditions and
+partial-withdrawal accounting (scenario parity:
+`test/electra/block_processing/test_process_withdrawal_request.py`)."""
+
+from consensus_specs_tpu.testlib.context import (
+    with_presets,
+    ELECTRA,
+    spec_state_test,
+    with_all_phases_from,
+)
+from consensus_specs_tpu.testlib.helpers.state import next_slots
+
+with_electra_and_later = with_all_phases_from(ELECTRA)
+ADDRESS = b"\x42" * 20
+
+
+def _activate_credentials(spec, state, index, compounding=False):
+    prefix = (spec.COMPOUNDING_WITHDRAWAL_PREFIX if compounding
+              else spec.ETH1_ADDRESS_WITHDRAWAL_PREFIX)
+    state.validators[index].withdrawal_credentials = (
+        bytes(prefix) + b"\x00" * 11 + ADDRESS)
+
+
+def _mature_state(spec, state):
+    next_slots(spec, state,
+               spec.config.SHARD_COMMITTEE_PERIOD * spec.SLOTS_PER_EPOCH)
+
+
+def _request(spec, state, index, amount):
+    return spec.WithdrawalRequest(
+        source_address=ADDRESS,
+        validator_pubkey=state.validators[index].pubkey,
+        amount=amount)
+
+
+def _run(spec, state, request, valid=True):
+    yield "pre", state
+    yield "withdrawal_request", request
+    spec.process_withdrawal_request(state, request)
+    yield "post", state
+
+
+@with_electra_and_later
+@spec_state_test
+def test_unknown_pubkey_ignored(spec, state):
+    _mature_state(spec, state)
+    request = spec.WithdrawalRequest(
+        source_address=ADDRESS, validator_pubkey=b"\xee" * 48,
+        amount=spec.FULL_EXIT_REQUEST_AMOUNT)
+    pre_exit = state.validators[0].exit_epoch
+    yield from _run(spec, state, request)
+    assert state.validators[0].exit_epoch == pre_exit
+
+
+@with_electra_and_later
+@spec_state_test
+def test_bls_credentials_ignored(spec, state):
+    """0x00-prefixed credentials cannot be the request source."""
+    _mature_state(spec, state)
+    index = 4
+    request = _request(spec, state, index,
+                       spec.FULL_EXIT_REQUEST_AMOUNT)
+    yield from _run(spec, state, request)
+    assert state.validators[index].exit_epoch == spec.FAR_FUTURE_EPOCH
+
+
+@with_electra_and_later
+@spec_state_test
+def test_exit_already_initiated_ignored(spec, state):
+    _mature_state(spec, state)
+    index = 5
+    _activate_credentials(spec, state, index)
+    state.validators[index].exit_epoch = spec.Epoch(
+        spec.get_current_epoch(state) + 10)
+    request = _request(spec, state, index,
+                       spec.FULL_EXIT_REQUEST_AMOUNT)
+    yield from _run(spec, state, request)
+    assert state.validators[index].exit_epoch == \
+        spec.get_current_epoch(state) + 10
+
+
+@with_electra_and_later
+@spec_state_test
+def test_not_active_long_enough_ignored(spec, state):
+    index = 6
+    _activate_credentials(spec, state, index)
+    request = _request(spec, state, index,
+                       spec.FULL_EXIT_REQUEST_AMOUNT)
+    yield from _run(spec, state, request)  # no SHARD_COMMITTEE_PERIOD wait
+    assert state.validators[index].exit_epoch == spec.FAR_FUTURE_EPOCH
+
+
+@with_electra_and_later
+@spec_state_test
+def test_full_exit_blocked_by_pending_withdrawal(spec, state):
+    _mature_state(spec, state)
+    index = 7
+    _activate_credentials(spec, state, index, compounding=True)
+    state.pending_partial_withdrawals.append(
+        spec.PendingPartialWithdrawal(
+            validator_index=index, amount=spec.Gwei(10**9),
+            withdrawable_epoch=spec.get_current_epoch(state) + 5))
+    request = _request(spec, state, index,
+                       spec.FULL_EXIT_REQUEST_AMOUNT)
+    yield from _run(spec, state, request)
+    assert state.validators[index].exit_epoch == spec.FAR_FUTURE_EPOCH
+
+
+@with_electra_and_later
+@spec_state_test
+def test_partial_clamped_to_excess_balance(spec, state):
+    _mature_state(spec, state)
+    index = 8
+    _activate_credentials(spec, state, index, compounding=True)
+    excess = spec.Gwei(3 * 10**9)
+    state.balances[index] = spec.MIN_ACTIVATION_BALANCE + excess
+    huge = spec.Gwei(10**12)
+    request = _request(spec, state, index, huge)
+    yield from _run(spec, state, request)
+    assert len(state.pending_partial_withdrawals) == 1
+    pending = state.pending_partial_withdrawals[0]
+    assert pending.validator_index == index
+    assert pending.amount == excess  # clamped, not the requested amount
+
+
+@with_electra_and_later
+@spec_state_test
+def test_partial_without_excess_balance_ignored(spec, state):
+    _mature_state(spec, state)
+    index = 9
+    _activate_credentials(spec, state, index, compounding=True)
+    state.balances[index] = spec.MIN_ACTIVATION_BALANCE  # nothing excess
+    request = _request(spec, state, index, spec.Gwei(10**9))
+    yield from _run(spec, state, request)
+    assert len(state.pending_partial_withdrawals) == 0
+
+
+@with_electra_and_later
+@with_presets(["minimal"], reason="queue fill is preset-limit sized")
+@spec_state_test
+def test_partial_queue_full_only_full_exits(spec, state):
+    _mature_state(spec, state)
+    index = 10
+    _activate_credentials(spec, state, index, compounding=True)
+    state.balances[index] = spec.MIN_ACTIVATION_BALANCE + spec.Gwei(10**9)
+    limit = int(spec.PENDING_PARTIAL_WITHDRAWALS_LIMIT)
+    for _ in range(limit):
+        state.pending_partial_withdrawals.append(
+            spec.PendingPartialWithdrawal(
+                validator_index=0, amount=1, withdrawable_epoch=0))
+    # a partial request is dropped on a full queue...
+    request = _request(spec, state, index, spec.Gwei(10**9))
+    yield from _run(spec, state, request)
+    assert len(state.pending_partial_withdrawals) == limit
+    # ...but a full exit still processes (validator 11 has no pendings)
+    index2 = 11
+    _activate_credentials(spec, state, index2)
+    full_exit = _request(spec, state, index2,
+                         spec.FULL_EXIT_REQUEST_AMOUNT)
+    spec.process_withdrawal_request(state, full_exit)
+    assert state.validators[index2].exit_epoch != spec.FAR_FUTURE_EPOCH
+
+
+@with_electra_and_later
+@spec_state_test
+def test_partial_updates_exit_churn(spec, state):
+    _mature_state(spec, state)
+    index = 12
+    _activate_credentials(spec, state, index, compounding=True)
+    excess = spec.Gwei(2 * 10**9)
+    state.balances[index] = spec.MIN_ACTIVATION_BALANCE + excess
+    pre_churn = int(state.exit_balance_to_consume)
+    request = _request(spec, state, index, excess)
+    yield from _run(spec, state, request)
+    pending = state.pending_partial_withdrawals[0]
+    assert pending.amount == excess
+    assert pending.withdrawable_epoch >= (
+        spec.get_current_epoch(state)
+        + spec.config.MIN_VALIDATOR_WITHDRAWABILITY_DELAY)
+    # churn accounting moved (either consumed balance or advanced epoch)
+    assert (int(state.exit_balance_to_consume) != pre_churn
+            or int(state.earliest_exit_epoch) > 0)

@@ -1,0 +1,120 @@
+"""Electra: `process_withdrawals` with pending partial withdrawals —
+queue consumption order, skip conditions, sweep interleaving (scenario
+parity: `test/electra/block_processing/test_process_withdrawals.py`)."""
+
+from consensus_specs_tpu.testlib.context import (
+    ELECTRA,
+    spec_state_test,
+    with_all_phases_from,
+)
+from consensus_specs_tpu.testlib.helpers.execution_payload import (
+    build_empty_execution_payload,
+)
+from consensus_specs_tpu.testlib.helpers.state import next_slot
+
+with_electra_and_later = with_all_phases_from(ELECTRA)
+ADDRESS = b"\x42" * 20
+
+
+def _compounding(spec, state, index, excess=0):
+    state.validators[index].withdrawal_credentials = (
+        bytes(spec.COMPOUNDING_WITHDRAWAL_PREFIX) + b"\x00" * 11
+        + ADDRESS)
+    state.balances[index] = spec.MIN_ACTIVATION_BALANCE + excess
+
+
+def _queue_partial(spec, state, index, amount, withdrawable_epoch=None):
+    if withdrawable_epoch is None:
+        withdrawable_epoch = spec.get_current_epoch(state)
+    state.pending_partial_withdrawals.append(
+        spec.PendingPartialWithdrawal(
+            validator_index=index, amount=amount,
+            withdrawable_epoch=withdrawable_epoch))
+
+
+def _run_withdrawals(spec, state):
+    """Build the matching payload and run process_withdrawals."""
+    next_slot(spec, state)
+    payload = build_empty_execution_payload(spec, state)
+    yield "pre", state
+    yield "execution_payload", payload
+    spec.process_withdrawals(state, payload)
+    yield "post", state
+
+
+@with_electra_and_later
+@spec_state_test
+def test_pending_partial_withdrawn(spec, state):
+    index = 3
+    excess = spec.Gwei(2 * 10**9)
+    _compounding(spec, state, index, excess=excess)
+    _queue_partial(spec, state, index, excess)
+    pre_balance = int(state.balances[index])
+
+    yield from _run_withdrawals(spec, state)
+
+    assert len(state.pending_partial_withdrawals) == 0
+    assert int(state.balances[index]) == pre_balance - int(excess)
+
+
+@with_electra_and_later
+@spec_state_test
+def test_pending_partial_not_yet_withdrawable(spec, state):
+    index = 3
+    _compounding(spec, state, index, excess=spec.Gwei(2 * 10**9))
+    _queue_partial(spec, state, index, spec.Gwei(10**9),
+                   withdrawable_epoch=spec.get_current_epoch(state) + 10)
+    pre_balance = int(state.balances[index])
+
+    yield from _run_withdrawals(spec, state)
+
+    # still queued; balance untouched by the partial
+    assert len(state.pending_partial_withdrawals) == 1
+    assert int(state.balances[index]) == pre_balance
+
+
+@with_electra_and_later
+@spec_state_test
+def test_pending_partial_skipped_for_exited_validator(spec, state):
+    index = 3
+    _compounding(spec, state, index, excess=spec.Gwei(2 * 10**9))
+    state.validators[index].exit_epoch = spec.Epoch(
+        spec.get_current_epoch(state) + 1)
+    _queue_partial(spec, state, index, spec.Gwei(10**9))
+    pre_balance = int(state.balances[index])
+
+    yield from _run_withdrawals(spec, state)
+
+    # consumed from the queue without withdrawing
+    assert len(state.pending_partial_withdrawals) == 0
+    assert int(state.balances[index]) == pre_balance
+
+
+@with_electra_and_later
+@spec_state_test
+def test_pending_partial_clamped_to_excess(spec, state):
+    index = 3
+    excess = spec.Gwei(10**9)
+    _compounding(spec, state, index, excess=excess)
+    _queue_partial(spec, state, index, spec.Gwei(5 * 10**9))  # > excess
+    pre_balance = int(state.balances[index])
+
+    yield from _run_withdrawals(spec, state)
+
+    assert int(state.balances[index]) == pre_balance - int(excess)
+
+
+@with_electra_and_later
+@spec_state_test
+def test_multiple_partials_same_validator(spec, state):
+    index = 3
+    excess = spec.Gwei(3 * 10**9)
+    _compounding(spec, state, index, excess=excess)
+    _queue_partial(spec, state, index, spec.Gwei(10**9))
+    _queue_partial(spec, state, index, spec.Gwei(10**9))
+    pre_balance = int(state.balances[index])
+
+    yield from _run_withdrawals(spec, state)
+
+    assert len(state.pending_partial_withdrawals) == 0
+    assert int(state.balances[index]) == pre_balance - 2 * 10**9

@@ -1,0 +1,46 @@
+"""Electra weak subjectivity: the balance-churn-denominated period
+(specs/electra/weak-subjectivity.md :32-72 — including the published
+reference table values)."""
+
+from consensus_specs_tpu.testlib.context import (
+    ELECTRA,
+    spec_state_test,
+    with_all_phases_from,
+)
+
+with_electra_and_later = with_all_phases_from(ELECTRA)
+
+
+@with_electra_and_later
+@spec_state_test
+def test_ws_period_matches_published_table(spec, state):
+    """Pin against the table in the spec: at SAFETY_DECAY=10 and total
+    active balance T, ws_period = MIN_VALIDATOR_WITHDRAWABILITY_DELAY
+    + 10*T // (2*delta*100)."""
+    t = spec.get_total_active_balance(state)
+    delta = spec.get_balance_churn_limit(state)
+    expected = (spec.config.MIN_VALIDATOR_WITHDRAWABILITY_DELAY
+                + spec.SAFETY_DECAY * t // (2 * delta * 100))
+    assert spec.compute_weak_subjectivity_period(state) == expected
+    yield "pre", state
+    yield "post", None
+
+
+@with_electra_and_later
+@spec_state_test
+def test_ws_period_published_values(spec, state):
+    """The spec's own table: 1,048,576 ETH total balance -> 665 epochs
+    (mainnet churn floor); recompute with the formula's components."""
+    gwei_per_eth = 10**9
+    for total_eth, expected_epochs in ((1_048_576, 665),
+                                       (2_097_152, 1_075),
+                                       (4_194_304, 1_894),
+                                       (8_388_608, 3_532)):
+        t = spec.Gwei(total_eth * gwei_per_eth)
+        # mainnet churn: max(MIN_PER_EPOCH_CHURN_LIMIT_ELECTRA
+        #   = 128 ETH, T // CHURN_LIMIT_QUOTIENT), quotient 65536
+        delta = max(128 * gwei_per_eth, t // 65536)
+        got = 256 + 10 * t // (2 * delta * 100)  # mainnet MIN_..._DELAY
+        assert got == expected_epochs, (total_eth, int(got))
+    yield "pre", state
+    yield "post", None
