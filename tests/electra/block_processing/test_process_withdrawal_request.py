@@ -33,7 +33,7 @@ def _request(spec, state, index, amount):
         amount=amount)
 
 
-def _run(spec, state, request, valid=True):
+def _run(spec, state, request):
     yield "pre", state
     yield "withdrawal_request", request
     spec.process_withdrawal_request(state, request)

@@ -29,18 +29,29 @@ def test_ws_period_matches_published_table(spec, state):
 @with_electra_and_later
 @spec_state_test
 def test_ws_period_published_values(spec, state):
-    """The spec's own table: 1,048,576 ETH total balance -> 665 epochs
-    (mainnet churn floor); recompute with the formula's components."""
-    gwei_per_eth = 10**9
+    """The spec's own table, through the REAL function: size the
+    registry so get_total_active_balance hits each table row's total,
+    then assert compute_weak_subjectivity_period returns the published
+    epoch count (mainnet config values via spec_with_config)."""
+    from consensus_specs_tpu.models.builder import build_spec
+
+    mainnet_spec = build_spec("electra", "mainnet")
     for total_eth, expected_epochs in ((1_048_576, 665),
                                        (2_097_152, 1_075),
                                        (4_194_304, 1_894),
                                        (8_388_608, 3_532)):
-        t = spec.Gwei(total_eth * gwei_per_eth)
-        # mainnet churn: max(MIN_PER_EPOCH_CHURN_LIMIT_ELECTRA
-        #   = 128 ETH, T // CHURN_LIMIT_QUOTIENT), quotient 65536
-        delta = max(128 * gwei_per_eth, t // 65536)
-        got = 256 + 10 * t // (2 * delta * 100)  # mainnet MIN_..._DELAY
-        assert got == expected_epochs, (total_eth, int(got))
+        # n validators at 32 ETH effective balance
+        n = total_eth // 32
+        ws_state = mainnet_spec.BeaconState(
+            slot=mainnet_spec.SLOTS_PER_EPOCH,
+            validators=[mainnet_spec.Validator(
+                effective_balance=32 * 10**9,
+                exit_epoch=mainnet_spec.FAR_FUTURE_EPOCH,
+                withdrawable_epoch=mainnet_spec.FAR_FUTURE_EPOCH,
+            )] * n,
+            balances=[32 * 10**9] * n,
+        )
+        got = mainnet_spec.compute_weak_subjectivity_period(ws_state)
+        assert int(got) == expected_epochs, (total_eth, int(got))
     yield "pre", state
     yield "post", None
