@@ -39,6 +39,10 @@ def main(argv=None) -> int:
         cases.extend(got)
     if args.modcheck:
         return 0
+    # module selection is already applied; some modules emit cases under
+    # a different runner_name (compliance -> fork_choice_compliance,
+    # kzg_4844/kzg_7594 -> kzg), so run_generator must not re-filter
+    args.runners = []
     return run_generator(cases, args)
 
 

@@ -21,13 +21,20 @@ def prepare_signed_exits(spec, state, indices, fork_version=None):
 
 def sign_voluntary_exit(spec, state, voluntary_exit, privkey_int,
                         fork_version=None):
-    if fork_version is None:
-        domain = spec.get_domain(state, spec.DOMAIN_VOLUNTARY_EXIT,
-                                 voluntary_exit.epoch)
-    else:
+    from .forks import is_post_deneb
+
+    if fork_version is not None:
         domain = spec.compute_domain(spec.DOMAIN_VOLUNTARY_EXIT,
                                      fork_version,
                                      state.genesis_validators_root)
+    elif is_post_deneb(spec):
+        # EIP-7044 locks exit signatures to the capella domain
+        domain = spec.compute_domain(spec.DOMAIN_VOLUNTARY_EXIT,
+                                     spec.config.CAPELLA_FORK_VERSION,
+                                     state.genesis_validators_root)
+    else:
+        domain = spec.get_domain(state, spec.DOMAIN_VOLUNTARY_EXIT,
+                                 voluntary_exit.epoch)
     signing_root = spec.compute_signing_root(voluntary_exit, domain)
     return spec.SignedVoluntaryExit(
         message=voluntary_exit,
