@@ -143,7 +143,34 @@ def _worker_setup_jax():
     from consensus_specs_tpu.utils.jaxtools import enable_compile_cache
 
     enable_compile_cache()
+
+    # CST_PROFILE=<dir>: capture a jax profiler trace of the worker
+    # (TensorBoard-loadable; the tracing hook SURVEY §5.1 calls for)
+    profile_dir = os.environ.get("CST_PROFILE")
+    if profile_dir:
+        import atexit
+
+        jax.profiler.start_trace(profile_dir)
+        log(f"profiler trace -> {profile_dir}")
+        # atexit alone would lose the trace when the driver's subprocess
+        # timeout kills the worker — workers also call
+        # _stop_profile_trace() right after their measured section
+        atexit.register(_stop_profile_trace)
     return jax
+
+
+_profile_stopped = False
+
+
+def _stop_profile_trace():
+    """Flush the CST_PROFILE trace (idempotent; no-op when disabled)."""
+    global _profile_stopped
+    if not os.environ.get("CST_PROFILE") or _profile_stopped:
+        return
+    _profile_stopped = True
+    import jax
+
+    jax.profiler.stop_trace()
 
 
 def worker_epoch(n: int) -> None:
@@ -193,6 +220,7 @@ def worker_epoch(n: int) -> None:
     dt = (time.perf_counter() - t0) / iters
     log(f"{dt * 1e3:.1f} ms/step @ {n} validators "
         f"(root {np.asarray(out[3])[:2]})")
+    _stop_profile_trace()
     print(json.dumps({"seconds": dt, "platform": dev.platform}), flush=True)
 
 
@@ -236,6 +264,7 @@ def worker_bls() -> None:
     sync_dt = (time.perf_counter() - t0) / iters
     sync_base = base["oracle_seconds_per_sync_aggregate_verify"]
 
+    _stop_profile_trace()
     print(json.dumps({
         f"attestation_batch_{n_att}x{committee}_verify_wall":
             {"value": round(att_dt, 4), "unit": "s",
@@ -291,6 +320,7 @@ def worker_kzg() -> None:
         f"{time.perf_counter() - first:.1f}s")
     dev_dt = measure()
 
+    _stop_profile_trace()
     print(json.dumps({
         "blob_kzg_proof_batch_6_verify_wall":
             {"value": round(dev_dt, 4), "unit": "s",
@@ -340,6 +370,7 @@ def worker_spec() -> None:
     transition_one(state.copy())  # compile
     dev_dt = measure()
 
+    _stop_profile_trace()
     print(json.dumps({
         "minimal_phase0_state_transition_signed_block_wall":
             {"value": round(dev_dt, 4), "unit": "s",

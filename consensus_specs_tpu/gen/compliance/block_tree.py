@@ -22,15 +22,27 @@ from ...testlib.helpers.fork_choice import (
 from ...testlib.helpers.state import state_transition_and_sign_block
 
 
-def instantiate_block_tree_test(parents, votes):
+def instantiate_block_tree_test(parents, votes, n_mutations: int = 0,
+                                mutation_seed: int = 0):
     """A dual-mode test function for one abstract instance.
 
     parents: canonical parent vector (parents[0] == 0 is the anchor).
     votes: [(block_index, committee_fraction_percent)] attestation loads.
+    n_mutations > 0 emits the MUTATED variant: the valid step sequence
+    with `n_mutations` random shift/drop/duplicate operators applied
+    (`compliance/mutations.py`), per-step validity re-derived and the
+    final head check recomputed by replaying through a fresh store.
     """
 
     def case(spec, state):
         test_steps = []
+        objects = {}  # part name -> SSZ object (for mutated replay)
+
+        def tee(part_gen):
+            for name, obj in part_gen:
+                objects[name] = obj
+                yield name, obj
+
         yield "anchor_state", state
         anchor_block = spec.BeaconBlock(
             state_root=spec.hash_tree_root(state))
@@ -56,7 +68,7 @@ def instantiate_block_tree_test(parents, votes):
             time = (store.genesis_time
                     + block.slot * spec.config.SECONDS_PER_SLOT)
             on_tick_and_append_step(spec, store, time, test_steps)
-            yield from add_block(spec, store, signed, test_steps)
+            yield from tee(add_block(spec, store, signed, test_steps))
 
         # vote loads: committee-fraction attestations to chosen targets
         for block_index, fraction in votes:
@@ -79,10 +91,53 @@ def instantiate_block_tree_test(parents, votes):
             if next_time > store.time:
                 on_tick_and_append_step(spec, store, next_time,
                                         test_steps)
-            yield from add_attestation(spec, store, attestation,
-                                       test_steps)
+            yield from tee(add_attestation(spec, store, attestation,
+                                           test_steps))
 
         output_head_check(spec, store, test_steps)
+
+        if n_mutations:
+            test_steps = _mutated_replay(
+                spec, state, anchor_block, test_steps, objects,
+                n_mutations, mutation_seed)
         yield "steps", test_steps
 
     return case
+
+
+def _mutated_replay(spec, anchor_state, anchor_block, base_steps,
+                    objects, n_mutations, mutation_seed):
+    """Mutate the valid sequence, replay it, annotate per-step validity,
+    and append the recomputed final head check."""
+    import random as random_mod
+
+    from ...testlib.helpers.fork_choice import encode_hex
+    from .mutations import mutate_steps
+
+    rng = random_mod.Random(mutation_seed)
+    steps = mutate_steps(base_steps, rng, n_mutations)
+
+    store = spec.get_forkchoice_store(anchor_state, anchor_block)
+    out_steps = []
+    for step in steps:
+        step = dict(step)
+        try:
+            if "tick" in step:
+                spec.on_tick(store, step["tick"])
+            elif "block" in step:
+                signed = objects[step["block"]]
+                spec.on_block(store, signed)
+                for attestation in signed.message.body.attestations:
+                    spec.on_attestation(store, attestation,
+                                        is_from_block=True)
+            elif "attestation" in step:
+                spec.on_attestation(store, objects[step["attestation"]])
+        except (AssertionError, KeyError):
+            step["valid"] = False
+        out_steps.append(step)
+
+    head = spec.get_head(store)
+    out_steps.append({"checks": {
+        "head": {"slot": int(store.blocks[head].slot),
+                 "root": encode_hex(head)}}})
+    return out_steps

@@ -1,0 +1,78 @@
+"""Step-sequence mutation operators (the role of
+`compliance_runners/fork_choice/instantiators/mutation_operators.py`):
+derive adversarial orderings from a valid fork-choice step sequence —
+time-shifted, dropped, and duplicated message deliveries — while keeping
+the sequence REPLAYABLE (ticks stay monotone, the store never sees a
+time earlier than it already reached).
+
+A mutated vector carries no step-by-step checks (intermediate store
+state differs run to run); the final head check is recomputed by
+replaying the mutated sequence through the spec's own store, so the
+vector still asserts spec conformance."""
+
+from __future__ import annotations
+
+
+def _message_indices(steps):
+    """Indices of movable events (block/attestation deliveries — ticks
+    and checks are scheduling scaffolding)."""
+    return [i for i, step in enumerate(steps)
+            if "block" in step or "attestation" in step]
+
+
+def mut_shift(steps, rng):
+    """Move one message delivery to a later position (delayed
+    delivery)."""
+    indices = _message_indices(steps)
+    if len(indices) < 2:
+        return list(steps)
+    src = rng.choice(indices[:-1])
+    dst = rng.choice([i for i in indices if i > src])
+    out = list(steps)
+    moved = out.pop(src)
+    out.insert(dst, moved)
+    return out
+
+
+def mut_drop(steps, rng):
+    """Drop one message delivery (lost message)."""
+    indices = _message_indices(steps)
+    if not indices:
+        return list(steps)
+    victim = rng.choice(indices)
+    return [step for i, step in enumerate(steps) if i != victim]
+
+
+def mut_dup(steps, rng):
+    """Deliver one message twice (gossip duplicate) at a later point."""
+    indices = _message_indices(steps)
+    if not indices:
+        return list(steps)
+    src = rng.choice(indices)
+    out = list(steps)
+    insert_at = rng.randrange(src + 1, len(out) + 1)
+    out.insert(insert_at, dict(out[src]))
+    return out
+
+
+MUTATIONS = (mut_shift, mut_drop, mut_dup)
+
+
+def strip_checks(steps):
+    """Remove per-step checks; keep ticks and deliveries."""
+    out = []
+    for step in steps:
+        step = {k: v for k, v in step.items() if k != "checks"}
+        if step:
+            out.append(step)
+    return out
+
+
+def mutate_steps(steps, rng, count: int):
+    """Apply `count` random mutation operators to a check-stripped copy
+    of `steps`."""
+    out = strip_checks(steps)
+    for _ in range(count):
+        op = rng.choice(MUTATIONS)
+        out = op(out, rng)
+    return out

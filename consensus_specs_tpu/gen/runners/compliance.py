@@ -19,6 +19,7 @@ TINY = {
     "max_branching": 2,
     "seed": 123,
     "nr_variations": 2,
+    "nr_mutations": 1,
 }
 
 
@@ -31,14 +32,23 @@ def iter_tiny_cases():
             rng, len(parents), TINY["nr_variations"])
         for var_index, votes in enumerate(variations):
             name = f"block_tree_{tree_index}_var_{var_index}"
-            yield name, parents, votes
+            yield name, parents, votes, 0, 0
+            for mutation in range(TINY["nr_mutations"]):
+                # fold the case identity into the seed so the operator
+                # draws differ across the suite
+                seed = (TINY["seed"] + 1000 * tree_index
+                        + 100 * var_index + mutation)
+                yield (f"{name}_mut_{mutation}", parents, votes,
+                       mutation + 1, seed)
 
 
 def get_test_cases():
     cases = []
-    for name, parents, votes in iter_tiny_cases():
+    for name, parents, votes, n_mutations, seed in iter_tiny_cases():
         tfn = with_phases(["phase0"])(spec_state_test(
-            instantiate_block_tree_test(parents, votes)))
+            instantiate_block_tree_test(
+                parents, votes, n_mutations=n_mutations,
+                mutation_seed=seed)))
         cases.append(TestCase(
             fork_name="phase0",
             preset_name="minimal",

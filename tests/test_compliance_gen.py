@@ -41,8 +41,11 @@ def test_compliance_vectors_replay(tmp_path):
 
     import argparse
 
-    cases = compliance.get_test_cases()[:2]
-    assert cases
+    all_cases = compliance.get_test_cases()
+    # two base instances + their mutated variants
+    cases = [c for c in all_cases if "_mut_" not in c.case_name][:2] \
+        + [c for c in all_cases if "_mut_" in c.case_name][:2]
+    assert len(cases) == 4
     args = argparse.Namespace(
         output=str(tmp_path), runners=[], presets=[], forks=[], cases=[],
         threads=1, disable_bls=True, modcheck=False, verbose=False)
@@ -61,21 +64,27 @@ def test_compliance_vectors_replay(tmp_path):
         steps = yaml.safe_load((case_dir / "steps.yaml").read_text())
         checks_seen = 0
         for step in steps:
-            if "tick" in step:
-                spec.on_tick(store, step["tick"])
-            elif "block" in step:
-                block = spec.SignedBeaconBlock.decode_bytes(decompress(
-                    (case_dir / f"{step['block']}.ssz_snappy")
-                    .read_bytes()))
-                spec.on_block(store, block)
-                for attestation in block.message.body.attestations:
-                    spec.on_attestation(store, attestation,
-                                        is_from_block=True)
-            elif "attestation" in step:
-                attestation = spec.Attestation.decode_bytes(decompress(
-                    (case_dir / f"{step['attestation']}.ssz_snappy")
-                    .read_bytes()))
-                spec.on_attestation(store, attestation)
+            expect_valid = step.get("valid", True)
+            try:
+                if "tick" in step:
+                    spec.on_tick(store, step["tick"])
+                elif "block" in step:
+                    block = spec.SignedBeaconBlock.decode_bytes(decompress(
+                        (case_dir / f"{step['block']}.ssz_snappy")
+                        .read_bytes()))
+                    spec.on_block(store, block)
+                    for attestation in block.message.body.attestations:
+                        spec.on_attestation(store, attestation,
+                                            is_from_block=True)
+                elif "attestation" in step:
+                    attestation = spec.Attestation.decode_bytes(decompress(
+                        (case_dir / f"{step['attestation']}.ssz_snappy")
+                        .read_bytes()))
+                    spec.on_attestation(store, attestation)
+            except AssertionError:
+                assert not expect_valid, f"step unexpectedly rejected: {step}"
+            else:
+                assert expect_valid, f"step unexpectedly accepted: {step}"
             if "checks" in step:
                 checks = step["checks"]
                 if "head" in checks:
@@ -87,4 +96,4 @@ def test_compliance_vectors_replay(tmp_path):
                     checks_seen += 1
         assert checks_seen > 0
         replayed += 1
-    assert replayed == 2
+    assert replayed == 4
