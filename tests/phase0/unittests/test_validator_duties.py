@@ -1,0 +1,164 @@
+"""phase0 honest-validator duties: committee assignment, aggregation
+selection, subnet computation, eth1 voting, signature helpers (scenario
+parity: `test/phase0/unittests/validator/test_validator_unittest.py`)."""
+
+from random import Random
+
+from consensus_specs_tpu.testlib.context import (
+    always_bls,
+    spec_state_test,
+    with_all_phases,
+)
+from consensus_specs_tpu.testlib.helpers.block import (
+    build_empty_block_for_next_slot,
+)
+from consensus_specs_tpu.testlib.helpers.keys import privkeys, pubkeys
+from consensus_specs_tpu.testlib.helpers.state import next_epoch
+from consensus_specs_tpu.ops import bls
+
+
+@with_all_phases
+@spec_state_test
+def test_committee_assignment_covers_every_active_validator(spec, state):
+    """Each active validator has exactly one committee assignment in the
+    current epoch, consistent with get_beacon_committee."""
+    epoch = spec.get_current_epoch(state)
+    seen = set()
+    for index in spec.get_active_validator_indices(state, epoch):
+        assignment = spec.get_committee_assignment(state, epoch, index)
+        assert assignment is not None
+        committee, committee_index, slot = assignment
+        assert index in committee
+        assert spec.compute_epoch_at_slot(slot) == epoch
+        assert list(committee) == list(spec.get_beacon_committee(
+            state, slot, committee_index))
+        assert index not in seen
+        seen.add(index)
+    yield "pre", state
+    yield "post", None
+
+
+@with_all_phases
+@spec_state_test
+def test_committee_assignment_future_epoch_bound(spec, state):
+    """Assignments are only computable through the next epoch."""
+    from consensus_specs_tpu.testlib.utils import expect_assertion_error
+
+    next_ep = spec.get_current_epoch(state) + 1
+    assert spec.get_committee_assignment(state, next_ep, 0) is not None
+    expect_assertion_error(lambda: spec.get_committee_assignment(
+        state, next_ep + 1, 0))
+    yield "pre", state
+    yield "post", None
+
+
+@with_all_phases
+@spec_state_test
+def test_is_proposer_matches_proposer_index(spec, state):
+    proposer = spec.get_beacon_proposer_index(state)
+    assert spec.is_proposer(state, proposer)
+    non_proposers = [i for i in range(len(state.validators))
+                     if i != proposer]
+    assert not spec.is_proposer(state, non_proposers[0])
+    yield "pre", state
+    yield "post", None
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_aggregator_selection_is_signature_deterministic(spec, state):
+    """is_aggregator depends only on the slot signature; modulo math
+    keeps at least TARGET_AGGREGATORS_PER_COMMITTEE expected hits."""
+    slot = state.slot
+    committee_index = spec.CommitteeIndex(0)
+    committee = spec.get_beacon_committee(state, slot, committee_index)
+    hits = 0
+    for validator_index in committee:
+        signature = spec.get_slot_signature(
+            state, slot, privkeys[validator_index])
+        if spec.is_aggregator(state, slot, committee_index, signature):
+            hits += 1
+        # deterministic: same signature, same answer
+        assert spec.is_aggregator(
+            state, slot, committee_index, signature) == \
+            spec.is_aggregator(state, slot, committee_index, signature)
+    modulo = max(1, len(committee)
+                 // int(spec.TARGET_AGGREGATORS_PER_COMMITTEE))
+    if modulo == 1:
+        assert hits == len(committee)
+    yield "pre", state
+    yield "post", None
+
+
+@with_all_phases
+@spec_state_test
+def test_attestation_subnet_is_stable_partition(spec, state):
+    epoch = spec.get_current_epoch(state)
+    committees_per_slot = spec.get_committee_count_per_slot(state, epoch)
+    n_subnets = int(spec.config.ATTESTATION_SUBNET_COUNT)
+    for slot in range(int(state.slot),
+                      int(state.slot) + int(spec.SLOTS_PER_EPOCH)):
+        for committee_index in range(int(committees_per_slot)):
+            subnet = spec.compute_subnet_for_attestation(
+                committees_per_slot, spec.Slot(slot),
+                spec.CommitteeIndex(committee_index))
+            assert 0 <= int(subnet) < n_subnets
+    yield "pre", state
+    yield "post", None
+
+
+@with_all_phases
+@spec_state_test
+def test_subscribed_subnets_deterministic_per_epoch(spec, state):
+    node_id = spec.NodeID(12345678901234567890)
+    epoch = spec.get_current_epoch(state)
+    first = spec.compute_subscribed_subnets(node_id, epoch)
+    second = spec.compute_subscribed_subnets(node_id, epoch)
+    assert list(first) == list(second)
+    assert len(first) == int(spec.config.SUBNETS_PER_NODE)
+    assert all(0 <= int(s) < int(spec.config.ATTESTATION_SUBNET_COUNT)
+               for s in first)
+    yield "pre", state
+    yield "post", None
+
+
+@with_all_phases
+@spec_state_test
+def test_eth1_vote_default_on_no_candidates(spec, state):
+    """With no candidate eth1 blocks, the vote falls back to the state's
+    current eth1_data (or the leading pending vote)."""
+    next_epoch(spec, state)
+    vote = spec.get_eth1_vote(state, [])
+    assert vote == state.eth1_data
+    yield "pre", state
+    yield "post", None
+
+
+@with_all_phases
+@spec_state_test
+@always_bls
+def test_randao_reveal_verifies_under_proposal_domain(spec, state):
+    block = build_empty_block_for_next_slot(spec, state)
+    proposer = block.proposer_index
+    epoch = spec.compute_epoch_at_slot(block.slot)
+    signature = spec.get_epoch_signature(state, block,
+                                         privkeys[proposer])
+    domain = spec.get_domain(state, spec.DOMAIN_RANDAO, epoch)
+    signing_root = spec.compute_signing_root(spec.Epoch(epoch), domain)
+    assert bls.Verify(pubkeys[proposer], signing_root, signature)
+    yield "pre", state
+    yield "post", None
+
+
+@with_all_phases
+@spec_state_test
+def test_compute_new_state_root_matches_transition(spec, state):
+    block = build_empty_block_for_next_slot(spec, state)
+    root = spec.compute_new_state_root(state.copy(), block)
+    shadow = state.copy()
+    spec.process_slots(shadow, block.slot)
+    spec.process_block(shadow, block)
+    assert root == spec.hash_tree_root(shadow)
+    yield "pre", state
+    yield "post", None
