@@ -84,3 +84,137 @@ def test_random_inactivity_scores_leaking(spec, state):
     for index in spec.get_eligible_validator_indices(state):
         assert (state.inactivity_scores[index]
                 == pre_scores[index] + int(spec.config.INACTIVITY_SCORE_BIAS))
+
+
+def _randomize_scores(spec, state, rng):
+    state.inactivity_scores = [rng.randint(0, 100)
+                               for _ in range(len(state.validators))]
+
+
+def _randomize_flags(spec, state, rng):
+    from consensus_specs_tpu.testlib.helpers.random import (
+        randomize_previous_epoch_participation,
+    )
+
+    randomize_previous_epoch_participation(spec, state, rng)
+
+
+def _run_and_check_monotonicity(spec, state):
+    """Shared oracle: scores of participating eligibles fall (or stay),
+    non-participants rise by the bias (minus recovery off-leak).
+
+    The leak flag is read AFTER the justification step, exactly where
+    the spec's recovery branch reads it."""
+    from consensus_specs_tpu.testlib.helpers.epoch_processing import (
+        run_epoch_processing_to,
+    )
+
+    run_epoch_processing_to(spec, state, "process_inactivity_updates")
+    leaking = spec.is_in_inactivity_leak(state)
+    pre_scores = list(state.inactivity_scores)
+    previous_epoch = spec.get_previous_epoch(state)
+    participating = set(spec.get_unslashed_participating_indices(
+        state, spec.TIMELY_TARGET_FLAG_INDEX, previous_epoch))
+
+    yield "pre", state
+    spec.process_inactivity_updates(state)
+    yield "post", state
+
+    for index in spec.get_eligible_validator_indices(state):
+        pre = int(pre_scores[index])
+        post = int(state.inactivity_scores[index])
+        if index in participating:
+            assert post <= pre
+        else:
+            delta = int(spec.config.INACTIVITY_SCORE_BIAS)
+            if not leaking:
+                delta -= min(
+                    int(spec.config.INACTIVITY_SCORE_RECOVERY_RATE),
+                    pre + delta)
+            assert post == pre + delta
+
+
+@with_altair_and_later
+@spec_state_test
+def test_random_scores_random_participation(spec, state):
+    from random import Random
+
+    rng = Random(10101)
+    next_epoch(spec, state)
+    next_epoch(spec, state)
+    _randomize_scores(spec, state, rng)
+    _randomize_flags(spec, state, rng)
+    yield from _run_and_check_monotonicity(spec, state)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_random_scores_random_participation_leaking(spec, state):
+    from random import Random
+
+    from consensus_specs_tpu.testlib.helpers.rewards import (
+        transition_state_to_leak,
+    )
+
+    rng = Random(10102)
+    transition_state_to_leak(spec, state)
+    _randomize_scores(spec, state, rng)
+    _randomize_flags(spec, state, rng)
+    assert spec.is_in_inactivity_leak(state)
+    yield from _run_and_check_monotonicity(spec, state)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_some_slashed_full_participation(spec, state):
+    """Slashed validators cannot count as participating: their scores
+    rise even with their flags set."""
+    from random import Random
+
+    rng = Random(10103)
+    _, _, state = next_epoch_with_attestations(spec, state, True, False)
+    _randomize_scores(spec, state, rng)
+    n_slashed = len(state.validators) // 4
+    for index in range(n_slashed):
+        state.validators[index].slashed = True
+    leaking = spec.is_in_inactivity_leak(state)
+
+    pre_scores = list(state.inactivity_scores)
+    yield from run_epoch_processing_with(
+        spec, state, "process_inactivity_updates")
+
+    eligible = set(spec.get_eligible_validator_indices(state))
+    for index in range(n_slashed):
+        if index not in eligible:
+            continue
+        pre = int(pre_scores[index])
+        delta = int(spec.config.INACTIVITY_SCORE_BIAS)
+        if not leaking:
+            delta -= min(int(spec.config.INACTIVITY_SCORE_RECOVERY_RATE),
+                         pre + delta)
+        assert int(state.inactivity_scores[index]) == pre + delta
+
+
+@with_altair_and_later
+@spec_state_test
+def test_score_one_clamps_to_zero(spec, state):
+    """Recovery clamps at zero (no uint64 wrap): a participating
+    validator at score 1 lands exactly on 0; a non-participant lands on
+    the oracle value, never a wrapped giant."""
+    from consensus_specs_tpu.testlib.helpers.attestations import (
+        next_epoch_with_attestations as _full_epoch,
+    )
+
+    _, _, state = _full_epoch(spec, state, True, False)
+    state.inactivity_scores = [1] * len(state.validators)
+    previous_epoch = spec.get_previous_epoch(state)
+    yield from run_epoch_processing_with(
+        spec, state, "process_inactivity_updates")
+    participating = set(spec.get_unslashed_participating_indices(
+        state, spec.TIMELY_TARGET_FLAG_INDEX, previous_epoch))
+    for index in spec.get_eligible_validator_indices(state):
+        score = int(state.inactivity_scores[index])
+        if index in participating:
+            assert score == 0  # 1 - min(1,1) - recovery-clamp
+        else:
+            assert score <= 1 + int(spec.config.INACTIVITY_SCORE_BIAS)

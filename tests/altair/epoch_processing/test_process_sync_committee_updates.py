@@ -46,3 +46,61 @@ def test_sync_committees_progress_at_period_boundary(spec, state):
     assert state.current_sync_committee == next_sync_committee
     expected_next = spec.get_next_sync_committee(state)
     assert state.next_sync_committee == expected_next
+
+
+@with_altair_and_later
+@spec_state_test
+def test_sync_committees_progress_misc_balances(spec, state):
+    """Rotation samples by effective balance: perturbed balances still
+    produce a valid committee of registered pubkeys."""
+    from random import Random
+
+    rng = Random(404)
+    for index in range(len(state.validators)):
+        if rng.random() < 0.5:
+            eff = spec.Gwei(
+                int(spec.EFFECTIVE_BALANCE_INCREMENT)
+                * rng.randint(1, int(spec.MAX_EFFECTIVE_BALANCE
+                                     // spec.EFFECTIVE_BALANCE_INCREMENT)))
+            # keep balance in the hysteresis band so the perturbation
+            # survives the epoch boundaries before rotation
+            state.validators[index].effective_balance = eff
+            state.balances[index] = eff
+
+    for _ in range(int(spec.EPOCHS_PER_SYNC_COMMITTEE_PERIOD) - 1):
+        next_epoch(spec, state)
+    assert len({int(v.effective_balance)
+                for v in state.validators}) > 1
+
+    yield from run_epoch_processing_with(
+        spec, state, "process_sync_committee_updates")
+
+    registered = {bytes(v.pubkey) for v in state.validators}
+    assert all(bytes(pk) in registered
+               for pk in state.next_sync_committee.pubkeys)
+    assert state.next_sync_committee == spec.get_next_sync_committee(state)
+
+
+@with_altair_and_later
+@spec_state_test
+def test_aggregate_pubkey_matches_members(spec, state):
+    """The rotated committee's aggregate pubkey is the aggregate of its
+    members."""
+    from consensus_specs_tpu.ops import bls
+
+    for _ in range(int(spec.EPOCHS_PER_SYNC_COMMITTEE_PERIOD) - 1):
+        next_epoch(spec, state)
+
+    # the rotation must aggregate with real crypto for the invariant
+    # to be observable (the suite default stubs AggregatePKs)
+    prev_active = bls.bls_active
+    bls.bls_active = True
+    try:
+        yield from run_epoch_processing_with(
+            spec, state, "process_sync_committee_updates")
+        committee = state.next_sync_committee
+        expected = bls.AggregatePKs(
+            [bytes(pk) for pk in committee.pubkeys])
+    finally:
+        bls.bls_active = prev_active
+    assert bytes(committee.aggregate_pubkey) == bytes(expected)
