@@ -177,11 +177,18 @@ def test_some_slashed_full_participation(spec, state):
     n_slashed = len(state.validators) // 4
     for index in range(n_slashed):
         state.validators[index].slashed = True
-    leaking = spec.is_in_inactivity_leak(state)
 
+    from consensus_specs_tpu.testlib.helpers.epoch_processing import (
+        run_epoch_processing_to,
+    )
+
+    # read the leak flag where the spec's recovery branch reads it
+    run_epoch_processing_to(spec, state, "process_inactivity_updates")
+    leaking = spec.is_in_inactivity_leak(state)
     pre_scores = list(state.inactivity_scores)
-    yield from run_epoch_processing_with(
-        spec, state, "process_inactivity_updates")
+    yield "pre", state
+    spec.process_inactivity_updates(state)
+    yield "post", state
 
     eligible = set(spec.get_eligible_validator_indices(state))
     for index in range(n_slashed):
@@ -201,11 +208,7 @@ def test_score_one_clamps_to_zero(spec, state):
     """Recovery clamps at zero (no uint64 wrap): a participating
     validator at score 1 lands exactly on 0; a non-participant lands on
     the oracle value, never a wrapped giant."""
-    from consensus_specs_tpu.testlib.helpers.attestations import (
-        next_epoch_with_attestations as _full_epoch,
-    )
-
-    _, _, state = _full_epoch(spec, state, True, False)
+    _, _, state = next_epoch_with_attestations(spec, state, True, False)
     state.inactivity_scores = [1] * len(state.validators)
     previous_epoch = spec.get_previous_epoch(state)
     yield from run_epoch_processing_with(

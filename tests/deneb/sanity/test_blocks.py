@@ -29,14 +29,16 @@ from consensus_specs_tpu.testlib.helpers.state import (
 with_deneb_and_later = with_all_phases_from(DENEB)
 
 
-def run_block_with_blobs(spec, state, blob_count):
+def run_block_with_blobs(spec, state, blob_count, tx_count=1,
+                         non_blob_txs=0):
     yield "pre", state
 
     block = build_empty_block_for_next_slot(spec, state)
     opaque_tx, _, blob_kzg_commitments, _ = get_sample_blob_tx(
         spec, blob_count)
+    txs = [opaque_tx] * tx_count + [b"\x99" * 64] * non_blob_txs
     block.body.blob_kzg_commitments = blob_kzg_commitments
-    block.body.execution_payload.transactions = [opaque_tx]
+    block.body.execution_payload.transactions = txs
     block.body.execution_payload.block_hash = compute_el_block_hash(
         spec, block.body.execution_payload, state)
     signed_block = state_transition_and_sign_block(spec, state, block)
@@ -81,3 +83,17 @@ def test_invalid_exceed_max_blobs_per_block(spec, state):
         spec, state, block, expect_fail=True)
     assert signed_block is None
     yield "post", None
+
+
+@with_deneb_and_later
+@spec_state_test
+def test_one_blob_two_txs(spec, state):
+    """The same blob tx twice: commitments still bound once."""
+    yield from run_block_with_blobs(spec, state, blob_count=1, tx_count=2)
+
+
+@with_deneb_and_later
+@spec_state_test
+def test_mix_blob_tx_and_non_blob_tx(spec, state):
+    yield from run_block_with_blobs(spec, state, blob_count=1,
+                                    non_blob_txs=2)
