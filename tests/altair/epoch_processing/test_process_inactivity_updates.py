@@ -10,6 +10,7 @@ from consensus_specs_tpu.testlib.helpers.attestations import (
     next_epoch_with_attestations,
 )
 from consensus_specs_tpu.testlib.helpers.epoch_processing import (
+    run_epoch_processing_to,
     run_epoch_processing_with,
 )
 from consensus_specs_tpu.testlib.helpers.state import (
@@ -105,10 +106,6 @@ def _run_and_check_monotonicity(spec, state):
 
     The leak flag is read AFTER the justification step, exactly where
     the spec's recovery branch reads it."""
-    from consensus_specs_tpu.testlib.helpers.epoch_processing import (
-        run_epoch_processing_to,
-    )
-
     run_epoch_processing_to(spec, state, "process_inactivity_updates")
     leaking = spec.is_in_inactivity_leak(state)
     pre_scores = list(state.inactivity_scores)
@@ -177,10 +174,6 @@ def test_some_slashed_full_participation(spec, state):
     n_slashed = len(state.validators) // 4
     for index in range(n_slashed):
         state.validators[index].slashed = True
-
-    from consensus_specs_tpu.testlib.helpers.epoch_processing import (
-        run_epoch_processing_to,
-    )
 
     # read the leak flag where the spec's recovery branch reads it
     run_epoch_processing_to(spec, state, "process_inactivity_updates")

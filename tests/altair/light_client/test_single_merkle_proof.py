@@ -1,0 +1,66 @@
+"""Altair light client: single merkle proofs for the three LC branches
+(scenario parity:
+`test/altair/light_client/test_single_merkle_proof.py`)."""
+
+from consensus_specs_tpu.testlib.context import (
+    ALTAIR,
+    spec_state_test,
+    spec_state_test_with_matching_config,
+    with_all_phases_from,
+)
+
+with_light_client = with_all_phases_from(ALTAIR)
+
+
+def _run_branch_case(spec, state, gindex, leaf):
+    yield "object", state
+    proof = spec.compute_merkle_proof(state, gindex)
+    yield "proof", "data", {
+        "leaf": "0x" + bytes(leaf).hex(),
+        "leaf_index": int(gindex),
+        "branch": ["0x" + bytes(node).hex() for node in proof],
+    }
+    assert spec.is_valid_merkle_branch(
+        leaf=leaf,
+        branch=proof,
+        depth=spec.floorlog2(gindex),
+        index=spec.get_subtree_index(gindex),
+        root=spec.hash_tree_root(state),
+    )
+    # a corrupted branch fails
+    bad = list(proof)
+    bad[0] = spec.Bytes32(b"\x66" * 32)
+    assert not spec.is_valid_merkle_branch(
+        leaf=leaf,
+        branch=bad,
+        depth=spec.floorlog2(gindex),
+        index=spec.get_subtree_index(gindex),
+        root=spec.hash_tree_root(state),
+    )
+
+
+@with_light_client
+@spec_state_test_with_matching_config
+def test_current_sync_committee_merkle_proof(spec, state):
+    yield from _run_branch_case(
+        spec, state,
+        spec.current_sync_committee_gindex_at_slot(state.slot),
+        spec.hash_tree_root(state.current_sync_committee))
+
+
+@with_light_client
+@spec_state_test_with_matching_config
+def test_next_sync_committee_merkle_proof(spec, state):
+    yield from _run_branch_case(
+        spec, state,
+        spec.next_sync_committee_gindex_at_slot(state.slot),
+        spec.hash_tree_root(state.next_sync_committee))
+
+
+@with_light_client
+@spec_state_test_with_matching_config
+def test_finality_root_merkle_proof(spec, state):
+    yield from _run_branch_case(
+        spec, state,
+        spec.finalized_root_gindex_at_slot(state.slot),
+        state.finalized_checkpoint.root)
