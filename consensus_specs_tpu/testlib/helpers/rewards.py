@@ -307,7 +307,9 @@ def run_test_partial(spec, state, fraction_filled):
         state.previous_epoch_attestations = \
             state.previous_epoch_attestations[:num_attestations]
     else:
-        for index in range(int(len(state.validators) * fraction_filled)):
+        # keep `fraction_filled` participating (mirror the phase0 branch)
+        n_keep = int(len(state.validators) * fraction_filled)
+        for index in range(n_keep, len(state.validators)):
             state.previous_epoch_participation[index] = \
                 spec.ParticipationFlags(0)
     yield from run_deltas(spec, state)
@@ -319,8 +321,14 @@ def run_test_half_full(spec, state):
 
 def run_test_one_attestation_one_correct(spec, state):
     cached_prepare_state_with_attestations(spec, state)
-    state.previous_epoch_attestations = \
-        state.previous_epoch_attestations[:1]
+    if not is_post_altair(spec):
+        state.previous_epoch_attestations = \
+            state.previous_epoch_attestations[:1]
+    else:
+        # a single fully-correct participant
+        for index in range(1, len(state.validators)):
+            state.previous_epoch_participation[index] = \
+                spec.ParticipationFlags(0)
     yield from run_deltas(spec, state)
 
 
@@ -373,13 +381,26 @@ def run_test_some_very_low_effective_balances_that_did_not_attest(
 def run_test_full_fraction_incorrect(spec, state, correct_target,
                                      correct_head, fraction_incorrect):
     cached_prepare_state_with_attestations(spec, state)
-    num_incorrect = int(fraction_incorrect
-                        * len(state.previous_epoch_attestations))
-    for pending in state.previous_epoch_attestations[:num_incorrect]:
-        if not correct_target:
-            pending.data.target.root = b"\x55" * 32
-        if not correct_head:
-            pending.data.beacon_block_root = b"\x66" * 32
+    if not is_post_altair(spec):
+        num_incorrect = int(fraction_incorrect
+                            * len(state.previous_epoch_attestations))
+        for pending in state.previous_epoch_attestations[:num_incorrect]:
+            if not correct_target:
+                pending.data.target.root = b"\x55" * 32
+            if not correct_head:
+                pending.data.beacon_block_root = b"\x66" * 32
+    else:
+        # clear the corresponding flags for the chosen fraction
+        num_incorrect = int(fraction_incorrect * len(state.validators))
+        for index in range(num_incorrect):
+            flags = state.previous_epoch_participation[index]
+            if not correct_target:
+                flags &= ~spec.ParticipationFlags(
+                    1 << spec.TIMELY_TARGET_FLAG_INDEX)
+            if not correct_head:
+                flags &= ~spec.ParticipationFlags(
+                    1 << spec.TIMELY_HEAD_FLAG_INDEX)
+            state.previous_epoch_participation[index] = flags
     yield from run_deltas(spec, state)
 
 

@@ -39,3 +39,81 @@ def test_half_full_leak(spec, state):
 def test_full_but_partial_participation_leak(spec, state):
     assert spec.is_in_inactivity_leak(state)
     yield from rewards.run_test_full_but_partial_participation(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+@leaking()
+def test_quarter_full_leak(spec, state):
+    assert spec.is_in_inactivity_leak(state)
+    yield from rewards.run_test_partial(spec, state, 0.25)
+
+
+@with_all_phases
+@spec_state_test
+@leaking()
+def test_one_attestation_one_correct_leak(spec, state):
+    assert spec.is_in_inactivity_leak(state)
+    yield from rewards.run_test_one_attestation_one_correct(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+@leaking()
+def test_with_not_yet_activated_validators_leak(spec, state):
+    assert spec.is_in_inactivity_leak(state)
+    yield from rewards.run_test_with_not_yet_activated_validators(
+        spec, state)
+
+
+@with_all_phases
+@spec_state_test
+@leaking()
+def test_with_exited_validators_leak(spec, state):
+    assert spec.is_in_inactivity_leak(state)
+    yield from rewards.run_test_with_exited_validators(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+@leaking()
+def test_with_slashed_validators_leak(spec, state):
+    assert spec.is_in_inactivity_leak(state)
+    yield from rewards.run_test_with_slashed_validators(spec, state)
+
+
+@with_all_phases
+@spec_state_test
+@leaking()
+def test_some_very_low_effective_balances_that_attested_leak(spec, state):
+    assert spec.is_in_inactivity_leak(state)
+    yield from rewards.run_test_some_very_low_effective_balances_that_attested(
+        spec, state)
+
+
+@with_all_phases
+@spec_state_test
+@leaking()
+def test_full_half_correct_target_incorrect_head_leak(spec, state):
+    assert spec.is_in_inactivity_leak(state)
+    yield from rewards.run_test_full_fraction_incorrect(
+        spec, state, correct_target=True, correct_head=False,
+        fraction_incorrect=0.5)
+
+
+@with_all_phases
+@spec_state_test
+@leaking()
+def test_full_half_incorrect_target_correct_head_leak(spec, state):
+    assert spec.is_in_inactivity_leak(state)
+    yield from rewards.run_test_full_fraction_incorrect(
+        spec, state, correct_target=False, correct_head=True,
+        fraction_incorrect=0.5)
+
+
+@with_all_phases
+@spec_state_test
+@leaking()
+def test_full_random_leak(spec, state):
+    assert spec.is_in_inactivity_leak(state)
+    yield from rewards.run_test_full_random(spec, state)

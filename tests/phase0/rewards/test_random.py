@@ -1,0 +1,56 @@
+"""Rewards over randomized states (reference:
+test/phase0/rewards/test_random.py)."""
+
+from random import Random
+
+from consensus_specs_tpu.testlib.context import (
+    spec_state_test,
+    with_all_phases,
+)
+from consensus_specs_tpu.testlib.helpers import rewards
+
+
+@with_all_phases
+@spec_state_test
+def test_full_random_0(spec, state):
+    yield from rewards.run_test_full_random(spec, state, rng=Random(1010))
+
+
+@with_all_phases
+@spec_state_test
+def test_full_random_1(spec, state):
+    yield from rewards.run_test_full_random(spec, state, rng=Random(2020))
+
+
+@with_all_phases
+@spec_state_test
+def test_full_random_2(spec, state):
+    yield from rewards.run_test_full_random(spec, state, rng=Random(3030))
+
+
+@with_all_phases
+@spec_state_test
+def test_full_random_low_balances(spec, state):
+    rng = Random(4040)
+    for index in range(len(state.validators)):
+        if rng.random() < 0.5:
+            # keep balance in the hysteresis band so the low effective
+            # balance survives randomize_state's epoch transitions
+            state.validators[index].effective_balance = \
+                spec.config.EJECTION_BALANCE
+            state.balances[index] = spec.config.EJECTION_BALANCE
+    yield from rewards.run_test_full_random(spec, state, rng=rng)
+
+
+@with_all_phases
+@spec_state_test
+def test_full_random_misc_balances(spec, state):
+    rng = Random(5050)
+    for index in range(len(state.validators)):
+        eff = spec.Gwei(
+            int(spec.EFFECTIVE_BALANCE_INCREMENT)
+            * rng.randint(1, int(spec.MAX_EFFECTIVE_BALANCE
+                                 // spec.EFFECTIVE_BALANCE_INCREMENT)))
+        state.validators[index].effective_balance = eff
+        state.balances[index] = eff  # survives hysteresis
+    yield from rewards.run_test_full_random(spec, state, rng=rng)
