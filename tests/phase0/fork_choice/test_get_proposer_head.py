@@ -1,0 +1,182 @@
+"""Fork choice: `get_proposer_head` — the late-head single-slot re-org
+decision (scenario parity:
+`test/phase0/fork_choice/test_get_proposer_head.py` plus the
+reorg-prerequisite matrix of `test_reorg.py`).
+
+Cases emit the standard fork_choice vector shape (anchor + steps with a
+final head check); the proposer-head expectations are python-side
+assertions on the same store."""
+
+from consensus_specs_tpu.testlib.context import (
+    spec_state_test,
+    with_all_phases,
+)
+from consensus_specs_tpu.testlib.helpers.attestations import (
+    get_valid_attestation_at_slot,
+)
+from consensus_specs_tpu.testlib.helpers.block import (
+    build_empty_block_for_next_slot,
+)
+from consensus_specs_tpu.testlib.helpers.fork_choice import (
+    add_attestation,
+    get_genesis_forkchoice_store_and_block,
+    on_tick_and_append_step,
+    output_head_check,
+    tick_and_add_block,
+)
+from consensus_specs_tpu.testlib.helpers.state import (
+    state_transition_and_sign_block,
+)
+
+
+def _begin(spec, state):
+    store, anchor_block = get_genesis_forkchoice_store_and_block(spec,
+                                                                 state)
+    return store, anchor_block, []
+
+
+def _add_block(spec, state, store, test_steps, timely=True):
+    """Import the next-slot block; returns (root, block) parts via the
+    enclosing generator."""
+    block = build_empty_block_for_next_slot(spec, state)
+    signed = state_transition_and_sign_block(spec, state, block)
+    root = spec.hash_tree_root(block)
+
+    def parts():
+        yield from tick_and_add_block(spec, store, signed, test_steps)
+        store.block_timeliness[root] = timely
+
+    return root, block, parts()
+
+
+def _enter_next_slot(spec, store, test_steps):
+    next_time = (store.genesis_time
+                 + (spec.get_current_slot(store) + 1)
+                 * spec.config.SECONDS_PER_SLOT)
+    on_tick_and_append_step(spec, store, next_time, test_steps)
+
+
+def _attest_parent_chain(spec, parent_state, store, test_steps, slots):
+    """All committees of `slots` vote for the parent-chain head (they
+    never saw the late block)."""
+    for att_slot in slots:
+        for attestation in get_valid_attestation_at_slot(
+                parent_state, spec, spec.Slot(att_slot)):
+            yield from add_attestation(spec, store, attestation,
+                                       test_steps)
+
+
+@with_all_phases
+@spec_state_test
+def test_timely_head_is_kept(spec, state):
+    store, anchor_block, test_steps = _begin(spec, state)
+    yield "anchor_state", state
+    yield "anchor_block", anchor_block
+
+    head_root, block, parts = _add_block(spec, state, store, test_steps,
+                                         timely=True)
+    yield from parts
+    _enter_next_slot(spec, store, test_steps)
+    output_head_check(spec, store, test_steps)
+    yield "steps", test_steps
+
+    assert spec.get_proposer_head(store, head_root, block.slot + 1) == \
+        head_root
+
+
+@with_all_phases
+@spec_state_test
+def test_late_weak_head_reorged_to_parent(spec, state):
+    """A late head whose own slot's attesters all voted for the parent
+    satisfies every re-org prerequisite: the proposer builds on the
+    parent."""
+    store, anchor_block, test_steps = _begin(spec, state)
+    yield "anchor_state", state
+    yield "anchor_block", anchor_block
+
+    # a timely PARENT block, then the late head on top of it
+    parent_root, parent_block, parts = _add_block(
+        spec, state, store, test_steps, timely=True)
+    yield from parts
+    parent_state = state.copy()
+    head_root, block, parts = _add_block(spec, state, store, test_steps,
+                                         timely=False)
+    yield from parts
+    _enter_next_slot(spec, store, test_steps)
+
+    # committees of the parent's slot AND of the late head's slot vote
+    # for the parent: 200% of a slot's committee weight, clearing the
+    # 160% parent-strength threshold
+    spec.process_slots(parent_state, block.slot)
+    yield from _attest_parent_chain(
+        spec, parent_state, store, test_steps,
+        (int(parent_block.slot), int(block.slot)))
+    output_head_check(spec, store, test_steps)
+    yield "steps", test_steps
+
+    assert block.parent_root == parent_root
+    proposal_slot = block.slot + 1
+    assert spec.is_head_weak(store, head_root)
+    assert spec.is_parent_strong(store, parent_root)
+    assert spec.is_shuffling_stable(proposal_slot)
+    assert spec.get_proposer_head(store, head_root, proposal_slot) == \
+        parent_root
+
+
+@with_all_phases
+@spec_state_test
+def test_late_head_kept_at_epoch_boundary(spec, state):
+    """Same weak-head/strong-parent setup, but the proposal slot is an
+    epoch boundary: shuffling instability alone blocks the re-org."""
+    store, anchor_block, test_steps = _begin(spec, state)
+    yield "anchor_state", state
+    yield "anchor_block", anchor_block
+
+    # parent at boundary-2, late head at boundary-1
+    spec.process_slots(state, spec.Slot(int(spec.SLOTS_PER_EPOCH) - 3))
+    parent_root, parent_block, parts = _add_block(
+        spec, state, store, test_steps, timely=True)
+    yield from parts
+    parent_state = state.copy()
+    head_root, block, parts = _add_block(spec, state, store, test_steps,
+                                         timely=False)
+    yield from parts
+    _enter_next_slot(spec, store, test_steps)
+
+    spec.process_slots(parent_state, block.slot)
+    yield from _attest_parent_chain(
+        spec, parent_state, store, test_steps,
+        (int(parent_block.slot), int(block.slot)))
+    output_head_check(spec, store, test_steps)
+    yield "steps", test_steps
+
+    proposal_slot = block.slot + 1
+    assert proposal_slot % spec.SLOTS_PER_EPOCH == 0
+    # every prerequisite but shuffling stability holds
+    assert spec.is_head_weak(store, head_root)
+    assert spec.is_parent_strong(store, parent_root)
+    assert not spec.is_shuffling_stable(proposal_slot)
+    assert spec.get_proposer_head(store, head_root, proposal_slot) == \
+        head_root
+
+
+@with_all_phases
+@spec_state_test
+def test_late_head_kept_when_not_single_slot(spec, state):
+    """A two-slot-deep re-org is never attempted: proposing two slots
+    after the late head keeps the head."""
+    store, anchor_block, test_steps = _begin(spec, state)
+    yield "anchor_state", state
+    yield "anchor_block", anchor_block
+
+    head_root, block, parts = _add_block(spec, state, store, test_steps,
+                                         timely=False)
+    yield from parts
+    skip_time = (store.genesis_time
+                 + (block.slot + 2) * spec.config.SECONDS_PER_SLOT)
+    on_tick_and_append_step(spec, store, skip_time, test_steps)
+    output_head_check(spec, store, test_steps)
+    yield "steps", test_steps
+
+    assert spec.get_proposer_head(store, head_root, block.slot + 2) == \
+        head_root
