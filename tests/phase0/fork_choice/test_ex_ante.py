@@ -1,0 +1,163 @@
+"""Fork choice: ex-ante re-org protection — proposer boost shields a
+timely proposal from adversarially withheld siblings (scenario parity:
+`test/phase0/fork_choice/test_ex_ante.py`)."""
+
+from consensus_specs_tpu.testlib.context import (
+    spec_state_test,
+    with_all_phases,
+)
+from consensus_specs_tpu.testlib.helpers.attestations import (
+    get_valid_attestation,
+)
+from consensus_specs_tpu.testlib.helpers.block import (
+    build_empty_block,
+)
+from consensus_specs_tpu.testlib.helpers.fork_choice import (
+    add_attestation,
+    add_block,
+    check_head_against_root,
+    get_genesis_forkchoice_store_and_block,
+    on_tick_and_append_step,
+    output_head_check,
+    tick_and_add_block,
+)
+from consensus_specs_tpu.testlib.helpers.state import (
+    state_transition_and_sign_block,
+)
+
+
+def _start(spec, state):
+    store, anchor_block = get_genesis_forkchoice_store_and_block(spec,
+                                                                 state)
+    test_steps = []
+    current_time = (state.slot * spec.config.SECONDS_PER_SLOT
+                    + store.genesis_time)
+    on_tick_and_append_step(spec, store, current_time, test_steps)
+    return store, anchor_block, test_steps
+
+
+def _block_on(spec, parent_state, slot):
+    """(signed_block, post_state) for an empty block on a copy."""
+    post = parent_state.copy()
+    block = build_empty_block(spec, post, slot=slot)
+    signed = state_transition_and_sign_block(spec, post, block)
+    return signed, post
+
+
+def _participants_cap(n):
+    def cap(committee):
+        return set(list(committee)[:n])
+    return cap
+
+
+@with_all_phases
+@spec_state_test
+def test_ex_ante_vanilla(spec, state):
+    """A single adversarial attestation for the withheld sibling B
+    cannot outweigh block C's proposer boost: C stays head."""
+    store, anchor_block, test_steps = _start(spec, state)
+    yield "anchor_state", state
+    yield "anchor_block", anchor_block
+
+    # Block A at slot N+1
+    signed_a, state_a = _block_on(spec, state, state.slot + 1)
+    yield from tick_and_add_block(spec, store, signed_a, test_steps)
+    root_a = spec.hash_tree_root(signed_a.message)
+    check_head_against_root(spec, store, root_a)
+
+    # B (withheld) and C both build on A
+    signed_b, state_b = _block_on(spec, state_a, state_a.slot + 1)
+    signed_c, _ = _block_on(spec, state_a, state_a.slot + 2)
+
+    # C arrives timely at its own slot: boost applies
+    yield from tick_and_add_block(spec, store, signed_c, test_steps)
+    root_c = spec.hash_tree_root(signed_c.message)
+    check_head_against_root(spec, store, root_c)
+    assert store.proposer_boost_root == root_c
+
+    # the withheld B arrives late, with one adversarial attester
+    yield from add_block(spec, store, signed_b, test_steps)
+    check_head_against_root(spec, store, root_c)
+    attestation = get_valid_attestation(
+        spec, state_b, slot=signed_b.message.slot, signed=True,
+        filter_participant_set=_participants_cap(1))
+    yield from add_attestation(spec, store, attestation, test_steps)
+
+    check_head_against_root(spec, store, root_c)
+    output_head_check(spec, store, test_steps)
+    yield "steps", test_steps
+
+
+@with_all_phases
+@spec_state_test
+def test_ex_ante_attestations_beat_boost(spec, state):
+    """When the adversarial attestations for B outweigh the boost, the
+    withheld block wins — the boost only shields against small
+    advantages."""
+    store, anchor_block, test_steps = _start(spec, state)
+    yield "anchor_state", state
+    yield "anchor_block", anchor_block
+
+    signed_a, state_a = _block_on(spec, state, state.slot + 1)
+    yield from tick_and_add_block(spec, store, signed_a, test_steps)
+
+    signed_b, state_b = _block_on(spec, state_a, state_a.slot + 1)
+    signed_c, _ = _block_on(spec, state_a, state_a.slot + 2)
+
+    yield from tick_and_add_block(spec, store, signed_c, test_steps)
+    root_c = spec.hash_tree_root(signed_c.message)
+    assert store.proposer_boost_root == root_c
+
+    yield from add_block(spec, store, signed_b, test_steps)
+    root_b = spec.hash_tree_root(signed_b.message)
+
+    # every attester of B's slot voted for B: far above the boost
+    epoch = spec.get_current_epoch(state_b)
+    committees = int(spec.get_committee_count_per_slot(state_b, epoch))
+    for committee_index in range(committees):
+        attestation = get_valid_attestation(
+            spec, state_b, slot=signed_b.message.slot,
+            index=spec.CommitteeIndex(committee_index), signed=True)
+        yield from add_attestation(spec, store, attestation, test_steps)
+
+    check_head_against_root(spec, store, root_b)
+    output_head_check(spec, store, test_steps)
+    yield "steps", test_steps
+
+
+@with_all_phases
+@spec_state_test
+def test_boost_expires_at_next_slot(spec, state):
+    """The boost wears off on the next on_tick: without it, an attested
+    sibling takes the head."""
+    store, anchor_block, test_steps = _start(spec, state)
+    yield "anchor_state", state
+    yield "anchor_block", anchor_block
+
+    signed_a, state_a = _block_on(spec, state, state.slot + 1)
+    yield from tick_and_add_block(spec, store, signed_a, test_steps)
+
+    signed_b, state_b = _block_on(spec, state_a, state_a.slot + 1)
+    signed_c, _ = _block_on(spec, state_a, state_a.slot + 2)
+
+    yield from tick_and_add_block(spec, store, signed_c, test_steps)
+    root_c = spec.hash_tree_root(signed_c.message)
+    yield from add_block(spec, store, signed_b, test_steps)
+    root_b = spec.hash_tree_root(signed_b.message)
+
+    # one vote for B while C holds the boost: C stays head
+    attestation = get_valid_attestation(
+        spec, state_b, slot=signed_b.message.slot, signed=True,
+        filter_participant_set=_participants_cap(1))
+    yield from add_attestation(spec, store, attestation, test_steps)
+    check_head_against_root(spec, store, root_c)
+
+    # next slot: the boost resets; B's (only) vote now decides
+    next_time = (store.genesis_time
+                 + (signed_c.message.slot + 1)
+                 * spec.config.SECONDS_PER_SLOT)
+    on_tick_and_append_step(spec, store, next_time, test_steps)
+    assert store.proposer_boost_root == spec.Root()
+    check_head_against_root(spec, store, root_b)
+    output_head_check(spec, store, test_steps)
+    yield "steps", test_steps

@@ -19,6 +19,7 @@ from consensus_specs_tpu.testlib.helpers.block import (
 )
 from consensus_specs_tpu.testlib.helpers.fork_choice import (
     add_attestation,
+    add_block,
     get_genesis_forkchoice_store_and_block,
     on_tick_and_append_step,
     output_head_check,
@@ -37,14 +38,27 @@ def _begin(spec, state):
 
 def _add_block(spec, state, store, test_steps, timely=True):
     """Import the next-slot block; returns (root, block) parts via the
-    enclosing generator."""
+    enclosing generator.  A late block is made late ORGANICALLY — the
+    store ticks past the attestation deadline before delivery — so the
+    emitted vector encodes the lateness a consumer can replay."""
     block = build_empty_block_for_next_slot(spec, state)
     signed = state_transition_and_sign_block(spec, state, block)
     root = spec.hash_tree_root(block)
 
     def parts():
-        yield from tick_and_add_block(spec, store, signed, test_steps)
-        store.block_timeliness[root] = timely
+        if timely:
+            yield from tick_and_add_block(spec, store, signed,
+                                          test_steps)
+        else:
+            late_time = (store.genesis_time
+                         + block.slot * spec.config.SECONDS_PER_SLOT
+                         + spec.config.SECONDS_PER_SLOT
+                         // spec.INTERVALS_PER_SLOT)
+            if late_time > store.time:
+                on_tick_and_append_step(spec, store, late_time,
+                                        test_steps)
+            yield from add_block(spec, store, signed, test_steps)
+        assert store.block_timeliness[root] == timely
 
     return root, block, parts()
 
@@ -163,20 +177,34 @@ def test_late_head_kept_at_epoch_boundary(spec, state):
 @with_all_phases
 @spec_state_test
 def test_late_head_kept_when_not_single_slot(spec, state):
-    """A two-slot-deep re-org is never attempted: proposing two slots
-    after the late head keeps the head."""
+    """Same weak-head/strong-parent setup as the re-org case, but the
+    proposal comes two slots after the head: the single-slot rule alone
+    keeps the head."""
     store, anchor_block, test_steps = _begin(spec, state)
     yield "anchor_state", state
     yield "anchor_block", anchor_block
 
+    parent_state = state.copy()
     head_root, block, parts = _add_block(spec, state, store, test_steps,
                                          timely=False)
     yield from parts
+    # skip a slot: proposal is head.slot + 2
     skip_time = (store.genesis_time
                  + (block.slot + 2) * spec.config.SECONDS_PER_SLOT)
     on_tick_and_append_step(spec, store, skip_time, test_steps)
+
+    # the anchor (= the head's parent) holds 200% of a slot's votes
+    spec.process_slots(parent_state, spec.Slot(int(block.slot) + 1))
+    yield from _attest_parent_chain(
+        spec, parent_state, store, test_steps,
+        (int(block.slot), int(block.slot) + 1))
     output_head_check(spec, store, test_steps)
     yield "steps", test_steps
 
-    assert spec.get_proposer_head(store, head_root, block.slot + 2) == \
+    proposal_slot = block.slot + 2
+    # every prerequisite but the single-slot rule holds
+    assert spec.is_head_weak(store, head_root)
+    assert spec.is_parent_strong(store, block.parent_root)
+    assert spec.is_shuffling_stable(proposal_slot)
+    assert spec.get_proposer_head(store, head_root, proposal_slot) == \
         head_root
