@@ -8,13 +8,14 @@ CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 VECTOR_OUT ?= out/vectors
 
 .PHONY: test test-fast test-all test-bls lint vectors kzg_setups bench \
-	multichip help
+	bench-smoke multichip help
 
 help:
 	@echo "targets: test (fast suite) | test-all (incl. slow crypto) |"
 	@echo "  test-bls (operation suites with real signatures, jax backend) |"
 	@echo "  lint (compile + spec static checks) | vectors [VECTOR_OUT=dir] |"
-	@echo "  kzg_setups | bench (real TPU) | multichip (8-dev CPU dryrun)"
+	@echo "  kzg_setups | bench (real TPU) | bench-smoke (tiny CPU shapes,"
+	@echo "  asserts the bench JSON contract) | multichip (8-dev CPU dryrun)"
 
 test:
 	$(PYTHON) -m pytest tests/ -q -m "not slow"
@@ -47,6 +48,11 @@ kzg_setups:
 
 bench:
 	$(PYTHON) bench.py
+
+# no TPU required: tiny-shape epoch + BLS bench runs on CPU, asserting
+# the one-JSON-line-per-metric contract the external driver parses
+bench-smoke:
+	$(CPU_ENV) $(PYTHON) bench_smoke.py
 
 multichip:
 	$(CPU_ENV) $(PYTHON) -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('ok')"

@@ -1,10 +1,13 @@
 """BLS batch benchmarks — BASELINE.md configs #2 and #3.
 
 #2: 128 aggregate-attestation verifications (FastAggregateVerify-style
-    statements, 64-strong committees) — device RLC batch (129 pairings,
-    one final exponentiation) vs the pure-Python oracle loop.
+    statements, 64-strong committees) — device RLC batch (129 pairings
+    through ONE shared Fq12 Miller accumulator, one final
+    exponentiation, message hash-to-curve on device) vs the pure-Python
+    oracle loop.
 #3: one 512-member sync-committee aggregate (eth_fast_aggregate_verify
-    hot path) — device pairing check vs oracle.
+    hot path) — device pairing check with host-precomputed fixed-argument
+    Miller lines vs oracle.
 
 Prints one JSON line per metric:
   {"metric": ..., "value": ..., "unit": "s", "vs_baseline": ...}

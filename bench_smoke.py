@@ -1,0 +1,71 @@
+"""CPU smoke for the benchmark harnesses (`make bench-smoke`).
+
+Runs tiny-shape configurations of bench.py (epoch worker) and
+bench_bls.py on the CPU platform and asserts the JSON output contract
+the external driver parses — so bench bit-rot (import errors, schema
+drift, kernel regressions that crash at trace time) is caught without a
+TPU.  The kzg worker is excluded: its mainnet 4096-wide blob shapes have
+no tiny-shape knob and would dominate the lane's wall time.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+HERE = Path(__file__).resolve().parent
+
+
+def _run(cmd, env_extra, timeout):
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.update(env_extra)
+    print(f"--- {' '.join(cmd)} ---", file=sys.stderr, flush=True)
+    proc = subprocess.run([sys.executable] + cmd, capture_output=True,
+                          text=True, timeout=timeout, env=env,
+                          cwd=str(HERE))
+    if proc.stderr:
+        sys.stderr.write(proc.stderr[-2000:])
+        sys.stderr.flush()
+    if proc.returncode != 0:
+        raise SystemExit(f"{cmd}: rc={proc.returncode}")
+    parsed = []
+    for line in (proc.stdout or "").splitlines():
+        if not line.strip():
+            continue
+        try:
+            parsed.append(json.loads(line))
+        except json.JSONDecodeError:
+            raise SystemExit(f"{cmd}: non-JSON stdout line: {line!r}")
+    if not parsed:
+        raise SystemExit(f"{cmd}: produced no JSON line")
+    return parsed
+
+
+def main():
+    out = _run(["bench.py", "--worker", "epoch"],
+               {"CST_BENCH_N": "1024", "CST_NO_COMPILE_CACHE": "1"},
+               timeout=900)
+    last = out[-1]
+    assert isinstance(last.get("seconds"), (int, float)) \
+        and last["seconds"] > 0, last
+    print("bench.py epoch worker JSON OK:", json.dumps(last))
+
+    out = _run(["bench_bls.py"],
+               {"CST_BLS_BENCH_N": "2", "CST_BLS_BENCH_COMMITTEE": "2",
+                "CST_BLS_BENCH_SYNC": "4"},
+               timeout=1800)
+    metrics = [o for o in out if "metric" in o]
+    assert len(metrics) == 2, out
+    for m in metrics:
+        assert {"metric", "value", "unit", "vs_baseline"} <= set(m), m
+        assert isinstance(m["value"], (int, float)), m
+    print("bench_bls.py JSON OK:", json.dumps(metrics))
+    print("bench smoke: PASS")
+
+
+if __name__ == "__main__":
+    main()

@@ -7,7 +7,9 @@ FastAggregateVerify pairings *deferred* (inputs still validated eagerly),
 and all recorded statements — attestations, sync aggregates, indexed
 attestations from slashings — settle afterwards in ONE random-linear-
 combination batch on the accelerator (`ops.bls_batch.batch_verify`: B+1
-pairings, one final exponentiation).  Semantics match the inline path for
+pairings folded through a shared Fq12 Miller accumulator, one final
+exponentiation, and the 32-byte signing-root hash-to-curve also on
+device — the whole batch is device-resident end to end).  Semantics match the inline path for
 every valid block; an invalid aggregate signature surfaces as the batch
 check failing (AssertionError), the same acceptance boundary as the spec.
 

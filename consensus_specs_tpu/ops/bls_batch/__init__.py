@@ -5,17 +5,50 @@ Public surface:
   pairing_check_device(pairs)      drop-in for the oracle's pairing_check
                                    (`ops/bls/pairing.py:160`): product of
                                    pairings == 1, one shared final exp,
-                                   computed on device.
+                                   computed on device with HOST-precomputed
+                                   fixed-argument Miller lines (the G2
+                                   points of a pairing check are always
+                                   host-known), so the device program has
+                                   no G2 Jacobian arithmetic at all.
   batch_verify(tasks)              random-linear-combination batch of
                                    FastAggregateVerify-style checks: B
                                    signatures verified with B+1 pairings
-                                   and ONE final exponentiation, with the
-                                   G1/G2 scalar multiplications also on
-                                   device.
+                                   and ONE final exponentiation; the G1/G2
+                                   scalar multiplications AND the message
+                                   hash-to-curve (sha256 xmd + SVDW map +
+                                   cofactor clearing, `h2c_jax`) also run
+                                   on device, so the whole statement batch
+                                   is device-resident end to end.
+  g1_multi_exp_device(pts, ks)     G1 multiscalar multiplication via a
+                                   windowed bucketed (Pippenger) kernel.
 
-Host keeps parsing/subgroup checks/hash-to-curve (the oracle code); the
-device does every pairing and scalar multiplication.  Batch shapes are
-padded to power-of-two buckets so jit caches a handful of executables.
+Host keeps parsing and subgroup checks (the oracle code); the device does
+every pairing, scalar multiplication, and hash-to-curve.  Batch shapes are
+padded to a 4-step bucket ladder so each jit entry point compiles at most
+4 executables (`_bucket`).
+
+Multi-pairing soundness (why ONE shared Fq12 accumulator and 128-bit RLC
+scalars keep the forgery probability negligible, ~2^-127): the batch
+check accepts iff
+prod_i e(r_i PK_i, H_i) * e(-G1, sum_i r_i S_i) == 1, i.e. iff
+prod_i e(PK_i, H_i)^{r_i} == prod_i e(G1, S_i)^{r_i}.  Writing
+d_i = e(PK_i, H_i) / e(G1, S_i) (elements of the order-r multiplicative
+group mu_r), acceptance means prod_i d_i^{r_i} == 1.  The sampling pins
+r_0 = 1 and draws the other r_i as random ODD 128-bit values (2^127
+possibilities each; odd => nonzero mod r).  A single false statement
+with all others true is rejected deterministically when it sits at slot
+0, else: conditioning on every other coefficient, at most one of the
+2^127 values of r_i mod ord(d_i) can collapse the product to 1, so the
+acceptance probability of any forged batch is at most 2^-127 — one bit
+under the nominal 2^-RLC_SCALAR_BITS from the odd-only restriction, and
+far below any feasible attack budget.  Folding the B Miller values into
+one shared accumulator (f <- f^2 * prod_b line_b, `pairing_jax
+.miller_product_batch`) computes exactly the same product of pairings —
+conjugation and squaring are field automorphisms/homomorphisms, so the
+algebraic predicate (and hence the bound) is unchanged; only the schedule
+of Fq12 squarings differs (1 per loop bit instead of B).  See
+`tests/formats/README.md` for the vector formats that pin the
+accept/reject parity between this path and the oracle.
 
 Replaces the reference's native backends behind
 `eth2spec/utils/bls.py:141-296` (milagro `Verify`/`FastAggregateVerify`,
@@ -25,6 +58,7 @@ arkworks point ops).
 from __future__ import annotations
 
 import functools
+import os
 import secrets
 
 import numpy as np
@@ -36,7 +70,13 @@ from . import fq as _fq
 from . import pairing_jax as pj
 from . import tower as tw
 
-RLC_SCALAR_BITS = 128     # soundness 2^-128 per forged batch
+RLC_SCALAR_BITS = 128     # soundness 2^-127 per forged batch (odd draws)
+
+# batch-shape ladder: every entry point compiles at most these 4 shapes
+# for realistic batch sizes (larger batches fall back to powers of two).
+# Ratio-4 rungs bound padding waste at 4x while landing the BASELINE
+# config shapes exactly (attestation batch 128+1 lanes, sync pairing 2->8)
+_BUCKET_STEPS = (8, 32, 128, 512)
 
 
 def _jnp():
@@ -45,10 +85,15 @@ def _jnp():
 
 
 def _bucket(n: int) -> int:
-    m = 1
-    while m < n:
-        m *= 2
-    return m
+    """Padded batch shape for n live lanes: the next power of two,
+    quantized UP to the 4-step ladder so jit caches stay tiny.  n <= 1
+    (including the n == 0 never-dispatched case) maps to the bottom rung;
+    padded lanes are masked out, so correctness never depends on n."""
+    b = 1 if n <= 1 else 1 << (n - 1).bit_length()
+    for step in _BUCKET_STEPS:
+        if b <= step:
+            return step
+    return b
 
 
 # --- device helpers ---------------------------------------------------------
@@ -75,18 +120,24 @@ def g2_to_affine_dev(p):
 
 
 @functools.lru_cache(maxsize=16)
-def _pairing_check_fn(batch: int):
+def _pairing_check_precomp_fn(batch: int):
     import jax
 
-    def run(xp, yp, xq, yq, mask):
-        return pj.multi_pairing_check(xp, yp, xq, yq, mask)
+    def run(xp, yp, lines, mask):
+        return pj.multi_pairing_check_precomp(xp, yp, lines, mask)
 
     return jax.jit(run)
 
 
 def pairing_check_device(pairs) -> bool:
     """pairs: [(g1_jacobian, g2_jacobian)] oracle points.  Infinity pairs
-    contribute the identity (matching the oracle's skip)."""
+    contribute the identity (matching the oracle's skip).
+
+    The G2 arguments are host points by construction, so their Miller
+    line coefficients are precomputed once per point on the host
+    (`pj.precompute_g2_lines`, lru-cached) and shipped as scan constants:
+    the device program is just the shared-accumulator line evaluation and
+    one final exponentiation."""
     live = [(p, q) for p, q in pairs
             if not _pycurve.g1.is_inf(p) and not _pycurve.g2.is_inf(q)]
     if not live:
@@ -94,56 +145,86 @@ def pairing_check_device(pairs) -> bool:
     jnp = _jnp()
     B = _bucket(len(live))
     xp, yp = cj.g1_affine_to_limbs([p for p, _ in live])
-    xq, yq = cj.g2_affine_to_limbs([q for _, q in live])
+    # (n_bits, B_live, 6, 2, 33): per-bit line coefficients per pair
+    lines = np.stack([pj.precompute_g2_lines(q) for _, q in live], axis=1)
     pad = B - len(live)
     if pad:
         xp = np.concatenate([xp, np.repeat(xp[:1], pad, 0)])
         yp = np.concatenate([yp, np.repeat(yp[:1], pad, 0)])
-        xq = np.concatenate([xq, np.repeat(xq[:1], pad, 0)])
-        yq = np.concatenate([yq, np.repeat(yq[:1], pad, 0)])
+        lines = np.concatenate([lines, np.repeat(lines[:, :1], pad, 1)],
+                               axis=1)
     mask = np.arange(B) < len(live)
-    out = _pairing_check_fn(B)(jnp.asarray(xp), jnp.asarray(yp),
-                               jnp.asarray(xq), jnp.asarray(yq),
-                               jnp.asarray(mask))
+    out = _pairing_check_precomp_fn(B)(jnp.asarray(xp), jnp.asarray(yp),
+                                       jnp.asarray(lines),
+                                       jnp.asarray(mask))
     return bool(out)
 
 
 # --- RLC batch verify -------------------------------------------------------
 
 
+def _rlc_pairing_core(pk_x, pk_y, sig_x, sig_y, h_x, h_y, h_ok,
+                      r_bits, mask):
+    """Traced body shared by the host-hash and device-hash RLC kernels:
+    scalar-mul the B pubkeys and signatures by the random coefficients,
+    sum the signature side, run the B+1 pairing product with the shared
+    Fq12 accumulator."""
+    jnp = _jnp()
+    B = pk_x.shape[0]
+    neg_g1 = cj.g1_affine_to_limbs([_pycurve.g1.neg(_pycurve.G1_GEN)])
+    one1 = jnp.broadcast_to(jnp.asarray(_fq.ONE_MONT),
+                            pk_x.shape).astype(jnp.int32)
+    one2 = jnp.broadcast_to(jnp.asarray(tw.FQ2_ONE_L),
+                            sig_x.shape).astype(jnp.int32)
+
+    r_pk = cj.pt_scalar_mul(cj.F1, (pk_x, pk_y, one1), r_bits)
+    r_sig = cj.pt_scalar_mul(cj.F2, (sig_x, sig_y, one2), r_bits)
+    # padding lanes -> infinity so they vanish from the signature sum
+    r_sig = cj.pt_select(cj.F2, mask, r_sig,
+                         cj.pt_infinity(cj.F2, r_sig))
+    sum_sig = cj.pt_sum(cj.F2, r_sig, B)
+
+    apx, apy, a_inf = g1_to_affine_dev(r_pk)
+    sx, sy, s_inf = g2_to_affine_dev(tuple(c[None] for c in sum_sig))
+
+    # pairing lanes: (r_i PK_i, H_i) for live i, plus (-G1, sum_sig)
+    xp = jnp.concatenate([apx, jnp.asarray(neg_g1[0])])
+    yp = jnp.concatenate([apy, jnp.asarray(neg_g1[1])])
+    xq = jnp.concatenate([h_x, sx])
+    yq = jnp.concatenate([h_y, sy])
+    lane_mask = jnp.concatenate([mask & ~a_inf & h_ok, ~s_inf])
+    return pj.multi_pairing_check(xp, yp, xq, yq, lane_mask)
+
+
 @functools.lru_cache(maxsize=16)
 def _rlc_kernel(batch: int):
-    """Jitted kernel: scalar-mul the B pubkeys and signatures by the random
-    coefficients, sum the signature side, run the B+1 pairing product."""
+    """Jitted RLC kernel, message hashes computed on host."""
     import jax
     jnp = _jnp()
 
-    neg_g1 = cj.g1_affine_to_limbs([_pycurve.g1.neg(_pycurve.G1_GEN)])
-
     def run(pk_x, pk_y, sig_x, sig_y, h_x, h_y, r_bits, mask):
-        B = pk_x.shape[0]
-        one1 = jnp.broadcast_to(jnp.asarray(_fq.ONE_MONT),
-                                pk_x.shape).astype(jnp.int32)
-        one2 = jnp.broadcast_to(jnp.asarray(tw.FQ2_ONE_L),
-                                sig_x.shape).astype(jnp.int32)
+        h_ok = jnp.ones(pk_x.shape[0], dtype=bool)
+        return _rlc_pairing_core(pk_x, pk_y, sig_x, sig_y, h_x, h_y,
+                                 h_ok, r_bits, mask)
 
-        r_pk = cj.pt_scalar_mul(cj.F1, (pk_x, pk_y, one1), r_bits)
-        r_sig = cj.pt_scalar_mul(cj.F2, (sig_x, sig_y, one2), r_bits)
-        # padding lanes -> infinity so they vanish from the signature sum
-        r_sig = cj.pt_select(cj.F2, mask, r_sig,
-                             cj.pt_infinity(cj.F2, r_sig))
-        sum_sig = cj.pt_sum(cj.F2, r_sig, B)
+    return jax.jit(run)
 
-        apx, apy, a_inf = g1_to_affine_dev(r_pk)
-        sx, sy, s_inf = g2_to_affine_dev(tuple(c[None] for c in sum_sig))
 
-        # pairing lanes: (r_i PK_i, H_i) for live i, plus (-G1, sum_sig)
-        xp = jnp.concatenate([apx, jnp.asarray(neg_g1[0])])
-        yp = jnp.concatenate([apy, jnp.asarray(neg_g1[1])])
-        xq = jnp.concatenate([h_x, sx])
-        yq = jnp.concatenate([h_y, sy])
-        lane_mask = jnp.concatenate([mask & ~a_inf, ~s_inf])
-        return pj.multi_pairing_check(xp, yp, xq, yq, lane_mask)
+@functools.lru_cache(maxsize=16)
+def _rlc_kernel_h2c(batch: int):
+    """Jitted RLC kernel with DEVICE hash-to-curve: the 32-byte message
+    roots enter as uint32 words and the whole statement batch —
+    expand_message_xmd, SVDW map, cofactor clearing, scalar muls,
+    pairings — runs in one device program."""
+    import jax
+    jnp = _jnp()
+    from . import h2c_jax as h2c
+
+    def run(pk_x, pk_y, sig_x, sig_y, msg_words, r_bits, mask):
+        H = h2c.hash_to_g2_dev(msg_words)
+        h_x, h_y, h_inf = g2_to_affine_dev(H)
+        return _rlc_pairing_core(pk_x, pk_y, sig_x, sig_y, h_x, h_y,
+                                 ~h_inf, r_bits, mask)
 
     return jax.jit(run)
 
@@ -151,9 +232,8 @@ def _rlc_kernel(batch: int):
 @functools.lru_cache(maxsize=16)
 def _msm_kernel(batch: int):
     """Jitted G1 MSM: batched 255-step double-and-add over all points at
-    once, then a log-depth tree sum.  Uniform control flow — the
-    TPU-idiomatic MSM (bucketed Pippenger's data-dependent gathers do not
-    vectorize onto the MXU)."""
+    once, then a log-depth tree sum.  Fully uniform control flow; kept as
+    the reference kernel and the `CST_MSM_ALGO=double-add` fallback."""
     import jax
     jnp = _jnp()
 
@@ -169,11 +249,59 @@ def _msm_kernel(batch: int):
     return jax.jit(run)
 
 
+@functools.lru_cache(maxsize=16)
+def _msm_pippenger_kernel(batch: int, c: int):
+    """Jitted G1 Pippenger MSM: one scan over the points scatters each
+    into its per-window bucket (all ceil(255/c) windows in parallel),
+    then suffix-sum bucket reduction and the windowed combine — total
+    point-add work B + 2^(c+1) + 255/c instead of 255 doubles + adds per
+    scalar.  Zero scalars (and padding lanes) land in bucket 0, which the
+    reduction skips, so no mask input is needed."""
+    import jax
+    jnp = _jnp()
+
+    def run(x, y, digits):
+        one1 = jnp.broadcast_to(jnp.asarray(_fq.ONE_MONT),
+                                x.shape).astype(jnp.int32)
+        return cj.pt_msm_pippenger(cj.F1, (x, y, one1), digits, c)
+
+    return jax.jit(run)
+
+
 SCALAR_BITS = 255  # BLS12-381 subgroup order is 255 bits
 
 
+def _msm_window(n: int) -> int:
+    """Pippenger window size for an n-point batch (2^c buckets must stay
+    well under n for the bucket phase to amortize)."""
+    if n < 32:
+        return 4
+    if n < 256:
+        return 6
+    if n < 2048:
+        return 8
+    return 10
+
+
+# Pippenger's bucket scatter is sequential in B while double-and-add is
+# sequential only in the 255 scalar bits (B-wide each step): bucketed
+# wins while the batch is latency-bound, the uniform kernel wins once B
+# is wide enough to saturate the vector units.  Crossover set at the
+# bucket ladder's top shape; CST_MSM_ALGO=pippenger|double-add forces one.
+_MSM_PIPPENGER_MAX = 512
+
+
+def _msm_algo(batch: int) -> str:
+    algo = os.environ.get("CST_MSM_ALGO", "auto")
+    if algo == "auto":
+        return "pippenger" if batch <= _MSM_PIPPENGER_MAX else "double-add"
+    return algo
+
+
 def g1_multi_exp_device(points, scalars):
-    """Device G1 multiscalar multiplication.
+    """Device G1 multiscalar multiplication (bucketed Pippenger below
+    the width crossover, batched double-and-add above it — see
+    `_msm_algo`).
 
     points: oracle Jacobian G1 points; scalars: ints (reduced mod r).
     Returns an oracle Jacobian point.  The KZG batch path's `g1_lincomb`
@@ -193,32 +321,45 @@ def g1_multi_exp_device(points, scalars):
 
     B = _bucket(len(live))
     x, y = cj.g1_affine_to_limbs([p for p, _ in live])
-    bits = cj.scalars_to_bits([s for _, s in live], SCALAR_BITS)
     pad = B - len(live)
     if pad:
         x = np.concatenate([x, np.repeat(x[:1], pad, 0)])
         y = np.concatenate([y, np.repeat(y[:1], pad, 0)])
-        bits = np.concatenate([bits,
-                               np.zeros((pad, SCALAR_BITS), np.int32)])
-    mask = np.arange(B) < len(live)
 
-    out = _msm_kernel(B)(jnp.asarray(x), jnp.asarray(y),
-                         jnp.asarray(bits), jnp.asarray(mask))
-    return cj.g1_limbs_to_oracle(tuple(np.asarray(c) for c in out))
+    if _msm_algo(B) == "pippenger":
+        c = _msm_window(B)
+        digits = cj.scalars_to_digits([s for _, s in live], SCALAR_BITS, c)
+        if pad:
+            digits = np.concatenate(
+                [digits, np.zeros((pad,) + digits.shape[1:], np.int32)])
+        out = _msm_pippenger_kernel(B, c)(jnp.asarray(x), jnp.asarray(y),
+                                          jnp.asarray(digits))
+    else:
+        bits = cj.scalars_to_bits([s for _, s in live], SCALAR_BITS)
+        if pad:
+            bits = np.concatenate(
+                [bits, np.zeros((pad, SCALAR_BITS), np.int32)])
+        mask = np.arange(B) < len(live)
+        out = _msm_kernel(B)(jnp.asarray(x), jnp.asarray(y),
+                             jnp.asarray(bits), jnp.asarray(mask))
+    return cj.g1_limbs_to_oracle(tuple(np.asarray(co) for co in out))
 
 
-def _prepare_rlc_inputs(tasks, rand, lanes: int):
+def _prepare_rlc_inputs(tasks, rand, lanes: int, device_h2c: bool = False):
     """Host-side prep shared by the single-device and sharded RLC paths:
-    hash messages, drop trivial pairs, build limb arrays padded to
-    `lanes` (or the power-of-two bucket when `lanes` is None).
+    drop trivial pairs, hash messages (host) or pack them as uint32 words
+    (device h2c), build limb arrays padded to `lanes` (or the bucket
+    ladder shape when `lanes` is None).
 
     Returns (arrays, n_live) with arrays None when a degenerate path
-    already decided the answer (n_live then carries the bool)."""
+    already decided the answer (n_live then carries the bool).  With
+    device_h2c the h_x/h_y slots of the array tuple are replaced by one
+    (B, 8) big-endian message-word matrix."""
     live = []
     for pk, msg, sig in tasks:
         if _pycurve.g1.is_inf(pk) and _pycurve.g2.is_inf(sig):
             continue          # 1 == 1 trivially; mirrors oracle skip
-        live.append((pk, hash_to_g2(bytes(msg), DST_G2), sig))
+        live.append((pk, bytes(msg), sig))
     if not live:
         return None, True
 
@@ -227,15 +368,20 @@ def _prepare_rlc_inputs(tasks, rand, lanes: int):
     if any(_pycurve.g1.is_inf(pk) or _pycurve.g2.is_inf(sig)
            for pk, _, sig in live):
         ok = all(
-            pairing_check_device([(pk, h),
+            pairing_check_device([(pk, hash_to_g2(msg, DST_G2)),
                                   (_pycurve.g1.neg(_pycurve.G1_GEN), s)])
-            for pk, h, s in live)
+            for pk, msg, s in live)
         return None, ok
 
     B = _bucket(len(live)) if lanes is None else lanes
     assert B >= len(live)
     pk_x, pk_y = cj.g1_affine_to_limbs([t[0] for t in live])
-    h_x, h_y = cj.g2_affine_to_limbs([t[1] for t in live])
+    if device_h2c:
+        from . import h2c_jax as h2c
+        h_arrays = (h2c.msgs_to_words([t[1] for t in live]),)
+    else:
+        h_arrays = cj.g2_affine_to_limbs(
+            [hash_to_g2(t[1], DST_G2) for t in live])
     sig_x, sig_y = cj.g2_affine_to_limbs([t[2] for t in live])
     scalars = [1] + [rand.getrandbits(RLC_SCALAR_BITS) | 1
                      for _ in range(len(live) - 1)]
@@ -246,33 +392,40 @@ def _prepare_rlc_inputs(tasks, rand, lanes: int):
         def _p(a):
             return np.concatenate([a, np.repeat(a[:1], pad, 0)])
         pk_x, pk_y = _p(pk_x), _p(pk_y)
-        h_x, h_y = _p(h_x), _p(h_y)
+        h_arrays = tuple(_p(a) for a in h_arrays)
         sig_x, sig_y = _p(sig_x), _p(sig_y)
         r_bits = np.concatenate(
             [r_bits, np.zeros((pad, RLC_SCALAR_BITS), np.int32)])
     mask = np.arange(B) < len(live)
-    return (pk_x, pk_y, sig_x, sig_y, h_x, h_y, r_bits, mask), len(live)
+    return ((pk_x, pk_y, sig_x, sig_y) + h_arrays + (r_bits, mask),
+            len(live))
 
 
-def batch_verify(tasks, rng=None) -> bool:
+def batch_verify(tasks, rng=None, device_h2c: bool | None = None) -> bool:
     """tasks: [(g1_pubkey_jacobian, message_bytes, g2_sig_jacobian)].
 
     Verifies all FastAggregateVerify-style statements
     e(PK_i, H(m_i)) == e(G1, S_i) at once: random 128-bit coefficients
     r_i collapse them into   prod e(r_i PK_i, H_i) · e(-G1, Σ r_i S_i) == 1.
-    Host does hashing/aggregation; device does everything elliptic."""
+
+    With device_h2c (the default for 32-byte message roots; opt out with
+    CST_BLS_DEVICE_H2C=0) the message hashing runs on device too, so the
+    host only parses points and draws coefficients."""
     if not tasks:
         return True
     rand = rng if rng is not None else secrets.SystemRandom()
-    arrays, n = _prepare_rlc_inputs(tasks, rand, None)
+    if device_h2c is None:
+        device_h2c = os.environ.get("CST_BLS_DEVICE_H2C", "1") != "0"
+    # the device xmd kernel is specialized to 32-byte signing roots
+    device_h2c = device_h2c and all(
+        len(bytes(m)) == 32 for _, m, _ in tasks)
+    arrays, n = _prepare_rlc_inputs(tasks, rand, None,
+                                    device_h2c=device_h2c)
     if arrays is None:
         return bool(n)
     jnp = _jnp()
-    pk_x, pk_y, sig_x, sig_y, h_x, h_y, r_bits, mask = arrays
-    out = _rlc_kernel(pk_x.shape[0])(
-        jnp.asarray(pk_x), jnp.asarray(pk_y), jnp.asarray(sig_x),
-        jnp.asarray(sig_y), jnp.asarray(h_x), jnp.asarray(h_y),
-        jnp.asarray(r_bits), jnp.asarray(mask))
+    kernel = _rlc_kernel_h2c if device_h2c else _rlc_kernel
+    out = kernel(arrays[0].shape[0])(*(jnp.asarray(a) for a in arrays))
     return bool(out)
 
 
@@ -308,36 +461,29 @@ def _rlc_kernel_sharded(n_devices: int, per_shard: int, axis: str):
             lambda c: jax.lax.all_gather(c, axis), local_sum)
         sum_sig = cj.pt_sum(cj.F2, gathered, n_devices)
 
-        # local pairing lanes (r_i PK_i, H_i)
+        # local pairing lanes (r_i PK_i, H_i): shared-accumulator Miller
+        # product per shard (one Fq12 squaring per bit per device)
         apx, apy, a_inf = g1_to_affine_dev(r_pk)
-        f_local = pj.miller_batch(apx, apy, h_x, h_y)
-        one12 = jnp.broadcast_to(jnp.asarray(tw.FQ12_ONE_L),
-                                 f_local.shape).astype(jnp.int32)
-        live = mask & ~a_inf
-        f_local = jnp.where(live[:, None, None, None, None], f_local,
-                            one12)
-        partial = pj._product_tree(f_local, B)          # unbatched <fq12>
+        partial = pj.miller_product_batch(apx, apy, h_x, h_y,
+                                          mask & ~a_inf)
         partials = jax.lax.all_gather(partial, axis)    # (D, <fq12>)
         total = pj._product_tree(partials, n_devices)
 
         # the shared (-G1, Σ r_i S_i) lane, multiplied in exactly once
         sx, sy, s_inf = g2_to_affine_dev(
             tuple(c[None] for c in sum_sig))
-        f_extra = pj.miller_batch(
-            jnp.asarray(neg_g1[0]), jnp.asarray(neg_g1[1]), sx, sy)
-        one_extra = jnp.broadcast_to(
-            jnp.asarray(tw.FQ12_ONE_L), f_extra.shape).astype(jnp.int32)
-        f_extra = jnp.where((~s_inf)[:, None, None, None, None],
-                            f_extra, one_extra)
-        total = tw.fq12_mul(total, f_extra[0])
+        f_extra = pj.miller_product_batch(
+            jnp.asarray(neg_g1[0]), jnp.asarray(neg_g1[1]), sx, sy,
+            ~s_inf)
+        total = tw.fq12_mul(total, f_extra)
         return tw.fq12_is_one(pj.final_exponentiate(total))
 
-    sharded = jax.shard_map(
+    from ...utils.jaxtools import shard_map_compat
+    sharded = shard_map_compat(
         local, mesh=mesh,
         in_specs=(P(axis), P(axis), P(axis), P(axis), P(axis), P(axis),
                   P(axis), P(axis)),
         out_specs=P(),
-        check_vma=False,
     )
     return jax.jit(sharded)
 

@@ -164,6 +164,90 @@ def pt_scalar_mul(F: FieldOps, p, scalar_bits):
     return acc
 
 
+def pt_scalar_mul_const(F: FieldOps, p, bits_np):
+    """Double-and-add by ONE fixed host-known scalar (MSB-first int32
+    numpy bit array) applied to the whole batch — the cofactor-clearing
+    shape: the bit schedule rides the scan's xs, so the add executes only
+    on set bits at runtime while the HLO stays one small step body."""
+    import jax
+    jnp = _jnp()
+
+    def step(acc, bit):
+        acc = pt_double(F, acc)
+        acc = jax.lax.cond(bit == 1,
+                           lambda a: pt_add(F, a, p),
+                           lambda a: a, acc)
+        return acc, None
+
+    acc0 = pt_infinity(F, p)
+    acc, _ = jax.lax.scan(step, acc0, jnp.asarray(bits_np))
+    return acc
+
+
+def pt_msm_pippenger(F: FieldOps, p, digits, c: int):
+    """Bucketed (Pippenger) multiscalar multiplication over the batch.
+
+    p: (x, y, one) batched points (B leading axis); digits: (B, W) int32
+    window digits, MOST-significant window first (`scalars_to_digits`);
+    c: static window bit width, W = ceil(nbits / c).
+
+    Phase 1 scans the B points once, scattering each into its bucket in
+    every window simultaneously (the W axis is the vectorized one — a
+    point has exactly one bucket per window, so all windows update in
+    parallel).  Phase 2 reduces each window's 2^c buckets with the
+    classic suffix-sum (sum_k k*B_k), still W-wide.  Phase 3 combines
+    windows MSB-first with c doublings each.  Bucket 0 is never read, so
+    zero digits — including padding lanes — contribute nothing and no
+    mask is needed."""
+    import jax
+    jnp = _jnp()
+
+    B, W = digits.shape
+    nb = 1 << c
+    elem = p[0].shape[1:]
+
+    one = jnp.broadcast_to(jnp.asarray(F.one),
+                           (W, nb) + elem).astype(jnp.int32)
+    zero = jnp.zeros((W, nb) + elem, jnp.int32)
+    buckets = (one, one, zero)          # grid of infinities
+    widx = jnp.arange(W)
+
+    def scatter_step(bk, xs):
+        px, py, pz, d = xs
+        cur = tuple(b[widx, d] for b in bk)
+        pt = tuple(jnp.broadcast_to(co[None], (W,) + elem).astype(jnp.int32)
+                   for co in (px, py, pz))
+        new = pt_add(F, cur, pt)
+        bk = tuple(b.at[widx, d].set(nc) for b, nc in zip(bk, new))
+        return bk, None
+
+    buckets, _ = jax.lax.scan(scatter_step, buckets,
+                              (p[0], p[1], p[2], digits))
+
+    # suffix-sum reduction: iterate k = nb-1 .. 1 (bucket 0 skipped)
+    rev = tuple(jnp.moveaxis(b[:, :0:-1], 1, 0) for b in buckets)
+    inf_w = pt_infinity(F, tuple(b[0] for b in rev))
+
+    def red_step(carry, bk):
+        running, acc = carry
+        running = pt_add(F, running, bk)
+        acc = pt_add(F, acc, running)
+        return (running, acc), None
+
+    (_, win_sums), _ = jax.lax.scan(red_step, (inf_w, inf_w), rev)
+
+    # window combine, MSB-first: c doublings then add the window sum
+    res0 = pt_infinity(F, tuple(a[:1] for a in win_sums))
+
+    def comb_step(res, acc_w):
+        for _ in range(c):
+            res = pt_double(F, res)
+        return pt_add(F, res, tuple(a[None] for a in acc_w)), None
+
+    res, _ = jax.lax.scan(comb_step, res0, win_sums)
+    return tuple(co[0] for co in res)
+
+
 def pt_sum(F: FieldOps, p, n: int):
     """Sum a batch of n points (leading axis) with a log-depth add tree."""
     jnp = _jnp()
@@ -192,6 +276,20 @@ def scalars_to_bits(scalars, nbits: int) -> np.ndarray:
         assert 0 <= s < (1 << nbits)
         for j in range(nbits):
             out[i, nbits - 1 - j] = (s >> j) & 1
+    return out
+
+
+def scalars_to_digits(scalars, nbits: int, c: int) -> np.ndarray:
+    """Python ints -> (B, ceil(nbits/c)) int32 c-bit window digits,
+    most-significant window first (Pippenger layout)."""
+    n_windows = -(-nbits // c)
+    out = np.zeros((len(scalars), n_windows), dtype=np.int32)
+    m = (1 << c) - 1
+    for i, s in enumerate(scalars):
+        s = int(s)
+        assert 0 <= s < (1 << nbits)
+        for w in range(n_windows):
+            out[i, n_windows - 1 - w] = (s >> (c * w)) & m
     return out
 
 

@@ -68,6 +68,9 @@ def from_mont(limbs) -> int:
 P_LIMBS = int_to_limbs(Q)
 TWO_P_LIMBS = int_to_limbs(2 * Q)
 ONE_MONT = to_mont(1)
+# multiplying by the PLAIN one under Montgomery mul maps x*R -> x: the
+# device-side from-Montgomery conversion (h2c sgn0 needs canonical parity)
+ONE_PLAIN = int_to_limbs(1)
 
 # p - 2 bits, MSB first (Fermat inversion exponent)
 _P_MINUS_2_BITS = np.array(
@@ -195,12 +198,11 @@ def fq_is_zero(a):
     return jnp.all(fq_canon(a) == 0, axis=-1)
 
 
-def fq_inv(a):
-    """Fermat inversion a**(p-2); zero maps to zero."""
+def fq_pow_const(a, bits):
+    """a**e for a fixed exponent given as an MSB-first int32 bit array
+    (numpy, host constant): square-and-multiply over a lax.scan."""
     import jax
     jnp = _jnp()
-
-    bits = jnp.asarray(_P_MINUS_2_BITS)
 
     def step(acc, bit):
         acc = fq_sqr(acc)
@@ -208,5 +210,10 @@ def fq_inv(a):
         return jnp.where(bit, acc_mul, acc), None
 
     one = jnp.broadcast_to(jnp.asarray(ONE_MONT), a.shape).astype(jnp.int32)
-    acc, _ = jax.lax.scan(step, one, bits)
+    acc, _ = jax.lax.scan(step, one, jnp.asarray(bits))
     return acc
+
+
+def fq_inv(a):
+    """Fermat inversion a**(p-2); zero maps to zero."""
+    return fq_pow_const(a, _P_MINUS_2_BITS)

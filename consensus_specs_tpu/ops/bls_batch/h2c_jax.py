@@ -1,0 +1,302 @@
+"""Batched hash-to-G2 on device — RFC 9380 structure end to end.
+
+The oracle keeps `ops/bls/hash_to_curve.py` (pure Python, per message);
+this module reproduces it bit-for-bit as ONE device program over a batch
+of 32-byte message roots (the shape every consensus signing root has):
+
+  expand_message_xmd  sha256 compression (`ops/sha256_jax.py` kernel)
+                      over host-templated block layouts: only the message
+                      words and the chained digests are device data, all
+                      padding/DST bytes are trace-time constants.
+  hash_to_field       512-bit big-endian draws reduced into Montgomery Fq
+                      limbs with two constant multiplies (no big-int
+                      arithmetic: a + b*2^396 folds through the CIOS
+                      Montgomery kernel).
+  map_to_curve        the oracle's Shallue–van de Woestijne straight line
+                      (`hash_to_curve.py:168`), made branchless: all
+                      three x-candidates and their Fq2 square roots are
+                      computed, candidate selection is by masked select
+                      with the same priority order as the oracle.
+  clear_cofactor      fixed-scalar double-and-add by the derived G2
+                      cofactor (`curve_jax.pt_scalar_mul_const`).
+
+Fq2 square roots run the same norm-based construction as the oracle
+(`fields.py:99`): every exponentiation is a fixed-schedule scan, the
+first-phase (norm, x, -x) and second-phase (t+, t-) chains are stacked so
+the whole map costs two pow scans + one inversion scan regardless of how
+many candidates end up used.  All selects mirror the oracle's branch
+order, so device and host outputs are identical points, not just
+equivalent ones.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..bls import curve as _pycurve
+from ..bls.fields import Q
+from ..bls.hash_to_curve import DST_G2, _SVDW_G2
+from . import curve_jax as cj
+from . import fq as _fq
+from . import tower as tw
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+# --- host-side templates and constants --------------------------------------
+
+MSG_BYTES = 32                    # consensus signing roots are 32 bytes
+_L = 64                           # bytes per hash_to_field draw
+_COUNT = 2                        # two Fq2 elements (random-oracle map)
+_LEN_IN_BYTES = _COUNT * 2 * _L   # 256
+_ELL = _LEN_IN_BYTES // 32        # sha256 draws
+_DST_PRIME = DST_G2 + bytes([len(DST_G2)])
+
+
+def _pad_sha(data: bytes) -> bytes:
+    """Append SHA-256 Merkle–Damgård padding (length must be static)."""
+    rem = (len(data) + 9) % 64
+    zeros = (64 - rem) % 64
+    return (data + b"\x80" + b"\x00" * zeros
+            + (len(data) * 8).to_bytes(8, "big"))
+
+
+def _words(data: bytes) -> np.ndarray:
+    """Padded byte string -> (n_blocks, 16) big-endian uint32 words."""
+    return np.frombuffer(data, dtype=">u4").astype(np.uint32).reshape(-1, 16)
+
+
+# b0 input: Z_pad(64) || msg(32) || len(2) || 0x00 || DST'  — the message
+# occupies exactly words 0..8 of block 1
+_B0_TPL = _words(_pad_sha(
+    b"\x00" * 64 + b"\x00" * MSG_BYTES
+    + _LEN_IN_BYTES.to_bytes(2, "big") + b"\x00" + _DST_PRIME))
+# b_i inputs: digest(32) || i(1) || DST' — digest is words 0..8 of block 0
+_BI_TPLS = [_words(_pad_sha(b"\x00" * 32 + bytes([i]) + _DST_PRIME))
+            for i in range(1, _ELL + 1)]
+
+# Montgomery folding constants for the 512-bit draw u = a + b*2^396:
+# mont_mul(a, 2^792) = a*2^396 = a*R and mont_mul(b, 2^1188) = b*2^396*R
+_C_LO = _fq.int_to_limbs(pow(2, 2 * _fq.R_BITS, Q))
+_C_HI = _fq.int_to_limbs(pow(2, 3 * _fq.R_BITS, Q))
+
+# (Q+1)/4 bits, MSB first: the q = 3 mod 4 square-root exponent
+_P14_BITS = np.array([int(b) for b in bin((Q + 1) // 4)[2:]], dtype=np.int32)
+_INV2_MONT = _fq.to_mont(pow(2, -1, Q))
+
+# SVDW constants, derived by the oracle at import (no transcription)
+_C1_L = tw.fq2_from_oracle(_SVDW_G2.c1)
+_C2_L = tw.fq2_from_oracle(_SVDW_G2.c2)
+_C3_L = tw.fq2_from_oracle(_SVDW_G2.c3)
+_C4_L = tw.fq2_from_oracle(_SVDW_G2.c4)
+_Z_L = tw.fq2_from_oracle(_SVDW_G2.Z)
+_B2_L = tw.fq2_from_oracle(_pycurve.B2)
+
+# G2 cofactor bits, MSB first (derived in ops/bls/curve.py)
+_H2_BITS = np.array([int(b) for b in bin(_pycurve.H2)[2:]], dtype=np.int32)
+
+
+def msgs_to_words(msgs) -> np.ndarray:
+    """32-byte messages -> (B, 8) big-endian uint32 word matrix."""
+    out = []
+    for m in msgs:
+        m = bytes(m)
+        assert len(m) == MSG_BYTES, "device h2c is fixed to 32-byte roots"
+        out.append(np.frombuffer(m, dtype=">u4"))
+    return np.stack(out).astype(np.uint32)
+
+
+# --- expand_message_xmd ------------------------------------------------------
+
+
+def _sha_blocks(blocks):
+    """SHA-256 over a fixed block sequence (each (..., 16) words)."""
+    from .. import sha256_jax as sha
+    jnp = _jnp()
+    state = jnp.broadcast_to(sha._IVj, blocks[0].shape[:-1] + (8,))
+    for blk in blocks:
+        state = sha._compress(state, blk)
+    return state
+
+
+def expand_message_xmd_dev(msg_words):
+    """RFC 9380 §5.3 expand_message_xmd(SHA-256) for fixed 32-byte
+    messages and the module DST: (B, 8) words -> (B, 64) words (256
+    uniform bytes)."""
+    jnp = _jnp()
+    B = msg_words.shape[0]
+
+    def bc(w):
+        return jnp.broadcast_to(jnp.asarray(w), (B,) + w.shape)
+
+    blocks = [bc(_B0_TPL[0]),
+              jnp.concatenate([msg_words, bc(_B0_TPL[1][8:])], axis=-1)]
+    blocks += [bc(row) for row in _B0_TPL[2:]]
+    b0 = _sha_blocks(blocks)
+
+    outs = []
+    bi = None
+    for i in range(_ELL):
+        first = b0 if i == 0 else b0 ^ bi
+        tpl = _BI_TPLS[i]
+        blks = [jnp.concatenate([first, bc(tpl[0][8:])], axis=-1)]
+        blks += [bc(row) for row in tpl[1:]]
+        bi = _sha_blocks(blks)
+        outs.append(bi)
+    return jnp.concatenate(outs, axis=-1)
+
+
+# --- hash_to_field -----------------------------------------------------------
+
+
+def _words512_to_fq_mont(chunk):
+    """(..., 16) big-endian words of one 512-bit draw -> Montgomery Fq
+    limbs of (value mod Q): 12-bit limb extraction by static shifts, then
+    the two-constant Montgomery fold (u = a + b*2^396)."""
+    jnp = _jnp()
+    lw = chunk[..., ::-1]          # little-endian word order
+    limbs = []
+    for j in range((16 * 32 + 11) // 12):
+        lo = 12 * j
+        t0, off = divmod(lo, 32)
+        v = lw[..., t0] >> np.uint32(off)
+        if off > 20 and t0 + 1 < 16:
+            v = v | (lw[..., t0 + 1] << np.uint32(32 - off))
+        limbs.append(v & np.uint32(0xFFF))
+    x = jnp.stack(limbs, axis=-1).astype(jnp.int32)
+    n = _fq.N_LIMBS
+    lo33 = x[..., :n]
+    hi = x[..., n:]
+    hi33 = jnp.concatenate(
+        [hi, jnp.zeros(hi.shape[:-1] + (2 * n - x.shape[-1],), jnp.int32)],
+        axis=-1)
+    return _fq.fq_add(_fq.fq_mul(lo33, jnp.asarray(_C_LO)),
+                      _fq.fq_mul(hi33, jnp.asarray(_C_HI)))
+
+
+def hash_to_field_fq2_dev(msg_words):
+    """RFC 9380 §5.2 hash_to_field, count=2: (B, 8) message words ->
+    (u0, u1) each (B, 2, 33) Montgomery Fq2 limbs."""
+    jnp = _jnp()
+    uniform = expand_message_xmd_dev(msg_words)      # (B, 64) words
+    els = [_words512_to_fq_mont(uniform[..., 16 * k:16 * (k + 1)])
+           for k in range(2 * _COUNT)]
+    u0 = jnp.stack([els[0], els[1]], axis=-2)
+    u1 = jnp.stack([els[2], els[3]], axis=-2)
+    return u0, u1
+
+
+# --- branchless Fq2 square root / sgn0 --------------------------------------
+
+
+def fq2_sqrt_dev(a):
+    """Batched Fq2 square root with the oracle's exact branch priority
+    (`fields.py:99` Fq2.sqrt), branchless.  Returns (root, is_square);
+    root is garbage where is_square is False."""
+    jnp = _jnp()
+    x, y = a[..., 0, :], a[..., 1, :]
+    sq = _fq.fq_mul(jnp.stack([x, y]), jnp.stack([x, y]))
+    norm = _fq.fq_add(sq[0], sq[1])
+
+    # phase 1: candidate roots of norm, x, and -x in one stacked scan
+    ph1 = _fq.fq_pow_const(jnp.stack([norm, x, _fq.fq_neg(x)]), _P14_BITS)
+    n, rx, rnx = ph1[0], ph1[1], ph1[2]
+
+    # phase 2: c± = sqrt((x ± n)/2) candidates, one stacked scan
+    inv2 = jnp.asarray(_INV2_MONT)
+    ts = jnp.stack([_fq.fq_mul(_fq.fq_add(x, n), inv2),
+                    _fq.fq_mul(_fq.fq_sub(x, n), inv2)])
+    cs = _fq.fq_pow_const(ts, _P14_BITS)
+    wy = _fq.fq_mul(_fq.fq_inv(_fq.fq_mul_small(cs, 2)), y[None])
+
+    zero = jnp.zeros_like(x)
+    cands = jnp.stack([
+        jnp.stack([rx, zero], axis=-2),      # y == 0, x a QR
+        jnp.stack([zero, rnx], axis=-2),     # y == 0, x a non-QR
+        jnp.stack([cs[0], wy[0]], axis=-2),  # general, + sign
+        jnp.stack([cs[1], wy[1]], axis=-2),  # general, - sign
+    ])
+    ok = tw.fq2_eq(tw.fq2_sqr(cands), a[None])
+    y_zero = _fq.fq_is_zero(y)
+
+    def e(m):
+        return m[..., None, None]
+
+    gen = jnp.where(e(ok[2]), cands[2], cands[3])
+    yz = jnp.where(e(ok[0]), cands[0], cands[1])
+    root = jnp.where(e(y_zero), yz, gen)
+    is_sq = jnp.where(y_zero, ok[0] | ok[1], ok[2] | ok[3])
+    return root, is_sq
+
+
+def sgn0_fq2_dev(a):
+    """RFC 9380 sgn0 for Montgomery Fq2 limbs: convert to the plain
+    domain on device (multiply by the non-Montgomery one), canonicalize,
+    take lexicographic parity."""
+    jnp = _jnp()
+    stacked = jnp.stack([a[..., 0, :], a[..., 1, :]])
+    plain = _fq.fq_canon(_fq.fq_mul(stacked, jnp.asarray(_fq.ONE_PLAIN)))
+    s0 = (plain[0][..., 0] & 1) == 1
+    z0 = jnp.all(plain[0] == 0, axis=-1)
+    s1 = (plain[1][..., 0] & 1) == 1
+    return s0 | (z0 & s1)
+
+
+# --- Shallue–van de Woestijne map -------------------------------------------
+
+
+def _bc2(const, like):
+    jnp = _jnp()
+    return jnp.broadcast_to(jnp.asarray(const), like.shape).astype(jnp.int32)
+
+
+def svdw_map_g2_dev(u):
+    """RFC 9380 §6.6.1 straight line on (..., 2, 33) Fq2 limbs ->
+    affine (x, y) on the twist, bit-identical to the oracle map."""
+    jnp = _jnp()
+    one = _bc2(tw.FQ2_ONE_L, u)
+    tv1 = tw.fq2_mul(tw.fq2_sqr(u), _bc2(_C1_L, u))
+    tv2 = tw.fq2_add(one, tv1)
+    tv1 = tw.fq2_sub(one, tv1)
+    tv3 = tw.fq2_inv(tw.fq2_mul(tv1, tv2))           # inv0: 0 -> 0
+    tv4 = tw.fq2_mul(tw.fq2_mul(u, tv1),
+                     tw.fq2_mul(tv3, _bc2(_C3_L, u)))
+    x1 = tw.fq2_sub(_bc2(_C2_L, u), tv4)
+    x2 = tw.fq2_add(_bc2(_C2_L, u), tv4)
+    t = tw.fq2_sqr(tw.fq2_mul(tw.fq2_sqr(tv2), tv3))
+    x3 = tw.fq2_add(tw.fq2_mul(t, _bc2(_C4_L, u)), _bc2(_Z_L, u))
+
+    xs = jnp.stack([x1, x2, x3])
+    gx = tw.fq2_add(tw.fq2_mul(tw.fq2_sqr(xs), xs), _bc2(_B2_L, xs))
+    roots, ok = fq2_sqrt_dev(gx)
+
+    def e(m):
+        return m[..., None, None]
+
+    x = jnp.where(e(ok[0]), x1, jnp.where(e(ok[1]), x2, x3))
+    y = jnp.where(e(ok[0]), roots[0],
+                  jnp.where(e(ok[1]), roots[1], roots[2]))
+    flip = sgn0_fq2_dev(u) != sgn0_fq2_dev(y)
+    y = jnp.where(e(flip), tw.fq2_neg(y), y)
+    return x, y
+
+
+# --- hash_to_curve -----------------------------------------------------------
+
+
+def hash_to_g2_dev(msg_words):
+    """Device hash_to_g2 (random-oracle construction): (B, 8) message
+    words -> batched Jacobian G2 point (X, Y, Z limb arrays).  Matches
+    `ops/bls/hash_to_curve.py:hash_to_g2` exactly (same DST, same map,
+    same cofactor)."""
+    jnp = _jnp()
+    B = msg_words.shape[0]
+    u0, u1 = hash_to_field_fq2_dev(msg_words)
+    mx, my = svdw_map_g2_dev(jnp.concatenate([u0, u1], axis=0))
+    one2 = jnp.broadcast_to(jnp.asarray(tw.FQ2_ONE_L),
+                            (B, 2, _fq.N_LIMBS)).astype(jnp.int32)
+    q = cj.pt_add(cj.F2, (mx[:B], my[:B], one2), (mx[B:], my[B:], one2))
+    return cj.pt_scalar_mul_const(cj.F2, q, _H2_BITS)

@@ -1,13 +1,25 @@
 """Batched optimal-ate pairing on TPU.
 
 The Miller loop runs on the sextic twist in Fq2 with Jacobian T and
-*inversion-free* line coefficients; the only Fq12 work per step is one
-square and one sparse multiply.  All B pairings advance in lockstep through
-a lax.scan over the fixed 64-bit BLS parameter, their Miller values are
-product-reduced, and ONE shared final exponentiation finishes the batch —
-the random-linear-combination batching trick of the KZG spec
-(`specs/deneb/polynomial-commitments.md:415` `verify_kzg_proof_batch`)
-applied to the pairing layer itself.
+*inversion-free* line coefficients.  All B pairings advance in lockstep
+through a lax.scan over the fixed 64-bit BLS parameter and share ONE Fq12
+accumulator: because every caller consumes a *product* of pairings, the
+per-bit recurrence is  f <- f^2 * prod_b line_b  — a single unbatched Fq12
+squaring per loop bit regardless of B (`miller_product_batch`), instead of
+B per-pairing squarings product-reduced at the end.  One shared final
+exponentiation finishes the batch — the random-linear-combination batching
+trick of the KZG spec (`specs/deneb/polynomial-commitments.md:415`
+`verify_kzg_proof_batch`) applied inside the pairing layer itself.
+
+For pairings whose G2 argument is known on the host (every
+`pairing_check_device` call: verify/aggregate-verify hashes, KZG setup
+points), `precompute_g2_lines` runs the whole T-update schedule in oracle
+Fq2 arithmetic ONCE per point and ships the line coefficients as scan
+constants; the device program then contains no G2 Jacobian arithmetic at
+all (`miller_product_precomp`) — the classical fixed-argument pairing
+optimization.  Any per-line Fq2 scale factor introduced by representative
+choices is killed by the easy part of the final exponentiation, so the
+host and device T-update formulas need not match step for step.
 
 Line equations (derived, not transcribed; scaling by Fq2 factors is free
 because any Fq2 element is killed by the easy part of the final
@@ -29,8 +41,11 @@ factor 3 is harmless for pairing *checks* (μ_r has prime order r ∤ 3).
 
 from __future__ import annotations
 
+import functools
+
 import numpy as np
 
+from ..bls import curve as _pycurve
 from ..bls import pairing as _pyp
 from ..bls.fields import BLS_X, Q, R, Fq2
 from . import curve_jax as cj
@@ -114,11 +129,16 @@ def _add_step(T, xq, yq, xp, yp):
     return Tn, line
 
 
-def miller_batch(xp, yp, xq, yq):
-    """f_{|x|,Q}(P) for a batch: xp/yp (B,33) G1 affine (Fq limbs),
-    xq/yq (B,2,33) G2 affine on the twist.  Returns (B, <fq12>) Miller
-    values (conjugated for the negative parameter; NOT final-exponentiated).
-    """
+def miller_product_batch(xp, yp, xq, yq, mask):
+    """prod_b f_{|x|,Q_b}(P_b)^(mask_b) with a SHARED Fq12 accumulator.
+
+    Since conjugation is a field automorphism,
+    prod_b conj(f_b) = conj(prod_b f_b), and each per-bit update
+    f_b <- f_b^2 * line_b folds into  F <- F^2 * prod_b line_b:  one
+    unbatched Fq12 squaring per Miller-loop bit independent of B, plus a
+    log-depth product tree over the (sparse) lines.  Masked-out lanes
+    contribute the identity line every step.  Returns a single (<fq12>)
+    value (conjugated; NOT final-exponentiated)."""
     import jax
     jnp = _jnp()
 
@@ -126,25 +146,131 @@ def miller_batch(xp, yp, xq, yq):
     one2 = jnp.broadcast_to(jnp.asarray(tw.FQ2_ONE_L),
                             xq.shape).astype(jnp.int32)
     T0 = (xq, yq, one2)
-    f0 = jnp.broadcast_to(jnp.asarray(tw.FQ12_ONE_L),
-                          (B,) + tw.FQ12_ONE_L.shape).astype(jnp.int32)
+    f0 = jnp.asarray(tw.FQ12_ONE_L).astype(jnp.int32)
+    one_b = jnp.broadcast_to(jnp.asarray(tw.FQ12_ONE_L),
+                             (B,) + tw.FQ12_ONE_L.shape).astype(jnp.int32)
+    mask_e = mask[:, None, None, None, None]
 
     def step(carry, bit):
         f, T = carry
-        f = tw.fq12_sqr(f)
+        f = tw.fq12_sqr(f)                       # ONE square, unbatched
         T, line = _dbl_step(T, xp, yp)
-        f = tw.fq12_mul(f, line)
+        line = jnp.where(mask_e, line, one_b)
+        f = tw.fq12_mul(f, _product_tree(line, B))
 
         def with_add(op):
             f_, T_ = op
             T2, line2 = _add_step(T_, xq, yq, xp, yp)
-            return tw.fq12_mul(f_, line2), T2
+            line2 = jnp.where(mask_e, line2, one_b)
+            return tw.fq12_mul(f_, _product_tree(line2, B)), T2
 
         f, T = jax.lax.cond(bit == 1, with_add, lambda op: op, (f, T))
         return (f, T), None
 
     (f, _), _ = jax.lax.scan(step, (f0, T0), jnp.asarray(_X_BITS))
     return tw.fq12_conj(f)       # negative BLS parameter
+
+
+# --- fixed-argument (host-known G2) line precomputation ---------------------
+
+
+def _host_line_coeffs_dbl(T):
+    """Oracle-Fq2 tangent coefficients at Jacobian T (same formula as
+    `_dbl_step`, host side)."""
+    X, Y, Z = T
+    XX = X.square()
+    YY = Y.square()
+    ZZ = Z.square()
+    cy = Y * Z * ZZ * 2
+    cx = -(XX * ZZ * 3)
+    c0 = XX * X * 3 - YY * 2
+    return c0, cx, cy
+
+
+def _host_line_coeffs_add(T, xq, yq):
+    """Oracle-Fq2 chord coefficients through T and affine (xq, yq)."""
+    X, Y, Z = T
+    ZZ = Z.square()
+    H = X - xq * ZZ
+    I = Y - yq * ZZ * Z
+    ZH = Z * H
+    return I * xq - ZH * yq, -I, ZH
+
+
+@functools.lru_cache(maxsize=64)
+def _g2_lines_from_affine(x0: int, x1: int, y0: int, y1: int) -> np.ndarray:
+    """Miller line coefficients for a fixed affine G2 point, as one
+    (n_bits, 6, 2, N_LIMBS) int32 array of Montgomery Fq2 limbs in the
+    order [dbl_c0, dbl_cx, dbl_cy, add_c0, add_cx, add_cy] (add slots are
+    identity filler on 0 bits; the device consumer guards them with the
+    same lax.cond schedule)."""
+    xq, yq = Fq2(x0, x1), Fq2(y0, y1)
+    T = _pycurve.g2.from_affine(xq, yq)
+    rows = []
+    filler = (Fq2(1, 0), Fq2(0, 0), Fq2(0, 0))
+    for bit in _X_BITS:
+        dbl = _host_line_coeffs_dbl(T)
+        T = _pycurve.g2.double(T)
+        if bit:
+            add = _host_line_coeffs_add(T, xq, yq)
+            T = _pycurve.g2.add(T, _pycurve.g2.from_affine(xq, yq))
+        else:
+            add = filler
+        rows.append(np.stack([tw.fq2_from_oracle(c) for c in dbl + add]))
+    return np.stack(rows).astype(np.int32)
+
+
+def precompute_g2_lines(q_pt) -> np.ndarray:
+    """Host-side fixed-argument precompute for a (non-infinity) oracle
+    Jacobian G2 point; cached per affine point."""
+    aff = _pycurve.g2.to_affine(q_pt)
+    assert aff is not None, "cannot precompute lines for infinity"
+    x, y = aff
+    return _g2_lines_from_affine(x.c0, x.c1, y.c0, y.c1)
+
+
+def miller_product_precomp(xp, yp, lines, mask):
+    """Shared-accumulator Miller product with HOST-precomputed lines.
+
+    xp/yp (B,33) G1 affine limbs; lines (n_bits, B, 6, 2, 33) from
+    `precompute_g2_lines` stacked over the batch; mask (B,).  The scan
+    body contains no G2 arithmetic — only the sparse line placement, the
+    product tree, and the single accumulator square/multiply."""
+    import jax
+    jnp = _jnp()
+
+    B = xp.shape[0]
+    f0 = jnp.asarray(tw.FQ12_ONE_L).astype(jnp.int32)
+    one_b = jnp.broadcast_to(jnp.asarray(tw.FQ12_ONE_L),
+                             (B,) + tw.FQ12_ONE_L.shape).astype(jnp.int32)
+    mask_e = mask[:, None, None, None, None]
+
+    def _line(c0, cx, cy):
+        line = _line_to_fq12(c0, tw.fq2_mul_fq(cx, xp),
+                             tw.fq2_mul_fq(cy, yp))
+        return jnp.where(mask_e, line, one_b)
+
+    def step(f, xs):
+        bit, L = xs
+        f = tw.fq12_sqr(f)
+        f = tw.fq12_mul(
+            f, _product_tree(_line(L[:, 0], L[:, 1], L[:, 2]), B))
+
+        def with_add(f_):
+            return tw.fq12_mul(
+                f_, _product_tree(_line(L[:, 3], L[:, 4], L[:, 5]), B))
+
+        f = jax.lax.cond(bit == 1, with_add, lambda f_: f_, f)
+        return f, None
+
+    f, _ = jax.lax.scan(step, f0, (jnp.asarray(_X_BITS), lines))
+    return tw.fq12_conj(f)
+
+
+def multi_pairing_check_precomp(xp, yp, lines, mask):
+    """`multi_pairing_check` with fixed-argument precomputed lines."""
+    total = miller_product_precomp(xp, yp, lines, mask)
+    return tw.fq12_is_one(final_exponentiate(total))
 
 
 def fq12_pow_x_abs(g):
@@ -186,18 +312,19 @@ def final_exponentiate(f):
 
 
 def _product_tree(f, n: int):
-    """Product over the leading batch axis (log-depth)."""
+    """Product over the leading batch axis: exactly n-1 Fq12 multiplies in
+    ceil(log2 n) levels (odd level sizes carry their tail element instead
+    of padding with identities)."""
     jnp = _jnp()
-    m = 1
-    while m < n:
-        m *= 2
-    if m != n:
-        pad = jnp.broadcast_to(jnp.asarray(tw.FQ12_ONE_L),
-                               (m - n,) + f.shape[1:]).astype(jnp.int32)
-        f = jnp.concatenate([f, pad])
-    while m > 1:
-        m //= 2
-        f = tw.fq12_mul(f[:m], f[m:2 * m])
+    assert f.shape[0] == n and n >= 1
+    while n > 1:
+        half = n // 2
+        prod = tw.fq12_mul(f[:half], f[half:2 * half])
+        if n % 2:
+            f = jnp.concatenate([prod, f[2 * half:]])
+            n = half + 1
+        else:
+            f, n = prod, half
     return f[0]
 
 
@@ -205,11 +332,7 @@ def multi_pairing_check(xp, yp, xq, yq, mask):
     """prod_i e(P_i, Q_i)^(mask_i) == 1 with one final exponentiation.
 
     mask (B,) bool lets callers pad the batch to a fixed shape (padded
-    lanes contribute the identity)."""
-    jnp = _jnp()
-    f = miller_batch(xp, yp, xq, yq)
-    one = jnp.broadcast_to(jnp.asarray(tw.FQ12_ONE_L),
-                           f.shape).astype(jnp.int32)
-    f = jnp.where(mask[:, None, None, None, None], f, one)
-    total = _product_tree(f, f.shape[0])
+    lanes contribute the identity).  Runs the shared-accumulator Miller
+    product: one Fq12 squaring per loop bit for the whole batch."""
+    total = miller_product_batch(xp, yp, xq, yq, mask)
     return tw.fq12_is_one(final_exponentiate(total))

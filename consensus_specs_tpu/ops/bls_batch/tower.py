@@ -133,6 +133,8 @@ def fq2_mul_xi(a):
 
 
 def fq2_inv(a):
+    """Norm-based inverse; 0 maps to 0 (RFC 9380 inv0 semantics — the
+    device SVDW map in `h2c_jax` relies on this)."""
     jnp = _jnp()
     a0, a1 = a[..., 0, :], a[..., 1, :]
     t = fq_mul(jnp.stack([a0, a1]), jnp.stack([a0, a1]))

@@ -101,7 +101,7 @@ def make_sharded_epoch_step(mesh: Mesh, params: EpochParams,
     replicated.
     """
     require_x64()
-    from jax import shard_map
+    from ..utils.jaxtools import shard_map_compat
 
     def _step(reg: RegistryArrays, sc: EpochScalars, length,
               pubkey_root, credentials):
@@ -116,11 +116,10 @@ def make_sharded_epoch_step(mesh: Mesh, params: EpochParams,
 
     data = P(axis)
     repl = P()
-    sharded = shard_map(
+    sharded = shard_map_compat(
         _step, mesh=mesh,
         in_specs=(RegistryArrays(*([data] * len(RegistryArrays._fields))),
                   EpochScalars(*([repl] * len(EpochScalars._fields))),
                   repl, data, data),
-        out_specs=(data, data, repl, repl),
-        check_vma=False)
+        out_specs=(data, data, repl, repl))
     return jax.jit(sharded)

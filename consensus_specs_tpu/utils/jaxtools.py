@@ -75,3 +75,20 @@ def backends_initialized() -> bool:
         return bool(xla_bridge._backends)
     except Exception:
         return False
+
+
+def shard_map_compat(f, mesh, in_specs, out_specs):
+    """`jax.shard_map` across jax versions: the stable API (jax >= 0.6,
+    `check_vma`) when present, `jax.experimental.shard_map` (`check_rep`)
+    on older builds like this image's 0.4.x.  Replication checking is
+    disabled either way — the sharded kernels replicate reductions by
+    explicit all_gathers."""
+    import jax
+
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=False)
