@@ -50,7 +50,9 @@ bench:
 	$(PYTHON) bench.py
 
 # no TPU required: tiny-shape epoch + BLS bench runs on CPU, asserting
-# the one-JSON-line-per-metric contract the external driver parses
+# the one-JSON-line-per-metric contract the external driver parses —
+# including the CST_TELEMETRY "telemetry" sub-object (compile/run split,
+# padding waste, MSM/h2c routing) and the CST_TRACE_FILE Chrome trace
 bench-smoke:
 	$(CPU_ENV) $(PYTHON) bench_smoke.py
 

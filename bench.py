@@ -174,8 +174,13 @@ def _stop_profile_trace():
 
 
 def worker_epoch(n: int) -> None:
-    """Config #4: fused epoch sweep + registry merkleization on device."""
+    """Config #4: fused epoch sweep + registry merkleization on device.
+    With CST_TELEMETRY=1 the JSON carries a `"telemetry"` sub-object
+    splitting the flagship wall into compile_s (trace + XLA compile of
+    the fused step, measured from the first call) vs run_s."""
     import numpy as np
+
+    from consensus_specs_tpu import telemetry
 
     jax = _worker_setup_jax()
     from consensus_specs_tpu.models.builder import build_spec
@@ -211,21 +216,32 @@ def worker_epoch(n: int) -> None:
 
     args = (reg, sc, np.uint64(n), pk_root, cred)
     t0 = time.perf_counter()
-    jax.block_until_ready(step(*args))
-    log(f"compile+first run {time.perf_counter() - t0:.1f}s")
+    with telemetry.span("bench.epoch.compile_first", n=n):
+        jax.block_until_ready(step(*args))
+    compile_dt = time.perf_counter() - t0
+    log(f"compile+first run {compile_dt:.1f}s")
     iters = 5
     t0 = time.perf_counter()
-    for _ in range(iters):
-        out = jax.block_until_ready(step(*args))
+    with telemetry.span("bench.epoch.steady", n=n, iters=iters):
+        for _ in range(iters):
+            out = jax.block_until_ready(step(*args))
     dt = (time.perf_counter() - t0) / iters
     log(f"{dt * 1e3:.1f} ms/step @ {n} validators "
         f"(root {np.asarray(out[3])[:2]})")
     _stop_profile_trace()
-    print(json.dumps({"seconds": dt, "platform": dev.platform}), flush=True)
+    result = {"seconds": dt, "platform": dev.platform}
+    if telemetry.enabled():
+        result["telemetry"] = telemetry.bench_block(
+            compile_s=compile_dt, run_s=dt)
+    print(json.dumps(result), flush=True)
 
 
 def worker_bls() -> None:
-    """Configs #2/#3: attestation RLC batch + sync-aggregate pairing."""
+    """Configs #2/#3: attestation RLC batch + sync-aggregate pairing.
+    With CST_TELEMETRY=1 each metric carries per-config compile/run,
+    padding, and routing telemetry."""
+    from consensus_specs_tpu import telemetry
+
     _worker_setup_jax()
     import bench_bls
 
@@ -240,6 +256,10 @@ def worker_bls() -> None:
     from consensus_specs_tpu.ops.bls_batch import (
         batch_verify, pairing_check_device)
 
+    _tel = telemetry.embed_bench_block
+
+    if telemetry.enabled():
+        telemetry.reset()
     tasks, _ = bench_bls._build_tasks(n_att, committee, seed_base=1000)
     t0 = time.perf_counter()
     assert batch_verify(tasks)
@@ -250,6 +270,8 @@ def worker_bls() -> None:
         assert batch_verify(tasks)
     att_dt = (time.perf_counter() - t0) / iters
     att_base = base["oracle_seconds_per_fast_aggregate_verify"] * n_att
+    att = _tel({"value": round(att_dt, 4), "unit": "s",
+                "vs_baseline": round(att_base / att_dt, 1)})
 
     sync_tasks, _ = bench_bls._build_tasks(1, sync_n, seed_base=2000)
     pk, msg, sig = sync_tasks[0]
@@ -263,22 +285,37 @@ def worker_bls() -> None:
         assert pairing_check_device(pairs)
     sync_dt = (time.perf_counter() - t0) / iters
     sync_base = base["oracle_seconds_per_sync_aggregate_verify"]
+    sync = _tel({"value": round(sync_dt, 4), "unit": "s",
+                 "vs_baseline": round(sync_base / sync_dt, 1)})
+
+    out = {
+        f"attestation_batch_{n_att}x{committee}_verify_wall": att,
+        f"sync_aggregate_{sync_n}_verify_wall": sync,
+    }
+    # the ROADMAP's _MSM_DEVICE_MIN break-even question rides along on
+    # telemetry rounds (host-vs-device MSM wall + routing per size),
+    # same record shape as bench_bls.py's standalone emission.  A probe
+    # failure (e.g. its kernel-vs-oracle assert) must not cost the two
+    # already-measured config metrics — report it as a field instead.
+    if telemetry.enabled() and bench_bls.MSM_PROBE_SIZES:
+        try:
+            probe = _tel(bench_bls.msm_probe_record())
+            out[probe.pop("metric")] = probe
+        except Exception as e:
+            out["g1_msm_breakeven_probe_error"] = repr(e)[:300]
 
     _stop_profile_trace()
-    print(json.dumps({
-        f"attestation_batch_{n_att}x{committee}_verify_wall":
-            {"value": round(att_dt, 4), "unit": "s",
-             "vs_baseline": round(att_base / att_dt, 1)},
-        f"sync_aggregate_{sync_n}_verify_wall":
-            {"value": round(sync_dt, 4), "unit": "s",
-             "vs_baseline": round(sync_base / sync_dt, 1)},
-    }), flush=True)
+    print(json.dumps(out), flush=True)
 
 
 def worker_kzg() -> None:
     """Config #5: deneb `verify_blob_kzg_proof_batch` over 6 mainnet
     blobs — KZG pairings/MSM on device (jax backend) vs the pure-python
-    oracle."""
+    oracle.  The telemetry block's `routing` counts show how many of the
+    batch's G1 MSMs the `_MSM_DEVICE_MIN` threshold kept on the host —
+    the ROADMAP's open question for this config."""
+    from consensus_specs_tpu import telemetry
+
     _worker_setup_jax()
 
     from consensus_specs_tpu.models.builder import build_spec
@@ -314,6 +351,8 @@ def worker_kzg() -> None:
     py_dt = measure(iters=1)
     log(f"kzg batch py oracle: {py_dt:.2f}s")
     bls.use_backend("jax")
+    if telemetry.enabled():
+        telemetry.reset()   # count only the device-backend phase
     first = time.perf_counter()
     assert spec.verify_blob_kzg_proof_batch(blobs, commitments, proofs)
     log(f"kzg batch device compile+first: "
@@ -321,10 +360,11 @@ def worker_kzg() -> None:
     dev_dt = measure()
 
     _stop_profile_trace()
+    kzg = telemetry.embed_bench_block(
+        {"value": round(dev_dt, 4), "unit": "s",
+         "vs_baseline": round(py_dt / dev_dt, 1)})
     print(json.dumps({
-        "blob_kzg_proof_batch_6_verify_wall":
-            {"value": round(dev_dt, 4), "unit": "s",
-             "vs_baseline": round(py_dt / dev_dt, 1)},
+        "blob_kzg_proof_batch_6_verify_wall": kzg,
     }), flush=True)
 
 
@@ -332,6 +372,8 @@ def worker_spec() -> None:
     """Config #1: minimal-preset phase0 `state_transition` on 64
     validators with signatures ON — full-spec wall per signed block,
     device (jax) backend vs the pure-python oracle."""
+    from consensus_specs_tpu import telemetry
+
     _worker_setup_jax()
 
     from consensus_specs_tpu.models.builder import build_spec
@@ -367,14 +409,17 @@ def worker_spec() -> None:
     py_dt = measure()
     log(f"state_transition py oracle: {py_dt:.2f}s/block")
     bls.use_backend("jax")
+    if telemetry.enabled():
+        telemetry.reset()   # count only the device-backend phase
     transition_one(state.copy())  # compile
     dev_dt = measure()
 
     _stop_profile_trace()
+    rec = telemetry.embed_bench_block(
+        {"value": round(dev_dt, 4), "unit": "s",
+         "vs_baseline": round(py_dt / dev_dt, 1)})
     print(json.dumps({
-        "minimal_phase0_state_transition_signed_block_wall":
-            {"value": round(dev_dt, 4), "unit": "s",
-             "vs_baseline": round(py_dt / dev_dt, 1)},
+        "minimal_phase0_state_transition_signed_block_wall": rec,
     }), flush=True)
 
 
@@ -453,6 +498,8 @@ def main():
         out["value"] = round(result["seconds"], 4)
         out["vs_baseline"] = round(baseline_s / result["seconds"], 1)
         out["platform"] = platform or result.get("platform", "tpu")
+        if "telemetry" in result:    # CST_TELEMETRY=1 rounds: the
+            out["telemetry"] = result["telemetry"]  # compile/run split
     if errors:
         out["error"] = "; ".join(errors)
 

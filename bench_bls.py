@@ -15,6 +15,14 @@ Prints one JSON line per metric:
 Oracle costs are measured from ONE representative verify and scaled
 (each verify is an independent 2-pairing check; the loop is linear), and
 persisted in bench_bls_baseline.json next to this file.
+
+With CST_TELEMETRY=1 each metric line also carries a `"telemetry"`
+sub-object (compile_s/run_s split, bucket-padding waste, MSM + h2c
+routing counts — `consensus_specs_tpu.telemetry.bench_block`), and a
+third metric probes the G1 MSM host/device break-even
+(`_MSM_DEVICE_MIN`): host-oracle vs device-kernel wall at the sizes in
+CST_BLS_BENCH_MSM_SIZES (default "6,16" — config #5's size-6 MSMs and
+the current routing threshold), the ROADMAP's open routing question.
 """
 
 from __future__ import annotations
@@ -33,6 +41,7 @@ jax.config.update("jax_enable_x64", True)
 if os.environ.get("JAX_PLATFORMS"):
     jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
+from consensus_specs_tpu import telemetry  # noqa: E402
 from consensus_specs_tpu.utils.jaxtools import enable_compile_cache  # noqa: E402
 
 enable_compile_cache()
@@ -44,6 +53,10 @@ BASELINE_FILE = Path(__file__).resolve().parent / "bench_bls_baseline.json"
 N_ATTESTATIONS = int(os.environ.get("CST_BLS_BENCH_N", 128))
 COMMITTEE_SIZE = int(os.environ.get("CST_BLS_BENCH_COMMITTEE", 64))
 SYNC_COMMITTEE_SIZE = int(os.environ.get("CST_BLS_BENCH_SYNC", 512))
+# MSM break-even probe sizes; "" disables the probe
+MSM_PROBE_SIZES = tuple(
+    int(s) for s in os.environ.get("CST_BLS_BENCH_MSM_SIZES",
+                                   "6,16").split(",") if s.strip())
 
 
 def log(*a):
@@ -101,6 +114,99 @@ def _baselines() -> dict:
     return data
 
 
+def _emit(record: dict) -> None:
+    """Print one metric line, with the per-config `"telemetry"`
+    sub-object embedded on telemetry rounds."""
+    print(json.dumps(telemetry.embed_bench_block(record)), flush=True)
+
+
+def msm_breakeven_probe(sizes=MSM_PROBE_SIZES, iters: int = 3):
+    """Host-oracle vs device-kernel G1 MSM wall per batch size, plus the
+    route `ops.bls.multi_exp` actually takes at that size — the data the
+    ROADMAP's `_MSM_DEVICE_MIN = 16` open item asks for.  Returns the
+    per-size detail dict (empty when disabled via
+    CST_BLS_BENCH_MSM_SIZES="")."""
+    from consensus_specs_tpu.ops import bls
+    from consensus_specs_tpu.ops.bls import ciphersuite as cs
+    from consensus_specs_tpu.ops.bls.curve import g1
+    from consensus_specs_tpu.ops.bls.fields import R
+    from consensus_specs_tpu.ops.bls_batch import g1_multi_exp_device
+
+    detail = {}
+    for n in sizes:
+        pts = [g1.mul(cs.G1_GEN, 3 * i + 2) for i in range(n)]
+        ks = [pow(5, i + 1, R) for i in range(n)]
+        tagged = [(1, p) for p in pts]
+
+        t0 = time.perf_counter()
+        host_out = cs.multi_exp(tagged, ks)
+        host_dt = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        dev_out = g1_multi_exp_device(pts, ks)
+        compile_dt = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            dev_out = g1_multi_exp_device(pts, ks)
+        dev_dt = (time.perf_counter() - t0) / iters
+        assert g1.eq_points(host_out[1], dev_out), f"MSM mismatch at n={n}"
+
+        # where the facade's threshold actually routes this size, read
+        # back from the routing counters the call just incremented (one
+        # source of truth with the telemetry block); the global backend
+        # is restored — the probe must not change what any later
+        # measurement runs on
+        prev_backend = bls.backend_name()
+        dev_before = telemetry.counter_value("msm.route.device")
+        try:
+            bls.use_backend("jax")
+            bls.multi_exp(tagged, ks)
+        finally:
+            bls.use_backend(prev_backend)
+        dev_after = telemetry.counter_value("msm.route.device")
+        # counters are the source of truth when collecting; without
+        # telemetry (counters frozen) fall back to the threshold itself
+        routed_dev = (dev_after > dev_before if telemetry.enabled()
+                      else n >= bls._MSM_DEVICE_MIN)
+        detail[str(n)] = {
+            "host_s": round(host_dt, 4),
+            "device_s": round(dev_dt, 4),
+            "device_compile_first_s": round(compile_dt, 4),
+            # ratio from the UNROUNDED walls: at sub-ms device times the
+            # 4-dp display rounding would distort the number the
+            # _MSM_DEVICE_MIN decision rides on
+            "host_over_device": round(host_dt / dev_dt, 2) if dev_dt
+            else None,
+            "routed": "device" if routed_dev else "host",
+        }
+        log(f"msm probe n={n}: host {host_dt:.4f}s device {dev_dt:.4f}s "
+            f"(compile+first {compile_dt:.1f}s) -> routed "
+            f"{detail[str(n)]['routed']}")
+    return detail
+
+
+def msm_probe_record() -> dict:
+    """Run the break-even probe and shape it as one bench metric record
+    (metric/value/unit/vs_baseline + per-size detail) — the ONE shape
+    this metric has, whether emitted standalone here or embedded in
+    bench.py's extras."""
+    from consensus_specs_tpu.ops import bls
+
+    detail = msm_breakeven_probe()
+    smallest = str(min(MSM_PROBE_SIZES))
+    d = detail[smallest]
+    return {
+        "metric": f"g1_msm_breakeven_probe_n{smallest}",
+        "value": d["device_s"],
+        "unit": "s",
+        # >1.0 means the device kernel beats the host oracle at the
+        # smallest probed size => _MSM_DEVICE_MIN should drop
+        "vs_baseline": d["host_over_device"],
+        "detail": detail,
+        "msm_device_min": bls._MSM_DEVICE_MIN,
+    }
+
+
 def main():
     from consensus_specs_tpu.ops.bls_batch import (
         batch_verify, pairing_check_device)
@@ -109,6 +215,8 @@ def main():
     from consensus_specs_tpu.ops.bls.hash_to_curve import DST_G2, hash_to_g2
 
     base = _baselines()
+    if telemetry.enabled():
+        telemetry.reset()   # drop setup-phase counters; per-config blocks
 
     # config #2: attestation batch
     tasks, _ = _build_tasks(N_ATTESTATIONS, COMMITTEE_SIZE, seed_base=1000)
@@ -122,13 +230,13 @@ def main():
     dt = (time.perf_counter() - t0) / iters
     baseline = (base["oracle_seconds_per_fast_aggregate_verify"]
                 * N_ATTESTATIONS)
-    print(json.dumps({
+    _emit({
         "metric": f"attestation_batch_{N_ATTESTATIONS}x"
                   f"{COMMITTEE_SIZE}_verify_wall",
         "value": round(dt, 4),
         "unit": "s",
         "vs_baseline": round(baseline / dt, 1),
-    }), flush=True)
+    })
 
     # config #3: sync aggregate (one 512-member statement)
     sync_tasks, _ = _build_tasks(1, SYNC_COMMITTEE_SIZE, seed_base=2000)
@@ -143,12 +251,18 @@ def main():
         assert pairing_check_device(pairs)
     dt = (time.perf_counter() - t0) / iters
     baseline = base["oracle_seconds_per_sync_aggregate_verify"]
-    print(json.dumps({
+    _emit({
         "metric": f"sync_aggregate_{SYNC_COMMITTEE_SIZE}_verify_wall",
         "value": round(dt, 4),
         "unit": "s",
         "vs_baseline": round(baseline / dt, 1),
-    }), flush=True)
+    })
+
+    # MSM break-even probe (telemetry rounds only: it exists to produce
+    # routing data, and keeping it out of the default path holds the
+    # CST_TELEMETRY-unset bench wall identical to the pre-telemetry one)
+    if telemetry.enabled() and MSM_PROBE_SIZES:
+        _emit(msm_probe_record())
 
 
 if __name__ == "__main__":

@@ -6,6 +6,14 @@ the external driver parses — so bench bit-rot (import errors, schema
 drift, kernel regressions that crash at trace time) is caught without a
 TPU.  The kzg worker is excluded: its mainnet 4096-wide blob shapes have
 no tiny-shape knob and would dominate the lane's wall time.
+
+The sub-benches run with CST_TELEMETRY=1 so the `"telemetry"` sub-object
+(compile_s/run_s split, padding waste, MSM/h2c routing — see
+`consensus_specs_tpu.telemetry`) is asserted present and schema-valid on
+every metric line: the bench contract cannot silently drop it.  The
+bench_bls run also sets CST_TRACE_FILE and checks the emitted Chrome
+trace is loadable trace-event JSON, and probes the MSM break-even at one
+tiny size (n=4) to keep the probe path exercised.
 """
 
 from __future__ import annotations
@@ -15,6 +23,8 @@ import os
 import subprocess
 import sys
 from pathlib import Path
+
+from consensus_specs_tpu.telemetry import validate_bench_block
 
 HERE = Path(__file__).resolve().parent
 
@@ -45,25 +55,78 @@ def _run(cmd, env_extra, timeout):
     return parsed
 
 
+def _check_telemetry(record, where: str) -> dict:
+    tel = record.get("telemetry")
+    problems = validate_bench_block(tel)
+    if problems:
+        raise SystemExit(f"{where}: bad telemetry block {problems}: "
+                         f"{json.dumps(tel)[:500]}")
+    return tel
+
+
 def main():
     out = _run(["bench.py", "--worker", "epoch"],
-               {"CST_BENCH_N": "1024", "CST_NO_COMPILE_CACHE": "1"},
+               {"CST_BENCH_N": "1024", "CST_NO_COMPILE_CACHE": "1",
+                "CST_TELEMETRY": "1"},
                timeout=900)
     last = out[-1]
     assert isinstance(last.get("seconds"), (int, float)) \
         and last["seconds"] > 0, last
-    print("bench.py epoch worker JSON OK:", json.dumps(last))
+    tel = _check_telemetry(last, "epoch worker")
+    assert tel["compile_s"] > 0, tel   # the fused step DID compile
+    print("bench.py epoch worker JSON OK:",
+          json.dumps({k: v for k, v in last.items() if k != "telemetry"}),
+          f"(telemetry: compile {tel['compile_s']}s run {tel['run_s']}s)")
 
+    trace_file = HERE / "out" / "smoke_trace.json"
+    trace_file.parent.mkdir(exist_ok=True)
+    if trace_file.exists():
+        trace_file.unlink()
     out = _run(["bench_bls.py"],
                {"CST_BLS_BENCH_N": "2", "CST_BLS_BENCH_COMMITTEE": "2",
-                "CST_BLS_BENCH_SYNC": "4"},
+                "CST_BLS_BENCH_SYNC": "4",
+                "CST_TELEMETRY": "1", "CST_BLS_BENCH_MSM_SIZES": "4",
+                "CST_TRACE_FILE": str(trace_file)},
+               timeout=1800)
+    metrics = [o for o in out if "metric" in o]
+    assert len(metrics) == 3, out    # configs #2, #3 + the MSM probe
+    for m in metrics:
+        assert {"metric", "value", "unit", "vs_baseline"} <= set(m), m
+        assert isinstance(m["value"], (int, float)), m
+        _check_telemetry(m, m["metric"])
+    probe = [m for m in metrics
+             if m["metric"].startswith("g1_msm_breakeven_probe")]
+    assert probe and probe[0].get("detail", {}).get("4"), probe
+    print("bench_bls.py JSON OK:", json.dumps(
+        [{k: v for k, v in m.items() if k != "telemetry"}
+         for m in metrics]))
+
+    # CST_TRACE_FILE must have produced loadable Chrome trace-event JSON
+    trace = json.loads(trace_file.read_text())
+    events = trace["traceEvents"]
+    spans = [e for e in events if e.get("ph") == "X"]
+    assert spans, "trace file has no complete ('X') events"
+    for e in spans:
+        assert {"name", "ph", "ts", "dur", "pid", "tid"} <= set(e), e
+    names = {e["name"] for e in spans}
+    assert "bls.batch_verify" in names, sorted(names)
+    print(f"chrome trace OK: {len(spans)} spans -> {trace_file}")
+
+    # telemetry-OFF contract: the default path (what a non-telemetry
+    # TPU round runs) must emit the plain 2-metric lines — no
+    # "telemetry" key, no probe.  Same shapes as the run above, so the
+    # persistent compile cache makes this re-run cheap.
+    out = _run(["bench_bls.py"],
+               {"CST_BLS_BENCH_N": "2", "CST_BLS_BENCH_COMMITTEE": "2",
+                "CST_BLS_BENCH_SYNC": "4",
+                "CST_TELEMETRY": "", "CST_TRACE_FILE": ""},
                timeout=1800)
     metrics = [o for o in out if "metric" in o]
     assert len(metrics) == 2, out
     for m in metrics:
         assert {"metric", "value", "unit", "vs_baseline"} <= set(m), m
-        assert isinstance(m["value"], (int, float)), m
-    print("bench_bls.py JSON OK:", json.dumps(metrics))
+        assert "telemetry" not in m, m
+    print("bench_bls.py telemetry-off JSON OK:", json.dumps(metrics))
     print("bench smoke: PASS")
 
 

@@ -24,6 +24,7 @@ milagro calls inside `state_transition` (specs/phase0/beacon-chain.md
 
 from __future__ import annotations
 
+from . import telemetry
 from .ops import bls
 
 
@@ -33,15 +34,27 @@ def state_transition_batched(spec, state, signed_block,
     """Run `spec.state_transition` with aggregate pairings batched on the
     device.  Raises AssertionError exactly where the spec would (plus at
     the end if the signature batch fails); on failure the state is
-    partially advanced — run on a copy, as `on_block` does."""
+    partially advanced — run on a copy, as `on_block` does.
+
+    Each phase (slot advance, block body, batch settle, state-root
+    check) runs under a telemetry span, so a `CST_TRACE_FILE` capture of
+    a block import decomposes into per-phase wall time."""
     block = signed_block.message
-    spec.process_slots(state, block.slot)
-    if validate_result:
-        assert spec.verify_block_signature(state, signed_block)
-    with bls.deferred_batch_verification() as batch:
-        spec.process_block(state, block)
-    assert batch.verify(device=device), \
-        "batched aggregate-signature verification failed"
-    if validate_result:
-        assert block.state_root == spec.hash_tree_root(state)
+    with telemetry.span("executor.state_transition_batched",
+                        slot=int(block.slot)):
+        with telemetry.span("executor.process_slots"):
+            spec.process_slots(state, block.slot)
+        if validate_result:
+            with telemetry.span("executor.verify_block_signature"):
+                assert spec.verify_block_signature(state, signed_block)
+        with bls.deferred_batch_verification() as batch:
+            with telemetry.span("executor.process_block"):
+                spec.process_block(state, block)
+        with telemetry.span("executor.batch_settle",
+                            statements=len(batch.tasks)):
+            ok = batch.verify(device=device)
+        assert ok, "batched aggregate-signature verification failed"
+        if validate_result:
+            with telemetry.span("executor.state_root_check"):
+                assert block.state_root == spec.hash_tree_root(state)
     return state

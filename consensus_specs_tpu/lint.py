@@ -12,6 +12,13 @@ Two checks per fork x preset:
 2. **config-attribute discipline**: every `config.X` attribute access
    must exist in the loaded Configuration for that preset.
 
+Plus one repo-wide check:
+
+3. **env-knob discipline**: every `os.environ` read of a `CST_*`
+   variable anywhere in the tree must have a row in README.md's
+   "Environment knobs" table (and every table row must still have a
+   read) — the knob surface cannot silently drift from its docs.
+
 Run via `python -m consensus_specs_tpu.lint` (wired into `make lint`).
 """
 
@@ -19,6 +26,7 @@ from __future__ import annotations
 
 import ast
 import builtins
+import re
 import sys
 
 from .models.builder import (
@@ -163,6 +171,52 @@ def lint_spec(fork: str, preset: str) -> list[str]:
     return findings
 
 
+# any os.environ get/subscript/setdefault or os.getenv whose string key
+# carries the CST_ prefix, matched against whole-file text so reads
+# wrapped across lines still register.  Internal knobs (leading
+# underscore, e.g. _CST_DRYRUN_SUBPROCESS) are exempt by the prefix
+# anchor.
+_ENV_READ_RE = re.compile(
+    r"""(?:environ(?:\.get|\.setdefault)?\s*[\(\[]|getenv\s*\()"""
+    r"""\s*['"](CST_[A-Z0-9_]+)""")
+_SKIP_DIRS = {"__pycache__", ".git", ".jax_cache", ".pytest_cache",
+              "out", ".claude", ".venv", "venv", ".eggs",
+              "site-packages", "node_modules"}
+
+
+def lint_env_knobs() -> list[str]:
+    """Every `CST_*` env read in the tree needs a row in README.md's
+    knob table, and every row needs a surviving read."""
+    repo = PKG_ROOT.parent
+    readme = repo / "README.md"
+    documented = set(re.findall(r"\|\s*`(CST_[A-Z0-9_]+)`",
+                                readme.read_text()))
+
+    used: dict[str, str] = {}
+    for path in sorted(repo.rglob("*.py")):
+        if any(part in _SKIP_DIRS for part in path.parts):
+            continue
+        rel = str(path.relative_to(repo))
+        try:
+            text = path.read_text()
+        except (OSError, UnicodeDecodeError):
+            continue    # unreadable stray file — not ours to lint
+        for m in _ENV_READ_RE.finditer(text):
+            line = text.count("\n", 0, m.start()) + 1
+            used.setdefault(m.group(1), f"{rel}:{line}")
+
+    findings = []
+    for name in sorted(set(used) - documented):
+        findings.append(
+            f"{used[name]}: env knob '{name}' read but not documented "
+            f"in README.md's Environment knobs table")
+    for name in sorted(documented - set(used)):
+        findings.append(
+            f"README.md: env knob '{name}' documented but never read "
+            f"in the tree (stale table row?)")
+    return findings
+
+
 def main(argv=None) -> int:
     presets = ("minimal", "mainnet")
     total = 0
@@ -174,11 +228,15 @@ def main(argv=None) -> int:
                     seen.add(finding)
                     print(finding)
                     total += 1
+    for finding in lint_env_knobs():
+        print(finding)
+        total += 1
     if total:
         print(f"spec lint: {total} finding(s)", file=sys.stderr)
         return 1
     print(f"spec lint: {len(BUILDABLE_FORKS) * len(presets)} "
-          "spec builds clean (undefined-name + config-attribute checks)")
+          "spec builds clean (undefined-name + config-attribute checks); "
+          "env-knob table in sync")
     return 0
 
 

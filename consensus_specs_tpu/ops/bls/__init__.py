@@ -20,6 +20,7 @@ product-of-pairings predicate.
 
 from __future__ import annotations
 
+from ... import telemetry
 from . import ciphersuite as _py
 from . import curve as _curve
 from . import fields as _fields
@@ -178,6 +179,7 @@ class DeferredBatch:
 
         if len(pubkeys) == 0:
             self.failed = True
+            telemetry.count("bls.deferred.rejected")
             return False
         try:
             sig = _sig_to_point(bytes(signature))
@@ -186,8 +188,10 @@ class DeferredBatch:
                 agg = g1.add(agg, _pk_to_point(bytes(pk)))
         except ValueError:
             self.failed = True
+            telemetry.count("bls.deferred.rejected")
             return False
         self.tasks.append((agg, bytes(message), sig))
+        telemetry.count("bls.deferred.recorded")
         return True
 
     def verify(self, device: bool | None = None) -> bool:
@@ -199,17 +203,23 @@ class DeferredBatch:
             return True
         if device is None:
             device = _backend_name == "jax"
-        if device:
-            from ..bls_batch import batch_verify
+        telemetry.count("bls.deferred.settled", len(self.tasks))
+        telemetry.count("bls.deferred.backend.device" if device
+                        else "bls.deferred.backend.host")
+        with telemetry.span("bls.deferred.verify",
+                            statements=len(self.tasks),
+                            backend="device" if device else "host"):
+            if device:
+                from ..bls_batch import batch_verify
 
-            return batch_verify(self.tasks)
-        from .ciphersuite import G1_GEN, _pairing_check, g1
-        from .hash_to_curve import DST_G2, hash_to_g2
+                return batch_verify(self.tasks)
+            from .ciphersuite import G1_GEN, _pairing_check, g1
+            from .hash_to_curve import DST_G2, hash_to_g2
 
-        return all(
-            _pairing_check([(pk, hash_to_g2(msg, DST_G2)),
-                            (g1.neg(G1_GEN), sig)])
-            for pk, msg, sig in self.tasks)
+            return all(
+                _pairing_check([(pk, hash_to_g2(msg, DST_G2)),
+                                (g1.neg(G1_GEN), sig)])
+                for pk, msg, sig in self.tasks)
 
 
 _deferred: DeferredBatch | None = None
@@ -243,13 +253,23 @@ _MSM_DEVICE_MIN = 16
 
 def multi_exp(points, integers):
     """MSM; G1 batches route to the device kernel under the jax backend
-    (the KZG `g1_lincomb`/`verify_kzg_proof_batch` hot path)."""
+    (the KZG `g1_lincomb`/`verify_kzg_proof_batch` hot path).  Routing
+    decisions are counted (`msm.route.{device,host}` + size histograms)
+    so the `_MSM_DEVICE_MIN` break-even is measurable, not guessed."""
+    is_g1 = bool(points) and points[0][0] == 1
     if (_backend_name == "jax" and len(points) >= _MSM_DEVICE_MIN
-            and points and points[0][0] == 1):
+            and is_g1):
         from ..bls_batch import g1_multi_exp_device
 
+        telemetry.count("msm.route.device")
+        telemetry.observe("msm.route.device.n", len(points))
         return (1, g1_multi_exp_device([p for _, p in points],
                                        [int(i) for i in integers]))
+    # the host-route counter means "the threshold kept a jax-backend MSM
+    # on the host" — python-backend runs are not routing decisions
+    if is_g1 and _backend_name == "jax":
+        telemetry.count("msm.route.host")
+        telemetry.observe("msm.route.host.n", len(points))
     return _py.multi_exp(points, integers)
 Z1 = _py.Z1
 Z2 = _py.Z2

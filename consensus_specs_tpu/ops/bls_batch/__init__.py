@@ -60,9 +60,11 @@ from __future__ import annotations
 import functools
 import os
 import secrets
+import time
 
 import numpy as np
 
+from ... import telemetry
 from ..bls import curve as _pycurve
 from ..bls.hash_to_curve import DST_G2, hash_to_g2
 from . import curve_jax as cj
@@ -94,6 +96,38 @@ def _bucket(n: int) -> int:
         if b <= step:
             return step
     return b
+
+
+# --- telemetry-aware kernel dispatch ----------------------------------------
+
+
+def _dispatch(kernel: str, fn, args):
+    """Run a jitted kernel, attributing its wall time to compile vs run:
+    the FIRST dispatch of a given (kernel, padded-shape) key pays
+    trace + XLA compile (or a persistent-cache load — visible as an
+    anomalously cheap first call), later dispatches are pure run.  Off
+    (the default) this is a flag check and a tail call — no sync, no
+    timing."""
+    if not telemetry.enabled():
+        return fn(*args)
+    import jax
+
+    first = telemetry.first_call(f"kernel.{kernel}")
+    t0 = time.perf_counter()
+    out = jax.block_until_ready(fn(*args))
+    dt = time.perf_counter() - t0
+    which = "compile_first_s" if first else "run_s"
+    telemetry.observe(f"kernel.{which}", dt)
+    telemetry.observe(f"kernel.{kernel}.{which}", dt)
+    telemetry.count(f"kernel.{kernel}.calls")
+    return out
+
+
+def _count_lanes(live: int, padded: int) -> None:
+    """Bucket-padding accounting: live lanes actually carrying a
+    statement vs the `_bucket`-padded shape the kernel compiled for."""
+    telemetry.count("bls.lanes.live", live)
+    telemetry.count("bls.lanes.padded", padded)
 
 
 # --- device helpers ---------------------------------------------------------
@@ -144,19 +178,24 @@ def pairing_check_device(pairs) -> bool:
         return True
     jnp = _jnp()
     B = _bucket(len(live))
-    xp, yp = cj.g1_affine_to_limbs([p for p, _ in live])
-    # (n_bits, B_live, 6, 2, 33): per-bit line coefficients per pair
-    lines = np.stack([pj.precompute_g2_lines(q) for _, q in live], axis=1)
-    pad = B - len(live)
-    if pad:
-        xp = np.concatenate([xp, np.repeat(xp[:1], pad, 0)])
-        yp = np.concatenate([yp, np.repeat(yp[:1], pad, 0)])
-        lines = np.concatenate([lines, np.repeat(lines[:, :1], pad, 1)],
-                               axis=1)
-    mask = np.arange(B) < len(live)
-    out = _pairing_check_precomp_fn(B)(jnp.asarray(xp), jnp.asarray(yp),
-                                       jnp.asarray(lines),
-                                       jnp.asarray(mask))
+    with telemetry.span("bls.pairing_check_device", live=len(live),
+                        padded=B):
+        telemetry.count("bls.pairing_check.calls")
+        _count_lanes(len(live), B)
+        xp, yp = cj.g1_affine_to_limbs([p for p, _ in live])
+        # (n_bits, B_live, 6, 2, 33): per-bit line coefficients per pair
+        lines = np.stack([pj.precompute_g2_lines(q) for _, q in live],
+                         axis=1)
+        pad = B - len(live)
+        if pad:
+            xp = np.concatenate([xp, np.repeat(xp[:1], pad, 0)])
+            yp = np.concatenate([yp, np.repeat(yp[:1], pad, 0)])
+            lines = np.concatenate(
+                [lines, np.repeat(lines[:, :1], pad, 1)], axis=1)
+        mask = np.arange(B) < len(live)
+        out = _dispatch(f"pairing_check@{B}", _pairing_check_precomp_fn(B),
+                        (jnp.asarray(xp), jnp.asarray(yp),
+                         jnp.asarray(lines), jnp.asarray(mask)))
     return bool(out)
 
 
@@ -320,28 +359,39 @@ def g1_multi_exp_device(points, scalars):
         return _pycurve.g1.infinity()
 
     B = _bucket(len(live))
-    x, y = cj.g1_affine_to_limbs([p for p, _ in live])
-    pad = B - len(live)
-    if pad:
-        x = np.concatenate([x, np.repeat(x[:1], pad, 0)])
-        y = np.concatenate([y, np.repeat(y[:1], pad, 0)])
+    algo = _msm_algo(B)
+    with telemetry.span("bls.g1_multi_exp_device", live=len(live),
+                        padded=B, algo=algo):
+        telemetry.count("msm.device.calls")
+        telemetry.count(f"msm.algo.{algo}")
+        telemetry.observe("msm.device.n", len(live))
+        _count_lanes(len(live), B)
+        x, y = cj.g1_affine_to_limbs([p for p, _ in live])
+        pad = B - len(live)
+        if pad:
+            x = np.concatenate([x, np.repeat(x[:1], pad, 0)])
+            y = np.concatenate([y, np.repeat(y[:1], pad, 0)])
 
-    if _msm_algo(B) == "pippenger":
-        c = _msm_window(B)
-        digits = cj.scalars_to_digits([s for _, s in live], SCALAR_BITS, c)
-        if pad:
-            digits = np.concatenate(
-                [digits, np.zeros((pad,) + digits.shape[1:], np.int32)])
-        out = _msm_pippenger_kernel(B, c)(jnp.asarray(x), jnp.asarray(y),
-                                          jnp.asarray(digits))
-    else:
-        bits = cj.scalars_to_bits([s for _, s in live], SCALAR_BITS)
-        if pad:
-            bits = np.concatenate(
-                [bits, np.zeros((pad, SCALAR_BITS), np.int32)])
-        mask = np.arange(B) < len(live)
-        out = _msm_kernel(B)(jnp.asarray(x), jnp.asarray(y),
-                             jnp.asarray(bits), jnp.asarray(mask))
+        if algo == "pippenger":
+            c = _msm_window(B)
+            digits = cj.scalars_to_digits([s for _, s in live],
+                                          SCALAR_BITS, c)
+            if pad:
+                digits = np.concatenate(
+                    [digits, np.zeros((pad,) + digits.shape[1:], np.int32)])
+            out = _dispatch(f"msm_pippenger@{B}w{c}",
+                            _msm_pippenger_kernel(B, c),
+                            (jnp.asarray(x), jnp.asarray(y),
+                             jnp.asarray(digits)))
+        else:
+            bits = cj.scalars_to_bits([s for _, s in live], SCALAR_BITS)
+            if pad:
+                bits = np.concatenate(
+                    [bits, np.zeros((pad, SCALAR_BITS), np.int32)])
+            mask = np.arange(B) < len(live)
+            out = _dispatch(f"msm_double_add@{B}", _msm_kernel(B),
+                            (jnp.asarray(x), jnp.asarray(y),
+                             jnp.asarray(bits), jnp.asarray(mask)))
     return cj.g1_limbs_to_oracle(tuple(np.asarray(co) for co in out))
 
 
@@ -419,13 +469,26 @@ def batch_verify(tasks, rng=None, device_h2c: bool | None = None) -> bool:
     # the device xmd kernel is specialized to 32-byte signing roots
     device_h2c = device_h2c and all(
         len(bytes(m)) == 32 for _, m, _ in tasks)
-    arrays, n = _prepare_rlc_inputs(tasks, rand, None,
-                                    device_h2c=device_h2c)
-    if arrays is None:
-        return bool(n)
-    jnp = _jnp()
-    kernel = _rlc_kernel_h2c if device_h2c else _rlc_kernel
-    out = kernel(arrays[0].shape[0])(*(jnp.asarray(a) for a in arrays))
+    with telemetry.span("bls.batch_verify", tasks=len(tasks),
+                        device_h2c=device_h2c):
+        telemetry.count("bls.batch_verify.calls")
+        arrays, n = _prepare_rlc_inputs(tasks, rand, None,
+                                        device_h2c=device_h2c)
+        if arrays is None:
+            # degenerate path: trivial skip or the per-task host
+            # fallback — no statements reached the batched kernel
+            return bool(n)
+        jnp = _jnp()
+        B = arrays[0].shape[0]
+        # h2c routing counted per LIVE lane, after prepare: the
+        # degenerate paths above hash on the host (or not at all)
+        telemetry.count("bls.h2c.device" if device_h2c else "bls.h2c.host",
+                        n)
+        _count_lanes(n, B)
+        kernel = _rlc_kernel_h2c if device_h2c else _rlc_kernel
+        name = f"rlc_{'h2c' if device_h2c else 'host_hash'}@{B}"
+        out = _dispatch(name, kernel(B),
+                        tuple(jnp.asarray(a) for a in arrays))
     return bool(out)
 
 

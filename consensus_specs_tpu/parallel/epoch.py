@@ -19,8 +19,11 @@ from __future__ import annotations
 
 from typing import NamedTuple
 
+import jax
 import jax.numpy as jnp
 from jax import lax
+
+from .. import telemetry
 
 # x64 (uint64 spec arithmetic) is enabled once, in parallel/__init__ — this
 # module is only reachable through that package import.
@@ -119,7 +122,19 @@ def epoch_sweep(reg: RegistryArrays, sc: EpochScalars, params: EpochParams,
 
     Returns (new_balance, new_effective_balance), both (N,) uint64.
     Pure function of its inputs; jit/shard_map it at the call site.
-    """
+
+    The body runs under `jax.named_scope` (the sweep shows up as one
+    block in XLA device profiles) and a telemetry span — under jit the
+    span fires per TRACE, so its wall time is the Python tracing cost,
+    not the device step."""
+    with telemetry.span("parallel.epoch_sweep.trace",
+                        n=int(reg.balance.shape[0])), \
+            jax.named_scope("cst.epoch_sweep"):
+        return _epoch_sweep_impl(reg, sc, params, axis_name)
+
+
+def _epoch_sweep_impl(reg: RegistryArrays, sc: EpochScalars,
+                      params: EpochParams, axis_name: str | None = None):
     p = params
     one = jnp.uint64(1)
     prev_epoch = jnp.maximum(sc.current_epoch, one) - one

@@ -19,10 +19,12 @@ Parity oracle: `utils.ssz.ssz_impl.hash_tree_root` on the spec containers
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from .. import telemetry
 from ..ops.sha256_jax import hash_pairs, sha256_64B_words
 from ..ops.sha256_np import ZERO_HASH_WORDS
 
@@ -98,12 +100,15 @@ def balances_list_root(balances, length, limit_depth: int = 38,
         assert balances.shape[0] % 4 == 0, (
             f"sharded balances_list_root needs a chunk-aligned shard "
             f"(multiple of 4 uint64), got {balances.shape[0]}")
-    chunks = pack_u64_chunks(balances)
-    if axis_name is None:
-        root = subtree_root(chunks, limit_depth)
-    else:
-        root = _sharded_list_root(chunks, limit_depth, axis_name)
-    return mix_in_length(root, length)
+    with telemetry.span("parallel.balances_list_root.trace",
+                        n=int(balances.shape[0])), \
+            jax.named_scope("cst.balances_list_root"):
+        chunks = pack_u64_chunks(balances)
+        if axis_name is None:
+            root = subtree_root(chunks, limit_depth)
+        else:
+            root = _sharded_list_root(chunks, limit_depth, axis_name)
+        return mix_in_length(root, length)
 
 
 def _sharded_list_root(local_chunks, limit_depth: int, axis_name: str):
@@ -151,19 +156,23 @@ def validator_records_root(leaves: ValidatorLeaves, effective_balance,
                            activation_epoch, exit_epoch, withdrawable_epoch):
     """(N,) arrays -> (N, 8) root words of each Validator container (a full
     depth-3 reduction over the 8 field leaves, batched over validators)."""
-    f = [leaves.pubkey_root,
-         leaves.credentials,
-         u64_leaf_words(effective_balance),
-         u64_leaf_words(slashed.astype(jnp.uint64)),
-         u64_leaf_words(activation_eligibility_epoch),
-         u64_leaf_words(activation_epoch),
-         u64_leaf_words(exit_epoch),
-         u64_leaf_words(withdrawable_epoch)]
-    level = jnp.stack(f, axis=1)            # (N, 8 leaves, 8 words)
-    for _ in range(3):
-        half = level.shape[1] // 2
-        level = sha256_64B_words(level.reshape(level.shape[0], half, 16))
-    return level[:, 0, :]
+    with telemetry.span("parallel.validator_records_root.trace",
+                        n=int(effective_balance.shape[0])), \
+            jax.named_scope("cst.validator_records_root"):
+        f = [leaves.pubkey_root,
+             leaves.credentials,
+             u64_leaf_words(effective_balance),
+             u64_leaf_words(slashed.astype(jnp.uint64)),
+             u64_leaf_words(activation_eligibility_epoch),
+             u64_leaf_words(activation_epoch),
+             u64_leaf_words(exit_epoch),
+             u64_leaf_words(withdrawable_epoch)]
+        level = jnp.stack(f, axis=1)        # (N, 8 leaves, 8 words)
+        for _ in range(3):
+            half = level.shape[1] // 2
+            level = sha256_64B_words(
+                level.reshape(level.shape[0], half, 16))
+        return level[:, 0, :]
 
 
 def validator_registry_root(record_roots, length, limit_depth: int = 40,
@@ -175,15 +184,19 @@ def validator_registry_root(record_roots, length, limit_depth: int = 40,
     SSZ pads the List's leaf level with 32-byte zero chunks, NOT with the
     record root of an all-zero Validator."""
     n_local = record_roots.shape[0]
-    idx = jnp.arange(n_local, dtype=jnp.uint64)
-    if axis_name is not None:
-        idx = idx + (lax.axis_index(axis_name).astype(jnp.uint64)
-                     * jnp.uint64(n_local))
-    in_range = idx < jnp.asarray(length, dtype=jnp.uint64)
-    record_roots = jnp.where(in_range[:, None], record_roots,
-                             jnp.zeros_like(record_roots))
-    if axis_name is None:
-        root = subtree_root(record_roots, limit_depth)
-    else:
-        root = _sharded_list_root(record_roots, limit_depth, axis_name)
-    return mix_in_length(root, length)
+    with telemetry.span("parallel.validator_registry_root.trace",
+                        n=n_local), \
+            jax.named_scope("cst.validator_registry_root"):
+        idx = jnp.arange(n_local, dtype=jnp.uint64)
+        if axis_name is not None:
+            idx = idx + (lax.axis_index(axis_name).astype(jnp.uint64)
+                         * jnp.uint64(n_local))
+        in_range = idx < jnp.asarray(length, dtype=jnp.uint64)
+        record_roots = jnp.where(in_range[:, None], record_roots,
+                                 jnp.zeros_like(record_roots))
+        if axis_name is None:
+            root = subtree_root(record_roots, limit_depth)
+        else:
+            root = _sharded_list_root(record_roots, limit_depth,
+                                      axis_name)
+        return mix_in_length(root, length)

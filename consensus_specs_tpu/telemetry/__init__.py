@@ -1,0 +1,63 @@
+"""Env-gated telemetry for the device hot path — spans, counters,
+histograms, and three exporters.
+
+The observability backbone the ROADMAP's perf items read: every open
+question there (compile-vs-execute split of the 81s attestation
+first-call, the `_MSM_DEVICE_MIN=16` host/device break-even, bucket
+padding waste, tier-1 wall-time attribution) is answered from this
+registry rather than a single end-to-end number — the decomposition-
+first methodology of the committee-signature measurement literature
+(arXiv:2302.00418, arXiv:2602.06655).
+
+Gates (all collection OFF by default, disabled paths are a flag check):
+
+    CST_TELEMETRY=1       collect spans/counters/histograms in-process
+    CST_TRACE_FILE=f.json also write a Chrome trace-event file at exit
+                          (Perfetto / chrome://tracing loadable)
+
+Surface:
+
+    span(name, **attrs)   nestable wall-clock section (ctx manager);
+                          passes through to jax.profiler.TraceAnnotation
+                          when jax is live, so the same names appear in
+                          XLA device profiles
+    count(name, n=1)      monotonic counter
+    observe(name, v)      histogram sample (count/total/min/max)
+    set_meta(k, v)        one-shot string/num metadata (cache dir, ...)
+    first_call(key)       True once per key — compile-vs-run attribution
+    snapshot()            the whole registry as a dict (stable schema)
+    reset(), configure(), enabled()
+    write_jsonl(path), write_chrome_trace(path), chrome_trace()
+    bench_block(), validate_bench_block()   the bench JSON sub-object
+
+Zero dependencies (stdlib only); never imports jax, numpy, or any spec
+module — safe to import from anywhere, including before backend pinning.
+"""
+
+from .core import (
+    configure,
+    count,
+    counter_value,
+    enabled,
+    first_call,
+    observe,
+    reset,
+    set_meta,
+    snapshot,
+    span,
+)
+from .export import (
+    bench_block,
+    chrome_trace,
+    embed_bench_block,
+    validate_bench_block,
+    write_chrome_trace,
+    write_jsonl,
+)
+
+__all__ = [
+    "configure", "count", "counter_value", "enabled", "first_call",
+    "observe", "reset", "set_meta", "snapshot", "span", "bench_block",
+    "chrome_trace", "embed_bench_block", "validate_bench_block",
+    "write_chrome_trace", "write_jsonl",
+]

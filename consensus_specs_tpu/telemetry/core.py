@@ -1,0 +1,357 @@
+"""Process-global telemetry registry: spans, counters, histograms.
+
+Gating contract: everything here is OFF unless `CST_TELEMETRY` is set to
+a non-empty value other than "0" (or `CST_TRACE_FILE` names an output
+path, which implies collection), and the disabled paths are engineered
+to stay off the profile — `span()` returns a shared no-op context
+manager and `count()`/`observe()` are a single global-flag check.  The
+hot path (per-kernel dispatch in `ops.bls_batch`) therefore instruments
+unconditionally and lets this module decide.
+
+Enabled, the registry is a process singleton guarded by one lock:
+
+- spans     nestable wall-time sections (thread-local nesting stack),
+            aggregated by name and appended to a bounded trace-event
+            buffer for the Chrome/Perfetto exporter; when jax is already
+            imported, each span also enters a
+            `jax.profiler.TraceAnnotation` so the same names line up in
+            XLA device profiles (we never import jax ourselves — a
+            telemetry layer must not initialize a backend).
+- counters  monotonically increasing ints (routing decisions, lane
+            accounting, cache stats).
+- histograms count/total/min/max summaries of float samples (kernel
+            compile-vs-run latencies, MSM sizes).
+
+`first_call(key)` backs the compile-vs-run attribution: the first
+dispatch of a given (kernel, padded-shape) pair pays trace+XLA-compile
+(or a persistent-cache load), every later dispatch is pure run — so the
+instrumentation routes the first wall sample to `kernel.compile_first_s`
+and the rest to `kernel.run_s`, which is exactly the split the bench
+JSON contract reports (`export.bench_block`).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+
+# trace-event buffer cap: ~100 bytes/event keeps worst case ~20 MB and
+# bounds a runaway span loop; drops are counted, never silent
+_MAX_EVENTS = 200_000
+
+_lock = threading.Lock()
+_tls = threading.local()
+
+_T0 = time.perf_counter()   # chrome-trace timestamp origin (process)
+
+_counters: dict[str, int] = {}
+_hists: dict[str, dict] = {}
+_spans: dict[str, dict] = {}
+_events: list[dict] = []
+_events_dropped = 0
+_meta: dict[str, object] = {}
+_first_keys: set[str] = set()
+
+
+def _env_enabled() -> bool:
+    if os.environ.get("CST_TELEMETRY", "0") not in ("", "0"):
+        return True
+    return bool(os.environ.get("CST_TRACE_FILE"))
+
+
+_enabled = _env_enabled()
+_trace_file = os.environ.get("CST_TRACE_FILE") or None
+_atexit_registered = False
+
+
+def _register_atexit() -> None:
+    global _atexit_registered
+    if _atexit_registered or not _trace_file:
+        return
+    _atexit_registered = True
+    import atexit
+
+    from .export import write_chrome_trace
+
+    atexit.register(lambda: write_chrome_trace(_trace_file))
+
+
+if _trace_file:
+    _register_atexit()
+
+
+def enabled() -> bool:
+    """True when the registry is collecting (CST_TELEMETRY / CST_TRACE_FILE
+    or an explicit `configure(enabled=True)`)."""
+    return _enabled
+
+
+def configure(enabled: bool | None = None,
+              trace_file: str | None = None) -> None:
+    """Programmatic override of the env gate (benches and tests).
+    `trace_file` arms the atexit Chrome-trace writer and implies
+    collection."""
+    global _enabled, _trace_file
+    if trace_file is not None:
+        _trace_file = trace_file
+        _enabled = True
+        _register_atexit()
+    if enabled is not None:
+        _enabled = enabled
+
+
+def reset(full: bool = False) -> None:
+    """Clear the per-config aggregates (counters, histograms, span
+    stats) — how the benches isolate per-config telemetry blocks.
+    Process-level state survives by default: the trace-event timeline
+    (the whole-process CST_TRACE_FILE export), the first-call keys
+    (compile attribution is per-process — a kernel compiled during one
+    config must not be re-counted as a compile by the next), and the
+    meta entries (cache dir etc., recorded once at setup and owed to
+    every config's export).  `full=True` wipes those too (test
+    isolation).  The enabled flag and trace-file arming are always
+    unaffected."""
+    global _events_dropped
+    with _lock:
+        _counters.clear()
+        _hists.clear()
+        _spans.clear()
+        if full:
+            _meta.clear()
+            _events.clear()
+            _first_keys.clear()
+            _events_dropped = 0
+
+
+# --- recording primitives ---------------------------------------------------
+
+
+def count(name: str, n: int = 1) -> None:
+    if not _enabled:
+        return
+    with _lock:
+        _counters[name] = _counters.get(name, 0) + n
+
+
+def observe(name: str, value: float) -> None:
+    if not _enabled:
+        return
+    v = float(value)
+    with _lock:
+        h = _hists.get(name)
+        if h is None:
+            _hists[name] = {"count": 1, "total": v, "min": v, "max": v}
+        else:
+            h["count"] += 1
+            h["total"] += v
+            if v < h["min"]:
+                h["min"] = v
+            if v > h["max"]:
+                h["max"] = v
+
+
+def set_meta(key: str, value) -> None:
+    if not _enabled:
+        return
+    with _lock:
+        _meta[key] = value
+
+
+def counter_value(name: str, default: int = 0) -> int:
+    """One counter's current value — cheap point read, no registry
+    copy (use `snapshot()` for the full picture)."""
+    with _lock:
+        return _counters.get(name, default)
+
+
+def first_call(key: str) -> bool:
+    """True exactly once per key per process (per `reset(full=True)`):
+    the compile-vs-run discriminator for jitted kernel dispatches.
+    Disabled mode is a flag check returning False — no lock, no key
+    growth — like every other recording primitive."""
+    if not _enabled:
+        return False
+    with _lock:
+        if key in _first_keys:
+            return False
+        _first_keys.add(key)
+        return True
+
+
+# --- spans ------------------------------------------------------------------
+
+
+class _NullSpan:
+    """Shared no-op context manager handed out while disabled."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+def _span_stack() -> list:
+    st = getattr(_tls, "stack", None)
+    if st is None:
+        st = _tls.stack = []
+    return st
+
+
+def _trace_annotation(name: str):
+    """A `jax.profiler.TraceAnnotation` when jax is ALREADY imported in
+    this process, else None.  Importing jax from telemetry is forbidden:
+    on the TPU image, first import can claim a pooled device."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return None
+    try:
+        return jax.profiler.TraceAnnotation(name)
+    except Exception:
+        return None
+
+
+class _Span:
+    __slots__ = ("name", "attrs", "t0", "ann", "parent")
+
+    def __init__(self, name: str, attrs: dict):
+        self.name = name
+        self.attrs = attrs
+        self.ann = None
+
+    def __enter__(self):
+        stack = _span_stack()
+        self.parent = stack[-1] if stack else None
+        stack.append(self.name)
+        self.ann = _trace_annotation(self.name)
+        if self.ann is not None:
+            try:
+                self.ann.__enter__()
+            except Exception:
+                self.ann = None
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        t1 = time.perf_counter()
+        if self.ann is not None:
+            try:
+                self.ann.__exit__(exc_type, exc, tb)
+            except Exception:
+                pass
+        stack = _span_stack()
+        if stack and stack[-1] == self.name:
+            stack.pop()
+        dur = t1 - self.t0
+        global _events_dropped
+        with _lock:
+            s = _spans.get(self.name)
+            if s is None:
+                _spans[self.name] = {"count": 1, "total_s": dur,
+                                     "min_s": dur, "max_s": dur}
+            else:
+                s["count"] += 1
+                s["total_s"] += dur
+                if dur < s["min_s"]:
+                    s["min_s"] = dur
+                if dur > s["max_s"]:
+                    s["max_s"] = dur
+            if len(_events) < _MAX_EVENTS:
+                args = dict(self.attrs)
+                if self.parent:
+                    args["parent"] = self.parent
+                if exc_type is not None:
+                    args["error"] = exc_type.__name__
+                _events.append({
+                    "name": self.name,
+                    "ts": (self.t0 - _T0) * 1e6,    # µs, process-relative
+                    "dur": dur * 1e6,
+                    "tid": threading.get_ident() & 0x7FFFFFFF,
+                    "args": args,
+                })
+            else:
+                _events_dropped += 1
+        return False    # never swallow the exception
+
+
+def span(name: str, **attrs):
+    """Nestable wall-time section.  Usage:
+
+        with telemetry.span("bls.batch_verify", lanes=128):
+            ...
+
+    Disabled mode returns one shared no-op object (no allocation)."""
+    if not _enabled:
+        return _NULL_SPAN
+    return _Span(name, attrs)
+
+
+# --- snapshot ---------------------------------------------------------------
+
+
+def snapshot() -> dict:
+    """Point-in-time copy of the whole registry.  Schema (stable; pinned
+    by tests/test_telemetry.py):
+
+        {"enabled": bool,
+         "meta":       {str: json-able},
+         "counters":   {str: int},
+         "histograms": {str: {"count","total","min","max"}},
+         "spans":      {str: {"count","total_s","min_s","max_s"}},
+         "events": int, "events_dropped": int}
+    """
+    with _lock:
+        return {
+            "enabled": _enabled,
+            "meta": dict(_meta),
+            "counters": dict(_counters),
+            "histograms": {k: dict(v) for k, v in _hists.items()},
+            "spans": {k: dict(v) for k, v in _spans.items()},
+            "events": len(_events),
+            "events_dropped": _events_dropped,
+        }
+
+
+def _events_copy() -> tuple[list[dict], int]:
+    with _lock:
+        return [dict(e) for e in _events], _events_dropped
+
+
+def _save_state():
+    """Deep copy of the whole registry (test support: the telemetry
+    suite must reset the process-global registry without destroying the
+    session-wide data a CST_TELEMETRY CI run is accumulating)."""
+    with _lock:
+        return (dict(_counters),
+                {k: dict(v) for k, v in _hists.items()},
+                {k: dict(v) for k, v in _spans.items()},
+                [dict(e) for e in _events],
+                dict(_meta),
+                set(_first_keys),
+                _events_dropped)
+
+
+def _restore_state(state) -> None:
+    global _events_dropped
+    counters, hists, spans, events, meta, first_keys, dropped = state
+    with _lock:
+        _counters.clear()
+        _counters.update(counters)
+        _hists.clear()
+        _hists.update(hists)
+        _spans.clear()
+        _spans.update(spans)
+        _events.clear()
+        _events.extend(events)
+        _meta.clear()
+        _meta.update(meta)
+        _first_keys.clear()
+        _first_keys.update(first_keys)
+        _events_dropped = dropped

@@ -1,0 +1,169 @@
+"""Telemetry exporters: JSON-lines, Chrome trace-event, bench sub-object.
+
+Three consumers, three formats:
+
+- `write_jsonl(path)`     one JSON object per line (spans as emitted),
+                          greppable / `jq`-able post-hoc.
+- `write_chrome_trace(path)`  the Trace Event Format JSON object
+                          (https://docs.google.com/document/d/1CvAClvFfyA5R-PhYUmn5OOQtYMH4h6I0nSsKchNAySU)
+                          loadable in Perfetto / chrome://tracing.
+                          Armed automatically at process exit when
+                          `CST_TRACE_FILE` is set.
+- `bench_block()`         the `"telemetry"` sub-object embedded in the
+                          bench JSON contract (`bench.py` / `bench_bls
+                          .py`): the flagship split into compile_s vs
+                          run_s, bucket-padding waste, and MSM/h2c
+                          routing counts.  `validate_bench_block` pins
+                          the schema for `bench_smoke.py` and the tests.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from . import core
+
+
+def write_jsonl(path: str) -> int:
+    """Write every buffered span event as one JSON line; returns the
+    number of lines written."""
+    events, _ = core._events_copy()
+    with open(path, "w") as f:
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+    return len(events)
+
+
+def chrome_trace() -> dict:
+    """The trace-event JSON object: buffered spans as 'X' (complete)
+    events plus process/thread metadata, all on one pid."""
+    events, dropped = core._events_copy()
+    pid = os.getpid()
+    out = [{
+        "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+        "args": {"name": "consensus_specs_tpu"},
+    }]
+    for e in events:
+        out.append({
+            "name": e["name"], "ph": "X", "cat": "cst",
+            "pid": pid, "tid": e["tid"],
+            "ts": round(e["ts"], 3), "dur": round(e["dur"], 3),
+            "args": e["args"],
+        })
+    trace = {"traceEvents": out, "displayTimeUnit": "ms"}
+    if dropped:
+        trace["otherData"] = {"events_dropped": dropped}
+    return trace
+
+
+def write_chrome_trace(path: str) -> None:
+    # serialize fully before touching the file, and never raise:
+    # exporting must not fail (or truncate) at process exit — but a
+    # skipped export is announced, not silent, the file IS the output
+    try:
+        data = json.dumps(chrome_trace())
+        dirname = os.path.dirname(path)
+        if dirname:
+            os.makedirs(dirname, exist_ok=True)
+        with open(path, "w") as f:
+            f.write(data)
+    except Exception as e:
+        import sys
+
+        print(f"telemetry: chrome trace not written to {path}: {e}",
+              file=sys.stderr)
+
+
+# --- bench contract ---------------------------------------------------------
+
+
+def bench_block(compile_s: float | None = None,
+                run_s: float | None = None) -> dict:
+    """Assemble the `"telemetry"` sub-object for a bench JSON line from
+    the live registry.  compile_s/run_s default to the kernel-dispatch
+    histograms (`kernel.compile_first_s` / `kernel.run_s` — see
+    `core.first_call`); a bench that times its own jit entry point
+    (bench.py's epoch `step`) passes explicit values instead."""
+    snap = core.snapshot()
+    h = snap["histograms"]
+    c = snap["counters"]
+    if compile_s is None:
+        compile_s = h.get("kernel.compile_first_s", {}).get("total", 0.0)
+    if run_s is None:
+        run_s = h.get("kernel.run_s", {}).get("total", 0.0)
+    live = c.get("bls.lanes.live", 0)
+    padded = c.get("bls.lanes.padded", 0)
+    return {
+        "compile_s": round(float(compile_s), 4),
+        "run_s": round(float(run_s), 4),
+        # process-level meta (compile-cache dir + entry count, ...) —
+        # survives per-config resets, see core.reset
+        "meta": snap["meta"],
+        "padding": {
+            "live_lanes": live,
+            "padded_lanes": padded,
+            "waste_frac": round(1.0 - live / padded, 4) if padded else 0.0,
+        },
+        "routing": {
+            "msm_host": c.get("msm.route.host", 0),
+            "msm_device": c.get("msm.route.device", 0),
+            "msm_pippenger": c.get("msm.algo.pippenger", 0),
+            "msm_double_add": c.get("msm.algo.double-add", 0),
+            "h2c_device": c.get("bls.h2c.device", 0),
+            "h2c_host": c.get("bls.h2c.host", 0),
+        },
+        "counters": snap["counters"],
+    }
+
+
+def validate_bench_block(obj) -> list[str]:
+    """Schema check for a bench `"telemetry"` sub-object; returns a list
+    of problems (empty == valid).  Used by `bench_smoke.py` and
+    `tests/test_telemetry.py` so the bench contract cannot silently
+    drop or malform the block."""
+    problems: list[str] = []
+    if not isinstance(obj, dict):
+        return [f"telemetry block is {type(obj).__name__}, not dict"]
+    for key in ("compile_s", "run_s"):
+        v = obj.get(key)
+        if not isinstance(v, (int, float)) or isinstance(v, bool) or v < 0:
+            problems.append(f"{key!r} must be a non-negative number, "
+                            f"got {v!r}")
+    pad = obj.get("padding")
+    if not isinstance(pad, dict):
+        problems.append("'padding' must be a dict")
+    else:
+        for key in ("live_lanes", "padded_lanes"):
+            if not isinstance(pad.get(key), int):
+                problems.append(f"padding[{key!r}] must be an int")
+        wf = pad.get("waste_frac")
+        if not isinstance(wf, (int, float)) or not (0.0 <= wf <= 1.0):
+            problems.append("padding['waste_frac'] must be in [0, 1]")
+    routing = obj.get("routing")
+    if not isinstance(routing, dict):
+        problems.append("'routing' must be a dict")
+    else:
+        for key in ("msm_host", "msm_device", "msm_pippenger",
+                    "msm_double_add", "h2c_device", "h2c_host"):
+            v = routing.get(key)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                problems.append(f"routing[{key!r}] must be a "
+                                f"non-negative int, got {v!r}")
+    if not isinstance(obj.get("counters"), dict):
+        problems.append("'counters' must be a dict")
+    if not isinstance(obj.get("meta", {}), dict):
+        problems.append("'meta' must be a dict when present")
+    return problems
+
+
+def embed_bench_block(record: dict) -> dict:
+    """The shared per-config bench protocol: attach the current
+    `"telemetry"` block to a metric record and reset the per-config
+    aggregates so the next config's counters start clean.  No-op while
+    telemetry is off.  Used by both `bench.py` and `bench_bls.py` — one
+    copy of the protocol."""
+    if core.enabled():
+        record["telemetry"] = bench_block()
+        core.reset()
+    return record

@@ -40,12 +40,21 @@ def enable_compile_cache(cache_dir: Path | None = None) -> None:
     `.jax_cache/` so repeated bench / driver runs on one machine pay the
     XLA compile once.  Failure is never fatal — the cache is an
     optimization.  Set CST_NO_COMPILE_CACHE=1 to disable entirely (bench
-    retry path uses this to rule out cache poisoning)."""
+    retry path uses this to rule out cache poisoning).
+
+    Telemetry records the chosen directory and its entry count at setup;
+    cache HITS are not observable through jax's config API, so they are
+    inferred downstream from first-call latency (a hit makes the
+    `kernel.compile_first_s` sample collapse toward `kernel.run_s` —
+    see the README's telemetry notes)."""
     import os
+
+    from .. import telemetry
 
     import jax
 
     if os.environ.get("CST_NO_COMPILE_CACHE"):
+        telemetry.set_meta("compile_cache.dir", None)
         return
     try:
         d = cache_dir or (REPO_ROOT / ".jax_cache" / host_cache_key())
@@ -60,6 +69,10 @@ def enable_compile_cache(cache_dir: Path | None = None) -> None:
         # warnings the round-4 multichip log was full of
         jax.config.update("jax_persistent_cache_enable_xla_caches",
                           "none")
+        if telemetry.enabled():
+            telemetry.set_meta("compile_cache.dir", str(d))
+            telemetry.set_meta("compile_cache.entries_at_start",
+                               sum(1 for p in d.iterdir() if p.is_file()))
     except Exception:
         pass
 

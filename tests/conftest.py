@@ -64,3 +64,39 @@ def _configure_backends(request):
     context.DEFAULT_TEST_PRESET = request.config.getoption("--preset")
     context.DEFAULT_FORK_RESTRICTION = request.config.getoption("--fork")
     yield
+
+
+# --- telemetry attribution (CST_TELEMETRY=1 runs only) ----------------------
+# Each test runs under a span named by its nodeid, so the end-of-session
+# snapshot attributes wall time per test — the tier-1 870s-budget
+# overrun (ROADMAP) gets per-test data on every CI run, alongside
+# pytest's own --durations output.
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_test_span(request):
+    from consensus_specs_tpu import telemetry
+
+    if not telemetry.enabled():
+        yield
+        return
+    with telemetry.span(request.node.nodeid):
+        yield
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """Write the telemetry snapshot where CST_TELEMETRY_OUT points (CI
+    uploads it as an artifact); no-op unless telemetry is collecting."""
+    out = os.environ.get("CST_TELEMETRY_OUT")
+    if not out:
+        return
+    from consensus_specs_tpu import telemetry
+
+    if not telemetry.enabled():
+        return
+    import json
+    from pathlib import Path
+
+    path = Path(out)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(json.dumps(telemetry.snapshot(), indent=1) + "\n")
