@@ -13,7 +13,8 @@ VECTOR_OUT ?= out/vectors
 help:
 	@echo "targets: test (fast suite) | test-all (incl. slow crypto) |"
 	@echo "  test-bls (operation suites with real signatures, jax backend) |"
-	@echo "  lint (compile + spec static checks) | vectors [VECTOR_OUT=dir] |"
+	@echo "  lint (compile + spec static checks + device-path analyzer) |"
+	@echo "  vectors [VECTOR_OUT=dir] |"
 	@echo "  kzg_setups | bench (real TPU) | bench-smoke (tiny CPU shapes,"
 	@echo "  asserts the bench JSON contract) | multichip (8-dev CPU dryrun)"
 
@@ -35,6 +36,7 @@ test-all:
 lint:
 	$(PYTHON) -m compileall -q consensus_specs_tpu tests bench.py __graft_entry__.py
 	$(CPU_ENV) $(PYTHON) -m consensus_specs_tpu.lint
+	$(PYTHON) -m consensus_specs_tpu.analysis
 
 vectors:
 	$(CPU_ENV) $(PYTHON) -m consensus_specs_tpu.gen --output $(VECTOR_OUT) \

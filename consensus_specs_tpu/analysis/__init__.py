@@ -1,0 +1,43 @@
+"""Static analyzer for the JAX device path — the compile-time
+counterpart to the telemetry layer.
+
+PRs 1-2 showed that the device hot path's failure modes are *statically
+visible* properties of the kernel source: the 81s attestation
+compile+first is an unbucketed shape reaching jit, the below-parity KZG
+config is a silent host round-trip at a dispatch seam, a wrong-dtype
+constant is a mis-typed `jnp.asarray` at trace time.  This package
+catches those classes before a TPU bench round does, with four rule
+families over `ops/bls_batch`, `ops/bls`, `ops/sha256_jax`,
+`ops/fr_batch`, `parallel/` and `executor.py`:
+
+    recompile-unbucketed-dim, recompile-traced-branch   (recompile.py)
+    host-sync-item/-coerce/-np/-device-get              (hostsync.py)
+    dtype-int-literal/-float/-implicit-cast             (dtype.py)
+    instr-uncovered-entry                               (instrumentation.py)
+
+Findings print as `file:line: rule-id: message`; intentional cases are
+annotated in-source with `# cst: allow(<rule-id>): <reason>` — the
+allow inventory is itself a deliverable (it enumerates every remaining
+host-sync and compile-key seam for the next perf PR).
+
+Run it:
+
+    python -m consensus_specs_tpu.analysis                # whole tree
+    python -m consensus_specs_tpu.analysis path.py ...    # explicit files
+    python -m consensus_specs_tpu.analysis --json out.json
+
+Pure AST + stdlib: no jax import, no spec build — cheap enough for
+`make lint` and the CI lint job (which uploads the --json report as an
+artifact).  Sibling: `consensus_specs_tpu.lint` checks the *spec*
+namespaces; this package checks the *kernel* layer.
+"""
+
+from .core import (  # noqa: F401
+    ALL_ROLES,
+    Finding,
+    Report,
+    RULE_IDS,
+    analyze_source,
+    analyze_tree,
+    main,
+)

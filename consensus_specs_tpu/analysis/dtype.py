@@ -1,0 +1,120 @@
+"""Rule family 3 — dtype discipline in the limb-arithmetic modules.
+
+The BLS12-381 limb representation (33 x 12-bit limbs in int32 lanes,
+`ops/bls_batch/fq.py`) is only sound while every array stays int32 and
+every scalar mixed into lax ops fits the headroom budget.  Three ways
+that discipline silently breaks:
+
+dtype-int-literal    a Python int literal >= 2**32 mixed into an
+                     expression with non-constant operands: under jax's
+                     default 32-bit mode it wraps or weak-promotes
+                     depending on context — never loudly.
+dtype-float          any float literal or float-dtype reference: one
+                     float32 intermediate destroys exact limb
+                     arithmetic (and TPUs round f32 differently from
+                     hosts, so the corruption is platform-dependent).
+dtype-implicit-cast  jnp.asarray/array/zeros/ones/empty/full/arange
+                     without an explicit dtype: `jnp.zeros(shape)` is
+                     float32, `jnp.asarray(host_const)` inherits
+                     whatever numpy default the host picked — both are
+                     trace-time constants, so the wrong dtype bakes
+                     into the compiled kernel.
+
+These rules run module-wide (host conversion helpers included): the
+limb modules' host side feeds trace-time constants, so the same
+discipline applies.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, ModuleModel, _dotted
+
+_BIG = 1 << 32
+# NOTE: 'double'/'half' are deliberately absent — `g2.double(T)` (point
+# doubling) would collide; the jnp aliases below cover the real hazards
+_FLOAT_DTYPES = frozenset({"float16", "float32", "float64", "bfloat16",
+                           "float_"})
+# jnp constructors whose default dtype is a trap; zeros/ones/empty/full
+# accept dtype positionally after the shape (full: after the fill value)
+_CTORS = {"asarray": 1, "array": 1, "arange": 3,
+          "zeros": 1, "ones": 1, "empty": 1, "full": 2}
+
+
+def _is_big_literal(node) -> bool:
+    return (isinstance(node, ast.Constant)
+            and isinstance(node.value, int)
+            and not isinstance(node.value, bool)
+            and abs(node.value) >= _BIG)
+
+
+def _is_const(node) -> bool:
+    return isinstance(node, ast.Constant)
+
+
+def _check_int_literals(model: ModuleModel) -> list[Finding]:
+    findings = []
+    for node in ast.walk(model.tree):
+        operands = []
+        if isinstance(node, ast.BinOp):
+            operands = [node.left, node.right]
+        elif isinstance(node, ast.Compare):
+            operands = [node.left] + list(node.comparators)
+        if not operands:
+            continue
+        if any(_is_big_literal(o) for o in operands) \
+                and any(not _is_const(o) for o in operands):
+            findings.append(Finding(
+                model.path, node.lineno, "dtype-int-literal",
+                "int literal >= 2**32 mixed into limb arithmetic — "
+                "route it through int_to_limbs/to_mont or a typed "
+                "constant"))
+    return findings
+
+
+def _check_floats(model: ModuleModel) -> list[Finding]:
+    # whole-module walk: a module-level float constant is a trace-time
+    # constant feeding limb arithmetic just like one inside a function
+    findings = []
+    for node in ast.walk(model.tree):
+        if (isinstance(node, ast.Constant)
+                and isinstance(node.value, float)):
+            findings.append(Finding(
+                model.path, node.lineno, "dtype-float",
+                f"float literal {node.value!r} in a limb module"))
+        elif (isinstance(node, ast.Attribute)
+                and node.attr in _FLOAT_DTYPES):
+            findings.append(Finding(
+                model.path, node.lineno, "dtype-float",
+                f"float dtype '{node.attr}' referenced in a limb "
+                f"module"))
+    return findings
+
+
+def _check_implicit_casts(model: ModuleModel) -> list[Finding]:
+    findings = []
+    for node in ast.walk(model.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fd = _dotted(node.func)
+        if fd is None or "." not in fd:
+            continue
+        head, attr = fd.rsplit(".", 1)
+        if head != "jnp" or attr not in _CTORS:
+            continue
+        if any(kw.arg == "dtype" for kw in node.keywords):
+            continue
+        if len(node.args) > _CTORS[attr]:     # positional dtype
+            continue
+        findings.append(Finding(
+            model.path, node.lineno, "dtype-implicit-cast",
+            f"jnp.{attr}() without an explicit dtype — the default "
+            f"(float32 / inherited) bakes into the traced constant; "
+            f"pass dtype=jnp.int32 (or the intended type)"))
+    return findings
+
+
+def check(model: ModuleModel) -> list[Finding]:
+    return (_check_int_literals(model) + _check_floats(model)
+            + _check_implicit_casts(model))

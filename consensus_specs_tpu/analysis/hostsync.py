@@ -1,0 +1,132 @@
+"""Rule family 2 — host-sync points.
+
+A device value forced back to the host (`.item()`, `int()`/`float()`/
+`bool()`, `np.asarray`, `jax.device_get`) blocks until every queued
+device computation producing it has finished: the dispatch pipeline
+serializes and the accelerator idles behind Python.  Inside the device
+modules these syncs must be deliberate and visible — the intentional
+ones (API boundaries returning a host bool, the final root fetch) carry
+`# cst: allow(...)` annotations with reasons, which doubles as the
+inventory of serialization points for the next perf PR.
+
+Detection is provenance-based so the pure-Python oracle code sharing
+these packages stays quiet: coercions are flagged only on values the
+dataflow marks device-resident (results of `_dispatch`, a jitted local,
+a `factory(B)(...)` double call, `jax.block_until_ready`), or — inside
+jit bodies — on traced parameters (where a concretizing coercion is a
+trace-time error waiting to happen).  `.item()` and `jax.device_get`
+are unconditional: there is no host-side reason to use either in a
+device module.
+
+The fifth rule here is the inverse direction — device residency
+established too EARLY: `device-const-at-import` flags jnp arrays
+materialized at module scope.  Beyond allocating device memory at
+import, they leak tracers when the module's first import happens
+inside an active jit trace (kernels lazily import their dependencies
+from traced code — `h2c_jax` pulls in `sha256_jax` that way), after
+which every host-side use of the constant raises
+UnexpectedTracerError.  Found live on this tree: keep module-level
+constants numpy (the `fq.py` convention) and let jnp close over them
+at trace time.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, ModuleModel, _dotted, nonstatic_refs, scope_nodes
+
+_NP_NAMES = ("np.asarray", "np.array", "numpy.asarray", "numpy.array")
+_COERCIONS = ("int", "float", "bool")
+
+
+def _check_scope(model: ModuleModel, fn, aliases, tainted,
+                 traced: set[str]) -> list[Finding]:
+    findings = []
+
+    def is_device_value(arg) -> bool:
+        if isinstance(arg, ast.Name) and arg.id in tainted:
+            return True
+        if model.device_producing(arg, aliases):
+            return True
+        if traced and nonstatic_refs(arg, traced):
+            return True
+        return False
+
+    for node in scope_nodes(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        fd = _dotted(node.func)
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr == "item" and not node.args):
+            findings.append(Finding(
+                model.path, node.lineno, "host-sync-item",
+                ".item() forces a blocking device->host transfer"))
+        elif (fd or "").endswith("jax.device_get") or fd == "device_get":
+            findings.append(Finding(
+                model.path, node.lineno, "host-sync-device-get",
+                "jax.device_get serializes the dispatch pipeline"))
+        elif fd in _COERCIONS and len(node.args) == 1 \
+                and is_device_value(node.args[0]):
+            findings.append(Finding(
+                model.path, node.lineno, "host-sync-coerce",
+                f"{fd}() on a device value blocks until the pipeline "
+                f"drains — keep results on device or sync once at the "
+                f"API boundary"))
+        elif fd in _NP_NAMES and node.args \
+                and is_device_value(node.args[0]):
+            findings.append(Finding(
+                model.path, node.lineno, "host-sync-np",
+                f"{fd}() on a device value is an implicit device fetch"))
+    return findings
+
+
+# jnp calls that materialize an array (aliases like `U64 = jnp.uint64`
+# are references, not calls, and stay legal)
+_JNP_CTORS = frozenset({
+    "asarray", "array", "zeros", "ones", "empty", "full", "arange",
+    "stack", "concatenate", "broadcast_to", "frombuffer", "linspace",
+})
+
+
+def _check_module_level(model: ModuleModel) -> list[Finding]:
+    findings = []
+    stack = []
+    for node in model.tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        stack.append(node)
+    seen_lines = set()
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            continue                    # deferred execution — fine
+        if isinstance(node, ast.Call):
+            fd = _dotted(node.func)
+            if fd and "." in fd:
+                head, attr = fd.rsplit(".", 1)
+                if head in ("jnp", "jax.numpy") and attr in _JNP_CTORS \
+                        and node.lineno not in seen_lines:
+                    seen_lines.add(node.lineno)
+                    findings.append(Finding(
+                        model.path, node.lineno, "device-const-at-import",
+                        f"jnp.{attr}() at module scope materializes a "
+                        f"device array at import time — a first import "
+                        f"inside a jit trace binds it to a leaked "
+                        f"tracer; keep the constant numpy and jnp will "
+                        f"close over it at trace time"))
+        stack.extend(ast.iter_child_nodes(node))
+    return findings
+
+
+def check(model: ModuleModel) -> list[Finding]:
+    findings: list[Finding] = _check_module_level(model)
+    for fn in model.all_funcs:
+        aliases = model.factory_aliases(fn)
+        tainted = model.device_tainted(fn, aliases)
+        traced = model.traced_params.get(fn, set()) \
+            if fn in model.jit_bodies else set()
+        findings += _check_scope(model, fn, aliases, tainted, traced)
+    return findings

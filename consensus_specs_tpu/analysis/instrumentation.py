@@ -1,0 +1,156 @@
+"""Rule family 4 — instrumentation coverage of kernel entry points.
+
+PR 2's telemetry layer answers the ROADMAP's perf questions only while
+every kernel entry point reports into it; a new kernel that lands
+without a span or counter is invisible to the compile/run split, the
+padding-waste accounting and the routing counters.  This rule makes
+that a lint invariant on the two public kernel surfaces
+(`ops/bls_batch/__init__.py`, `ops/bls/__init__.py`):
+
+    every PUBLIC function (or public method of a public class) that
+    reaches a device dispatch — `_dispatch(...)`, a jit factory, a
+    jit-decorated local, or a covered bls_batch entry — must open a
+    `telemetry.span(...)` / `telemetry.count(...)` either directly or
+    via a same-surface function it calls.
+
+Coverage propagates along the local call graph (a facade function that
+delegates to `bls_batch.batch_verify` is covered by the span inside
+`batch_verify`), which is why the tree runner analyzes `ops/bls_batch`
+first and feeds its covered entry names into the facade's pass.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, ModuleModel, _dotted, scope_nodes
+
+# modules whose covered entries count as external coverage when called
+# as `bls_batch.X(...)` or via `from ..bls_batch import X`
+_DEVICE_PKG = "bls_batch"
+
+
+def _functions(model: ModuleModel):
+    """(qualname, node, public) for module-level functions and methods
+    of module-level classes."""
+    out = []
+    for node in model.tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.append((node.name, node, not node.name.startswith("_")))
+        elif isinstance(node, ast.ClassDef):
+            cls_public = not node.name.startswith("_")
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                    public = cls_public and not sub.name.startswith("_")
+                    out.append((f"{node.name}.{sub.name}", sub, public))
+    return out
+
+
+def _imported_device_names(model: ModuleModel) -> tuple[set[str], set[str]]:
+    """(names imported from the device package, module aliases of it)."""
+    names: set[str] = set()
+    aliases: set[str] = set()
+    for node in ast.walk(model.tree):
+        if isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if mod.split(".")[-1] == _DEVICE_PKG:
+                names |= {a.asname or a.name for a in node.names}
+            else:
+                aliases |= {a.asname or a.name for a in node.names
+                            if a.name == _DEVICE_PKG}
+        elif isinstance(node, ast.Import):
+            aliases |= {a.asname or a.name.split(".")[0]
+                        for a in node.names
+                        if a.name.split(".")[-1] == _DEVICE_PKG}
+    return names, aliases
+
+
+def check(model: ModuleModel, external_covered=frozenset(),
+          external_device=frozenset()):
+    """Returns (findings, covered_public_names, device_public_names)
+    so the tree runner can chain the bls_batch -> bls facade pair."""
+    funcs = _functions(model)
+    by_name: dict[str, list] = {}
+    for qual, node, _ in funcs:
+        by_name.setdefault(qual.split(".")[-1], []).append(node)
+    imported_dev, dev_aliases = _imported_device_names(model)
+
+    telemetry_direct: set = set()
+    reaches_device: set = set()
+    calls: dict = {n: set() for _, n, _ in funcs}
+
+    for qual, fn, _ in funcs:
+        aliases = model.factory_aliases(fn)
+        for node in scope_nodes(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            fd = _dotted(node.func)
+            if fd and fd.startswith("telemetry."):
+                telemetry_direct.add(fn)
+                continue
+            # device dispatch sites
+            if fd == "_dispatch":
+                reaches_device.add(fn)
+            elif isinstance(node.func, ast.Name):
+                name = node.func.id
+                if name in model.jit_factories or name in aliases:
+                    reaches_device.add(fn)
+                elif any(d in model.jit_decorated
+                         for d in model.func_index.get(name, [])):
+                    reaches_device.add(fn)
+                elif name in imported_dev and name in external_device:
+                    reaches_device.add(fn)
+                elif name in imported_dev and not external_device:
+                    # standalone run: imported device names count
+                    reaches_device.add(fn)
+                for callee in by_name.get(name, []):
+                    calls[fn].add(callee)
+            elif isinstance(node.func, ast.Attribute):
+                attr = node.func.attr
+                base = node.func.value
+                if (isinstance(base, ast.Name) and base.id in dev_aliases
+                        and (attr in external_device
+                             or not external_device)):
+                    reaches_device.add(fn)
+                if (isinstance(base, ast.Name) and base.id in dev_aliases
+                        and attr in external_covered):
+                    telemetry_direct.add(fn)
+                # method / local resolution by bare attribute name
+                for callee in by_name.get(attr, []):
+                    calls[fn].add(callee)
+            # calls to names imported from bls_batch that are covered
+            if (isinstance(node.func, ast.Name)
+                    and node.func.id in imported_dev
+                    and node.func.id in external_covered):
+                telemetry_direct.add(fn)
+
+    # propagate coverage and device reach over the local call graph
+    covered = set(telemetry_direct)
+    reach = set(reaches_device)
+    changed = True
+    while changed:
+        changed = False
+        for _, fn, _ in funcs:
+            if fn not in covered and calls[fn] & covered:
+                covered.add(fn)
+                changed = True
+            if fn not in reach and calls[fn] & reach:
+                reach.add(fn)
+                changed = True
+
+    findings = []
+    for qual, fn, public in funcs:
+        if public and fn in reach and fn not in covered:
+            findings.append(Finding(
+                model.path, fn.lineno, "instr-uncovered-entry",
+                f"public kernel entry point {qual}() dispatches to the "
+                f"device without opening a telemetry span/counter — "
+                f"new kernels must stay observable (see README "
+                f"Telemetry)"))
+
+    covered_public = {qual.split(".")[-1] for qual, fn, public in funcs
+                      if public and fn in covered}
+    device_public = {qual.split(".")[-1] for qual, fn, public in funcs
+                     if public and fn in reach}
+    return findings, covered_public, device_public

@@ -1,0 +1,154 @@
+"""Rule family 1 — recompile hazards.
+
+The 81s attestation compile+first (ROADMAP) is the cost model here:
+every distinct value of a jit compile key traces and compiles a fresh
+XLA executable.  The tree's defense is the `_bucket` 4-shape ladder —
+any batch dimension that reaches a kernel must be quantized through it.
+
+recompile-unbucketed-dim
+    A call into a jit factory (all of whose arguments are compile keys)
+    or into a jit-decorated function's *static* parameters, where the
+    argument is a raw dimension: a `len(...)`/`.shape` expression, or a
+    local name data-flow-derived from one, that was never routed
+    through a `BUCKET_FUNCS` call.
+
+recompile-traced-branch
+    Python `if`/`while`/`assert`/conditional-expression tests that
+    reference a traced value inside a jitted body (or, in kernel-role
+    modules, inside ANY function — those modules' functions are traced
+    via cross-module calls).  Metadata access (`x.shape`, `len(x)`,
+    `isinstance`) is static under trace and exempt, as are parameters
+    whose annotation/default marks them compile-time (`n: int`,
+    `axis_name: str | None`, `unroll=False`).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import (
+    BUCKET_FUNCS,
+    Finding,
+    ModuleModel,
+    ROLE_KERNEL,
+    _dotted,
+    nonstatic_refs,
+    param_names,
+    scope_nodes,
+    static_params,
+)
+
+
+def _check_unbucketed(model: ModuleModel, fn) -> list[Finding]:
+    findings = []
+    aliases = model.factory_aliases(fn)
+    tainted = model.raw_dim_tainted(fn)
+
+    def is_raw_dim(arg) -> bool:
+        """Mirrors `raw_dim_tainted`'s laundering rule: an inline
+        `_bucket(...)` wrapping (anywhere in the expression) makes the
+        value a ladder shape, not a raw dimension."""
+        found = False
+
+        def walk(node):
+            nonlocal found
+            if found:
+                return
+            if (isinstance(node, ast.Call)
+                    and _dotted(node.func) in BUCKET_FUNCS):
+                return                  # laundered subtree
+            if (isinstance(node, ast.Call)
+                    and _dotted(node.func) == "len"):
+                found = True
+            elif isinstance(node, ast.Attribute) and node.attr == "shape":
+                found = True
+            elif (isinstance(node, ast.Name)
+                    and isinstance(node.ctx, ast.Load)
+                    and node.id in tainted):
+                found = True
+            else:
+                for child in ast.iter_child_nodes(node):
+                    walk(child)
+
+        walk(arg)
+        return found
+
+    def flag(call, arg, callee: str, what: str):
+        findings.append(Finding(
+            model.path, call.lineno, "recompile-unbucketed-dim",
+            f"{what} of '{callee}' is a raw len()/shape-derived "
+            f"dimension not routed through the _bucket ladder — every "
+            f"distinct value compiles a new executable"))
+
+    for node in scope_nodes(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if not isinstance(f, ast.Name):
+            continue
+        if f.id in aliases:
+            # a jit factory: every argument keys the executable cache
+            for i, arg in enumerate(node.args):
+                if is_raw_dim(arg):
+                    flag(node, arg, f.id, f"argument {i}")
+            for kw in node.keywords:
+                if kw.arg and is_raw_dim(kw.value):
+                    flag(node, kw.value, f.id, f"argument '{kw.arg}'")
+            continue
+        # a jit-decorated local: only static params are compile keys
+        defs = [d for d in model.func_index.get(f.id, [])
+                if d in model.jit_decorated]
+        if not defs:
+            continue
+        target = defs[0]
+        statics = model.jit_decorated[target]
+        params = param_names(target)
+        for i, arg in enumerate(node.args):
+            if i < len(params) and params[i] in statics \
+                    and is_raw_dim(arg):
+                flag(node, arg, f.id, f"static argument '{params[i]}'")
+        for kw in node.keywords:
+            if kw.arg in statics and is_raw_dim(kw.value):
+                flag(node, kw.value, f.id, f"static argument '{kw.arg}'")
+    return findings
+
+
+def _check_traced_branch(model: ModuleModel, fn,
+                         traced: set[str]) -> list[Finding]:
+    findings = []
+    tests = []
+    for node in scope_nodes(fn):
+        if isinstance(node, (ast.If, ast.While)):
+            tests.append((node.test, node.lineno, "branch"))
+        elif isinstance(node, ast.IfExp):
+            tests.append((node.test, node.lineno, "conditional"))
+        elif isinstance(node, ast.Assert):
+            tests.append((node.test, node.lineno, "assert"))
+    for test, lineno, kind in tests:
+        refs = nonstatic_refs(test, traced)
+        if refs:
+            names = ", ".join(sorted({r.id for r in refs}))
+            findings.append(Finding(
+                model.path, lineno, "recompile-traced-branch",
+                f"Python {kind} on traced value(s) {names} inside a "
+                f"jitted body in {fn.name}() — concretizes at trace "
+                f"time (shape/dtype access is exempt; hoist the "
+                f"decision to the host or use lax.cond/jnp.where)"))
+    return findings
+
+
+def check(model: ModuleModel) -> list[Finding]:
+    findings: list[Finding] = []
+    for fn in model.all_funcs:
+        findings += _check_unbucketed(model, fn)
+
+    kernel_role = ROLE_KERNEL in model.roles
+    for fn in model.all_funcs:
+        if fn in model.jit_bodies:
+            traced = model.traced_params[fn]
+        elif kernel_role:
+            traced = set(param_names(fn)) - static_params(fn) - {"self"}
+        else:
+            continue
+        findings += _check_traced_branch(model, fn, traced)
+    return findings

@@ -3,14 +3,21 @@ mypy/pylint pass (`/root/reference/Makefile:183-189`), adapted to the
 flat exec'd-namespace architecture where import-based type checkers
 cannot resolve names.
 
-Two checks per fork x preset:
+Three checks per fork x preset:
 
 1. **Undefined names**: every `Name` load inside every spec function
    must resolve in the built namespace, builtins, or a local binding.
    This statically catches the NameError class of spec bug (a call to a
-   helper that no fork in the chain defines).
+   helper that no fork in the chain defines).  Lambdas get their own
+   scope: their parameters neither leak into the enclosing function's
+   bound set nor go unchecked inside the lambda body.
 2. **config-attribute discipline**: every `config.X` attribute access
    must exist in the loaded Configuration for that preset.
+3. **call arity**: every call from a LIVE spec function (one whose
+   definition survived fork overriding into the built namespace) to a
+   spec-defined helper must bind against the helper's signature in
+   that namespace — the fork-override drift the undefined-name check
+   cannot see (the name exists; its parameters changed).
 
 Plus one repo-wide check:
 
@@ -26,8 +33,10 @@ from __future__ import annotations
 
 import ast
 import builtins
+import inspect
 import re
 import sys
+import types
 
 from .models.builder import (
     BUILDABLE_FORKS,
@@ -103,8 +112,14 @@ class _LocalBindings(ast.NodeVisitor):
     visit_AsyncFunctionDef = visit_FunctionDef
 
     def visit_Lambda(self, node):
-        self.visit_arguments(node.args)
-        self.generic_visit(node)
+        # Lambdas are their OWN scope: binding their parameters here
+        # would leak them into the enclosing function's bound set and
+        # mask genuine undefined-name findings after the lambda (the
+        # body is checked separately by `_scope_findings`).  Only the
+        # default expressions evaluate in the enclosing scope.
+        for default in list(node.args.defaults) + [
+                d for d in node.args.kw_defaults if d is not None]:
+            self.visit(default)
 
     def visit_ClassDef(self, node):
         self.bound.add(node.name)
@@ -122,19 +137,35 @@ class _LocalBindings(ast.NodeVisitor):
     visit_Nonlocal = visit_Global
 
 
-def _function_findings(fn_node, known: set[str], config_keys: set[str],
-                       path: str):
-    locals_visitor = _LocalBindings()
-    locals_visitor.visit(fn_node)
-    bound = locals_visitor.bound | known
+def _split_lambdas(root):
+    """Walk `root` like ast.walk but stop at every Lambda subtree,
+    returning (nodes_in_this_scope, lambdas_found).  Callers recurse on
+    each lambda's body with its parameters bound (a body that is itself
+    a lambda lands in `lambdas` again, so chains nest correctly)."""
+    nodes, lambdas = [], []
+    stack = [root]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, ast.Lambda):
+            lambdas.append(node)
+            continue
+        nodes.append(node)
+        stack.extend(ast.iter_child_nodes(node))
+    return nodes, lambdas
 
+
+def _scope_findings(root, bound: set[str], config_keys: set[str],
+                    path: str, owner: str):
+    """Name/config findings for one scope; lambda subtrees recurse with
+    their parameters (and walrus bindings) added to the bound set."""
+    nodes, lambdas = _split_lambdas(root)
     findings = []
-    for node in ast.walk(fn_node):
+    for node in nodes:
         if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
             if node.id not in bound:
                 findings.append(
                     f"{path}:{node.lineno}: undefined name "
-                    f"'{node.id}' in {fn_node.name}()")
+                    f"'{node.id}' in {owner}()")
         elif (isinstance(node, ast.Attribute)
               and isinstance(node.value, ast.Name)
               and node.value.id == "config"
@@ -142,14 +173,101 @@ def _function_findings(fn_node, known: set[str], config_keys: set[str],
             if node.attr not in config_keys:
                 findings.append(
                     f"{path}:{node.lineno}: unknown config attribute "
-                    f"'config.{node.attr}' in {fn_node.name}()")
+                    f"'config.{node.attr}' in {owner}()")
+    for lam in lambdas:
+        lam_locals = _LocalBindings()
+        lam_locals.visit_arguments(lam.args)
+        lam_locals.visit(lam.body)           # walrus bindings in the body
+        findings.extend(_scope_findings(
+            lam.body, bound | lam_locals.bound, config_keys, path, owner))
+        # default expressions evaluate in the ENCLOSING scope
+        for default in list(lam.args.defaults) + [
+                d for d in lam.args.kw_defaults if d is not None]:
+            findings.extend(_scope_findings(
+                default, bound, config_keys, path, owner))
     return findings
+
+
+def _function_findings(fn_node, known: set[str], config_keys: set[str],
+                       path: str):
+    locals_visitor = _LocalBindings()
+    locals_visitor.visit(fn_node)
+    bound = locals_visitor.bound | known
+    return _scope_findings(fn_node, bound, config_keys, path,
+                           fn_node.name)
+
+
+def _call_arity_findings(fn_node, spec_funcs: dict, sig_cache: dict,
+                         path: str):
+    """Calls to spec-defined helpers must bind against the callee's
+    signature in the BUILT namespace (catches fork-override parameter
+    drift).  Skips *args/**kwargs call sites and locally shadowed
+    names; placeholder binding checks arity/keywords only."""
+    locals_visitor = _LocalBindings()
+    locals_visitor.visit(fn_node)
+    shadowed = locals_visitor.bound
+
+    findings = []
+    for node in ast.walk(fn_node):
+        if not isinstance(node, ast.Call) \
+                or not isinstance(node.func, ast.Name):
+            continue
+        name = node.func.id
+        if name in shadowed or name not in spec_funcs:
+            continue
+        if any(isinstance(a, ast.Starred) for a in node.args) \
+                or any(kw.arg is None for kw in node.keywords):
+            continue
+        if name not in sig_cache:
+            try:
+                sig_cache[name] = inspect.signature(spec_funcs[name])
+            except (TypeError, ValueError):
+                sig_cache[name] = None
+        sig = sig_cache[name]
+        if sig is None:
+            continue
+        try:
+            sig.bind(*([None] * len(node.args)),
+                     **{kw.arg: None for kw in node.keywords})
+        except TypeError as exc:
+            findings.append(
+                f"{path}:{node.lineno}: call to {name}() in "
+                f"{fn_node.name}() does not match the spec signature "
+                f"{sig}: {exc}")
+    return findings
+
+
+def _is_live_def(node: ast.FunctionDef, path, spec) -> bool:
+    """Did this source definition survive fork overriding into the
+    built namespace?  Superseded bodies never run, so arity-checking
+    them against the final namespace would be noise.
+
+    The namespace entry may be the builder's LRU cache wrapper
+    (`_install_caches` rewraps get_beacon_committee & co.) — unwrap
+    through `__wrapped__` before comparing code locations, else those
+    helpers' own bodies would silently escape the arity check.  A
+    decorated def's co_firstlineno is its first decorator line, so any
+    of those lines counts as a match."""
+    obj = spec._namespace.get(node.name)
+    if obj is None:
+        return False
+    try:
+        obj = inspect.unwrap(obj)
+    except ValueError:          # wrapper cycle — never ours
+        return False
+    code = getattr(obj, "__code__", None)
+    def_lines = {node.lineno} | {d.lineno for d in node.decorator_list}
+    return (code is not None and code.co_filename == str(path)
+            and code.co_firstlineno in def_lines)
 
 
 def lint_spec(fork: str, preset: str) -> list[str]:
     spec = build_spec(fork, preset)
     known = set(spec._namespace) | set(vars(builtins))
     config_keys = set(spec.config.to_dict())
+    spec_funcs = {name: obj for name, obj in spec._namespace.items()
+                  if isinstance(obj, types.FunctionType)}
+    sig_cache: dict = {}
 
     findings = []
     for chain_fork in fork_chain(fork):
@@ -158,16 +276,23 @@ def lint_spec(fork: str, preset: str) -> list[str]:
             tree = ast.parse(path.read_text())
             rel = str(path.relative_to(PKG_ROOT.parent))
             # top-level functions and methods only: nested defs are
-            # checked inside their parent's scope walk
-            tops = list(tree.body)
+            # checked inside their parent's scope walk.  Call arity is
+            # checked for LIVE top-level defs only (methods are called
+            # through instances, not the flat namespace).
             for node in tree.body:
                 if isinstance(node, ast.ClassDef):
-                    tops.extend(node.body)
-            for node in tops:
-                if isinstance(node, (ast.FunctionDef,
-                                     ast.AsyncFunctionDef)):
+                    for sub in node.body:
+                        if isinstance(sub, (ast.FunctionDef,
+                                            ast.AsyncFunctionDef)):
+                            findings.extend(_function_findings(
+                                sub, known, config_keys, rel))
+                elif isinstance(node, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
                     findings.extend(_function_findings(
                         node, known, config_keys, rel))
+                    if _is_live_def(node, path, spec):
+                        findings.extend(_call_arity_findings(
+                            node, spec_funcs, sig_cache, rel))
     return findings
 
 
@@ -220,23 +345,30 @@ def lint_env_knobs() -> list[str]:
 def main(argv=None) -> int:
     presets = ("minimal", "mainnet")
     total = 0
+    # ONE dedup set for every finding source: overlapping fork chains
+    # re-surface the same spec findings, and repeated runs of the env
+    # pass must not double-print either (they used to bypass `seen`)
     seen: set[str] = set()
+
+    def emit(finding: str) -> None:
+        nonlocal total
+        if finding not in seen:
+            seen.add(finding)
+            print(finding)
+            total += 1
+
     for fork in BUILDABLE_FORKS:
         for preset in presets:
             for finding in lint_spec(fork, preset):
-                if finding not in seen:
-                    seen.add(finding)
-                    print(finding)
-                    total += 1
+                emit(finding)
     for finding in lint_env_knobs():
-        print(finding)
-        total += 1
+        emit(finding)
     if total:
         print(f"spec lint: {total} finding(s)", file=sys.stderr)
         return 1
     print(f"spec lint: {len(BUILDABLE_FORKS) * len(presets)} "
-          "spec builds clean (undefined-name + config-attribute checks); "
-          "env-knob table in sync")
+          "spec builds clean (undefined-name + config-attribute + "
+          "call-arity checks); env-knob table in sync")
     return 0
 
 

@@ -196,6 +196,8 @@ def pairing_check_device(pairs) -> bool:
         out = _dispatch(f"pairing_check@{B}", _pairing_check_precomp_fn(B),
                         (jnp.asarray(xp), jnp.asarray(yp),
                          jnp.asarray(lines), jnp.asarray(mask)))
+    # cst: allow(host-sync-coerce): single accept/reject bool fetched at
+    # the API boundary — callers need a host answer
     return bool(out)
 
 
@@ -392,6 +394,8 @@ def g1_multi_exp_device(points, scalars):
             out = _dispatch(f"msm_double_add@{B}", _msm_kernel(B),
                             (jnp.asarray(x), jnp.asarray(y),
                              jnp.asarray(bits), jnp.asarray(mask)))
+    # cst: allow(host-sync-np): the MSM result leaves the device once
+    # per call, converted back to the oracle point representation
     return cj.g1_limbs_to_oracle(tuple(np.asarray(co) for co in out))
 
 
@@ -479,7 +483,10 @@ def batch_verify(tasks, rng=None, device_h2c: bool | None = None) -> bool:
             # fallback — no statements reached the batched kernel
             return bool(n)
         jnp = _jnp()
-        B = arrays[0].shape[0]
+        # lanes=None above means _prepare_rlc_inputs padded to the
+        # ladder shape for n live lanes — recompute it rather than
+        # reading arrays[0].shape (a raw dim the analyzer would flag)
+        B = _bucket(n)
         # h2c routing counted per LIVE lane, after prepare: the
         # degenerate paths above hash on the host (or not at all)
         telemetry.count("bls.h2c.device" if device_h2c else "bls.h2c.host",
@@ -489,6 +496,8 @@ def batch_verify(tasks, rng=None, device_h2c: bool | None = None) -> bool:
         name = f"rlc_{'h2c' if device_h2c else 'host_hash'}@{B}"
         out = _dispatch(name, kernel(B),
                         tuple(jnp.asarray(a) for a in arrays))
+    # cst: allow(host-sync-coerce): single accept/reject bool fetched at
+    # the API boundary — callers need a host answer
     return bool(out)
 
 
@@ -576,6 +585,14 @@ def batch_verify_sharded(tasks, n_devices: int | None = None,
     if arrays is None:
         return bool(n)
     jnp = _jnp()
-    out = _rlc_kernel_sharded(n_devices, per_shard, axis)(
-        *(jnp.asarray(a) for a in arrays))
+    with telemetry.span("bls.batch_verify_sharded", tasks=n_tasks,
+                        devices=n_devices, per_shard=per_shard):
+        telemetry.count("bls.batch_verify_sharded.calls")
+        _count_lanes(n, n_devices * per_shard)
+        # cst: allow(recompile-unbucketed-dim): the device count keys
+        # the executable — one value per host topology, not per batch
+        out = _rlc_kernel_sharded(n_devices, per_shard, axis)(
+            *(jnp.asarray(a) for a in arrays))
+    # cst: allow(host-sync-coerce): single accept/reject bool fetched at
+    # the API boundary — callers need a host answer
     return bool(out)

@@ -72,7 +72,8 @@ F2 = FieldOps(
 
 def pt_infinity(F: FieldOps, like):
     jnp = _jnp()
-    one = jnp.broadcast_to(jnp.asarray(F.one), like[0].shape).astype(jnp.int32)
+    one = jnp.broadcast_to(jnp.asarray(F.one, dtype=jnp.int32),
+                           like[0].shape)
     zero = jnp.zeros_like(like[0])
     return (one, one, zero)
 
@@ -180,7 +181,7 @@ def pt_scalar_mul_const(F: FieldOps, p, bits_np):
         return acc, None
 
     acc0 = pt_infinity(F, p)
-    acc, _ = jax.lax.scan(step, acc0, jnp.asarray(bits_np))
+    acc, _ = jax.lax.scan(step, acc0, jnp.asarray(bits_np, dtype=jnp.int32))
     return acc
 
 
@@ -206,11 +207,11 @@ def pt_msm_pippenger(F: FieldOps, p, digits, c: int):
     nb = 1 << c
     elem = p[0].shape[1:]
 
-    one = jnp.broadcast_to(jnp.asarray(F.one),
-                           (W, nb) + elem).astype(jnp.int32)
+    one = jnp.broadcast_to(jnp.asarray(F.one, dtype=jnp.int32),
+                           (W, nb) + elem)
     zero = jnp.zeros((W, nb) + elem, jnp.int32)
     buckets = (one, one, zero)          # grid of infinities
-    widx = jnp.arange(W)
+    widx = jnp.arange(W, dtype=jnp.int32)
 
     def scatter_step(bk, xs):
         px, py, pz, d = xs

@@ -126,7 +126,7 @@ def fq_mul(a, b):
     import jax
     jnp = _jnp()
 
-    p = jnp.asarray(P_LIMBS)
+    p = jnp.asarray(P_LIMBS, dtype=jnp.int32)
     a_steps = jnp.moveaxis(a, -1, 0)          # (33, ...) scan over a's limbs
 
     def step(t, a_i):
@@ -157,8 +157,8 @@ def fq_canon(x):
     jnp = _jnp()
 
     # collapse magnitude to (-2p, 2p), then shift positive into (0, 4p)
-    x = fq_mul(x, jnp.asarray(ONE_MONT))
-    x = fq_carry(x + jnp.asarray(TWO_P_LIMBS), passes=2)
+    x = fq_mul(x, jnp.asarray(ONE_MONT, dtype=jnp.int32))
+    x = fq_carry(x + jnp.asarray(TWO_P_LIMBS, dtype=jnp.int32), passes=2)
 
     # exact sequential carry (value in (0, 4p) ⊂ [0, 2**396))
     def carry_step(c, xi):
@@ -171,7 +171,7 @@ def fq_canon(x):
     x = jnp.moveaxis(limbs, 0, -1)
 
     # conditional subtract p three times (value < 4p)
-    p = jnp.asarray(P_LIMBS)
+    p = jnp.asarray(P_LIMBS, dtype=jnp.int32)
     for _ in range(3):
         d = x - p
 
@@ -209,8 +209,8 @@ def fq_pow_const(a, bits):
         acc_mul = fq_mul(acc, a)
         return jnp.where(bit, acc_mul, acc), None
 
-    one = jnp.broadcast_to(jnp.asarray(ONE_MONT), a.shape).astype(jnp.int32)
-    acc, _ = jax.lax.scan(step, one, jnp.asarray(bits))
+    one = jnp.broadcast_to(jnp.asarray(ONE_MONT, dtype=jnp.int32), a.shape)
+    acc, _ = jax.lax.scan(step, one, jnp.asarray(bits, dtype=jnp.int32))
     return acc
 
 

@@ -116,7 +116,8 @@ def _sha_blocks(blocks):
     """SHA-256 over a fixed block sequence (each (..., 16) words)."""
     from .. import sha256_jax as sha
     jnp = _jnp()
-    state = jnp.broadcast_to(sha._IVj, blocks[0].shape[:-1] + (8,))
+    state = jnp.broadcast_to(jnp.asarray(sha._IV_np, dtype=jnp.uint32),
+                             blocks[0].shape[:-1] + (8,))
     for blk in blocks:
         state = sha._compress(state, blk)
     return state
@@ -130,7 +131,8 @@ def expand_message_xmd_dev(msg_words):
     B = msg_words.shape[0]
 
     def bc(w):
-        return jnp.broadcast_to(jnp.asarray(w), (B,) + w.shape)
+        return jnp.broadcast_to(jnp.asarray(w, dtype=jnp.uint32),
+                                (B,) + w.shape)
 
     blocks = [bc(_B0_TPL[0]),
               jnp.concatenate([msg_words, bc(_B0_TPL[1][8:])], axis=-1)]
@@ -173,8 +175,9 @@ def _words512_to_fq_mont(chunk):
     hi33 = jnp.concatenate(
         [hi, jnp.zeros(hi.shape[:-1] + (2 * n - x.shape[-1],), jnp.int32)],
         axis=-1)
-    return _fq.fq_add(_fq.fq_mul(lo33, jnp.asarray(_C_LO)),
-                      _fq.fq_mul(hi33, jnp.asarray(_C_HI)))
+    return _fq.fq_add(
+        _fq.fq_mul(lo33, jnp.asarray(_C_LO, dtype=jnp.int32)),
+        _fq.fq_mul(hi33, jnp.asarray(_C_HI, dtype=jnp.int32)))
 
 
 def hash_to_field_fq2_dev(msg_words):
@@ -206,7 +209,7 @@ def fq2_sqrt_dev(a):
     n, rx, rnx = ph1[0], ph1[1], ph1[2]
 
     # phase 2: c± = sqrt((x ± n)/2) candidates, one stacked scan
-    inv2 = jnp.asarray(_INV2_MONT)
+    inv2 = jnp.asarray(_INV2_MONT, dtype=jnp.int32)
     ts = jnp.stack([_fq.fq_mul(_fq.fq_add(x, n), inv2),
                     _fq.fq_mul(_fq.fq_sub(x, n), inv2)])
     cs = _fq.fq_pow_const(ts, _P14_BITS)
@@ -238,7 +241,8 @@ def sgn0_fq2_dev(a):
     take lexicographic parity."""
     jnp = _jnp()
     stacked = jnp.stack([a[..., 0, :], a[..., 1, :]])
-    plain = _fq.fq_canon(_fq.fq_mul(stacked, jnp.asarray(_fq.ONE_PLAIN)))
+    plain = _fq.fq_canon(_fq.fq_mul(
+        stacked, jnp.asarray(_fq.ONE_PLAIN, dtype=jnp.int32)))
     s0 = (plain[0][..., 0] & 1) == 1
     z0 = jnp.all(plain[0] == 0, axis=-1)
     s1 = (plain[1][..., 0] & 1) == 1
@@ -250,7 +254,8 @@ def sgn0_fq2_dev(a):
 
 def _bc2(const, like):
     jnp = _jnp()
-    return jnp.broadcast_to(jnp.asarray(const), like.shape).astype(jnp.int32)
+    return jnp.broadcast_to(jnp.asarray(const, dtype=jnp.int32),
+                            like.shape)
 
 
 def svdw_map_g2_dev(u):
@@ -296,7 +301,7 @@ def hash_to_g2_dev(msg_words):
     B = msg_words.shape[0]
     u0, u1 = hash_to_field_fq2_dev(msg_words)
     mx, my = svdw_map_g2_dev(jnp.concatenate([u0, u1], axis=0))
-    one2 = jnp.broadcast_to(jnp.asarray(tw.FQ2_ONE_L),
-                            (B, 2, _fq.N_LIMBS)).astype(jnp.int32)
+    one2 = jnp.broadcast_to(jnp.asarray(tw.FQ2_ONE_L, dtype=jnp.int32),
+                            (B, 2, _fq.N_LIMBS))
     q = cj.pt_add(cj.F2, (mx[:B], my[:B], one2), (mx[B:], my[B:], one2))
     return cj.pt_scalar_mul_const(cj.F2, q, _H2_BITS)

@@ -90,9 +90,9 @@ def _line_to_fq12(c0, cx_xp, cy_yp):
     slots = [None] * 6
     slots[0] = c0
     slots[_JX] = tw.fq2_mul(cx_xp, jnp.broadcast_to(
-        jnp.asarray(_SX_L), cx_xp.shape).astype(jnp.int32))
+        jnp.asarray(_SX_L, dtype=jnp.int32), cx_xp.shape))
     slots[_JY] = tw.fq2_mul(cy_yp, jnp.broadcast_to(
-        jnp.asarray(_SY_L), cy_yp.shape).astype(jnp.int32))
+        jnp.asarray(_SY_L, dtype=jnp.int32), cy_yp.shape))
     zero = jnp.zeros_like(c0)
     slots = [zero if s is None else s for s in slots]
     return tw._from_w_coeffs(slots)
@@ -124,8 +124,9 @@ def _add_step(T, xq, yq, xp, yp):
     c0 = tw.fq2_sub(tw.fq2_mul(I, xq), tw.fq2_mul(ZH, yq))
     line = _line_to_fq12(c0, tw.fq2_mul_fq(cx, xp), tw.fq2_mul_fq(cy, yp))
     jnp = _jnp()
-    one = jnp.broadcast_to(jnp.asarray(tw.FQ2_ONE_L), xq.shape)
-    Tn = cj.pt_add(cj.F2, T, (xq, yq, one.astype(jnp.int32)))
+    one = jnp.broadcast_to(jnp.asarray(tw.FQ2_ONE_L, dtype=jnp.int32),
+                           xq.shape)
+    Tn = cj.pt_add(cj.F2, T, (xq, yq, one))
     return Tn, line
 
 
@@ -143,12 +144,12 @@ def miller_product_batch(xp, yp, xq, yq, mask):
     jnp = _jnp()
 
     B = xp.shape[0]
-    one2 = jnp.broadcast_to(jnp.asarray(tw.FQ2_ONE_L),
-                            xq.shape).astype(jnp.int32)
+    one2 = jnp.broadcast_to(jnp.asarray(tw.FQ2_ONE_L, dtype=jnp.int32),
+                            xq.shape)
     T0 = (xq, yq, one2)
-    f0 = jnp.asarray(tw.FQ12_ONE_L).astype(jnp.int32)
-    one_b = jnp.broadcast_to(jnp.asarray(tw.FQ12_ONE_L),
-                             (B,) + tw.FQ12_ONE_L.shape).astype(jnp.int32)
+    f0 = jnp.asarray(tw.FQ12_ONE_L, dtype=jnp.int32)
+    one_b = jnp.broadcast_to(jnp.asarray(tw.FQ12_ONE_L, dtype=jnp.int32),
+                             (B,) + tw.FQ12_ONE_L.shape)
     mask_e = mask[:, None, None, None, None]
 
     def step(carry, bit):
@@ -167,7 +168,8 @@ def miller_product_batch(xp, yp, xq, yq, mask):
         f, T = jax.lax.cond(bit == 1, with_add, lambda op: op, (f, T))
         return (f, T), None
 
-    (f, _), _ = jax.lax.scan(step, (f0, T0), jnp.asarray(_X_BITS))
+    (f, _), _ = jax.lax.scan(step, (f0, T0),
+                             jnp.asarray(_X_BITS, dtype=jnp.int32))
     return tw.fq12_conj(f)       # negative BLS parameter
 
 
@@ -240,9 +242,9 @@ def miller_product_precomp(xp, yp, lines, mask):
     jnp = _jnp()
 
     B = xp.shape[0]
-    f0 = jnp.asarray(tw.FQ12_ONE_L).astype(jnp.int32)
-    one_b = jnp.broadcast_to(jnp.asarray(tw.FQ12_ONE_L),
-                             (B,) + tw.FQ12_ONE_L.shape).astype(jnp.int32)
+    f0 = jnp.asarray(tw.FQ12_ONE_L, dtype=jnp.int32)
+    one_b = jnp.broadcast_to(jnp.asarray(tw.FQ12_ONE_L, dtype=jnp.int32),
+                             (B,) + tw.FQ12_ONE_L.shape)
     mask_e = mask[:, None, None, None, None]
 
     def _line(c0, cx, cy):
@@ -263,7 +265,8 @@ def miller_product_precomp(xp, yp, lines, mask):
         f = jax.lax.cond(bit == 1, with_add, lambda f_: f_, f)
         return f, None
 
-    f, _ = jax.lax.scan(step, f0, (jnp.asarray(_X_BITS), lines))
+    f, _ = jax.lax.scan(step, f0,
+                        (jnp.asarray(_X_BITS, dtype=jnp.int32), lines))
     return tw.fq12_conj(f)
 
 
@@ -284,9 +287,10 @@ def fq12_pow_x_abs(g):
                            lambda a: a, acc)
         return acc, None
 
-    one = jnp.broadcast_to(jnp.asarray(tw.FQ12_ONE_L),
-                           g.shape).astype(jnp.int32)
-    acc, _ = jax.lax.scan(step, one, jnp.asarray(_X_BITS_FULL))
+    one = jnp.broadcast_to(jnp.asarray(tw.FQ12_ONE_L, dtype=jnp.int32),
+                           g.shape)
+    acc, _ = jax.lax.scan(step, one,
+                          jnp.asarray(_X_BITS_FULL, dtype=jnp.int32))
     return acc
 
 

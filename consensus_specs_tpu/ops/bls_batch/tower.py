@@ -271,7 +271,7 @@ def fq12_eq(a, b):
 
 def fq12_is_one(a):
     jnp = _jnp()
-    one = jnp.asarray(FQ12_ONE_L)
+    one = jnp.asarray(FQ12_ONE_L, dtype=jnp.int32)
     return fq12_eq(a, jnp.broadcast_to(one, a.shape))
 
 
@@ -298,7 +298,8 @@ def fq12_frobenius(a, power: int = 1):
         coeffs = _w_coeffs(a)
         stacked = jnp.stack([fq2_conj(c) for c in coeffs])
         gammas = jnp.stack(
-            [jnp.broadcast_to(jnp.asarray(_GAMMA_L[i]), coeffs[i].shape)
+            [jnp.broadcast_to(jnp.asarray(_GAMMA_L[i], dtype=jnp.int32),
+                              coeffs[i].shape)
              for i in range(6)])
         mapped = fq2_mul(stacked, gammas)
         a = _from_w_coeffs([mapped[i] for i in range(6)])

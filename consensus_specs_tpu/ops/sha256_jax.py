@@ -26,9 +26,18 @@ from jax import lax
 from .sha256_np import _IV, _K, _PAD64, ZERO_HASH_WORDS
 from .sha256_np import sha256_64B_words as _host_sha256_64B
 
-_Kj = jnp.asarray(np.asarray(_K))
-_IVj = jnp.asarray(np.asarray(_IV))
-_PADj = jnp.asarray(np.asarray(_PAD64))
+# Device constants stay PLAIN NUMPY at module level (the `fq.py`
+# convention): materializing jnp arrays at import time leaks tracers
+# when the first import of this module happens inside an active jit
+# trace — `h2c_jax._sha_blocks` imports us lazily from traced code, so
+# an import-time `jnp.asarray` there would bind these names to that
+# trace's tracers and crash every later host-side use (found live by a
+# batch_verify-then-merkleize drive; the analyzer's
+# device-const-at-import rule now pins this).  jnp closes over numpy
+# constants at trace time instead.
+_K_np = np.asarray(_K)
+_IV_np = np.asarray(_IV)
+_PAD_np = np.asarray(_PAD64)
 
 
 def _rotr(x, n):
@@ -55,10 +64,11 @@ def _schedule_next(w):
 
 def _compress_loop(state, block):
     """Compression as a lax.fori_loop over 64 rounds (small HLO)."""
+    Kj = jnp.asarray(_K_np, dtype=jnp.uint32)   # t is traced: need jnp
 
     def body(t, carry):
         regs, w = carry
-        regs = _round(*regs, _Kj[t], w[..., 0])
+        regs = _round(*regs, Kj[t], w[..., 0])
         w = _schedule_next(w)
         return regs, w
 
@@ -76,7 +86,7 @@ def _compress_unrolled(state, block):
         w.append(w[t - 16] + s0 + w[t - 7] + s1)
     regs = tuple(state[..., i] for i in range(8))
     for t in range(64):
-        regs = _round(*regs, _Kj[t], w[t])
+        regs = _round(*regs, jnp.uint32(_K_np[t]), w[t])
     return state + jnp.stack(regs, axis=-1)
 
 
@@ -86,9 +96,14 @@ def _compress(state, block, unroll=False):
 
 def sha256_64B_words(blocks, unroll=False):
     """SHA-256 of (..., 16)-word 64-byte messages -> (..., 8)-word digests."""
-    state = jnp.broadcast_to(_IVj, blocks.shape[:-1] + (8,))
+    state = jnp.broadcast_to(jnp.asarray(_IV_np, dtype=jnp.uint32),
+                             blocks.shape[:-1] + (8,))
     state = _compress(state, blocks, unroll)
-    state = _compress(state, jnp.broadcast_to(_PADj, blocks.shape[:-1] + (16,)), unroll)
+    state = _compress(state,
+                      jnp.broadcast_to(jnp.asarray(_PAD_np,
+                                                   dtype=jnp.uint32),
+                                       blocks.shape[:-1] + (16,)),
+                      unroll)
     return state
 
 
@@ -127,6 +142,11 @@ def merkleize_words_jax(words: np.ndarray, limit_depth: int,
     d = max(n - 1, 0).bit_length()
     padded = np.zeros((1 << d, 8), dtype=np.uint32)
     padded[:n] = words
+    # cst: allow(recompile-unbucketed-dim): the static tree depth keys
+    # the executable — log-bounded (<= limit_depth distinct compiles),
+    # and each depth's program is a small rolled loop
+    # cst: allow(host-sync-np): single root fetch — this is the host
+    # API boundary of the device reduction
     root = np.asarray(merkle_root_pow2(jnp.asarray(padded), d, unroll))
     for lvl in range(d, limit_depth):
         blk = np.concatenate([root, ZERO_HASH_WORDS[lvl]]).astype(np.uint32)

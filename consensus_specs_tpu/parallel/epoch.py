@@ -108,8 +108,12 @@ def _isqrt_u64(n):
     return x
 
 
-def _total(x, axis_name):
-    """Global sum of a (N,) shard — psum across the mesh axis if sharded."""
+def _total(x, axis_name: str | None):
+    """Global sum of a (N,) shard — psum across the mesh axis if sharded.
+
+    `axis_name` is annotated static: the branch below is a host-side
+    sharding decision, not data-dependent control flow (the analyzer's
+    recompile-traced-branch rule keys off the annotation)."""
     s = jnp.sum(x)
     if axis_name is not None:
         s = lax.psum(s, axis_name)

@@ -30,7 +30,10 @@ from ..ops.sha256_np import ZERO_HASH_WORDS
 
 # uint64 packing needs x64; entry points enable it (see parallel.require_x64)
 
-_ZEROS = jnp.asarray(np.stack(ZERO_HASH_WORDS[:64]))  # (64, 8) uint32
+# plain numpy at module level (jnp closes over it at trace time):
+# import-time jnp arrays leak tracers if this module's first import
+# happens inside a jit trace — the device-const-at-import rule
+_ZEROS = np.stack(ZERO_HASH_WORDS[:64])  # (64, 8) uint32
 
 
 def _bswap32(x):

@@ -1,0 +1,497 @@
+"""The device-path static analyzer (`consensus_specs_tpu/analysis/`):
+every rule family fires on a known-bad snippet at the exact line,
+stays quiet on clean code, round-trips suppressions, and reports zero
+unsuppressed findings on the real tree (which `make lint` and CI
+enforce).  Pure AST — no jax, no spec builds."""
+
+import textwrap
+
+import pytest
+
+from consensus_specs_tpu.analysis import (
+    RULE_IDS,
+    analyze_source,
+    analyze_tree,
+    main,
+)
+
+
+def run(src, **kw):
+    return analyze_source(textwrap.dedent(src), "snippet.py", **kw)
+
+
+def rules_at(report):
+    return [(f.rule, f.line) for f in report.unsuppressed]
+
+
+# --- family 1: recompile hazards ---------------------------------------------
+
+
+def test_unbucketed_len_into_jit_factory_fires():
+    report = run("""\
+        import jax
+
+        def _kern(batch):
+            def body(x):
+                return x
+            return jax.jit(body)
+
+        def entry(xs):
+            return _kern(len(xs))(xs)
+        """)
+    assert ("recompile-unbucketed-dim", 9) in rules_at(report)
+
+
+def test_unbucketed_shape_derived_name_fires():
+    report = run("""\
+        import jax
+
+        def _kern(batch):
+            def body(x):
+                return x
+            return jax.jit(body)
+
+        def entry(xs):
+            n = xs.shape[0]
+            return _kern(n)(xs)
+        """)
+    assert ("recompile-unbucketed-dim", 10) in rules_at(report)
+
+
+def test_bucketed_dim_is_clean():
+    report = run("""\
+        import jax
+
+        def _bucket(n):
+            return max(8, n)
+
+        def _kern(batch):
+            def body(x):
+                return x
+            return jax.jit(body)
+
+        def _entry(xs):
+            B = _bucket(len(xs))
+            return _kern(B)(xs)
+        """)
+    assert rules_at(report) == []
+
+
+def test_rebinding_through_bucket_untaints():
+    # regression: kill must apply in SOURCE order — rebinding the same
+    # name through _bucket launders it (the documented fix recipe)
+    report = run("""\
+        import jax
+
+        def _bucket(n):
+            return max(8, n)
+
+        def _kern(batch):
+            def body(x):
+                return x
+            return jax.jit(body)
+
+        def _entry(xs):
+            n = xs.shape[0]
+            n = _bucket(n)
+            return _kern(n)(xs)
+        """)
+    assert rules_at(report) == []
+
+
+def test_inline_bucket_call_is_clean():
+    report = run("""\
+        import jax
+
+        def _bucket(n):
+            return max(8, n)
+
+        def _kern(batch):
+            def body(x):
+                return x
+            return jax.jit(body)
+
+        def _entry(xs):
+            return _kern(_bucket(len(xs)))(xs)
+        """)
+    assert rules_at(report) == []
+
+
+def test_static_arg_of_jitted_fn_fires():
+    report = run("""\
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("depth",))
+        def reduce(words, depth):
+            return words
+
+        def entry(words):
+            d = words.shape[0]
+            return reduce(words, d)
+        """)
+    assert ("recompile-unbucketed-dim", 10) in rules_at(report)
+
+
+def test_traced_branch_in_jit_body_fires():
+    report = run("""\
+        import jax
+
+        @jax.jit
+        def f(x, n: int):
+            if x:
+                return x
+            return x
+        """)
+    assert ("recompile-traced-branch", 5) in rules_at(report)
+
+
+def test_shape_access_and_static_params_are_clean():
+    report = run("""\
+        import jax
+
+        @jax.jit
+        def f(x, n: int, unroll=False):
+            assert x.shape[0] == n
+            if unroll:
+                return x
+            return x
+        """)
+    assert rules_at(report) == []
+
+
+# --- family 2: host-sync points ----------------------------------------------
+
+
+def test_item_fires():
+    report = run("""\
+        def g(x):
+            return x.item()
+        """)
+    assert rules_at(report) == [("host-sync-item", 2)]
+
+
+def test_device_get_fires():
+    report = run("""\
+        import jax
+
+        def g(x):
+            return jax.device_get(x)
+        """)
+    assert ("host-sync-device-get", 4) in rules_at(report)
+
+
+def test_coercion_of_dispatched_value_fires():
+    report = run("""\
+        import jax
+
+        def _kern(b):
+            def body(x):
+                return x
+            return jax.jit(body)
+
+        def entry(xs, b):
+            out = _kern(b)(xs)
+            return bool(out)
+        """)
+    assert ("host-sync-coerce", 10) in rules_at(report)
+
+
+def test_np_asarray_of_dispatched_value_fires():
+    report = run("""\
+        import jax
+        import numpy as np
+
+        def _kern(b):
+            def body(x):
+                return x
+            return jax.jit(body)
+
+        def entry(xs, b):
+            out = _kern(b)(xs)
+            return np.asarray(out)
+        """)
+    assert ("host-sync-np", 11) in rules_at(report)
+
+
+def test_device_const_at_import_fires():
+    # the live bug class: sha256_jax's import-time jnp constants became
+    # leaked tracers when h2c_jax first imported it inside a jit trace
+    report = run("""\
+        import jax.numpy as jnp
+        import numpy as np
+
+        _IVj = jnp.asarray(np.arange(8))
+        """)
+    assert ("device-const-at-import", 4) in rules_at(report)
+
+
+def test_numpy_module_constants_are_clean():
+    report = run("""\
+        import numpy as np
+
+        _IV_np = np.arange(8)
+
+        def f(x):
+            import jax.numpy as jnp
+            return x + jnp.asarray(_IV_np, dtype=jnp.int32)
+        """)
+    assert rules_at(report) == []
+
+
+def test_jnp_inside_function_not_flagged_as_import_const():
+    report = run("""\
+        def f():
+            import jax.numpy as jnp
+            return jnp.zeros((4,), jnp.int32)
+        """)
+    assert rules_at(report) == []
+
+
+def test_host_coercions_of_host_values_are_clean():
+    # the pure-Python oracle pattern: int()/bool() on host data
+    report = run("""\
+        def host(points, scalars):
+            ks = [int(s) % 7 for s in scalars]
+            return bool(ks) and len(points)
+        """)
+    assert rules_at(report) == []
+
+
+# --- family 3: dtype discipline ----------------------------------------------
+
+
+def test_big_int_literal_fires():
+    report = run("""\
+        def f(x):
+            import jax.numpy as jnp
+            return x * 68719476736
+        """)
+    assert ("dtype-int-literal", 3) in rules_at(report)
+
+
+def test_module_level_float_fires():
+    # module-level floats are trace-time constants too
+    report = run("""\
+        import jax.numpy as jnp
+
+        THRESH = 1.5
+        """)
+    assert ("dtype-float", 3) in rules_at(report)
+
+
+def test_float_literal_fires():
+    report = run("""\
+        def f(x):
+            import jax.numpy as jnp
+            return x * 1.5
+        """)
+    assert ("dtype-float", 3) in rules_at(report)
+
+
+def test_float_dtype_reference_fires():
+    report = run("""\
+        def f(x):
+            import jax.numpy as jnp
+            return x.astype(jnp.float32)
+        """)
+    assert ("dtype-float", 3) in rules_at(report)
+
+
+def test_implicit_cast_fires():
+    report = run("""\
+        def f(a):
+            import jax.numpy as jnp
+            return jnp.asarray(a)
+        """)
+    assert ("dtype-implicit-cast", 3) in rules_at(report)
+
+
+def test_explicit_dtypes_are_clean():
+    report = run("""\
+        def f(a):
+            import jax.numpy as jnp
+            x = jnp.asarray(a, dtype=jnp.int32)
+            y = jnp.zeros((4,), jnp.int32)
+            return x + y * 4095
+        """)
+    assert rules_at(report) == []
+
+
+# --- family 4: instrumentation coverage --------------------------------------
+
+
+def test_uncovered_entry_point_fires():
+    report = run("""\
+        def _dispatch(kernel, fn, args):
+            return fn(*args)
+
+        def entry(x):
+            return _dispatch("k", None, (x,))
+        """)
+    assert ("instr-uncovered-entry", 4) in rules_at(report)
+
+
+def test_spanned_entry_point_is_clean():
+    report = run("""\
+        def _dispatch(kernel, fn, args):
+            return fn(*args)
+
+        def entry(x):
+            with telemetry.span("k"):
+                return _dispatch("k", None, (x,))
+        """)
+    assert rules_at(report) == []
+
+
+def test_coverage_propagates_through_local_delegation():
+    report = run("""\
+        def _dispatch(kernel, fn, args):
+            return fn(*args)
+
+        def covered(x):
+            telemetry.count("covered.calls")
+            return _dispatch("k", None, (x,))
+
+        def entry(x):
+            return covered(x)
+        """)
+    assert rules_at(report) == []
+
+
+def test_private_dispatch_helper_not_flagged():
+    report = run("""\
+        def _dispatch(kernel, fn, args):
+            return fn(*args)
+
+        def _helper(x):
+            return _dispatch("k", None, (x,))
+        """)
+    assert rules_at(report) == []
+
+
+# --- suppressions ------------------------------------------------------------
+
+
+def test_suppression_same_line_round_trip():
+    src = """\
+        def g(x):
+            return x.item()  # cst: allow(host-sync-item): test boundary
+        """
+    report = run(src)
+    assert report.unsuppressed == []
+    assert len(report.suppressed) == 1
+    finding, reason = report.suppressed[0]
+    assert finding.rule == "host-sync-item"
+    assert reason == "test boundary"
+
+
+def test_suppression_standalone_line_above():
+    report = run("""\
+        def g(x):
+            # cst: allow(host-sync-item): reason on its own line,
+            # continued in a second comment line
+            return x.item()
+        """)
+    assert report.unsuppressed == []
+    # the continuation comment line is part of the recorded reason
+    assert report.suppressed[0][1] == (
+        "reason on its own line, continued in a second comment line")
+
+
+def test_stacked_allows_keep_their_own_reasons():
+    # two annotations (each multi-line) over one statement: each rule
+    # must keep ITS reason — the JSON artifact is the worklist
+    report = run("""\
+        import jax
+
+        def g(x):
+            # cst: allow(host-sync-item): first reason part one
+            # and part two
+            # cst: allow(host-sync-coerce): second reason
+            return int(x.item())
+        """, )
+    # only .item() fires here (int() of a non-tainted value is clean),
+    # and it must carry the item rule's full reason, not the coerce one
+    assert report.unsuppressed == []
+    reasons = {f.rule: r for f, r in report.suppressed}
+    assert reasons["host-sync-item"] == "first reason part one and part two"
+
+
+def test_wrong_rule_id_does_not_suppress():
+    report = run("""\
+        def g(x):
+            return x.item()  # cst: allow(host-sync-coerce): wrong id
+        """)
+    assert rules_at(report) == [("host-sync-item", 2)]
+
+
+# --- registry / whole-tree / CLI ---------------------------------------------
+
+
+def test_all_four_families_have_rule_ids():
+    families = {r.split("-")[0] for r in RULE_IDS}
+    assert {"recompile", "host", "dtype", "instr"} <= families
+
+
+def test_whole_tree_has_zero_unsuppressed_findings():
+    report = analyze_tree()
+    assert report.unsuppressed == [], [
+        f.render() for f in report.unsuppressed]
+    # every tree suppression must carry a reason — the allow-list is
+    # the documented worklist, not a mute button
+    missing = [f.render() for f, reason in report.suppressed
+               if not reason]
+    assert missing == []
+    assert report.files >= 15
+
+
+def test_cli_exits_1_on_bad_file(tmp_path, capsys):
+    bad = tmp_path / "bad.py"
+    bad.write_text("def g(x):\n    return x.item()\n")
+    assert main([str(bad)]) == 1
+    out = capsys.readouterr().out
+    assert f"{bad}:2: host-sync-item:" in out
+
+
+def test_cli_exits_0_on_tree_and_writes_json(tmp_path, capsys):
+    import json
+
+    out_json = tmp_path / "report.json"
+    assert main(["--json", str(out_json)]) == 0
+    data = json.loads(out_json.read_text())
+    assert data["schema"] == "cst-analysis-v1"
+    assert data["finding_count"] == 0
+    assert data["suppressed_count"] == data["suppressed_with_reason_count"]
+    assert data["suppressed_count"] > 0
+    capsys.readouterr()
+
+
+def test_cli_reports_each_seeded_bad_fixture(tmp_path, capsys):
+    """One seeded-bad file per rule family -> exit 1 with the family's
+    rule-id in the `file:line: rule-id` output."""
+    fixtures = {
+        "recompile-unbucketed-dim": (
+            "import jax\n"
+            "def _kern(b):\n"
+            "    def body(x):\n"
+            "        return x\n"
+            "    return jax.jit(body)\n"
+            "def entry(xs):\n"
+            "    return _kern(len(xs))(xs)\n"),
+        "host-sync-item": "def g(x):\n    return x.item()\n",
+        "dtype-implicit-cast": (
+            "def f(a):\n"
+            "    import jax.numpy as jnp\n"
+            "    return jnp.asarray(a)\n"),
+        "instr-uncovered-entry": (
+            "def _dispatch(k, fn, a):\n"
+            "    return fn(*a)\n"
+            "def entry(x):\n"
+            "    return _dispatch('k', None, (x,))\n"),
+    }
+    for rule, src in fixtures.items():
+        path = tmp_path / f"{rule}.py"
+        path.write_text(src)
+        assert main([str(path)]) == 1, rule
+        assert f" {rule}: " in capsys.readouterr().out, rule

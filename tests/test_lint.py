@@ -1,11 +1,15 @@
 """The static spec linter (`consensus_specs_tpu/lint.py`): catches
-undefined names and unknown config attributes, stays quiet on the real
-spec tree."""
+undefined names, unknown config attributes and call-arity drift, gives
+lambdas their own scope, stays quiet on the real spec tree."""
 
 import ast
 import builtins
 
-from consensus_specs_tpu.lint import _function_findings, lint_spec
+from consensus_specs_tpu.lint import (
+    _call_arity_findings,
+    _function_findings,
+    lint_spec,
+)
 
 
 def _findings(src, known=frozenset(), config_keys=frozenset()):
@@ -58,6 +62,94 @@ def test_catches_unknown_config_attribute():
     found = _findings(src, config_keys={"REAL_KNOB"})
     assert len(found) == 1
     assert "config.NO_SUCH_KNOB" in found[0]
+
+
+def test_lambda_params_do_not_leak_into_enclosing_scope():
+    # regression: lambda params used to join the enclosing bound set,
+    # masking genuine undefined names AFTER the lambda
+    src = ("def f(xs):\n"
+           "    g = lambda n: n + 1\n"
+           "    return n\n")
+    found = _findings(src)
+    assert len(found) == 1
+    assert "undefined name 'n'" in found[0]
+    assert ":3:" in found[0]
+
+
+def test_lambda_body_sees_own_params_and_enclosing_locals():
+    src = ("def f(xs, offset):\n"
+           "    g = lambda n: n + offset\n"
+           "    return g(xs)\n")
+    assert _findings(src) == []
+
+
+def test_lambda_body_undefined_name_is_caught():
+    src = ("def f(xs):\n"
+           "    return sorted(xs, key=lambda v: weight(v))\n")
+    found = _findings(src)
+    assert len(found) == 1
+    assert "undefined name 'weight'" in found[0]
+
+
+def test_nested_lambda_chain_scopes():
+    src = ("def f(xs):\n"
+           "    add = lambda a: lambda b: a + b\n"
+           "    return add(1)(2)\n")
+    assert _findings(src) == []
+
+
+def test_lambda_default_evaluates_in_enclosing_scope():
+    src = ("def f(xs):\n"
+           "    g = lambda n=missing: n\n"
+           "    return g()\n")
+    found = _findings(src)
+    assert len(found) == 1
+    assert "undefined name 'missing'" in found[0]
+
+
+# --- call arity --------------------------------------------------------------
+
+
+def _arity(src, helpers):
+    tree = ast.parse(src)
+    out = []
+    for node in tree.body:
+        if isinstance(node, ast.FunctionDef):
+            out.extend(_call_arity_findings(node, helpers, {}, "x.py"))
+    return out
+
+
+def _helper2(state, epoch):
+    return state
+
+
+def test_arity_drift_is_caught():
+    src = ("def f(state):\n"
+           "    return get_thing(state)\n")
+    found = _arity(src, {"get_thing": _helper2})
+    assert len(found) == 1
+    assert "get_thing()" in found[0] and ":2:" in found[0]
+
+
+def test_matching_call_and_keywords_pass():
+    src = ("def f(state):\n"
+           "    return get_thing(state, epoch=3)\n")
+    assert _arity(src, {"get_thing": _helper2}) == []
+
+
+def test_unknown_keyword_is_caught():
+    src = ("def f(state):\n"
+           "    return get_thing(state, slot=3)\n")
+    assert len(_arity(src, {"get_thing": _helper2})) == 1
+
+
+def test_starargs_and_shadowed_names_are_skipped():
+    src = ("def f(state, args):\n"
+           "    get_thing = state.fn\n"
+           "    get_thing(1, 2, 3)\n"
+           "    return helper(*args)\n")
+    assert _arity(src, {"get_thing": _helper2,
+                        "helper": _helper2}) == []
 
 
 def test_real_spec_tree_is_clean_minimal_phase0():
