@@ -8,7 +8,7 @@ CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 VECTOR_OUT ?= out/vectors
 
 .PHONY: test test-fast test-all test-bls lint vectors kzg_setups bench \
-	bench-smoke multichip help
+	bench-smoke bench-report multichip help
 
 help:
 	@echo "targets: test (fast suite) | test-all (incl. slow crypto) |"
@@ -16,7 +16,10 @@ help:
 	@echo "  lint (compile + spec static checks + device-path analyzer) |"
 	@echo "  vectors [VECTOR_OUT=dir] |"
 	@echo "  kzg_setups | bench (real TPU) | bench-smoke (tiny CPU shapes,"
-	@echo "  asserts the bench JSON contract) | multichip (8-dev CPU dryrun)"
+	@echo "  asserts the bench JSON contract) | bench-report (benchwatch"
+	@echo "  trend/threshold dashboard over the checked-in rounds +"
+	@echo "  out/bench_history.jsonl; exits nonzero on regression) |"
+	@echo "  multichip (8-dev CPU dryrun)"
 
 test:
 	$(PYTHON) -m pytest tests/ -q -m "not slow"
@@ -57,6 +60,14 @@ bench:
 # padding waste, MSM/h2c routing) and the CST_TRACE_FILE Chrome trace
 bench-smoke:
 	$(CPU_ENV) $(PYTHON) bench_smoke.py
+
+# benchwatch: ingest BENCH_r*/MULTICHIP_r* rounds, baselines, and any
+# telemetry snapshot into out/bench_history.jsonl, render the markdown
+# trend + ROADMAP-threshold dashboard (out/bench_report.md), and exit
+# nonzero on a round-over-round regression (CI gates on this; stdlib
+# only, no jax)
+bench-report:
+	$(PYTHON) -m consensus_specs_tpu.telemetry.report --out out/bench_report.md
 
 multichip:
 	$(CPU_ENV) $(PYTHON) -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('ok')"

@@ -41,6 +41,9 @@ import sys
 import time
 from pathlib import Path
 
+# stdlib-only (never initializes a backend in the parent process)
+from consensus_specs_tpu.telemetry import history as benchwatch
+
 HERE = Path(__file__).resolve().parent
 BASELINE_FILE = HERE / "bench_baseline.json"
 
@@ -504,8 +507,11 @@ def main():
         out["error"] = "; ".join(errors)
 
     # the flagship line goes out FIRST so an external driver timeout during
-    # the extras can never lose it (the rounds-3/4 failure mode)
+    # the extras can never lose it (the rounds-3/4 failure mode); the same
+    # record is appended to the benchwatch store when
+    # CST_BENCHWATCH_HISTORY is set — incrementally, for the same reason
     print(json.dumps(out), flush=True)
+    benchwatch.append_emission(out, ts=time.time())
 
     # extras — BASELINE configs #2/#3 (bls), #5 (kzg blob batch),
     # #1 (minimal full transition): each runs only while comfortably
@@ -522,6 +528,10 @@ def main():
         if extras is not None:
             out.setdefault("extra", {}).update(extras)
             print(json.dumps(out), flush=True)
+            for name, rec in extras.items():
+                if isinstance(rec, dict) and "value" in rec:
+                    benchwatch.append_emission(
+                        dict(rec, metric=name), ts=time.time())
         else:
             log(f"{mode} extras skipped: {err}")
 

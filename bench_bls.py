@@ -42,6 +42,7 @@ if os.environ.get("JAX_PLATFORMS"):
     jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
 from consensus_specs_tpu import telemetry  # noqa: E402
+from consensus_specs_tpu.telemetry import history as benchwatch  # noqa: E402
 from consensus_specs_tpu.utils.jaxtools import enable_compile_cache  # noqa: E402
 
 enable_compile_cache()
@@ -116,8 +117,13 @@ def _baselines() -> dict:
 
 def _emit(record: dict) -> None:
     """Print one metric line, with the per-config `"telemetry"`
-    sub-object embedded on telemetry rounds."""
-    print(json.dumps(telemetry.embed_bench_block(record)), flush=True)
+    sub-object embedded on telemetry rounds.  When
+    CST_BENCHWATCH_HISTORY names a path, the same record also lands in
+    the longitudinal store as a normalized history record
+    (`telemetry.history`) — the stdout contract is unchanged."""
+    record = telemetry.embed_bench_block(record)
+    benchwatch.append_emission(record, ts=time.time())
+    print(json.dumps(record), flush=True)
 
 
 def msm_breakeven_probe(sizes=MSM_PROBE_SIZES, iters: int = 3):

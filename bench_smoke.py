@@ -22,9 +22,11 @@ import json
 import os
 import subprocess
 import sys
+import time
 from pathlib import Path
 
 from consensus_specs_tpu.telemetry import validate_bench_block
+from consensus_specs_tpu.telemetry import history as benchwatch
 
 HERE = Path(__file__).resolve().parent
 
@@ -82,11 +84,24 @@ def main():
     trace_file.parent.mkdir(exist_ok=True)
     if trace_file.exists():
         trace_file.unlink()
+    # CST_BENCHWATCH_HISTORY makes every emitted metric line also land
+    # in the longitudinal store; default to a scratch file so a local
+    # smoke run does not pollute out/bench_history.jsonl, but let CI
+    # point it AT the real store (its benchwatch job reports over it).
+    # Only the scratch default is ever deleted — an externally named
+    # store is longitudinal data this smoke must append to, not wipe.
+    hist_env = os.environ.get("CST_BENCHWATCH_HISTORY")
+    hist_file = Path(hist_env) if hist_env \
+        else HERE / "out" / "smoke_history.jsonl"
+    if not hist_env and hist_file.exists():
+        hist_file.unlink()
+    run_t0 = time.time()
     out = _run(["bench_bls.py"],
                {"CST_BLS_BENCH_N": "2", "CST_BLS_BENCH_COMMITTEE": "2",
                 "CST_BLS_BENCH_SYNC": "4",
                 "CST_TELEMETRY": "1", "CST_BLS_BENCH_MSM_SIZES": "4",
-                "CST_TRACE_FILE": str(trace_file)},
+                "CST_TRACE_FILE": str(trace_file),
+                "CST_BENCHWATCH_HISTORY": str(hist_file)},
                timeout=1800)
     metrics = [o for o in out if "metric" in o]
     assert len(metrics) == 3, out    # configs #2, #3 + the MSM probe
@@ -100,6 +115,30 @@ def main():
     print("bench_bls.py JSON OK:", json.dumps(
         [{k: v for k, v in m.items() if k != "telemetry"}
          for m in metrics]))
+
+    # the benchwatch history-record contract: every metric line this run
+    # emitted must have landed in the store as one schema-valid record,
+    # platform-stamped "cpu" (the smoke pin).  Assertions apply to THIS
+    # run's records (ts >= run start, with clock slack) — a pre-existing
+    # external store may hold anything
+    hist_records, skipped, hist_warns = benchwatch.load_history(hist_file)
+    if not hist_env:     # we created the scratch file fresh
+        assert not skipped and not hist_warns, (skipped, hist_warns)
+    fresh = [r for r in hist_records
+             if isinstance(r.get("ts"), (int, float))
+             and r["ts"] >= run_t0 - 5]
+    stored = {r["metric"] for r in fresh}
+    assert {m["metric"] for m in metrics} <= stored, (stored, metrics)
+    for rec in fresh:
+        problems = benchwatch.validate_record(rec)
+        assert not problems, (problems, rec)
+        assert rec["source"] == "bench_emit", rec
+        assert rec["platform"] == "cpu", rec
+    probe_rec = [r for r in fresh
+                 if r["metric"].startswith("g1_msm_breakeven_probe")]
+    assert probe_rec and probe_rec[0].get("detail", {}).get("4"), probe_rec
+    print(f"benchwatch history OK: {len(fresh)} records this run -> "
+          f"{hist_file}")
 
     # CST_TRACE_FILE must have produced loadable Chrome trace-event JSON
     trace = json.loads(trace_file.read_text())

@@ -37,6 +37,7 @@ import inspect
 import re
 import sys
 import types
+from pathlib import Path
 
 from .models.builder import (
     BUILDABLE_FORKS,
@@ -309,13 +310,20 @@ _SKIP_DIRS = {"__pycache__", ".git", ".jax_cache", ".pytest_cache",
               "site-packages", "node_modules"}
 
 
-def lint_env_knobs() -> list[str]:
+def lint_env_knobs(repo=None) -> list[str]:
     """Every `CST_*` env read in the tree needs a row in README.md's
-    knob table, and every row needs a surviving read."""
-    repo = PKG_ROOT.parent
+    knob table, and every row needs a surviving read.  Benchwatch knobs
+    (`CST_BENCHWATCH_*`) additionally need a mention in the README's
+    "Benchwatch" section — the threshold-gate surface must document its
+    own configuration where it is explained, not only in the flat
+    table.  `repo` overrides the tree root (tests)."""
+    repo = Path(repo) if repo is not None else PKG_ROOT.parent
     readme = repo / "README.md"
-    documented = set(re.findall(r"\|\s*`(CST_[A-Z0-9_]+)`",
-                                readme.read_text()))
+    readme_text = readme.read_text()
+    documented = set(re.findall(r"\|\s*`(CST_[A-Z0-9_]+)`", readme_text))
+    bw_match = re.search(r"^## Benchwatch$(.*?)(?=^## |\Z)", readme_text,
+                         re.M | re.S)
+    benchwatch_section = bw_match.group(1) if bw_match else ""
 
     used: dict[str, str] = {}
     for path in sorted(repo.rglob("*.py")):
@@ -339,6 +347,13 @@ def lint_env_knobs() -> list[str]:
         findings.append(
             f"README.md: env knob '{name}' documented but never read "
             f"in the tree (stale table row?)")
+    for name in sorted(set(used)):
+        # a mention may carry an example value: `CST_BENCHWATCH_STRICT=1`
+        if name.startswith("CST_BENCHWATCH_") and not re.search(
+                rf"`{name}(?:=[^`]*)?`", benchwatch_section):
+            findings.append(
+                f"{used[name]}: benchwatch knob '{name}' must also be "
+                f"documented in README.md's \"## Benchwatch\" section")
     return findings
 
 

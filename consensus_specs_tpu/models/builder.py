@@ -25,6 +25,8 @@ from typing import Any
 
 import yaml
 
+from .. import telemetry
+
 
 class _SpecYamlLoader(yaml.SafeLoader):
     """SafeLoader that keeps 0x… scalars as strings (PyYAML would parse
@@ -335,16 +337,21 @@ def build_spec(fork: str, preset_name: str) -> Spec:
     key = (fork, preset_name)
     if key in _SPEC_CACHE:
         return _SPEC_CACHE[key]
-    ns = _preamble_namespace()
-    ns.update(load_preset(preset_name, fork))
-    ns["config"] = Configuration(**load_config(preset_name))
-    ns["TRUSTED_SETUPS_DIR"] = str(
-        PKG_ROOT / "presets" / preset_name / "trusted_setups")
-    _exec_sources(fork, ns)
-    _install_caches(ns)
-    # bind functions' globals: they already close over `ns` via exec globals
-    spec = Spec(fork, preset_name, ns)
-    ns["spec"] = spec
+    # cache misses only: the cumulative `spec.build` span is what the
+    # per-test phase attribution (tests/conftest.py -> benchwatch
+    # tier-1 table) charges to the spec-build phase
+    with telemetry.span("spec.build", fork=fork, preset=preset_name):
+        ns = _preamble_namespace()
+        ns.update(load_preset(preset_name, fork))
+        ns["config"] = Configuration(**load_config(preset_name))
+        ns["TRUSTED_SETUPS_DIR"] = str(
+            PKG_ROOT / "presets" / preset_name / "trusted_setups")
+        _exec_sources(fork, ns)
+        _install_caches(ns)
+        # bind functions' globals: they already close over `ns` via exec
+        # globals
+        spec = Spec(fork, preset_name, ns)
+        ns["spec"] = spec
     _SPEC_CACHE[key] = spec
     return spec
 
@@ -354,17 +361,19 @@ def get_copy_of_spec(spec: Spec) -> Spec:
     functions (`spec.retrieve_blobs_and_proofs = stub` …): writes to the
     copy never leak into the shared `build_spec` cache.  Mirrors the
     reference's re-import isolation (`test/context.py:663-734`)."""
-    ns = _preamble_namespace()
-    ns.update(load_preset(spec.preset_name, spec.fork))
-    # carry the source spec's live config (it may hold overrides from
-    # spec_with_config), not a fresh load of the preset defaults
-    ns["config"] = Configuration(**spec.config.to_dict())
-    ns["TRUSTED_SETUPS_DIR"] = str(
-        PKG_ROOT / "presets" / spec.preset_name / "trusted_setups")
-    _exec_sources(spec.fork, ns)
-    _install_caches(ns)
-    fresh = Spec(spec.fork, spec.preset_name, ns)
-    ns["spec"] = fresh
+    with telemetry.span("spec.build", fork=spec.fork,
+                        preset=spec.preset_name, copy=True):
+        ns = _preamble_namespace()
+        ns.update(load_preset(spec.preset_name, spec.fork))
+        # carry the source spec's live config (it may hold overrides from
+        # spec_with_config), not a fresh load of the preset defaults
+        ns["config"] = Configuration(**spec.config.to_dict())
+        ns["TRUSTED_SETUPS_DIR"] = str(
+            PKG_ROOT / "presets" / spec.preset_name / "trusted_setups")
+        _exec_sources(spec.fork, ns)
+        _install_caches(ns)
+        fresh = Spec(spec.fork, spec.preset_name, ns)
+        ns["spec"] = fresh
     return fresh
 
 
@@ -389,16 +398,19 @@ def spec_with_config(spec: Spec, overrides: dict[str, Any]) -> Spec:
     key = (spec.fork, spec.preset_name, fp)
     if key in _OVERRIDE_SPEC_CACHE:
         return _OVERRIDE_SPEC_CACHE[key]
-    ns = _preamble_namespace()
-    ns.update(load_preset(spec.preset_name, spec.fork))
-    cfg = load_config(spec.preset_name)
-    cfg.update(overrides)
-    ns["config"] = Configuration(**{k: _parse_value(v) for k, v in cfg.items()})
-    ns["TRUSTED_SETUPS_DIR"] = str(
-        PKG_ROOT / "presets" / spec.preset_name / "trusted_setups")
-    _exec_sources(spec.fork, ns)
-    _install_caches(ns)
-    fresh = Spec(spec.fork, spec.preset_name, ns)
-    ns["spec"] = fresh
+    with telemetry.span("spec.build", fork=spec.fork,
+                        preset=spec.preset_name, overrides=True):
+        ns = _preamble_namespace()
+        ns.update(load_preset(spec.preset_name, spec.fork))
+        cfg = load_config(spec.preset_name)
+        cfg.update(overrides)
+        ns["config"] = Configuration(
+            **{k: _parse_value(v) for k, v in cfg.items()})
+        ns["TRUSTED_SETUPS_DIR"] = str(
+            PKG_ROOT / "presets" / spec.preset_name / "trusted_setups")
+        _exec_sources(spec.fork, ns)
+        _install_caches(ns)
+        fresh = Spec(spec.fork, spec.preset_name, ns)
+        ns["spec"] = fresh
     _OVERRIDE_SPEC_CACHE[key] = fresh
     return fresh

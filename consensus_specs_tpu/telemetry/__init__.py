@@ -24,17 +24,27 @@ Surface:
     count(name, n=1)      monotonic counter
     observe(name, v)      histogram sample (count/total/min/max)
     set_meta(k, v)        one-shot string/num metadata (cache dir, ...)
+    add_event(name, dur)  record an externally-measured duration as a
+                          closed span (derived phase accounting)
+    span_seconds(name)    one span's cumulative total_s — point read
     first_call(key)       True once per key — compile-vs-run attribution
     snapshot()            the whole registry as a dict (stable schema)
     reset(), configure(), enabled()
     write_jsonl(path), write_chrome_trace(path), chrome_trace()
     bench_block(), validate_bench_block()   the bench JSON sub-object
 
+Benchwatch (longitudinal layer, not re-exported here): `history.py`
+ingests bench/telemetry rounds into the schema-versioned
+`out/bench_history.jsonl` store, and `python -m
+consensus_specs_tpu.telemetry.report` renders the trend/threshold/
+attribution dashboard and gates on regressions.
+
 Zero dependencies (stdlib only); never imports jax, numpy, or any spec
 module — safe to import from anywhere, including before backend pinning.
 """
 
 from .core import (
+    add_event,
     configure,
     count,
     counter_value,
@@ -45,6 +55,7 @@ from .core import (
     set_meta,
     snapshot,
     span,
+    span_seconds,
 )
 from .export import (
     bench_block,
@@ -56,8 +67,8 @@ from .export import (
 )
 
 __all__ = [
-    "configure", "count", "counter_value", "enabled", "first_call",
-    "observe", "reset", "set_meta", "snapshot", "span", "bench_block",
-    "chrome_trace", "embed_bench_block", "validate_bench_block",
-    "write_chrome_trace", "write_jsonl",
+    "add_event", "configure", "count", "counter_value", "enabled",
+    "first_call", "observe", "reset", "set_meta", "snapshot", "span",
+    "span_seconds", "bench_block", "chrome_trace", "embed_bench_block",
+    "validate_bench_block", "write_chrome_trace", "write_jsonl",
 ]

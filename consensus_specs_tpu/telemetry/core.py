@@ -166,6 +166,51 @@ def counter_value(name: str, default: int = 0) -> int:
         return _counters.get(name, default)
 
 
+def span_seconds(name: str, default: float = 0.0) -> float:
+    """One span aggregate's cumulative `total_s` — cheap point read.
+    Backs delta accounting (tests/conftest.py reads `spec.build` before
+    and after each test to split its wall into phases)."""
+    with _lock:
+        s = _spans.get(name)
+        return s["total_s"] if s else default
+
+
+def add_event(name: str, dur_s: float, **attrs) -> None:
+    """Record an already-measured duration as if a span of that length
+    just closed: aggregates under `name` and (buffer permitting) a
+    trace event ending now, carrying `attrs` as args.  For derived
+    timings that were never a live `span()` — e.g. the per-test
+    spec-build/test-body phase split, computed from deltas after the
+    test ran."""
+    if not _enabled:
+        return
+    dur = max(float(dur_s), 0.0)
+    t1 = time.perf_counter()
+    global _events_dropped
+    with _lock:
+        s = _spans.get(name)
+        if s is None:
+            _spans[name] = {"count": 1, "total_s": dur,
+                            "min_s": dur, "max_s": dur}
+        else:
+            s["count"] += 1
+            s["total_s"] += dur
+            if dur < s["min_s"]:
+                s["min_s"] = dur
+            if dur > s["max_s"]:
+                s["max_s"] = dur
+        if len(_events) < _MAX_EVENTS:
+            _events.append({
+                "name": name,
+                "ts": (t1 - dur - _T0) * 1e6,   # µs, process-relative
+                "dur": dur * 1e6,
+                "tid": threading.get_ident() & 0x7FFFFFFF,
+                "args": dict(attrs),
+            })
+        else:
+            _events_dropped += 1
+
+
 def first_call(key: str) -> bool:
     """True exactly once per key per process (per `reset(full=True)`):
     the compile-vs-run discriminator for jitted kernel dispatches.

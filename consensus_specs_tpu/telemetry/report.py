@@ -1,0 +1,649 @@
+"""Benchwatch reporter: trends, ROADMAP threshold gates, attribution.
+
+`python -m consensus_specs_tpu.telemetry.report` ingests every perf
+artifact in the repo (BENCH/MULTICHIP round wrappers, oracle baselines,
+the optional pytest telemetry snapshot), folds it into the longitudinal
+store (`out/bench_history.jsonl`, see `telemetry.history`), and renders
+one markdown dashboard:
+
+- per-metric trend tables across rounds (value, speedup vs the
+  pure-Python oracle, delta vs the previous round);
+- the declarative ROADMAP threshold table (attestation >= 30x, sync
+  aggregate >= 5x, `verify_blob_kzg_proof_batch` >= 2x, compile+first
+  < 40s, tier-1 wall < 870s, multichip dryrun ok) evaluated against the
+  latest data;
+- a generic round-over-round regression rule (no TPU metric may
+  regress more than CST_BENCHWATCH_MAX_REGRESS_PCT percent);
+- the `_MSM_DEVICE_MIN` break-even recommendation from the
+  `g1_msm_breakeven_probe` rows;
+- the tier-1 wall-time attribution table, split spec-build vs
+  test-body per test (the conftest phase spans), naming the trim
+  targets the ROADMAP asks for.
+
+Exit code contract (what CI gates on): nonzero iff a round-over-round
+regression fired, or — with `--strict` / CST_BENCHWATCH_STRICT=1 — any
+ROADMAP threshold FAILs.  Without strict mode the threshold column is
+advisory: the ROADMAP targets are acceptance criteria for the *next*
+TPU round ("re-open per config if not met"), and several checked-in
+rounds predate the kernels that are meant to meet them, so hard-gating
+every CI run on them would just mean a permanently red gate.
+
+Adding a threshold for a new metric = one entry in `THRESHOLDS`
+(regex over metric names, field, op, target); the README's Benchwatch
+section documents the columns.
+
+Stdlib-only; safe to run anywhere, never imports jax.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+import time
+from pathlib import Path
+
+from . import history
+
+# --- declarative threshold table --------------------------------------------
+#
+# field: which record field is compared ("vs_baseline" | "value").
+# tpu_only: evaluate only against TPU-platform records (the ROADMAP
+#   speedup targets are TPU acceptance criteria; a CPU smoke round must
+#   read "no data", not FAIL).
+# op: ">=" (bigger is better) or "<" (smaller is better).
+
+THRESHOLDS = (
+    {"id": "attestation-speedup",
+     "title": "#2 attestation batch vs oracle",
+     "metric": r"attestation_batch_\d+x\d+_verify_wall",
+     "field": "vs_baseline", "op": ">=", "target": 30.0, "tpu_only": True},
+    {"id": "sync-aggregate-speedup",
+     "title": "#3 sync aggregate vs oracle",
+     "metric": r"sync_aggregate_\d+_verify_wall",
+     "field": "vs_baseline", "op": ">=", "target": 5.0, "tpu_only": True},
+    {"id": "kzg-batch-speedup",
+     "title": "#5 verify_blob_kzg_proof_batch vs oracle",
+     "metric": r"blob_kzg_proof_batch_\d+_verify_wall",
+     "field": "vs_baseline", "op": ">=", "target": 2.0, "tpu_only": True},
+    {"id": "attestation-compile-first",
+     "title": "attestation compile+first wall",
+     "metric": r"attestation_batch_compile_first_s",
+     "field": "value", "op": "<", "target": 40.0, "tpu_only": True},
+    {"id": "tier1-wall",
+     "title": "tier-1 suite wall budget",
+     "metric": r"tier1_wall_s",
+     "field": "value", "op": "<", "target": 870.0, "tpu_only": False},
+    {"id": "multichip",
+     "title": "multichip dryrun healthy",
+     "metric": r"multichip_dryrun_ok",
+     "field": "value", "op": ">=", "target": 1.0, "tpu_only": False},
+)
+
+FLAGSHIP = "mainnet_epoch_sweep_1m_validators_wall"
+
+
+def _platform_group(rec: dict) -> str:
+    """Records from the historical TPU driver rounds predate the
+    `platform` field — group them with explicit TPU records."""
+    p = rec.get("platform")
+    if p is None or str(p).startswith("tpu"):
+        return "tpu"
+    return str(p)
+
+
+def _order_key(rec: dict):
+    """Rounds first (by number), then live emissions (by timestamp) —
+    'latest' and 'previous' mean the same thing everywhere."""
+    rnd = rec.get("round")
+    return (0, rnd, 0.0) if isinstance(rnd, int) \
+        else (1, 0, float(rec.get("ts") or 0.0))
+
+
+def _by_metric(records) -> dict[str, list[dict]]:
+    out: dict[str, list[dict]] = {}
+    for rec in records:
+        out.setdefault(rec["metric"], []).append(rec)
+    for series in out.values():
+        series.sort(key=_order_key)
+    return out
+
+
+def _where(rec: dict) -> str:
+    if isinstance(rec.get("round"), int):
+        return f"round {rec['round']}"
+    if rec.get("ts"):
+        return time.strftime("%Y-%m-%d %H:%M",
+                             time.localtime(rec["ts"]))
+    return rec.get("file", "?")
+
+
+# --- threshold evaluation ----------------------------------------------------
+
+
+def evaluate_thresholds(records) -> list[dict]:
+    """One row per THRESHOLDS entry: the latest eligible measurement
+    and its PASS / FAIL / 'no data' status."""
+    rows = []
+    for th in THRESHOLDS:
+        pattern = re.compile(th["metric"] + r"\Z")
+        candidates = [
+            r for r in records
+            if pattern.match(r["metric"])
+            and isinstance(r.get(th["field"]), (int, float))
+            and (not th["tpu_only"] or _platform_group(r) == "tpu")
+        ]
+        row = dict(th, status="no data", observed=None, where=None)
+        if candidates:
+            latest = max(candidates, key=_order_key)
+            observed = float(latest[th["field"]])
+            ok = observed >= th["target"] if th["op"] == ">=" \
+                else observed < th["target"]
+            row.update(status="PASS" if ok else "FAIL",
+                       observed=observed, where=_where(latest),
+                       metric_name=latest["metric"])
+        rows.append(row)
+    return rows
+
+
+# --- round-over-round regression rule ----------------------------------------
+
+
+def _comparable_oracles(prev: dict, cur: dict) -> bool:
+    """vs_baseline numbers only compare across rounds when both divided
+    by the same kind of oracle measurement.  The flagship rounds carry
+    the oracle fingerprint (us/validator) mined from the round tail;
+    fingerprints within 2x mean 'same oracle', a missing fingerprint on
+    one side means the baseline was re-measured or the tail was
+    truncated — fall back to raw wall."""
+    fa = prev.get("baseline_us_per_validator")
+    fb = cur.get("baseline_us_per_validator")
+    if fa and fb:
+        return 0.5 <= fa / fb <= 2.0
+    return fa is None and fb is None
+
+
+def find_regressions(records, max_regress_pct: float) -> list[dict]:
+    """Latest-vs-previous comparison per TPU metric.  A drop in
+    vs_baseline (comparable oracles) or a rise in wall seconds beyond
+    `max_regress_pct` is a regression.  <= 0 disables the rule."""
+    if max_regress_pct <= 0:
+        return []
+    regressions = []
+    for metric, series in sorted(_by_metric(records).items()):
+        series = [r for r in series
+                  if _platform_group(r) == "tpu"
+                  and r.get("unit") != "bool"
+                  and isinstance(r.get("value"), (int, float))]
+        if len(series) < 2:
+            continue
+        prev, cur = series[-2], series[-1]
+        pv, cv = prev.get("vs_baseline"), cur.get("vs_baseline")
+        if isinstance(pv, (int, float)) and isinstance(cv, (int, float)) \
+                and pv > 0 and _comparable_oracles(prev, cur):
+            change_pct = (cv - pv) / pv * 100.0
+            if change_pct < -max_regress_pct:
+                regressions.append({
+                    "metric": metric,
+                    "kind": "vs_baseline",
+                    "prev": pv, "cur": cv,
+                    "change_pct": round(change_pct, 1),
+                    "prev_where": _where(prev), "cur_where": _where(cur),
+                })
+            continue
+        if prev["value"] > 0:
+            change_pct = (cur["value"] - prev["value"]) / prev["value"] * 100.0
+            if change_pct > max_regress_pct:
+                regressions.append({
+                    "metric": metric,
+                    "kind": "wall",
+                    "prev": prev["value"], "cur": cur["value"],
+                    "change_pct": round(change_pct, 1),
+                    "prev_where": _where(prev), "cur_where": _where(cur),
+                })
+    return regressions
+
+
+# --- _MSM_DEVICE_MIN recommendation ------------------------------------------
+
+
+def msm_recommendation(records) -> dict:
+    """Close the ROADMAP measurement loop: from the latest
+    `g1_msm_breakeven_probe` detail rows, the smallest batch size where
+    the device kernel beats the host oracle (host_over_device > 1), or
+    'keep the current threshold' when no size wins."""
+    probes = [r for r in records
+              if r["metric"].startswith("g1_msm_breakeven_probe")
+              and isinstance(r.get("detail"), dict)]
+    if not probes:
+        return {"status": "no data",
+                "text": ("no `g1_msm_breakeven_probe` rows ingested yet — "
+                         "run `bench_bls.py` with CST_TELEMETRY=1 on the "
+                         "TPU to produce them")}
+    # the routing decision is for the TPU: a real-chip probe always
+    # outranks a CPU smoke probe, however recent the smoke run
+    tpu_probes = [r for r in probes if _platform_group(r) == "tpu"]
+    latest = max(tpu_probes or probes, key=_order_key)
+    current = latest.get("msm_device_min", 16)
+    sizes = []
+    for n, d in latest["detail"].items():
+        try:
+            n = int(n)
+        except (TypeError, ValueError):
+            continue
+        ratio = d.get("host_over_device") if isinstance(d, dict) else None
+        if isinstance(ratio, (int, float)):
+            sizes.append((n, float(ratio), d.get("routed")))
+    sizes.sort()
+    wins = [n for n, ratio, _ in sizes if ratio > 1.0]
+    if wins:
+        # assuming win/loss is monotone in n, the right threshold is the
+        # smallest winning size — below current means small MSMs are
+        # being left on the host that the device would win, ABOVE
+        # current means sizes in [current, suggested) are routed to a
+        # device that measurably loses there
+        suggested = min(wins)
+        if suggested < current:
+            status = "lower"
+            verdict = (f"suggest `_MSM_DEVICE_MIN = {suggested}` — "
+                       f"device beats host from n={suggested} "
+                       f"(currently {current})")
+        elif suggested == current:
+            status = "keep"
+            verdict = (f"keep {current} — device wins from exactly "
+                       f"n={current}, the threshold is right")
+        else:
+            status = "raise"
+            verdict = (f"suggest `_MSM_DEVICE_MIN = {suggested}` — "
+                       f"device only wins from n={suggested}, but "
+                       f"n>={current} already routes to the device "
+                       f"where the host measures faster")
+    else:
+        suggested = None
+        verdict = (f"keep {current} — no device win observed at any "
+                   f"probed size")
+        status = "keep"
+    if _platform_group(latest) != "tpu":
+        verdict += (" (CPU probe only — the routing decision needs a "
+                    "TPU round to confirm)")
+    return {"status": status, "suggested": suggested, "current": current,
+            "where": _where(latest), "platform": _platform_group(latest),
+            "sizes": [{"n": n, "host_over_device": r, "routed": routed}
+                      for n, r, routed in sizes],
+            "text": verdict}
+
+
+# --- markdown rendering ------------------------------------------------------
+
+
+def _fmt(v, nd=4) -> str:
+    if v is None:
+        return "—"
+    if isinstance(v, float):
+        return f"{round(v, nd):g}"
+    return str(v)
+
+
+def _cell(rec: dict | None) -> str:
+    if rec is None:
+        return "—"
+    if rec.get("value") is None:
+        return "fail" if rec.get("error") else "—"
+    s = f"{_fmt(rec['value'])}{'' if rec.get('unit') == 'bool' else ' s'}"
+    if isinstance(rec.get("vs_baseline"), (int, float)):
+        s += f" ({_fmt(rec['vs_baseline'], 1)}x)"
+    return s
+
+
+def render_trend_tables(records) -> list[str]:
+    lines: list[str] = []
+    round_recs = [r for r in records
+                  if r["source"] in ("bench_round", "multichip_round")]
+    rounds = sorted({r["round"] for r in round_recs
+                     if isinstance(r.get("round"), int)})
+    if rounds:
+        lines.append("## Round trends\n")
+        lines.append("Cells are `wall (speedup vs the pure-Python "
+                     "oracle)`; Δ compares the last two measured "
+                     "rounds.\n")
+        header = "| metric | " + " | ".join(f"r{n:02d}" for n in rounds) \
+            + " | Δ last |"
+        lines.append(header)
+        lines.append("|---" * (len(rounds) + 2) + "|")
+        for metric, series in sorted(_by_metric(round_recs).items()):
+            per_round = {r["round"]: r for r in series
+                         if isinstance(r.get("round"), int)}
+            cells = [_cell(per_round.get(n)) for n in rounds]
+            measured = [per_round[n] for n in rounds
+                        if n in per_round
+                        and isinstance(per_round[n].get("value"),
+                                       (int, float))]
+            delta = "—"
+            if len(measured) >= 2 and series[0].get("unit") != "bool":
+                prev, cur = measured[-2], measured[-1]
+                pv, cv = prev.get("vs_baseline"), cur.get("vs_baseline")
+                if isinstance(pv, (int, float)) \
+                        and isinstance(cv, (int, float)) and pv > 0 \
+                        and _comparable_oracles(prev, cur):
+                    delta = f"{(cv - pv) / pv * 100.0:+.1f}% speedup"
+                elif prev["value"] > 0:
+                    pct = ((cur["value"] - prev["value"])
+                           / prev["value"] * 100.0)
+                    delta = f"{pct:+.1f}% wall"
+            lines.append(f"| `{metric}` | " + " | ".join(cells)
+                         + f" | {delta} |")
+        lines.append("")
+
+    emits = [r for r in records if r["source"] == "bench_emit"]
+    if emits:
+        lines.append("## Live emissions (CST_BENCHWATCH_HISTORY)\n")
+        lines.append("| metric | platform | latest | when |")
+        lines.append("|---|---|---|---|")
+        for metric, series in sorted(_by_metric(emits).items()):
+            latest = series[-1]
+            lines.append(f"| `{metric}` | {latest.get('platform', '—')} "
+                         f"| {_cell(latest)} | {_where(latest)} |")
+        lines.append("")
+
+    oracles = [r for r in records if r["source"] == "baseline"]
+    if oracles:
+        lines.append("## Oracle baselines (pure-Python costs the "
+                     "speedups divide by)\n")
+        lines.append("| metric | value | measured |")
+        lines.append("|---|---|---|")
+        for rec in sorted(oracles, key=lambda r: r["metric"]):
+            lines.append(f"| `{rec['metric']}` | {_fmt(rec['value'])} "
+                         f"{rec['unit']} | {rec.get('measured_at', '—')} |")
+        lines.append("")
+    return lines
+
+
+def render_thresholds(rows, strict: bool) -> list[str]:
+    lines = ["## ROADMAP thresholds\n"]
+    mode = ("**strict** — any FAIL fails the run" if strict
+            else "advisory — only regressions gate the exit code "
+                 "(promote with CST_BENCHWATCH_STRICT=1)")
+    lines.append(f"Gate mode: {mode}.\n")
+    lines.append("| threshold | target | observed | where | status |")
+    lines.append("|---|---|---|---|---|")
+    for row in rows:
+        target = (f"{row['field']} {row['op']} {_fmt(row['target'], 1)}")
+        observed = "—" if row["observed"] is None \
+            else _fmt(row["observed"], 2)
+        mark = {"PASS": "✅ PASS", "FAIL": "❌ FAIL",
+                "no data": "— no data"}[row["status"]]
+        lines.append(f"| {row['title']} | {target} | {observed} "
+                     f"| {row['where'] or '—'} | {mark} |")
+    lines.append("")
+    return lines
+
+
+def render_regressions(regressions, max_regress_pct) -> list[str]:
+    lines = ["## Round-over-round regressions\n"]
+    if max_regress_pct <= 0:
+        lines.append("Regression rule disabled "
+                     "(CST_BENCHWATCH_MAX_REGRESS_PCT <= 0).\n")
+        return lines
+    if not regressions:
+        lines.append(f"None — no TPU metric regressed more than "
+                     f"{_fmt(max_regress_pct, 1)}% against its previous "
+                     f"round.\n")
+        return lines
+    lines.append("| metric | compared | previous | current | change |")
+    lines.append("|---|---|---|---|---|")
+    for r in regressions:
+        lines.append(
+            f"| `{r['metric']}` | {r['kind']} "
+            f"({r['prev_where']} → {r['cur_where']}) "
+            f"| {_fmt(r['prev'], 2)} | {_fmt(r['cur'], 2)} "
+            f"| {r['change_pct']:+.1f}% |")
+    lines.append("")
+    return lines
+
+
+def render_msm(msm: dict) -> list[str]:
+    lines = ["## `_MSM_DEVICE_MIN` break-even\n", msm["text"] + "\n"]
+    if msm.get("sizes"):
+        lines.append(f"Latest probe: {msm['where']} "
+                     f"(platform {msm.get('platform', '?')}).\n")
+        lines.append("| n | host/device wall | routed |")
+        lines.append("|---|---|---|")
+        for s in msm["sizes"]:
+            lines.append(f"| {s['n']} | {_fmt(s['host_over_device'], 2)} "
+                         f"| {s.get('routed') or '—'} |")
+        lines.append("")
+    return lines
+
+
+def render_attribution(attribution, durations, top_n: int) -> list[str]:
+    lines = ["## Tier-1 wall-time attribution\n"]
+    if attribution:
+        total = sum(r["total_s"] for r in attribution)
+        build = sum(r["spec_build_s"] for r in attribution)
+        body = sum(r["test_body_s"] for r in attribution)
+        lines.append(
+            f"{len(attribution)} tests, {total:.1f}s in-test wall; "
+            f"phase split {build:.1f}s spec-build vs {body:.1f}s "
+            f"test-body.  Spec-build-dominated rows are the ROADMAP's "
+            f"trim targets (session compile-cache reuse / redundant "
+            f"spec builds).\n")
+        lines.append(f"Top {min(top_n, len(attribution))} time sinks:\n")
+        lines.append("| test | total | spec-build | test-body | "
+                     "build share |")
+        lines.append("|---|---|---|---|---|")
+        for row in attribution[:top_n]:
+            share = (row["spec_build_s"] / row["total_s"] * 100.0
+                     if row["total_s"] else 0.0)
+            lines.append(
+                f"| `{row['test']}` | {row['total_s']:.2f}s "
+                f"| {row['spec_build_s']:.2f}s "
+                f"| {row['test_body_s']:.2f}s | {share:.0f}% |")
+        lines.append("")
+    elif durations:
+        lines.append("No telemetry snapshot with phase spans; falling "
+                     "back to pytest --durations rows (no spec-build "
+                     "split).\n")
+        lines.append("| test | phase | wall |")
+        lines.append("|---|---|---|")
+        for row in sorted(durations, key=lambda r: -r["dur_s"])[:top_n]:
+            lines.append(f"| `{row['test']}` | {row['phase']} "
+                         f"| {row['dur_s']:.2f}s |")
+        lines.append("")
+    else:
+        lines.append("No attribution data — run the suite with "
+                     "CST_TELEMETRY=1 CST_TELEMETRY_OUT=out/"
+                     "telemetry_snapshot.json (CI does) and re-run the "
+                     "report.\n")
+    return lines
+
+
+def render_report(result: dict) -> str:
+    lines = ["# Benchwatch report\n"]
+    lines.append(
+        f"{result['n_records']} history records "
+        f"({result['n_new_records']} new this run) from "
+        f"{result['repo']}; store: `{result['history_path']}`.\n")
+    lines.extend(render_thresholds(result["thresholds"], result["strict"]))
+    lines.extend(render_regressions(result["regressions"],
+                                    result["max_regress_pct"]))
+    lines.extend(render_msm(result["msm"]))
+    lines.extend(render_trend_tables(result["records"]))
+    lines.extend(render_attribution(result["attribution"],
+                                    result["durations"],
+                                    result["top_n"]))
+    if result["warnings"]:
+        lines.append("## Ingest warnings\n")
+        lines.append(f"{len(result['warnings'])} input(s) skipped "
+                     "(malformed / truncated / unknown schema):\n")
+        for w in result["warnings"]:
+            lines.append(f"- {w}")
+        lines.append("")
+    verdict = result["verdict"]
+    lines.append(f"---\n\n**Verdict: {verdict}**\n")
+    return "\n".join(lines)
+
+
+# --- orchestration -----------------------------------------------------------
+
+
+def build_report(repo: Path, history_path: Path,
+                 snapshots: list[Path], durations_path: Path | None,
+                 top_n: int, strict: bool, max_regress_pct: float,
+                 update_history: bool = True) -> dict:
+    records, warnings = history.ingest_repo(repo)
+
+    attribution: list[dict] = []
+    for snap in snapshots:
+        recs, attr, warns = history.parse_telemetry_snapshot(snap)
+        records.extend(recs)
+        warnings.extend(warns)
+        if attr:
+            attribution = attr   # latest snapshot wins
+    durations: list[dict] = []
+    if durations_path is not None:
+        try:
+            durations = history.parse_durations(
+                Path(durations_path).read_text())
+        except (OSError, UnicodeDecodeError) as e:
+            warnings.append(f"{durations_path}: unreadable durations "
+                            f"file ({type(e).__name__}) — skipped")
+
+    # one pass over the store: load, diff the freshly parsed records
+    # against it, optionally persist the new ones, and report over the
+    # union either way
+    stored, skipped, hist_warns = history.load_history(history_path)
+    warnings.extend(hist_warns)
+    seen = {history._canonical_line(r) for r in stored}
+    fresh = [r for r in records
+             if not history.validate_record(r)
+             and history._canonical_line(r) not in seen]
+    n_new = history.append_records(history_path, fresh) \
+        if update_history else 0
+    stored.extend(fresh)
+
+    thresholds = evaluate_thresholds(stored)
+    regressions = find_regressions(stored, max_regress_pct)
+    msm = msm_recommendation(stored)
+
+    failed = [t for t in thresholds if t["status"] == "FAIL"]
+    gate_failures = list(regressions)
+    if strict:
+        gate_failures.extend(failed)
+    if regressions:
+        verdict = ("REGRESSION — " + ", ".join(
+            f"`{r['metric']}` {r['change_pct']:+.1f}% ({r['kind']})"
+            for r in regressions))
+    elif strict and failed:
+        verdict = ("THRESHOLD FAIL — " + ", ".join(
+            t["id"] for t in failed))
+    else:
+        unmet = ", ".join(t["id"] for t in failed) or "none"
+        verdict = f"clean (no regressions; unmet targets: {unmet})"
+
+    return {
+        "repo": str(repo),
+        "history_path": str(history_path),
+        "n_records": len(stored),
+        "n_new_records": n_new,
+        "records": stored,
+        "thresholds": thresholds,
+        "regressions": regressions,
+        "msm": msm,
+        "attribution": attribution,
+        "durations": durations,
+        "warnings": warnings,
+        "skipped_history_lines": skipped,
+        "strict": strict,
+        "max_regress_pct": max_regress_pct,
+        "top_n": top_n,
+        "verdict": verdict,
+        "exit_code": 1 if gate_failures else 0,
+    }
+
+
+def _default_repo() -> Path:
+    return Path(__file__).resolve().parents[2]
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m consensus_specs_tpu.telemetry.report",
+        description="Benchwatch: longitudinal perf dashboard + "
+                    "regression gate over bench/telemetry rounds.")
+    parser.add_argument("--repo", type=Path, default=_default_repo(),
+                        help="repo root holding BENCH_r*/MULTICHIP_r* "
+                             "round files (default: this checkout)")
+    parser.add_argument("--history", type=Path, default=None,
+                        help="history store path (default: "
+                             "<repo>/out/bench_history.jsonl)")
+    parser.add_argument("--out", type=Path, default=None,
+                        help="markdown report path (default: "
+                             "<repo>/out/bench_report.md)")
+    parser.add_argument("--snapshot", type=Path, action="append",
+                        default=None,
+                        help="telemetry snapshot file(s) for tier-1 "
+                             "attribution (default: <repo>/out/"
+                             "telemetry_snapshot.json when present)")
+    parser.add_argument("--durations", type=Path, default=None,
+                        help="saved pytest --durations output "
+                             "(attribution fallback)")
+    parser.add_argument("--top", type=int, default=None,
+                        help="rows in the attribution table (default "
+                             "CST_BENCHWATCH_TOP or 15)")
+    parser.add_argument("--strict", action="store_true",
+                        help="FAILing ROADMAP thresholds also gate the "
+                             "exit code (same as CST_BENCHWATCH_STRICT=1)")
+    parser.add_argument("--no-update", action="store_true",
+                        help="do not append newly ingested records to "
+                             "the history store")
+    parser.add_argument("--json", type=Path, default=None,
+                        help="also write the machine-readable result "
+                             "(thresholds/regressions/msm) as JSON")
+    args = parser.parse_args(argv)
+
+    repo = args.repo.resolve()
+    history_path = args.history or repo / "out" / "bench_history.jsonl"
+    out_path = args.out or repo / "out" / "bench_report.md"
+    snapshots = args.snapshot
+    if snapshots is None:
+        default_snap = repo / "out" / "telemetry_snapshot.json"
+        snapshots = [default_snap] if default_snap.exists() else []
+    strict = args.strict or \
+        os.environ.get("CST_BENCHWATCH_STRICT", "0") not in ("", "0")
+    try:
+        max_regress_pct = float(
+            os.environ.get("CST_BENCHWATCH_MAX_REGRESS_PCT", "20"))
+    except ValueError:
+        max_regress_pct = 20.0
+    if args.top is not None:
+        top_n = args.top
+    else:
+        try:
+            top_n = int(os.environ.get("CST_BENCHWATCH_TOP", "15") or 15)
+        except ValueError:
+            top_n = 15
+
+    result = build_report(
+        repo=repo, history_path=history_path, snapshots=snapshots,
+        durations_path=args.durations, top_n=top_n, strict=strict,
+        max_regress_pct=max_regress_pct,
+        update_history=not args.no_update)
+
+    text = render_report(result)
+    out_path.parent.mkdir(parents=True, exist_ok=True)
+    out_path.write_text(text)
+    print(text)
+    if args.json:
+        slim = {k: v for k, v in result.items()
+                if k not in ("records", "attribution", "durations")}
+        args.json.parent.mkdir(parents=True, exist_ok=True)
+        args.json.write_text(json.dumps(slim, indent=1) + "\n")
+    print(f"benchwatch: {result['verdict']} -> {out_path}",
+          file=sys.stderr)
+    return result["exit_code"]
+
+
+if __name__ == "__main__":
+    sys.exit(main())

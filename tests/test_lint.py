@@ -158,3 +158,56 @@ def test_real_spec_tree_is_clean_minimal_phase0():
 
 def test_real_spec_tree_is_clean_minimal_electra():
     assert lint_spec("electra", "minimal") == []
+
+
+# --- env-knob discipline (benchwatch extension) -----------------------------
+
+
+def _knob_repo(tmp_path, readme: str, code: str):
+    (tmp_path / "README.md").write_text(readme)
+    (tmp_path / "mod.py").write_text(code)
+    return tmp_path
+
+
+def test_benchwatch_knob_needs_benchwatch_section_mention(tmp_path):
+    from consensus_specs_tpu.lint import lint_env_knobs
+
+    readme = ("## Benchwatch\n\nno knob mention here\n\n"
+              "## Environment knobs\n\n"
+              "| `CST_BENCHWATCH_FOO` | unset | a knob |\n")
+    # knob name assembled at runtime so THIS file's source never
+    # pattern-matches the tree-wide env-read scan
+    knob = "CST_" + "BENCHWATCH_FOO"
+    repo = _knob_repo(tmp_path, readme,
+                      "import os\nX = os.environ.get(%r)\n" % knob)
+    found = lint_env_knobs(repo)
+    assert len(found) == 1
+    assert "Benchwatch" in found[0] and knob in found[0]
+
+
+def test_benchwatch_knob_mention_with_value_suffix_passes(tmp_path):
+    from consensus_specs_tpu.lint import lint_env_knobs
+
+    readme = ("## Benchwatch\n\nset `CST_BENCHWATCH_FOO=1` to enable\n\n"
+              "## Environment knobs\n\n"
+              "| `CST_BENCHWATCH_FOO` | unset | a knob |\n")
+    knob = "CST_" + "BENCHWATCH_FOO"
+    repo = _knob_repo(tmp_path, readme,
+                      "import os\nX = os.environ.get(%r)\n" % knob)
+    assert lint_env_knobs(repo) == []
+
+
+def test_undocumented_knob_still_caught(tmp_path):
+    from consensus_specs_tpu.lint import lint_env_knobs
+
+    knob = "CST_" + "NEW_KNOB"
+    repo = _knob_repo(tmp_path, "## Benchwatch\n",
+                      "import os\nX = os.environ[%r]\n" % knob)
+    found = lint_env_knobs(repo)
+    assert len(found) == 1 and knob in found[0]
+
+
+def test_real_tree_knob_table_in_sync():
+    from consensus_specs_tpu.lint import lint_env_knobs
+
+    assert lint_env_knobs() == []

@@ -306,3 +306,38 @@ def test_validate_bench_block_rejects_malformed():
         broken = json.loads(json.dumps(good))
         breakage(broken)
         assert telemetry.validate_bench_block(broken), breakage
+
+
+# --- add_event / span_seconds (benchwatch phase attribution) ----------------
+
+
+def test_add_event_aggregates_like_a_span():
+    telemetry.configure(enabled=True)
+    telemetry.add_event("t::x [spec-build]", 1.5, phase="spec-build")
+    telemetry.add_event("t::x [spec-build]", 0.5, phase="spec-build")
+    snap = telemetry.snapshot()
+    agg = snap["spans"]["t::x [spec-build]"]
+    assert agg["count"] == 2
+    assert agg["total_s"] == 2.0
+    assert agg["min_s"] == 0.5 and agg["max_s"] == 1.5
+    # the buffered trace events carry the attrs (Chrome-trace args)
+    events, _ = core._events_copy()
+    assert [e["args"] for e in events] == [{"phase": "spec-build"}] * 2
+    assert all(e["dur"] > 0 for e in events)
+
+
+def test_add_event_clamps_negative_and_respects_disabled():
+    telemetry.add_event("off", 1.0)        # disabled: no-op
+    assert telemetry.snapshot()["spans"] == {}
+    telemetry.configure(enabled=True)
+    telemetry.add_event("neg", -3.0)       # derived deltas can misfire
+    assert telemetry.snapshot()["spans"]["neg"]["total_s"] == 0.0
+
+
+def test_span_seconds_point_read():
+    telemetry.configure(enabled=True)
+    assert telemetry.span_seconds("spec.build") == 0.0
+    assert telemetry.span_seconds("spec.build", default=7.0) == 7.0
+    telemetry.add_event("spec.build", 1.25)
+    telemetry.add_event("spec.build", 0.25)
+    assert telemetry.span_seconds("spec.build") == 1.5
