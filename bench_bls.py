@@ -213,6 +213,25 @@ def msm_probe_record() -> dict:
     }
 
 
+def costmodel_kernel_sweep():
+    """Tiny-shape exercises of the device kernels that do NOT sit on
+    this bench's measured path — the sha256 merkle reduction and the
+    KZG barycentric evaluator — so a CST_COSTMODEL round's Utilization
+    table covers the whole kernel surface, not just the BLS configs.
+    Cost records are per-process facts (they survive the per-config
+    telemetry resets), so running this during setup is free for the
+    measured configs."""
+    import numpy as np
+
+    from consensus_specs_tpu.ops import fr_batch, sha256_jax
+
+    words = np.arange(8 * 8, dtype=np.uint32).reshape(8, 8)
+    sha256_jax.merkleize_words_jax(words, 3)
+    roots = [pow(5, i, fr_batch.R_MODULUS) for i in range(4)]
+    fr_batch.barycentric_eval([1, 2, 3, 4], roots, 7)
+    telemetry.costmodel.sample_watermark("bench_bls.cost_sweep")
+
+
 def main():
     from consensus_specs_tpu.ops.bls_batch import (
         batch_verify, pairing_check_device)
@@ -221,6 +240,8 @@ def main():
     from consensus_specs_tpu.ops.bls.hash_to_curve import DST_G2, hash_to_g2
 
     base = _baselines()
+    if telemetry.costmodel.enabled():
+        costmodel_kernel_sweep()
     if telemetry.enabled():
         telemetry.reset()   # drop setup-phase counters; per-config blocks
 

@@ -14,6 +14,12 @@ every metric line: the bench contract cannot silently drop it.  The
 bench_bls run also sets CST_TRACE_FILE and checks the emitted Chrome
 trace is loadable trace-event JSON, and probes the MSM break-even at one
 tiny size (n=4) to keep the probe path exercised.
+
+Both sub-benches additionally run with CST_COSTMODEL=1 and assert the
+cost-model contract: the telemetry block carries a `costmodel` block
+with nonzero flops/bytes for the flagship kernel (the fused epoch step;
+the BLS round must cover the pairing/MSM/h2c/sha256 kernel surface),
+and the benchwatch store round-trips the new `costmodel` record kind.
 """
 
 from __future__ import annotations
@@ -66,19 +72,50 @@ def _check_telemetry(record, where: str) -> dict:
     return tel
 
 
+def _check_costmodel(tel, where: str, expect_substrings=()) -> dict:
+    """Assert the `costmodel` block exists, is schema-valid, carries
+    nonzero flops/bytes for at least one kernel matching each expected
+    substring, and has a coherent watermark summary."""
+    from consensus_specs_tpu.telemetry import validate_costmodel_block
+
+    cm = tel.get("costmodel")
+    problems = validate_costmodel_block(cm)
+    if problems:
+        raise SystemExit(f"{where}: bad costmodel block {problems}: "
+                         f"{json.dumps(cm)[:500]}")
+    kernels = cm["kernels"]
+    good = {k: v for k, v in kernels.items() if "error" not in v}
+    for sub in expect_substrings:
+        hits = [k for k in good if sub in k]
+        assert hits, (where, sub, sorted(kernels))
+        k = hits[0]
+        assert good[k]["flops"] > 0 and good[k]["bytes_accessed"] > 0, \
+            (where, k, good[k])
+        assert good[k]["bound"] in ("compute", "memory", "launch"), \
+            (where, k, good[k])
+    assert cm["watermarks"], (where, "no watermark samples")
+    for dev, wm in cm["watermarks"].items():
+        assert wm["high_water_bytes"] >= wm["last_bytes"] >= 0, (dev, wm)
+    return cm
+
+
 def main():
     out = _run(["bench.py", "--worker", "epoch"],
                {"CST_BENCH_N": "1024", "CST_NO_COMPILE_CACHE": "1",
-                "CST_TELEMETRY": "1"},
+                "CST_TELEMETRY": "1", "CST_COSTMODEL": "1"},
                timeout=900)
     last = out[-1]
     assert isinstance(last.get("seconds"), (int, float)) \
         and last["seconds"] > 0, last
     tel = _check_telemetry(last, "epoch worker")
     assert tel["compile_s"] > 0, tel   # the fused step DID compile
+    # the flagship kernel's cost record: nonzero XLA flop/byte budget
+    cm = _check_costmodel(tel, "epoch worker",
+                          expect_substrings=("epoch_step",))
     print("bench.py epoch worker JSON OK:",
           json.dumps({k: v for k, v in last.items() if k != "telemetry"}),
-          f"(telemetry: compile {tel['compile_s']}s run {tel['run_s']}s)")
+          f"(telemetry: compile {tel['compile_s']}s run {tel['run_s']}s; "
+          f"costmodel: {len(cm['kernels'])} kernel(s))")
 
     trace_file = HERE / "out" / "smoke_trace.json"
     trace_file.parent.mkdir(exist_ok=True)
@@ -99,7 +136,8 @@ def main():
     out = _run(["bench_bls.py"],
                {"CST_BLS_BENCH_N": "2", "CST_BLS_BENCH_COMMITTEE": "2",
                 "CST_BLS_BENCH_SYNC": "4",
-                "CST_TELEMETRY": "1", "CST_BLS_BENCH_MSM_SIZES": "4",
+                "CST_TELEMETRY": "1", "CST_COSTMODEL": "1",
+                "CST_BLS_BENCH_MSM_SIZES": "4",
                 "CST_TRACE_FILE": str(trace_file),
                 "CST_BENCHWATCH_HISTORY": str(hist_file)},
                timeout=1800)
@@ -112,6 +150,12 @@ def main():
     probe = [m for m in metrics
              if m["metric"].startswith("g1_msm_breakeven_probe")]
     assert probe and probe[0].get("detail", {}).get("4"), probe
+    # the cost-model kernel surface: RLC (device h2c), pairing, MSM,
+    # sha256 merkle + barycentric from the cost sweep — cost records
+    # are per-process, so the last metric line carries them all
+    _check_costmodel(metrics[-1]["telemetry"], "bench_bls",
+                     expect_substrings=("rlc", "pairing", "msm",
+                                        "sha256", "barycentric"))
     print("bench_bls.py JSON OK:", json.dumps(
         [{k: v for k, v in m.items() if k != "telemetry"}
          for m in metrics]))
@@ -127,18 +171,46 @@ def main():
     fresh = [r for r in hist_records
              if isinstance(r.get("ts"), (int, float))
              and r["ts"] >= run_t0 - 5]
-    stored = {r["metric"] for r in fresh}
-    assert {m["metric"] for m in metrics} <= stored, (stored, metrics)
+    stored = {r["metric"]: r for r in fresh}
+    assert {m["metric"] for m in metrics} <= set(stored), (
+        sorted(stored), metrics)
+    # the bench metric lines land as bench_emit; the same run also
+    # appends costmodel-kind records (checked in depth below) — every
+    # fresh record of either kind must be schema-valid and cpu-stamped
+    for m in metrics:
+        assert stored[m["metric"]]["source"] == "bench_emit", \
+            stored[m["metric"]]
     for rec in fresh:
         problems = benchwatch.validate_record(rec)
         assert not problems, (problems, rec)
-        assert rec["source"] == "bench_emit", rec
+        assert rec["source"] in ("bench_emit", "costmodel"), rec
         assert rec["platform"] == "cpu", rec
     probe_rec = [r for r in fresh
                  if r["metric"].startswith("g1_msm_breakeven_probe")]
     assert probe_rec and probe_rec[0].get("detail", {}).get("4"), probe_rec
     print(f"benchwatch history OK: {len(fresh)} records this run -> "
           f"{hist_file}")
+
+    # the new `costmodel` record kind round-trips: one schema-valid
+    # record per captured kernel plus the per-device memory high-water
+    # marks, all re-loadable through the same history reader
+    cost_recs = [r for r in hist_records if r.get("source") == "costmodel"]
+    cost_kernels = [r for r in cost_recs
+                    if r["metric"].startswith("costmodel::")]
+    wm_recs = [r for r in cost_recs
+               if r["metric"].startswith("device_mem_high_water::")]
+    assert cost_kernels, [r["metric"] for r in hist_records]
+    assert wm_recs, [r["metric"] for r in cost_recs]
+    for rec in cost_recs:
+        assert not benchwatch.validate_record(rec), rec
+    names = {r["metric"] for r in cost_kernels}
+    for sub in ("rlc", "pairing", "msm", "sha256", "barycentric"):
+        assert any(sub in n for n in names), (sub, sorted(names))
+    for rec in cost_kernels:
+        cm = rec.get("costmodel")
+        assert isinstance(cm, dict) and cm.get("flops", 0) > 0, rec
+    print(f"costmodel history OK: {len(cost_kernels)} kernel record(s), "
+          f"{len(wm_recs)} watermark record(s)")
 
     # CST_TRACE_FILE must have produced loadable Chrome trace-event JSON
     trace = json.loads(trace_file.read_text())
@@ -149,7 +221,15 @@ def main():
         assert {"name", "ph", "ts", "dur", "pid", "tid"} <= set(e), e
     names = {e["name"] for e in spans}
     assert "bls.batch_verify" in names, sorted(names)
-    print(f"chrome trace OK: {len(spans)} spans -> {trace_file}")
+    # cost-model counter track: watermark samples + per-kernel cost
+    # records ride as 'C' (counter) events alongside the span track
+    counters = [e for e in events if e.get("ph") == "C"]
+    counter_names = {e["name"] for e in counters}
+    assert "device_memory_bytes" in counter_names, sorted(counter_names)
+    assert any(n.startswith("cost.") for n in counter_names), \
+        sorted(counter_names)
+    print(f"chrome trace OK: {len(spans)} spans + {len(counters)} "
+          f"counter events -> {trace_file}")
 
     # telemetry-OFF contract: the default path (what a non-telemetry
     # TPU round runs) must emit the plain 2-metric lines — no
@@ -158,7 +238,8 @@ def main():
     out = _run(["bench_bls.py"],
                {"CST_BLS_BENCH_N": "2", "CST_BLS_BENCH_COMMITTEE": "2",
                 "CST_BLS_BENCH_SYNC": "4",
-                "CST_TELEMETRY": "", "CST_TRACE_FILE": ""},
+                "CST_TELEMETRY": "", "CST_TRACE_FILE": "",
+                "CST_COSTMODEL": ""},
                timeout=1800)
     metrics = [o for o in out if "metric" in o]
     assert len(metrics) == 2, out

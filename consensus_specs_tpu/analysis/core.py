@@ -72,6 +72,10 @@ RULE_IDS = {
     "instr-uncovered-entry":
         "public kernel entry point without a telemetry span/counter — "
         "new kernels must not land unobservable",
+    "instr-uncovered-cost":
+        "public device-kernel entry point that never passes through "
+        "the cost-capture seam (_dispatch or costmodel.capture) — the "
+        "kernel stays invisible to the roofline/utilization layer",
 }
 
 # --- file roles (which rule families run where) ------------------------------
@@ -99,9 +103,13 @@ KERNEL_FILES = LIMB_FILES + (
     "ops/sha256_jax.py", "ops/fr_batch.py", "parallel/epoch.py",
     "parallel/merkle.py",
 )
-# kernel entry-point surface: analyzed as an ordered pair so the facade
-# (ops/bls) can credit calls into the already-covered bls_batch entries
-INSTR_FILES = ("ops/bls_batch/__init__.py", "ops/bls/__init__.py")
+# kernel entry-point surface: analyzed in chain order so the facade
+# (ops/bls) can credit calls into the already-covered bls_batch
+# entries; sha256_jax and fr_batch joined the surface with the
+# cost-capture rule (instr-uncovered-cost) — their device entry points
+# must stay visible to the roofline layer too
+INSTR_FILES = ("ops/bls_batch/__init__.py", "ops/bls/__init__.py",
+               "ops/sha256_jax.py", "ops/fr_batch.py")
 
 # shape-laundering functions: a value that went through one of these is
 # a bucketed compile key, not a raw dimension
@@ -609,10 +617,12 @@ def _apply_suppressions(model: ModuleModel,
 def analyze_source(src: str, path: str = "<snippet>",
                    roles: frozenset = ALL_ROLES,
                    external_covered: frozenset = frozenset(),
-                   external_device: frozenset = frozenset()) -> Report:
+                   external_device: frozenset = frozenset(),
+                   external_cost: frozenset = frozenset()) -> Report:
     """Analyze one module's source under the given roles.  Returns the
-    suppression-resolved report; `external_covered`/`external_device`
-    feed the instrumentation rule's cross-module resolution."""
+    suppression-resolved report; `external_covered`/`external_device`/
+    `external_cost` feed the instrumentation rules' cross-module
+    resolution."""
     from . import dtype, hostsync, instrumentation, recompile
 
     model = ModuleModel(src, path, roles)
@@ -624,7 +634,7 @@ def analyze_source(src: str, path: str = "<snippet>",
         findings += dtype.check(model)
     if ROLE_INSTR in roles:
         findings += instrumentation.check(
-            model, external_covered, external_device)[0]
+            model, external_covered, external_device, external_cost)[0]
     return _apply_suppressions(model, findings)
 
 
@@ -652,14 +662,15 @@ def _instr_chain(root: Path | None = None):
     """The ONE implementation of the ordered instrumentation pass over
     INSTR_FILES (ops/bls_batch first, so the facade's calls into its
     covered entry points count as coverage).  Returns, per file:
-    (resolved_path, model, findings, entry_covered, entry_device) where
-    the entry sets are the chained inputs that file's pass started
-    from — both the tree run and spot runs consume this."""
+    (resolved_path, model, findings, entry_covered, entry_device,
+    entry_cost) where the entry sets are the chained inputs that file's
+    pass started from — both the tree run and spot runs consume this."""
     from . import instrumentation
 
     root = Path(root) if root is not None else PKG_ROOT
     covered: frozenset = frozenset()
     device: frozenset = frozenset()
+    cost: frozenset = frozenset()
     out = []
     for rel in INSTR_FILES:
         path = root / rel
@@ -668,9 +679,12 @@ def _instr_chain(root: Path | None = None):
         model = ModuleModel(path.read_text(),
                             str(path.relative_to(root.parent)),
                             frozenset({ROLE_INSTR}))
-        findings, cov, dev = instrumentation.check(model, covered, device)
-        out.append((path.resolve(), model, findings, covered, device))
-        covered, device = frozenset(cov), frozenset(dev)
+        findings, cov, dev, cst = instrumentation.check(
+            model, covered, device, cost)
+        out.append((path.resolve(), model, findings, covered, device,
+                    cost))
+        covered, device, cost = (frozenset(cov), frozenset(dev),
+                                 frozenset(cst))
     return out
 
 
@@ -683,7 +697,7 @@ def analyze_tree(root: Path | None = None) -> Report:
         rel = str(path.relative_to(repo))
         report.extend(analyze_source(path.read_text(), rel, roles))
 
-    for _, model, findings, _, _ in _instr_chain(root):
+    for _, model, findings, _, _, _ in _instr_chain(root):
         sub = _apply_suppressions(model, findings)
         sub.files = 0           # already counted in the device pass
         report.extend(sub)
@@ -708,8 +722,8 @@ def main(argv=None) -> int:
         # test fixture — gets every rule family
         tree_roles = {p.resolve(): roles
                       for p, roles in _tree_files(PKG_ROOT)}
-        instr_inputs = {path: (cov, dev) for path, _, _, cov, dev
-                        in _instr_chain()}
+        instr_inputs = {path: (cov, dev, cst)
+                        for path, _, _, cov, dev, cst in _instr_chain()}
         report = Report([], [])
         for arg in argv:
             p = Path(arg)
@@ -721,12 +735,12 @@ def main(argv=None) -> int:
             try:
                 resolved = p.resolve()
                 roles = tree_roles.get(resolved, ALL_ROLES)
-                ext_cov, ext_dev = instr_inputs.get(
-                    resolved, (frozenset(), frozenset()))
+                ext_cov, ext_dev, ext_cost = instr_inputs.get(
+                    resolved, (frozenset(), frozenset(), frozenset()))
                 if resolved in instr_inputs:
                     roles = roles | {ROLE_INSTR}
                 report.extend(analyze_source(src, str(p), roles,
-                                             ext_cov, ext_dev))
+                                             ext_cov, ext_dev, ext_cost))
             except SyntaxError as exc:
                 print(f"{p}: not parseable python ({exc})",
                       file=sys.stderr)

@@ -3,20 +3,30 @@
 PR 2's telemetry layer answers the ROADMAP's perf questions only while
 every kernel entry point reports into it; a new kernel that lands
 without a span or counter is invisible to the compile/run split, the
-padding-waste accounting and the routing counters.  This rule makes
-that a lint invariant on the two public kernel surfaces
-(`ops/bls_batch/__init__.py`, `ops/bls/__init__.py`):
+padding-waste accounting and the routing counters.  This rule family
+makes that a lint invariant on the kernel surfaces named by
+`core.INSTR_FILES`:
 
+instr-uncovered-entry
     every PUBLIC function (or public method of a public class) that
     reaches a device dispatch — `_dispatch(...)`, a jit factory, a
     jit-decorated local, or a covered bls_batch entry — must open a
     `telemetry.span(...)` / `telemetry.count(...)` either directly or
     via a same-surface function it calls.
 
+instr-uncovered-cost
+    the same reach set must also pass through the COST-capture seam —
+    `_dispatch(...)` (which embeds it) or a `costmodel.*` call
+    (`costmodel.capture`, `costmodel.sample_watermark`) — directly or
+    transitively, so every kernel stays visible to the roofline /
+    utilization layer (`telemetry/costmodel.py`).  Intentional gaps are
+    allow-annotated with a reason, like every other rule.
+
 Coverage propagates along the local call graph (a facade function that
-delegates to `bls_batch.batch_verify` is covered by the span inside
-`batch_verify`), which is why the tree runner analyzes `ops/bls_batch`
-first and feeds its covered entry names into the facade's pass.
+delegates to `bls_batch.batch_verify` is covered by the span — and the
+capture seam — inside `batch_verify`), which is why the tree runner
+analyzes `ops/bls_batch` first and feeds its covered entry names into
+the facade's pass.
 """
 
 from __future__ import annotations
@@ -67,9 +77,10 @@ def _imported_device_names(model: ModuleModel) -> tuple[set[str], set[str]]:
 
 
 def check(model: ModuleModel, external_covered=frozenset(),
-          external_device=frozenset()):
-    """Returns (findings, covered_public_names, device_public_names)
-    so the tree runner can chain the bls_batch -> bls facade pair."""
+          external_device=frozenset(), external_cost=frozenset()):
+    """Returns (findings, covered_public_names, device_public_names,
+    cost_public_names) so the tree runner can chain the bls_batch ->
+    bls facade pair (and onward)."""
     funcs = _functions(model)
     by_name: dict[str, list] = {}
     for qual, node, _ in funcs:
@@ -77,6 +88,7 @@ def check(model: ModuleModel, external_covered=frozenset(),
     imported_dev, dev_aliases = _imported_device_names(model)
 
     telemetry_direct: set = set()
+    cost_direct: set = set()
     reaches_device: set = set()
     calls: dict = {n: set() for _, n, _ in funcs}
 
@@ -86,12 +98,23 @@ def check(model: ModuleModel, external_covered=frozenset(),
             if not isinstance(node, ast.Call):
                 continue
             fd = _dotted(node.func)
+            # the cost-capture seam, however the module spells the
+            # import — ONLY the seam calls count: a bare
+            # costmodel.enabled() gate must not silence the rule
+            if fd:
+                parts = fd.split(".")
+                if "costmodel" in parts[:-1] and parts[-1] in (
+                        "capture", "record_cost", "sample_watermark"):
+                    cost_direct.add(fn)
+                    continue
             if fd and fd.startswith("telemetry."):
                 telemetry_direct.add(fn)
                 continue
-            # device dispatch sites
+            # device dispatch sites (_dispatch also embeds the
+            # cost-capture seam)
             if fd == "_dispatch":
                 reaches_device.add(fn)
+                cost_direct.add(fn)
             elif isinstance(node.func, ast.Name):
                 name = node.func.id
                 if name in model.jit_factories or name in aliases:
@@ -116,6 +139,9 @@ def check(model: ModuleModel, external_covered=frozenset(),
                 if (isinstance(base, ast.Name) and base.id in dev_aliases
                         and attr in external_covered):
                     telemetry_direct.add(fn)
+                if (isinstance(base, ast.Name) and base.id in dev_aliases
+                        and attr in external_cost):
+                    cost_direct.add(fn)
                 # method / local resolution by bare attribute name
                 for callee in by_name.get(attr, []):
                     calls[fn].add(callee)
@@ -124,9 +150,15 @@ def check(model: ModuleModel, external_covered=frozenset(),
                     and node.func.id in imported_dev
                     and node.func.id in external_covered):
                 telemetry_direct.add(fn)
+            if (isinstance(node.func, ast.Name)
+                    and node.func.id in imported_dev
+                    and node.func.id in external_cost):
+                cost_direct.add(fn)
 
-    # propagate coverage and device reach over the local call graph
+    # propagate coverage, cost coverage and device reach over the
+    # local call graph
     covered = set(telemetry_direct)
+    cost_covered = set(cost_direct)
     reach = set(reaches_device)
     changed = True
     while changed:
@@ -134,6 +166,9 @@ def check(model: ModuleModel, external_covered=frozenset(),
         for _, fn, _ in funcs:
             if fn not in covered and calls[fn] & covered:
                 covered.add(fn)
+                changed = True
+            if fn not in cost_covered and calls[fn] & cost_covered:
+                cost_covered.add(fn)
                 changed = True
             if fn not in reach and calls[fn] & reach:
                 reach.add(fn)
@@ -148,9 +183,19 @@ def check(model: ModuleModel, external_covered=frozenset(),
                 f"device without opening a telemetry span/counter — "
                 f"new kernels must stay observable (see README "
                 f"Telemetry)"))
+        if public and fn in reach and fn not in cost_covered:
+            findings.append(Finding(
+                model.path, fn.lineno, "instr-uncovered-cost",
+                f"public device-kernel entry point {qual}() never "
+                f"passes through the cost-capture seam (_dispatch or "
+                f"costmodel.capture) — the kernel stays invisible to "
+                f"the roofline/utilization layer (see README Cost "
+                f"model)"))
 
     covered_public = {qual.split(".")[-1] for qual, fn, public in funcs
                       if public and fn in covered}
     device_public = {qual.split(".")[-1] for qual, fn, public in funcs
                      if public and fn in reach}
-    return findings, covered_public, device_public
+    cost_public = {qual.split(".")[-1] for qual, fn, public in funcs
+                   if public and fn in cost_covered}
+    return findings, covered_public, device_public, cost_public

@@ -25,6 +25,7 @@ milagro calls inside `state_transition` (specs/phase0/beacon-chain.md
 from __future__ import annotations
 
 from . import telemetry
+from .telemetry import costmodel
 from .ops import bls
 
 
@@ -38,23 +39,32 @@ def state_transition_batched(spec, state, signed_block,
 
     Each phase (slot advance, block body, batch settle, state-root
     check) runs under a telemetry span, so a `CST_TRACE_FILE` capture of
-    a block import decomposes into per-phase wall time."""
+    a block import decomposes into per-phase wall time; on
+    CST_COSTMODEL rounds each phase boundary also samples the
+    per-device live-buffer watermark (`costmodel.sample_watermark`), so
+    the same capture shows where device-memory pressure peaks inside a
+    block import."""
     block = signed_block.message
     with telemetry.span("executor.state_transition_batched",
                         slot=int(block.slot)):
+        costmodel.sample_watermark("executor.start")
         with telemetry.span("executor.process_slots"):
             spec.process_slots(state, block.slot)
+        costmodel.sample_watermark("executor.process_slots")
         if validate_result:
             with telemetry.span("executor.verify_block_signature"):
                 assert spec.verify_block_signature(state, signed_block)
         with bls.deferred_batch_verification() as batch:
             with telemetry.span("executor.process_block"):
                 spec.process_block(state, block)
+        costmodel.sample_watermark("executor.process_block")
         with telemetry.span("executor.batch_settle",
                             statements=len(batch.tasks)):
             ok = batch.verify(device=device)
+        costmodel.sample_watermark("executor.batch_settle")
         assert ok, "batched aggregate-signature verification failed"
         if validate_result:
             with telemetry.span("executor.state_root_check"):
                 assert block.state_root == spec.hash_tree_root(state)
+            costmodel.sample_watermark("executor.state_root_check")
     return state

@@ -65,6 +65,7 @@ import time
 import numpy as np
 
 from ... import telemetry
+from ...telemetry import costmodel
 from ..bls import curve as _pycurve
 from ..bls.hash_to_curve import DST_G2, hash_to_g2
 from . import curve_jax as cj
@@ -107,7 +108,12 @@ def _dispatch(kernel: str, fn, args):
     trace + XLA compile (or a persistent-cache load — visible as an
     anomalously cheap first call), later dispatches are pure run.  Off
     (the default) this is a flag check and a tail call — no sync, no
-    timing."""
+    timing.
+
+    This is also the cost-capture seam: on CST_COSTMODEL rounds the
+    first dispatch of each (kernel, shape) additionally records XLA's
+    cost/memory analysis for the compiled executable and samples the
+    per-device memory watermark (both no-op flag checks otherwise)."""
     if not telemetry.enabled():
         return fn(*args)
     import jax
@@ -120,6 +126,11 @@ def _dispatch(kernel: str, fn, args):
     telemetry.observe(f"kernel.{which}", dt)
     telemetry.observe(f"kernel.{kernel}.{which}", dt)
     telemetry.count(f"kernel.{kernel}.calls")
+    if first:
+        # after the timing window: the AOT analysis pass must not
+        # contaminate the compile-vs-run attribution above
+        costmodel.capture(kernel, fn, args)
+    costmodel.sample_watermark(f"kernel.{kernel}")
     return out
 
 
@@ -589,10 +600,17 @@ def batch_verify_sharded(tasks, n_devices: int | None = None,
                         devices=n_devices, per_shard=per_shard):
         telemetry.count("bls.batch_verify_sharded.calls")
         _count_lanes(n, n_devices * per_shard)
+        jargs = tuple(jnp.asarray(a) for a in arrays)
         # cst: allow(recompile-unbucketed-dim): the device count keys
         # the executable — one value per host topology, not per batch
-        out = _rlc_kernel_sharded(n_devices, per_shard, axis)(
-            *(jnp.asarray(a) for a in arrays))
+        kernel = _rlc_kernel_sharded(n_devices, per_shard, axis)
+        out = kernel(*jargs)
+    # cost-capture seam, outside the span so the AOT analysis pass does
+    # not contaminate the measured wall (capture degrades to an error
+    # record if the backend cannot analyze the mesh-sharded executable)
+    costmodel.capture(f"rlc_sharded@{n_devices}x{per_shard}",
+                      kernel, jargs)
+    costmodel.sample_watermark("bls.batch_verify_sharded")
     # cst: allow(host-sync-coerce): single accept/reject bool fetched at
     # the API boundary — callers need a host answer
     return bool(out)

@@ -28,6 +28,9 @@ import functools
 
 import numpy as np
 
+from .. import telemetry
+from ..telemetry import costmodel
+
 LIMB_BITS = 12
 N_LIMBS = 33
 LIMB_MASK = (1 << LIMB_BITS) - 1
@@ -233,14 +236,20 @@ def barycentric_eval(poly_ints, roots_brp_ints, z_int) -> int:
     width = len(poly_ints)
     assert width == len(roots_brp_ints)
     jnp = _jnp()
-    poly = jnp.asarray(FR.to_mont_batch([int(v) for v in poly_ints]))
-    roots = jnp.asarray(_roots_mont(tuple(int(r)
-                                          for r in roots_brp_ints)))
-    z = jnp.asarray(FR.to_mont(int(z_int)))
     # cst: allow(recompile-unbucketed-dim): width is the KZG evaluation
     # domain size — fixed per preset (4096 mainnet / 4 minimal), so the
     # lru-cached kernel compiles once per process in practice
-    out = _barycentric_kernel(width)(poly, roots, z)
+    kfn = _barycentric_kernel(width)
+    with telemetry.span("fr.barycentric_eval", width=width):
+        telemetry.count("fr.barycentric_eval.calls")
+        poly = jnp.asarray(FR.to_mont_batch([int(v) for v in poly_ints]))
+        roots = jnp.asarray(_roots_mont(tuple(int(r)
+                                              for r in roots_brp_ints)))
+        z = jnp.asarray(FR.to_mont(int(z_int)))
+        out = kfn(poly, roots, z)
+    # cost-capture seam (CST_COSTMODEL rounds), outside the span: the
+    # AOT analysis pass must not contaminate the measured wall
+    costmodel.capture(f"barycentric@{width}", kfn, (poly, roots, z))
     # cst: allow(host-sync-np): the evaluated field element returns to
     # the host KZG library — one fetch per evaluation by contract
     return FR.from_mont(np.asarray(out))

@@ -23,6 +23,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from .. import telemetry
+from ..telemetry import costmodel
 from .sha256_np import _IV, _K, _PAD64, ZERO_HASH_WORDS
 from .sha256_np import sha256_64B_words as _host_sha256_64B
 
@@ -142,12 +144,19 @@ def merkleize_words_jax(words: np.ndarray, limit_depth: int,
     d = max(n - 1, 0).bit_length()
     padded = np.zeros((1 << d, 8), dtype=np.uint32)
     padded[:n] = words
-    # cst: allow(recompile-unbucketed-dim): the static tree depth keys
-    # the executable — log-bounded (<= limit_depth distinct compiles),
-    # and each depth's program is a small rolled loop
-    # cst: allow(host-sync-np): single root fetch — this is the host
-    # API boundary of the device reduction
-    root = np.asarray(merkle_root_pow2(jnp.asarray(padded), d, unroll))
+    with telemetry.span("sha256.merkleize_words", depth=d):
+        dev_words = jnp.asarray(padded)
+        # cst: allow(recompile-unbucketed-dim): the static tree depth keys
+        # the executable — log-bounded (<= limit_depth distinct compiles),
+        # and each depth's program is a small rolled loop
+        # cst: allow(host-sync-np): single root fetch — this is the host
+        # API boundary of the device reduction
+        root = np.asarray(merkle_root_pow2(dev_words, d, unroll))
+    # cost-capture seam (CST_COSTMODEL rounds): flop/byte budget of the
+    # depth-d reduction, once per depth per process — outside the span
+    # so the AOT analysis pass does not contaminate the measured wall
+    costmodel.capture(f"sha256_merkle@d{d}", merkle_root_pow2,
+                      (dev_words, d, unroll))
     for lvl in range(d, limit_depth):
         blk = np.concatenate([root, ZERO_HASH_WORDS[lvl]]).astype(np.uint32)
         root = _host_sha256_64B(blk[None, :])[0]

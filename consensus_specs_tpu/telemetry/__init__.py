@@ -33,6 +33,15 @@ Surface:
     write_jsonl(path), write_chrome_trace(path), chrome_trace()
     bench_block(), validate_bench_block()   the bench JSON sub-object
 
+Cost model (`costmodel` submodule, gated CST_TELEMETRY + CST_COSTMODEL):
+per-kernel XLA cost/memory analysis (`costmodel.capture`), roofline
+utilization + compute/memory/launch-bound classification against the
+per-backend peak registry (`costmodel.block`), and per-device live-
+buffer watermarks sampled at span boundaries
+(`costmodel.sample_watermark`).  Flows into `snapshot()["costmodel"]`,
+the bench `"telemetry"` sub-object, the Chrome trace ('C' counter
+events), and the benchwatch report's Utilization section.
+
 Benchwatch (longitudinal layer, not re-exported here): `history.py`
 ingests bench/telemetry rounds into the schema-versioned
 `out/bench_history.jsonl` store, and `python -m
@@ -43,6 +52,7 @@ Zero dependencies (stdlib only); never imports jax, numpy, or any spec
 module — safe to import from anywhere, including before backend pinning.
 """
 
+from . import costmodel
 from .core import (
     add_event,
     configure,
@@ -62,13 +72,15 @@ from .export import (
     chrome_trace,
     embed_bench_block,
     validate_bench_block,
+    validate_costmodel_block,
     write_chrome_trace,
     write_jsonl,
 )
 
 __all__ = [
-    "add_event", "configure", "count", "counter_value", "enabled",
-    "first_call", "observe", "reset", "set_meta", "snapshot", "span",
-    "span_seconds", "bench_block", "chrome_trace", "embed_bench_block",
-    "validate_bench_block", "write_chrome_trace", "write_jsonl",
+    "add_event", "configure", "costmodel", "count", "counter_value",
+    "enabled", "first_call", "observe", "reset", "set_meta", "snapshot",
+    "span", "span_seconds", "bench_block", "chrome_trace",
+    "embed_bench_block", "validate_bench_block",
+    "validate_costmodel_block", "write_chrome_trace", "write_jsonl",
 ]

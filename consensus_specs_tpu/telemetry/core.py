@@ -123,6 +123,12 @@ def reset(full: bool = False) -> None:
             _events.clear()
             _first_keys.clear()
             _events_dropped = 0
+    if full:
+        # cost records and watermarks are process-level facts (like the
+        # first-call keys they attribute against): per-config resets
+        # keep them, full test-isolation resets wipe them too
+        from . import costmodel
+        costmodel._reset_state()
 
 
 # --- recording primitives ---------------------------------------------------
@@ -350,10 +356,12 @@ def snapshot() -> dict:
          "counters":   {str: int},
          "histograms": {str: {"count","total","min","max"}},
          "spans":      {str: {"count","total_s","min_s","max_s"}},
-         "events": int, "events_dropped": int}
+         "events": int, "events_dropped": int,
+         "costmodel": {"kernels": {...}, "watermarks": {...},
+                       "wm_events": int, "wm_events_dropped": int}}
     """
     with _lock:
-        return {
+        snap = {
             "enabled": _enabled,
             "meta": dict(_meta),
             "counters": dict(_counters),
@@ -362,6 +370,11 @@ def snapshot() -> dict:
             "events": len(_events),
             "events_dropped": _events_dropped,
         }
+    # outside _lock: the cost-model registry has its own lock, and its
+    # snapshot must not nest under ours (lock-order discipline)
+    from . import costmodel
+    snap["costmodel"] = costmodel.raw_snapshot()
+    return snap
 
 
 def _events_copy() -> tuple[list[dict], int]:

@@ -37,7 +37,12 @@ def write_jsonl(path: str) -> int:
 
 def chrome_trace() -> dict:
     """The trace-event JSON object: buffered spans as 'X' (complete)
-    events plus process/thread metadata, all on one pid."""
+    events, cost-model watermark samples and per-kernel cost records as
+    'C' (counter) events — so the Perfetto timeline shows device-memory
+    pressure and kernel flop/byte budgets alongside the span track —
+    plus process/thread metadata, all on one pid."""
+    from . import costmodel
+
     events, dropped = core._events_copy()
     pid = os.getpid()
     out = [{
@@ -51,9 +56,28 @@ def chrome_trace() -> dict:
             "ts": round(e["ts"], 3), "dur": round(e["dur"], 3),
             "args": e["args"],
         })
+    wm_events, wm_dropped = costmodel._wm_events_copy()
+    for w in wm_events:
+        # one counter series per device: Perfetto renders each args key
+        # as its own track under the "device_memory_bytes" counter
+        out.append({
+            "name": "device_memory_bytes", "ph": "C", "cat": "cst",
+            "pid": pid, "tid": 0, "ts": round(w["ts"], 3),
+            "args": {dev: b for dev, b in w["bytes"].items()},
+        })
+    for c in costmodel._cost_events_copy():
+        if "error" in c:
+            continue
+        out.append({
+            "name": f"cost.{c['kernel']}", "ph": "C", "cat": "cst",
+            "pid": pid, "tid": 0,
+            "ts": round(c.get("ts_rel_us", 0.0), 3),
+            "args": {"flops": c.get("flops", 0.0),
+                     "bytes_accessed": c.get("bytes_accessed", 0.0)},
+        })
     trace = {"traceEvents": out, "displayTimeUnit": "ms"}
-    if dropped:
-        trace["otherData"] = {"events_dropped": dropped}
+    if dropped or wm_dropped:
+        trace["otherData"] = {"events_dropped": dropped + wm_dropped}
     return trace
 
 
@@ -85,6 +109,8 @@ def bench_block(compile_s: float | None = None,
     histograms (`kernel.compile_first_s` / `kernel.run_s` — see
     `core.first_call`); a bench that times its own jit entry point
     (bench.py's epoch `step`) passes explicit values instead."""
+    from . import costmodel
+
     snap = core.snapshot()
     h = snap["histograms"]
     c = snap["counters"]
@@ -94,7 +120,8 @@ def bench_block(compile_s: float | None = None,
         run_s = h.get("kernel.run_s", {}).get("total", 0.0)
     live = c.get("bls.lanes.live", 0)
     padded = c.get("bls.lanes.padded", 0)
-    return {
+    cm = costmodel.block(h)
+    out = {
         "compile_s": round(float(compile_s), 4),
         "run_s": round(float(run_s), 4),
         # process-level meta (compile-cache dir + entry count, ...) —
@@ -115,6 +142,9 @@ def bench_block(compile_s: float | None = None,
         },
         "counters": snap["counters"],
     }
+    if cm is not None:   # CST_COSTMODEL rounds: joined roofline records
+        out["costmodel"] = cm
+    return out
 
 
 def validate_bench_block(obj) -> list[str]:
@@ -154,6 +184,55 @@ def validate_bench_block(obj) -> list[str]:
         problems.append("'counters' must be a dict")
     if not isinstance(obj.get("meta", {}), dict):
         problems.append("'meta' must be a dict when present")
+    cm = obj.get("costmodel")
+    if cm is not None:
+        problems.extend(validate_costmodel_block(cm))
+    return problems
+
+
+_BOUNDS = ("compute", "memory", "launch", "unknown")
+
+
+def validate_costmodel_block(cm) -> list[str]:
+    """Schema check for the `"costmodel"` sub-object (CST_COSTMODEL
+    rounds); returns problems (empty == valid).  Error records (capture
+    failed, reason attached) are valid by design — a kernel the backend
+    cannot analyze must stay visible, not break the contract."""
+    problems: list[str] = []
+    if not isinstance(cm, dict):
+        return [f"costmodel block is {type(cm).__name__}, not dict"]
+    kernels = cm.get("kernels")
+    if not isinstance(kernels, dict):
+        problems.append("costmodel['kernels'] must be a dict")
+        kernels = {}
+    for name, rec in kernels.items():
+        if not isinstance(rec, dict):
+            problems.append(f"costmodel kernel {name!r} must be a dict")
+            continue
+        if "error" in rec:
+            continue
+        for key in ("flops", "bytes_accessed"):
+            v = rec.get(key)
+            if not isinstance(v, (int, float)) or isinstance(v, bool) \
+                    or v < 0:
+                problems.append(f"costmodel kernel {name!r}: {key!r} "
+                                f"must be a non-negative number, got {v!r}")
+        if rec.get("bound") not in _BOUNDS:
+            problems.append(f"costmodel kernel {name!r}: 'bound' must "
+                            f"be one of {_BOUNDS}, got {rec.get('bound')!r}")
+    wms = cm.get("watermarks")
+    if not isinstance(wms, dict):
+        problems.append("costmodel['watermarks'] must be a dict")
+        wms = {}
+    for dev, wm in wms.items():
+        if not isinstance(wm, dict) or not isinstance(
+                wm.get("high_water_bytes"), int):
+            problems.append(f"costmodel watermark {dev!r} must carry an "
+                            f"int 'high_water_bytes'")
+        elif isinstance(wm.get("last_bytes"), int) \
+                and wm["last_bytes"] > wm["high_water_bytes"]:
+            problems.append(f"costmodel watermark {dev!r}: high water "
+                            f"below last sample")
     return problems
 
 

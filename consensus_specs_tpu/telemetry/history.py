@@ -15,7 +15,7 @@ Record schema (version `SCHEMA`; one JSON object per line):
 
     {"schema": 1,
      "source": "bench_round" | "multichip_round" | "baseline"
-               | "bench_emit" | "pytest_snapshot",
+               | "bench_emit" | "pytest_snapshot" | "costmodel",
      "metric": str,              # e.g. "attestation_batch_128x64_verify_wall"
      "value":  float | None,     # the measurement (unit below)
      "unit":   str,              # "s", "us", "bool", ...
@@ -29,6 +29,10 @@ Record schema (version `SCHEMA`; one JSON object per line):
      "telemetry": dict,          # compact compile_s/run_s/padding/routing
      "detail": dict,             # msm break-even per-size table
      "msm_device_min": int,
+     "costmodel": dict,          # one kernel's joined roofline record
+                                 # (source "costmodel" only; metric
+                                 # "costmodel::<kernel>" per kernel plus
+                                 # "device_mem_high_water::<device>")
      "ts": float}                # wall-clock stamp (live emissions only)
 
 Robustness contract (pinned by tests/test_benchwatch.py): malformed or
@@ -52,7 +56,7 @@ from pathlib import Path
 SCHEMA = 1
 
 SOURCES = ("bench_round", "multichip_round", "baseline", "bench_emit",
-           "pytest_snapshot")
+           "pytest_snapshot", "costmodel")
 
 _ROUND_FILE_RE = re.compile(r"(?:BENCH|MULTICHIP)_r(\d+)\.json$")
 
@@ -128,12 +132,51 @@ def _canonical_line(rec: dict) -> str:
 
 def _compact_telemetry(tel) -> dict | None:
     """The compile/run + padding + routing core of a bench telemetry
-    sub-object; the full counter registry stays in the round file."""
+    sub-object; the full counter registry stays in the round file.  The
+    `costmodel` watermark summary rides along compactly (per-kernel
+    cost records become their own `costmodel`-source records instead —
+    see `costmodel_records`)."""
     if not isinstance(tel, dict):
         return None
     out = {k: tel[k] for k in ("compile_s", "run_s", "padding", "routing")
            if k in tel}
+    cm = tel.get("costmodel")
+    if isinstance(cm, dict) and isinstance(cm.get("watermarks"), dict) \
+            and cm["watermarks"]:
+        out["watermarks"] = cm["watermarks"]
     return out or None
+
+
+def costmodel_records(metric: str, tel, **context) -> list[dict]:
+    """Per-kernel `costmodel`-source history records mined from one
+    metric line's telemetry sub-object (joined roofline records from
+    `telemetry.costmodel.block`).  Malformed blocks yield zero records,
+    never an exception — same degradation policy as every other parser
+    here.  `context` carries provenance (round/file/rc/platform/ts)."""
+    if not isinstance(tel, dict):
+        return []
+    cm = tel.get("costmodel")
+    if not isinstance(cm, dict) or not isinstance(cm.get("kernels"), dict):
+        return []
+    records = []
+    for kernel, rec in sorted(cm["kernels"].items()):
+        if not isinstance(rec, dict) or "error" in rec:
+            continue
+        run_s = rec.get("run_s_mean")
+        records.append(make_record(
+            "costmodel", f"costmodel::{kernel}",
+            run_s if isinstance(run_s, (int, float)) else None,
+            unit="s", costmodel=rec, via_metric=metric, **context))
+    wms = cm.get("watermarks")
+    if isinstance(wms, dict):
+        for dev, wm in sorted(wms.items()):
+            if isinstance(wm, dict) and isinstance(
+                    wm.get("high_water_bytes"), int):
+                records.append(make_record(
+                    "costmodel", f"device_mem_high_water::{dev}",
+                    wm["high_water_bytes"], unit="bytes",
+                    samples=wm.get("samples"), **context))
+    return records
 
 
 # --- bench round tails -------------------------------------------------------
@@ -203,6 +246,11 @@ def parse_bench_round(path) -> tuple[list[dict], list[str]]:
         fingerprint = float(fm.group(1) or fm.group(2))
 
     records: list[dict] = []
+    # cost records are cumulative per-process facts, so every metric
+    # line in a round carries (a superset of) the previous line's
+    # costmodel block — keep ONE record per kernel/device, last line
+    # wins (it has the most dispatches joined in)
+    cost_by_metric: dict[str, dict] = {}
     merged = _merge_metric_lines(_tail_json_lines(tail))
     for name, obj in merged.items():
         rec = make_record(
@@ -219,6 +267,11 @@ def parse_bench_round(path) -> tuple[list[dict], list[str]]:
         if name == "mainnet_epoch_sweep_1m_validators_wall" and fingerprint:
             rec["baseline_us_per_validator"] = fingerprint
         records.append(rec)
+        for crec in costmodel_records(
+                name, obj.get("telemetry"), round=rnd, file=path.name,
+                rc=rc, platform=obj.get("platform")):
+            cost_by_metric[crec["metric"]] = crec
+    records.extend(cost_by_metric.values())
 
     # compile+first walls from the stderr log lines; a metric record's
     # telemetry block is the second source when the log line is gone
@@ -476,6 +529,15 @@ def emission_platform() -> str:
     return os.environ.get("JAX_PLATFORMS") or "tpu"
 
 
+# live-emission costmodel dedupe: a bench process emits one metric line
+# per config, but cost records are cumulative per-process facts — each
+# later line carries (a superset of) the previous block, and the fresh
+# `ts`/`via_metric` stamps would defeat the store's canonical-line
+# dedupe.  Re-emit a kernel/watermark record only when its payload
+# actually changed (more dispatches joined in, high-water moved).
+_emitted_cost_payloads: dict[str, str] = {}
+
+
 def emission_records(metric_line: dict, ts: float | None = None
                      ) -> list[dict]:
     """Normalize one live bench stdout line (a bench_bls metric record,
@@ -484,16 +546,27 @@ def emission_records(metric_line: dict, ts: float | None = None
     distinct."""
     records = []
     for name, obj in _merge_metric_lines([metric_line]).items():
+        platform = obj.get("platform") or emission_platform()
         records.append(make_record(
             "bench_emit", name, obj.get("value"),
             unit=obj.get("unit", "s"),
             vs_baseline=obj.get("vs_baseline"),
-            platform=obj.get("platform") or emission_platform(),
+            platform=platform,
             telemetry=_compact_telemetry(obj.get("telemetry")),
             detail=obj.get("detail"),
             msm_device_min=obj.get("msm_device_min"),
             error=obj.get("error"),
             ts=round(ts, 1) if ts is not None else None))
+        for crec in costmodel_records(
+                name, obj.get("telemetry"), platform=platform,
+                ts=round(ts, 1) if ts is not None else None):
+            payload = _canonical_line(
+                {k: v for k, v in crec.items()
+                 if k not in ("ts", "via_metric")})
+            if _emitted_cost_payloads.get(crec["metric"]) == payload:
+                continue
+            _emitted_cost_payloads[crec["metric"]] = payload
+            records.append(crec)
     return records
 
 

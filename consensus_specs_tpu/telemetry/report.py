@@ -16,6 +16,11 @@ one markdown dashboard:
   regress more than CST_BENCHWATCH_MAX_REGRESS_PCT percent);
 - the `_MSM_DEVICE_MIN` break-even recommendation from the
   `g1_msm_breakeven_probe` rows;
+- the Utilization section (CST_COSTMODEL rounds): per-kernel roofline
+  table from the XLA cost/memory analysis records — flops, bytes,
+  arithmetic intensity, achieved-vs-peak, compute/memory/launch-bound
+  classification — plus the attestation compile-vs-execute verdict and
+  per-device memory high-water marks;
 - the tier-1 wall-time attribution table, split spec-build vs
   test-body per test (the conftest phase spans), naming the trim
   targets the ROADMAP asks for.
@@ -275,6 +280,91 @@ def msm_recommendation(records) -> dict:
             "text": verdict}
 
 
+# --- kernel utilization (cost model) -----------------------------------------
+
+
+_ATT_METRIC_RE = re.compile(r"attestation_batch_\d+x\d+_verify_wall\Z")
+
+
+def collect_utilization(records) -> dict:
+    """The cost-model read side: latest joined roofline record per
+    kernel (`costmodel`-source records; TPU rounds outrank CPU smoke,
+    same precedence as the MSM probe), latest per-device memory
+    high-water marks, and the attestation compile-vs-execute verdict
+    rendered from the latest attestation round's measured split.
+    Malformed costmodel fields are skipped with a counted warning
+    (`warnings` key), never a crash — CST_COSTMODEL rounds must degrade
+    like every other benchwatch input."""
+    warnings: list[str] = []
+    by_kernel: dict[str, list[dict]] = {}
+    watermarks: dict[str, list[dict]] = {}
+    for r in records:
+        if r.get("source") != "costmodel":
+            continue
+        metric = r["metric"]
+        if metric.startswith("costmodel::"):
+            cm = r.get("costmodel")
+            if not isinstance(cm, dict) or not isinstance(
+                    cm.get("flops"), (int, float)):
+                warnings.append(
+                    f"costmodel record {metric!r} has a malformed "
+                    f"cost block — skipped")
+                continue
+            by_kernel.setdefault(metric[len("costmodel::"):],
+                                 []).append(r)
+        elif metric.startswith("device_mem_high_water::"):
+            watermarks.setdefault(metric[len("device_mem_high_water::"):],
+                                  []).append(r)
+
+    def latest_preferring_tpu(series):
+        series.sort(key=_order_key)
+        tpu = [r for r in series if _platform_group(r) == "tpu"]
+        return (tpu or series)[-1]
+
+    kernels = {}
+    for kernel, series in sorted(by_kernel.items()):
+        rec = latest_preferring_tpu(series)
+        kernels[kernel] = dict(rec["costmodel"],
+                               where=_where(rec),
+                               platform=_platform_group(rec))
+    wm_rows = {}
+    for dev, series in sorted(watermarks.items()):
+        rec = latest_preferring_tpu(series)
+        wm_rows[dev] = {"high_water_bytes": rec.get("value"),
+                        "samples": rec.get("samples"),
+                        "where": _where(rec)}
+
+    # compile-vs-execute verdict for the attestation path (the ROADMAP's
+    # "is the 81s compile- or execute-bound?" question), from the latest
+    # attestation record that embeds the measured split — TPU rounds
+    # outrank the CI CPU smoke here too, else the smoke round appended
+    # before every report would always override the real chip's answer
+    verdict = None
+    att = [r for r in records
+           if _ATT_METRIC_RE.match(r.get("metric", ""))
+           and isinstance(r.get("telemetry"), dict)
+           and isinstance(r["telemetry"].get("compile_s"), (int, float))
+           and isinstance(r["telemetry"].get("run_s"), (int, float))]
+    if att:
+        latest = latest_preferring_tpu(att)
+        tel = latest["telemetry"]
+        c, x = float(tel["compile_s"]), float(tel["run_s"])
+        if x > 0 and c > 0:
+            ratio = c / x
+            kind = "compile-bound" if ratio >= 2.0 else (
+                "execute-bound" if ratio <= 0.5 else "balanced")
+            verdict = {
+                "kind": kind, "compile_s": c, "run_s": x,
+                "ratio": round(ratio, 1), "where": _where(latest),
+                "platform": _platform_group(latest),
+                "text": (f"{kind}: trace+XLA-compile {c:g}s vs "
+                         f"steady-state execute {x:g}s per round "
+                         f"({ratio:.1f}x) at {_where(latest)}"),
+            }
+    return {"kernels": kernels, "watermarks": wm_rows,
+            "verdict": verdict, "warnings": warnings}
+
+
 # --- markdown rendering ------------------------------------------------------
 
 
@@ -403,6 +493,96 @@ def render_regressions(regressions, max_regress_pct) -> list[str]:
     return lines
 
 
+def _si(v, unit="") -> str:
+    """1234567 -> '1.23 M'; keeps the roofline table readable."""
+    if v is None:
+        return "—"
+    v = float(v)
+    for thresh, suffix in ((1e12, "T"), (1e9, "G"), (1e6, "M"),
+                           (1e3, "k")):
+        if abs(v) >= thresh:
+            return f"{v / thresh:.2f} {suffix}{unit}"
+    return f"{v:g} {unit}".rstrip()
+
+
+def render_utilization(util: dict, msm: dict) -> list[str]:
+    lines = ["## Utilization (kernel cost model)\n"]
+    kernels = util["kernels"]
+    if not kernels:
+        lines.append("No cost-model data — run a bench round with "
+                     "`CST_TELEMETRY=1 CST_COSTMODEL=1` to capture "
+                     "per-kernel XLA cost/memory analysis and re-run "
+                     "the report.\n")
+        return lines
+    advisory = any("advisory" in str(k.get("peak_source", ""))
+                   for k in kernels.values())
+    lines.append("Per-kernel roofline: XLA `cost_analysis()` flop/byte "
+                 "budgets joined with the measured steady-state wall; "
+                 "achieved-vs-peak against the per-backend peak "
+                 "registry (`BASELINE.json` `\"peaks\"`)."
+                 + ("  CPU peaks are ADVISORY — utilization ranks "
+                    "kernels against each other, not the hardware."
+                    if advisory else "") + "\n")
+    lines.append("| kernel | flops | bytes | AI (flop/B) | "
+                 "FLOP/s (% peak) | B/s (% peak) | run (mean) | "
+                 "bound | where |")
+    lines.append("|---|---|---|---|---|---|---|---|---|")
+    for name, k in sorted(kernels.items()):
+        fl = _si(k.get("achieved_flops_per_s"))
+        bw = _si(k.get("achieved_bytes_per_s"), "B")
+        uf = k.get("util_flops_pct")
+        ub = k.get("util_bw_pct")
+        run = k.get("run_s_mean")
+        lines.append(
+            f"| `{name}` | {_si(k.get('flops'))} "
+            f"| {_si(k.get('bytes_accessed'), 'B')} "
+            f"| {_fmt(k.get('arithmetic_intensity'), 2)} "
+            f"| {fl}{'' if uf is None else f' ({uf:g}%)'} "
+            f"| {bw}{'' if ub is None else f' ({ub:g}%)'} "
+            f"| {'—' if run is None else f'{run:g} s'} "
+            f"| **{k.get('bound', 'unknown')}** "
+            f"| {k.get('where', '—')} |")
+    lines.append("")
+
+    verdict = util["verdict"]
+    lines.append("### Attestation compile-vs-execute\n")
+    if verdict:
+        lines.append(f"**{verdict['text']}** (platform "
+                     f"{verdict['platform']}).\n")
+    else:
+        lines.append("No attestation round with an embedded "
+                     "compile_s/run_s split ingested yet.\n")
+
+    launch_msms = [n for n, k in sorted(kernels.items())
+                   if ("msm" in n.lower() and k.get("bound") == "launch")]
+    lines.append("### `_MSM_DEVICE_MIN` cross-check\n")
+    if launch_msms:
+        names = ", ".join(f"`{n}`" for n in launch_msms)
+        lines.append(
+            f"{names}: launch-overhead-bound at the probed shape — the "
+            f"kernel's roofline legs explain almost none of its wall, "
+            f"so small-n routing is a dispatch-overhead question, not "
+            f"a throughput one.  Read together with the break-even "
+            f"probe above (status: {msm.get('status', 'no data')}).\n")
+    elif any("msm" in n.lower() for n in kernels):
+        lines.append("No MSM kernel classifies launch-bound at the "
+                     "captured shapes — the break-even probe's "
+                     "host/device walls are the deciding signal.\n")
+    else:
+        lines.append("No MSM kernel cost records captured yet.\n")
+
+    if util["watermarks"]:
+        lines.append("### Device-memory watermarks\n")
+        lines.append("| device | high water | samples | where |")
+        lines.append("|---|---|---|---|")
+        for dev, wm in sorted(util["watermarks"].items()):
+            lines.append(f"| `{dev}` | {_si(wm['high_water_bytes'], 'B')} "
+                         f"| {wm.get('samples') or '—'} "
+                         f"| {wm.get('where', '—')} |")
+        lines.append("")
+    return lines
+
+
 def render_msm(msm: dict) -> list[str]:
     lines = ["## `_MSM_DEVICE_MIN` break-even\n", msm["text"] + "\n"]
     if msm.get("sizes"):
@@ -469,6 +649,7 @@ def render_report(result: dict) -> str:
     lines.extend(render_regressions(result["regressions"],
                                     result["max_regress_pct"]))
     lines.extend(render_msm(result["msm"]))
+    lines.extend(render_utilization(result["utilization"], result["msm"]))
     lines.extend(render_trend_tables(result["records"]))
     lines.extend(render_attribution(result["attribution"],
                                     result["durations"],
@@ -526,6 +707,18 @@ def build_report(repo: Path, history_path: Path,
     thresholds = evaluate_thresholds(stored)
     regressions = find_regressions(stored, max_regress_pct)
     msm = msm_recommendation(stored)
+    utilization = collect_utilization(stored)
+    warnings.extend(utilization.pop("warnings"))
+    # a CST_COSTMODEL round that produced no costmodel block is a
+    # counted warning, never a crash/exit — matching history.py's
+    # malformed-input policy
+    from . import costmodel
+    if costmodel._env_enabled() and not utilization["kernels"]:
+        warnings.append(
+            "CST_COSTMODEL is set but no costmodel records were "
+            "ingested — the round's telemetry block is missing its "
+            "costmodel sub-object (bench run without CST_TELEMETRY, "
+            "or a pre-costmodel bench build?)")
 
     failed = [t for t in thresholds if t["status"] == "FAIL"]
     gate_failures = list(regressions)
@@ -551,6 +744,7 @@ def build_report(repo: Path, history_path: Path,
         "thresholds": thresholds,
         "regressions": regressions,
         "msm": msm,
+        "utilization": utilization,
         "attribution": attribution,
         "durations": durations,
         "warnings": warnings,

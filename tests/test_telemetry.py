@@ -179,11 +179,14 @@ def test_snapshot_schema_stable():
         telemetry.set_meta("m", "v")
     snap = telemetry.snapshot()
     assert set(snap) == {"enabled", "meta", "counters", "histograms",
-                         "spans", "events", "events_dropped"}
+                         "spans", "events", "events_dropped",
+                         "costmodel"}
     assert snap["enabled"] is True
     assert set(snap["histograms"]["h"]) == {"count", "total", "min", "max"}
     assert set(snap["spans"]["s"]) == {"count", "total_s", "min_s",
                                        "max_s"}
+    assert set(snap["costmodel"]) == {"kernels", "watermarks",
+                                      "wm_events", "wm_events_dropped"}
     json.dumps(snap)   # JSON-able end to end
 
 
