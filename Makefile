@@ -8,7 +8,7 @@ CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 VECTOR_OUT ?= out/vectors
 
 .PHONY: test test-fast test-all test-bls lint vectors kzg_setups bench \
-	bench-smoke bench-report multichip help
+	bench-smoke bench-report serve serve-smoke multichip help
 
 help:
 	@echo "targets: test (fast suite) | test-all (incl. slow crypto) |"
@@ -19,7 +19,10 @@ help:
 	@echo "  asserts the bench JSON contract) | bench-report (benchwatch"
 	@echo "  trend/threshold dashboard over the checked-in rounds +"
 	@echo "  out/bench_history.jsonl; exits nonzero on regression) |"
-	@echo "  multichip (8-dev CPU dryrun)"
+	@echo "  serve (sustained-load verification service, real TPU) |"
+	@echo "  serve-smoke (short closed-loop CPU serve round, emits the"
+	@echo "  serve bench JSON + benchwatch history) | multichip (8-dev"
+	@echo "  CPU dryrun)"
 
 test:
 	$(PYTHON) -m pytest tests/ -q -m "not slow"
@@ -68,6 +71,21 @@ bench-smoke:
 # only, no jax)
 bench-report:
 	$(PYTHON) -m consensus_specs_tpu.telemetry.report --out out/bench_report.md
+
+# the sustained-load attestation-verification service benchmark
+# (consensus_specs_tpu/serve): mainnet-rate arrival mix through the
+# deferred-futures executor, reports steady-state verifies/sec +
+# p50/p99 batch latency (CST_SERVE_* knobs, README "Serving")
+serve:
+	$(PYTHON) bench_serve.py
+
+# no TPU required: short closed-loop serve round on tiny CPU shapes —
+# the measured rate is the host's capacity, the JSON contract and the
+# serve::* history records are what CI checks
+serve-smoke:
+	@$(CPU_ENV) CST_SERVE_DURATION_S=12 CST_SERVE_RATE=0 CST_SERVE_POOL=4 \
+		CST_SERVE_COMMITTEE=4 CST_SERVE_MAX_BATCH=8 CST_SERVE_WINDOWS=3 \
+		$(PYTHON) bench_serve.py
 
 multichip:
 	$(CPU_ENV) $(PYTHON) -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('ok')"

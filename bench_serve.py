@@ -1,0 +1,115 @@
+"""Sustained-load serving benchmark — the ROADMAP's attestation-
+verification service under continuous traffic.
+
+Every other bench measures one cold batch; this one drives the
+`consensus_specs_tpu.serve` executor (deferred-result futures, AOT-
+warmed `_bucket` executables, double-buffered batch pipeline) with the
+mainnet per-slot arrival mix until throughput reaches steady state
+(last 3 windows within ±20%), then prints ONE JSON metric line:
+
+  {"metric": "serve_sustained_load", "value": <verifies/s>,
+   "unit": "verifies/s", "vs_baseline": <x vs the oracle's
+   FastAggregateVerify rate>, "serve": {...}}
+
+The `"serve"` sub-object is `serve.loadgen.run_load`'s block (schema
+pinned by `telemetry.export.validate_serve_block`): steady-state
+verifies/sec, p50/p99 batch latency, window rates, queue-depth
+histogram, pipeline stats.  `vs_baseline` divides the measured rate by
+the persisted pure-Python oracle's single-verify rate
+(bench_bls_baseline.json) — the per-core signatures/sec framing of
+PAPERS.md's EdDSA-vs-BLS committee-consensus paper.
+
+Knobs are the CST_SERVE_* family (README "Serving"); the CPU smoke runs
+closed-loop (`CST_SERVE_RATE=0`) so the measured rate is the host's
+capacity instead of an idle fixed-rate clock.  With CST_TELEMETRY=1 the
+line also carries the standard `"telemetry"` block, and
+CST_BENCHWATCH_HISTORY lands `serve::*` history records for the
+benchwatch threshold rows (steady-state throughput, p99 latency).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+from pathlib import Path
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+# the image's sitecustomize pins the platform to the pooled TPU through
+# live config; let an explicit JAX_PLATFORMS env override it (CPU smoke)
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+from consensus_specs_tpu import telemetry  # noqa: E402
+from consensus_specs_tpu.telemetry import history as benchwatch  # noqa: E402
+from consensus_specs_tpu.utils.jaxtools import enable_compile_cache  # noqa: E402
+
+enable_compile_cache()
+
+BLS_BASELINE_FILE = (Path(__file__).resolve().parent
+                     / "bench_bls_baseline.json")
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def _oracle_verifies_per_s() -> float | None:
+    """The pure-Python oracle's FastAggregateVerify rate (verifies/s)
+    from the persisted baseline — the denominator of `vs_baseline`."""
+    try:
+        data = json.loads(BLS_BASELINE_FILE.read_text())
+        per_verify = float(data["oracle_seconds_per_fast_aggregate_verify"])
+        return 1.0 / per_verify if per_verify > 0 else None
+    except (OSError, KeyError, ValueError, TypeError):
+        return None
+
+
+def _emit(record: dict) -> None:
+    """One metric line on stdout, `"telemetry"` embedded on telemetry
+    rounds, history records appended when CST_BENCHWATCH_HISTORY names
+    a path — the same contract as bench.py / bench_bls.py."""
+    record = telemetry.embed_bench_block(record)
+    benchwatch.append_emission(record, ts=time.time())
+    print(json.dumps(record), flush=True)
+
+
+def main() -> int:
+    from consensus_specs_tpu.serve.loadgen import config_from_env, run_load
+    from consensus_specs_tpu.telemetry import validate_serve_block
+
+    cfg = config_from_env()
+    log(f"serve bench: {cfg} on "
+        f"{jax.devices()[0].platform}:{len(jax.devices())}")
+    block = run_load(cfg)
+    problems = validate_serve_block(block)
+    if problems:
+        log(f"serve bench: INVALID serve block: {problems}")
+        return 1
+    oracle_rate = _oracle_verifies_per_s()
+    vs_baseline = (round(block["verifies_per_s"] / oracle_rate, 2)
+                   if oracle_rate else None)
+    _emit({
+        "metric": "serve_sustained_load",
+        "value": block["verifies_per_s"],
+        "unit": "verifies/s",
+        "vs_baseline": vs_baseline,
+        "serve": block,
+    })
+    log(f"serve bench: {block['verifies_per_s']} verifies/s "
+        f"(steady={block['steady']}, {block['mode']} loop), "
+        f"p50 {block['p50_ms']} ms / p99 {block['p99_ms']} ms, "
+        f"{block['settled']} settled in {block['duration_s']}s"
+        + (f", {vs_baseline}x oracle" if vs_baseline else ""))
+    if not block["steady"]:
+        log("serve bench: WARNING — did not reach steady state "
+            "(windows: " + ", ".join(str(w) for w in block["windows"])
+            + ")")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

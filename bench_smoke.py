@@ -20,6 +20,13 @@ cost-model contract: the telemetry block carries a `costmodel` block
 with nonzero flops/bytes for the flagship kernel (the fused epoch step;
 the BLS round must cover the pairing/MSM/h2c/sha256 kernel surface),
 and the benchwatch store round-trips the new `costmodel` record kind.
+
+A third round runs bench_serve.py closed-loop on tiny shapes and
+asserts the serving contract: a steady-state `"serve"` sub-object
+(verifies/sec, p50/p99 batch latency, queue-depth histogram —
+`validate_serve_block`), the `serve::*` benchwatch history records,
+and the queue-depth / in-flight gauge counter tracks in the Chrome
+trace.
 """
 
 from __future__ import annotations
@@ -230,6 +237,80 @@ def main():
         sorted(counter_names)
     print(f"chrome trace OK: {len(spans)} spans + {len(counters)} "
           f"counter events -> {trace_file}")
+
+    # the serving subsystem's sustained-load round: closed-loop (the
+    # measured rate is this host's capacity — an open-loop mainnet-rate
+    # clock on an arbitrary CI box would idle or diverge), tiny pool /
+    # committee / rung shapes, long-enough windows that batch-settle
+    # granularity doesn't defeat the ±20% steady-state check.  Asserts
+    # the `"serve"` bench sub-object contract, the serve::* history
+    # record round-trip, and the gauge counter tracks in the trace.
+    from consensus_specs_tpu.telemetry import validate_serve_block
+
+    serve_trace = HERE / "out" / "smoke_serve_trace.json"
+    if serve_trace.exists():
+        serve_trace.unlink()
+    serve_t0 = time.time()
+    out = _run(["bench_serve.py"],
+               {"CST_SERVE_DURATION_S": "12", "CST_SERVE_RATE": "0",
+                "CST_SERVE_POOL": "4", "CST_SERVE_COMMITTEE": "4",
+                "CST_SERVE_MAX_BATCH": "8", "CST_SERVE_WINDOWS": "3",
+                "CST_TELEMETRY": "1",
+                "CST_TRACE_FILE": str(serve_trace),
+                "CST_BENCHWATCH_HISTORY": str(hist_file)},
+               timeout=900)
+    serve_lines = [o for o in out if o.get("metric") == "serve_sustained_load"]
+    assert len(serve_lines) == 1, out
+    sl = serve_lines[0]
+    assert sl["unit"] == "verifies/s" and sl["value"] > 0, sl
+    block = sl.get("serve")
+    problems = validate_serve_block(block)
+    assert not problems, (problems, json.dumps(block)[:500])
+    assert block["steady"], ("no steady state", block["windows"])
+    assert block["settled"] == block["submitted"] > 0, block
+    assert block["failed"] == 0, block
+    assert block["p50_ms"] is not None and block["p99_ms"] is not None, block
+    assert block["queue_depth"]["hist"], block
+    assert block["mode"] == "closed", block
+    _check_telemetry(sl, "serve bench")
+    print("bench_serve.py JSON OK:", json.dumps(
+        {k: v for k, v in sl.items() if k not in ("telemetry", "serve")}),
+        f"({block['verifies_per_s']} verifies/s, steady over "
+        f"{len(block['windows'])} windows)")
+
+    # serve history round-trip: the emission must land as the
+    # bench_emit line PLUS serve-source serve::* records (throughput
+    # carrying the compacted block, latency percentiles standalone)
+    hist_records, _, _ = benchwatch.load_history(hist_file)
+    fresh = [r for r in hist_records
+             if isinstance(r.get("ts"), (int, float))
+             and r["ts"] >= serve_t0 - 5]
+    by_metric = {r["metric"]: r for r in fresh}
+    assert "serve_sustained_load" in by_metric, sorted(by_metric)
+    assert by_metric["serve_sustained_load"]["source"] == "bench_emit"
+    for name in ("serve::verifies_per_s", "serve::p50_ms",
+                 "serve::p99_ms"):
+        rec = by_metric.get(name)
+        assert rec is not None, (name, sorted(by_metric))
+        assert rec["source"] == "serve" and rec["platform"] == "cpu", rec
+        assert not benchwatch.validate_record(rec), rec
+    vrec = by_metric["serve::verifies_per_s"]
+    assert vrec["serve"]["queue_depth"]["hist"], vrec
+    assert isinstance(vrec["serve"]["steady"], bool), vrec
+    print(f"serve history OK: {len(fresh)} records this run")
+
+    # the serve pipeline's gauges ride the Chrome trace as 'C' counter
+    # tracks (queue depth + in-flight batches breathing against the
+    # span timeline, same mechanism as device_memory_bytes)
+    trace = json.loads(serve_trace.read_text())
+    counter_names = {e["name"] for e in trace["traceEvents"]
+                     if e.get("ph") == "C"}
+    assert "serve.queue_depth" in counter_names, sorted(counter_names)
+    assert "serve.inflight_batches" in counter_names, sorted(counter_names)
+    span_names = {e["name"] for e in trace["traceEvents"]
+                  if e.get("ph") == "X"}
+    assert "serve.pump" in span_names, sorted(span_names)
+    print(f"serve trace OK: gauge counter tracks present -> {serve_trace}")
 
     # telemetry-OFF contract: the default path (what a non-telemetry
     # TPU round runs) must emit the plain 2-metric lines — no

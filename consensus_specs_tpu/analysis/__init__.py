@@ -11,7 +11,8 @@ families over `ops/bls_batch`, `ops/bls`, `ops/sha256_jax`,
 `ops/fr_batch`, `parallel/` and `executor.py`:
 
     recompile-unbucketed-dim, recompile-traced-branch   (recompile.py)
-    host-sync-item/-coerce/-np/-device-get              (hostsync.py)
+    host-sync-item/-coerce/-np/-device-get/
+        -outside-settle, device-const-at-import         (hostsync.py)
     dtype-int-literal/-float/-implicit-cast             (dtype.py)
     instr-uncovered-entry                               (instrumentation.py)
 

@@ -56,6 +56,10 @@ RULE_IDS = {
         "fetch",
     "host-sync-device-get":
         "jax.device_get() inside a device module",
+    "host-sync-outside-settle":
+        "blocking fetch outside the serve.futures settle seam — "
+        "an `..._async(...).result()` chain beyond the synchronous "
+        "facade, or block_until_ready in a device module",
     "device-const-at-import":
         "jnp array materialized at module import time — leaks tracers "
         "when the module is first imported inside a jit trace (keep "

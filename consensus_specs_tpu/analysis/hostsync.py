@@ -18,7 +18,29 @@ trace-time error waiting to happen).  `.item()` and `jax.device_get`
 are unconditional: there is no host-side reason to use either in a
 device module.
 
-The fifth rule here is the inverse direction — device residency
+Since the serving subsystem landed, the sanctioned settle seam is
+`serve/futures.py` (`DeviceFuture.result()` holds the ONE blocking
+fetch) — the device entry points return futures and the old allow-
+annotated API-boundary syncs are retired.  `host-sync-outside-settle`
+keeps that contract from regressing: inside a device module it flags
+(a) an `<entry>_async(...).result()` chain anywhere except the matching
+synchronous facade (`def <entry>(): return <entry>_async(...).result()`
+is the sanctioned compatibility shape — dispatching and immediately
+blocking anywhere else rebuilds the serialization point the futures
+API removed), and (b) `block_until_ready` in any form (there is no
+reason to barrier the pipeline from a device module; the serve
+executor settles batches through futures instead) — EXCEPT when the
+barrier itself is `telemetry.enabled()`-gated (inside a positive
+`if telemetry.enabled():` branch, or after the early-out
+`if not telemetry.enabled(): return` guard): the compile-vs-run
+timing seam must barrier to measure, and its telemetry-off path
+dispatches without one, so instrumented barriers are measurement, not
+serving — but a merely nearby enabled() call does not exempt an
+unconditional barrier.  The
+oracle stays exempt the same way as the other host-sync rules:
+pure-Python code never produces `_async` chains or readiness barriers.
+
+The sixth rule here is the inverse direction — device residency
 established too EARLY: `device-const-at-import` flags jnp arrays
 materialized at module scope.  Beyond allocating device memory at
 import, they leak tracers when the module's first import happens
@@ -38,6 +60,45 @@ from .core import Finding, ModuleModel, _dotted, nonstatic_refs, scope_nodes
 
 _NP_NAMES = ("np.asarray", "np.array", "numpy.asarray", "numpy.array")
 _COERCIONS = ("int", "float", "bool")
+
+
+def _enabled_test(test) -> bool:
+    return isinstance(test, ast.Call) \
+        and (_dotted(test.func) or "").endswith("telemetry.enabled")
+
+
+def _barrier_is_gated(fn, barrier) -> bool:
+    """True when a readiness barrier is genuinely telemetry-gated — it
+    only runs on instrumented rounds, so it is measurement, not
+    serving.  Two sanctioned shapes (both the `_dispatch` structure):
+
+        if telemetry.enabled():          # (a) positive gate
+            out = jax.block_until_ready(...)
+
+        if not telemetry.enabled():      # (b) early-out guard
+            return fn(*args)
+        ...
+        out = jax.block_until_ready(...)
+
+    A merely NEARBY `telemetry.enabled()` call (an unrelated counter
+    guard elsewhere in the function) must not exempt an unconditional
+    barrier — that would let the per-dispatch serialization this rule
+    exists to prevent ship undetected."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.If) and _enabled_test(node.test) \
+                and any(n is barrier for stmt in node.body
+                        for n in ast.walk(stmt)):
+            return True
+    for stmt in getattr(fn, "body", []):
+        if isinstance(stmt, ast.If) \
+                and isinstance(stmt.test, ast.UnaryOp) \
+                and isinstance(stmt.test.op, ast.Not) \
+                and _enabled_test(stmt.test.operand) \
+                and stmt.body \
+                and isinstance(stmt.body[-1], (ast.Return, ast.Raise)) \
+                and stmt.lineno < barrier.lineno:
+            return True
+    return False
 
 
 def _check_scope(model: ModuleModel, fn, aliases, tainted,
@@ -78,7 +139,43 @@ def _check_scope(model: ModuleModel, fn, aliases, tainted,
             findings.append(Finding(
                 model.path, node.lineno, "host-sync-np",
                 f"{fd}() on a device value is an implicit device fetch"))
+        elif ((fd or "").endswith("block_until_ready")
+                or (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "block_until_ready")) \
+                and not _barrier_is_gated(fn, node):
+            findings.append(Finding(
+                model.path, node.lineno, "host-sync-outside-settle",
+                "block_until_ready barriers the dispatch pipeline from "
+                "a device module — return a serve.futures handle and "
+                "let the settle path block once, at result()"))
+        elif _is_immediate_settle(node, fn):
+            findings.append(Finding(
+                model.path, node.lineno, "host-sync-outside-settle",
+                "dispatching and immediately blocking "
+                "(`..._async(...).result()`) outside the synchronous "
+                "facade rebuilds the host-sync seam the futures API "
+                "retired — return the DeviceFuture (or route the work "
+                "through the serve executor) instead"))
     return findings
+
+
+def _is_immediate_settle(node: ast.Call, fn) -> bool:
+    """`<name>_async(...).result()` chained in one expression — the
+    dispatch-then-block anti-pattern — EXCEPT inside the matching
+    synchronous facade, the one sanctioned compatibility shape:
+    `def batch_verify(...): return batch_verify_async(...).result()`."""
+    if not (isinstance(node.func, ast.Attribute)
+            and node.func.attr == "result" and not node.args):
+        return False
+    inner = node.func.value
+    if not isinstance(inner, ast.Call):
+        return False
+    callee = (_dotted(inner.func) or "").rsplit(".", 1)[-1]
+    if not callee.endswith("_async"):
+        return False
+    return callee != getattr(fn, "name", None) + "_async" \
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+        else True
 
 
 # jnp calls that materialize an array (aliases like `U64 = jnp.uint64`

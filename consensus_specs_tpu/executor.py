@@ -58,6 +58,10 @@ def state_transition_batched(spec, state, signed_block,
             with telemetry.span("executor.process_block"):
                 spec.process_block(state, block)
         costmodel.sample_watermark("executor.process_block")
+        # settle is once-only (DeferredBatch caches the verdict and
+        # resolves every recorded handle); the gauge mirrors the serve
+        # executor's queue-depth track in block-import traces
+        telemetry.gauge("executor.deferred_statements", len(batch.tasks))
         with telemetry.span("executor.batch_settle",
                             statements=len(batch.tasks)):
             ok = batch.verify(device=device)

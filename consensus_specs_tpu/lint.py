@@ -314,16 +314,23 @@ def lint_env_knobs(repo=None) -> list[str]:
     """Every `CST_*` env read in the tree needs a row in README.md's
     knob table, and every row needs a surviving read.  Benchwatch knobs
     (`CST_BENCHWATCH_*`) additionally need a mention in the README's
-    "Benchwatch" section — the threshold-gate surface must document its
-    own configuration where it is explained, not only in the flat
+    "Benchwatch" section, and serving knobs (`CST_SERVE_*`) in the
+    "Serving" section — a subsystem's configuration surface must be
+    documented where the subsystem is explained, not only in the flat
     table.  `repo` overrides the tree root (tests)."""
     repo = Path(repo) if repo is not None else PKG_ROOT.parent
     readme = repo / "README.md"
     readme_text = readme.read_text()
     documented = set(re.findall(r"\|\s*`(CST_[A-Z0-9_]+)`", readme_text))
-    bw_match = re.search(r"^## Benchwatch$(.*?)(?=^## |\Z)", readme_text,
-                         re.M | re.S)
-    benchwatch_section = bw_match.group(1) if bw_match else ""
+
+    def section(title: str) -> str:
+        m = re.search(rf"^## {title}$(.*?)(?=^## |\Z)", readme_text,
+                      re.M | re.S)
+        return m.group(1) if m else ""
+
+    sectioned_prefixes = (("CST_BENCHWATCH_", "Benchwatch",
+                           section("Benchwatch")),
+                          ("CST_SERVE_", "Serving", section("Serving")))
 
     used: dict[str, str] = {}
     for path in sorted(repo.rglob("*.py")):
@@ -349,11 +356,13 @@ def lint_env_knobs(repo=None) -> list[str]:
             f"in the tree (stale table row?)")
     for name in sorted(set(used)):
         # a mention may carry an example value: `CST_BENCHWATCH_STRICT=1`
-        if name.startswith("CST_BENCHWATCH_") and not re.search(
-                rf"`{name}(?:=[^`]*)?`", benchwatch_section):
-            findings.append(
-                f"{used[name]}: benchwatch knob '{name}' must also be "
-                f"documented in README.md's \"## Benchwatch\" section")
+        for prefix, title, text in sectioned_prefixes:
+            if name.startswith(prefix) and not re.search(
+                    rf"`{name}(?:=[^`]*)?`", text):
+                findings.append(
+                    f"{used[name]}: {title.lower()} knob '{name}' must "
+                    f"also be documented in README.md's \"## {title}\" "
+                    f"section")
     return findings
 
 

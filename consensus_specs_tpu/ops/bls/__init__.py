@@ -168,58 +168,105 @@ def KeyValidate(pubkey):
 
 
 class DeferredBatch:
-    """Recorded FastAggregateVerify statements awaiting one batch check."""
+    """Recorded FastAggregateVerify statements awaiting one batch check.
+
+    This is the futures contract's origin (generalized repo-wide by
+    `consensus_specs_tpu.serve`): every `record()` also appends a
+    `DeviceFuture` handle to `self.handles`, settled — batch verdict or
+    propagated exception — when the batch settles.  Settlement is
+    once-only: `verify()` caches its outcome (a second call re-returns
+    or re-raises without re-dispatching), and recording after
+    settlement is a caller bug (`RuntimeError`) — the block executor
+    creates one batch per block, it never reuses a settled one."""
 
     def __init__(self):
         self.tasks = []      # (g1_pk_jacobian, message, g2_sig_jacobian)
         self.failed = False  # an input failed eager validation
+        self.handles = []    # one DeviceFuture per record() call
+        self._pending = []   # handles awaiting the batch verdict
+        self._settled = False
+        self._result: bool | None = None
+        self._exc: BaseException | None = None
 
     def record(self, pubkeys, message, signature) -> bool:
-        from .ciphersuite import _pk_to_point, _sig_to_point, g1
+        from ...serve.futures import DeviceFuture
+        from .ciphersuite import parse_fast_aggregate_task
 
-        if len(pubkeys) == 0:
+        if self._settled:
+            raise RuntimeError(
+                "deferred batch already settled — record() after "
+                "verify() would never be checked")
+        task = parse_fast_aggregate_task(pubkeys, message, signature)
+        if task is None:
             self.failed = True
             telemetry.count("bls.deferred.rejected")
+            self.handles.append(DeviceFuture.settled(False))
             return False
-        try:
-            sig = _sig_to_point(bytes(signature))
-            agg = g1.infinity()
-            for pk in pubkeys:
-                agg = g1.add(agg, _pk_to_point(bytes(pk)))
-        except ValueError:
-            self.failed = True
-            telemetry.count("bls.deferred.rejected")
-            return False
-        self.tasks.append((agg, bytes(message), sig))
+        self.tasks.append(task)
         telemetry.count("bls.deferred.recorded")
+        handle = DeviceFuture(waiter=lambda fut: self.verify())
+        self.handles.append(handle)
+        self._pending.append(handle)
         return True
+
+    def _settle_handles(self, ok: bool | None,
+                        exc: BaseException | None = None) -> None:
+        """Resolve every pending handle with the batch verdict — or
+        propagate a device-batch failure into each of them."""
+        pending, self._pending = self._pending, []
+        for handle in pending:
+            if exc is not None:
+                handle.set_exception(exc)
+            else:
+                handle.set_result(bool(ok))
 
     def verify(self, device: bool | None = None) -> bool:
         """Settle every recorded statement.  device=None follows the
-        active backend (jax -> device batch, py -> host oracle)."""
+        active backend (jax -> device batch, py -> host oracle).
+        Idempotent: the first call dispatches and caches, later calls
+        replay the cached verdict (or re-raise the cached exception)."""
+        if self._settled:
+            if self._exc is not None:
+                raise self._exc
+            return self._result
+        self._settled = True
         if self.failed:
+            self._result = False
+            self._settle_handles(False)
             return False
         if not self.tasks:
+            self._result = True
             return True
         if device is None:
             device = _backend_name == "jax"
         telemetry.count("bls.deferred.settled", len(self.tasks))
         telemetry.count("bls.deferred.backend.device" if device
                         else "bls.deferred.backend.host")
-        with telemetry.span("bls.deferred.verify",
-                            statements=len(self.tasks),
-                            backend="device" if device else "host"):
-            if device:
-                from ..bls_batch import batch_verify
+        try:
+            with telemetry.span("bls.deferred.verify",
+                                statements=len(self.tasks),
+                                backend="device" if device else "host"):
+                if device:
+                    from ..bls_batch import batch_verify
 
-                return batch_verify(self.tasks)
-            from .ciphersuite import G1_GEN, _pairing_check, g1
-            from .hash_to_curve import DST_G2, hash_to_g2
+                    ok = batch_verify(self.tasks)
+                else:
+                    from .ciphersuite import (
+                        _pairing_check,
+                        fast_aggregate_pairs,
+                    )
 
-            return all(
-                _pairing_check([(pk, hash_to_g2(msg, DST_G2)),
-                                (g1.neg(G1_GEN), sig)])
-                for pk, msg, sig in self.tasks)
+                    ok = all(_pairing_check(fast_aggregate_pairs(t))
+                             for t in self.tasks)
+        except BaseException as exc:
+            # a failed device batch fails EVERY pending handle, then
+            # surfaces to the settle caller too
+            self._exc = exc
+            self._settle_handles(None, exc)
+            raise
+        self._result = ok
+        self._settle_handles(ok)
+        return ok
 
 
 _deferred: DeferredBatch | None = None

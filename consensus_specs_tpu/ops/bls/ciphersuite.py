@@ -61,6 +61,38 @@ def _pk_to_point(pubkey: bytes):
     return p
 
 
+def parse_fast_aggregate_task(pubkeys, message, signature):
+    """Eager wire-format validation for one FastAggregateVerify
+    statement, shared by `DeferredBatch.record` (the block path) and
+    `ServeExecutor.submit_fast_aggregate_verify` (the serving path) so
+    the two can never drift on accept/reject behavior.  Returns the
+    (aggregate_pk_jacobian, message_bytes, sig_jacobian) task tuple the
+    batched RLC kernel consumes, or None when the inputs are invalid
+    (empty pubkey list, unparseable/out-of-subgroup points) — the
+    False verdict is decided here, without touching a kernel."""
+    if len(pubkeys) == 0:
+        return None
+    try:
+        sig = _sig_to_point(bytes(signature))
+        agg = g1.infinity()
+        for pk in pubkeys:
+            agg = g1.add(agg, _pk_to_point(bytes(pk)))
+    except ValueError:
+        return None
+    return (agg, bytes(message), sig)
+
+
+def fast_aggregate_pairs(task):
+    """The pairing-product statement for one parsed FastAggregateVerify
+    task: e(PK, H(m)) · e(-G1, S) == 1, as the [(g1, g2), ...] pair
+    list every pairing-check backend consumes.  The ONE definition of
+    the verification identity — the oracle path, the deferred-batch
+    host fallback, the serve recheck, and the load generator all call
+    this, so the formula cannot drift between them."""
+    pk, msg, sig = task
+    return [(pk, hash_to_g2(bytes(msg), DST_G2)), (g1.neg(G1_GEN), sig)]
+
+
 # --- core scheme ------------------------------------------------------------
 
 
@@ -121,8 +153,7 @@ def FastAggregateVerify(pubkeys: list[bytes], message: bytes,
             agg = g1.add(agg, _pk_to_point(pk))
     except ValueError:
         return False
-    h = hash_to_g2(message, DST_G2)
-    return _pairing_check([(agg, h), (g1.neg(G1_GEN), sig)])
+    return _pairing_check(fast_aggregate_pairs((agg, message, sig)))
 
 
 # --- point API for the KZG / polynomial-commitment library ------------------

@@ -22,6 +22,13 @@ Public surface:
   g1_multi_exp_device(pts, ks)     G1 multiscalar multiplication via a
                                    windowed bucketed (Pippenger) kernel.
 
+Every entry point also has an `_async` variant returning a
+`serve.futures.DeviceFuture` (the deferred-result contract): host prep +
+kernel dispatch happen eagerly, the device→host transfer happens once at
+`result()` — the serve executor pipelines batches through these, and the
+synchronous names above are thin `.result()` facades kept for the spec /
+block-executor call sites.
+
 Host keeps parsing and subgroup checks (the oracle code); the device does
 every pairing, scalar multiplication, and hash-to-curve.  Batch shapes are
 padded to a 4-step bucket ladder so each jit entry point compiles at most
@@ -65,6 +72,7 @@ import time
 import numpy as np
 
 from ... import telemetry
+from ...serve.futures import DeviceFuture, bool_future, value_future
 from ...telemetry import costmodel
 from ..bls import curve as _pycurve
 from ..bls.hash_to_curve import DST_G2, hash_to_g2
@@ -102,13 +110,22 @@ def _bucket(n: int) -> int:
 # --- telemetry-aware kernel dispatch ----------------------------------------
 
 
-def _dispatch(kernel: str, fn, args):
+def _dispatch(kernel: str, fn, args, block: bool = True):
     """Run a jitted kernel, attributing its wall time to compile vs run:
     the FIRST dispatch of a given (kernel, padded-shape) key pays
     trace + XLA compile (or a persistent-cache load — visible as an
     anomalously cheap first call), later dispatches are pure run.  Off
     (the default) this is a flag check and a tail call — no sync, no
     timing.
+
+    `block=False` is the pipelined-caller contract (the serve executor
+    threads it through the `*_async` entry points): after the first
+    call of a (kernel, shape) key — which still blocks, the compile
+    attribution and AOT cost capture need the built executable — later
+    dispatches enqueue WITHOUT syncing and observe `dispatch_s` (host
+    enqueue wall) instead of `run_s`, so an instrumented serve round
+    keeps overlapping host prep with device execution instead of
+    serializing the batch pipeline on every dispatch.
 
     This is also the cost-capture seam: on CST_COSTMODEL rounds the
     first dispatch of each (kernel, shape) additionally records XLA's
@@ -120,9 +137,13 @@ def _dispatch(kernel: str, fn, args):
 
     first = telemetry.first_call(f"kernel.{kernel}")
     t0 = time.perf_counter()
-    out = jax.block_until_ready(fn(*args))
+    if first or block:
+        out = jax.block_until_ready(fn(*args))
+        which = "compile_first_s" if first else "run_s"
+    else:
+        out = fn(*args)
+        which = "dispatch_s"
     dt = time.perf_counter() - t0
-    which = "compile_first_s" if first else "run_s"
     telemetry.observe(f"kernel.{which}", dt)
     telemetry.observe(f"kernel.{kernel}.{which}", dt)
     telemetry.count(f"kernel.{kernel}.calls")
@@ -174,9 +195,13 @@ def _pairing_check_precomp_fn(batch: int):
     return jax.jit(run)
 
 
-def pairing_check_device(pairs) -> bool:
+def pairing_check_device_async(pairs, block: bool = True) -> DeviceFuture:
     """pairs: [(g1_jacobian, g2_jacobian)] oracle points.  Infinity pairs
-    contribute the identity (matching the oracle's skip).
+    contribute the identity (matching the oracle's skip).  Returns a
+    `DeviceFuture[bool]`: the kernel is dispatched asynchronously and
+    the accept/reject bool crosses to the host only at `result()` —
+    callers (the serve executor above all) keep feeding the pipeline
+    instead of stalling on every check.
 
     The G2 arguments are host points by construction, so their Miller
     line coefficients are precomputed once per point on the host
@@ -186,7 +211,7 @@ def pairing_check_device(pairs) -> bool:
     live = [(p, q) for p, q in pairs
             if not _pycurve.g1.is_inf(p) and not _pycurve.g2.is_inf(q)]
     if not live:
-        return True
+        return DeviceFuture.settled(True)
     jnp = _jnp()
     B = _bucket(len(live))
     with telemetry.span("bls.pairing_check_device", live=len(live),
@@ -206,10 +231,15 @@ def pairing_check_device(pairs) -> bool:
         mask = np.arange(B) < len(live)
         out = _dispatch(f"pairing_check@{B}", _pairing_check_precomp_fn(B),
                         (jnp.asarray(xp), jnp.asarray(yp),
-                         jnp.asarray(lines), jnp.asarray(mask)))
-    # cst: allow(host-sync-coerce): single accept/reject bool fetched at
-    # the API boundary — callers need a host answer
-    return bool(out)
+                         jnp.asarray(lines), jnp.asarray(mask)),
+                        block=block)
+    return bool_future(out)
+
+
+def pairing_check_device(pairs) -> bool:
+    """Synchronous facade over `pairing_check_device_async` (the oracle
+    `pairing_check` drop-in); the settle happens in `serve.futures`."""
+    return pairing_check_device_async(pairs).result()
 
 
 # --- RLC batch verify -------------------------------------------------------
@@ -350,15 +380,17 @@ def _msm_algo(batch: int) -> str:
     return algo
 
 
-def g1_multi_exp_device(points, scalars):
+def g1_multi_exp_device_async(points, scalars,
+                              block: bool = True) -> DeviceFuture:
     """Device G1 multiscalar multiplication (bucketed Pippenger below
     the width crossover, batched double-and-add above it — see
     `_msm_algo`).
 
     points: oracle Jacobian G1 points; scalars: ints (reduced mod r).
-    Returns an oracle Jacobian point.  The KZG batch path's `g1_lincomb`
-    (`specs/deneb/polynomial-commitments.md:415-460` algorithms) lands
-    here when the jax backend is active."""
+    Returns a `DeviceFuture` settling to an oracle Jacobian point (the
+    limb→oracle conversion runs host-side at settle time).  The KZG
+    batch path's `g1_lincomb` (`specs/deneb/polynomial-commitments.md
+    :415-460` algorithms) lands here when the jax backend is active."""
     import jax.numpy as jnp
 
     assert len(points) == len(scalars) and len(points) > 0
@@ -369,7 +401,7 @@ def g1_multi_exp_device(points, scalars):
             continue
         live.append((p, s))
     if not live:
-        return _pycurve.g1.infinity()
+        return DeviceFuture.settled(_pycurve.g1.infinity())
 
     B = _bucket(len(live))
     algo = _msm_algo(B)
@@ -395,7 +427,7 @@ def g1_multi_exp_device(points, scalars):
             out = _dispatch(f"msm_pippenger@{B}w{c}",
                             _msm_pippenger_kernel(B, c),
                             (jnp.asarray(x), jnp.asarray(y),
-                             jnp.asarray(digits)))
+                             jnp.asarray(digits)), block=block)
         else:
             bits = cj.scalars_to_bits([s for _, s in live], SCALAR_BITS)
             if pad:
@@ -404,10 +436,16 @@ def g1_multi_exp_device(points, scalars):
             mask = np.arange(B) < len(live)
             out = _dispatch(f"msm_double_add@{B}", _msm_kernel(B),
                             (jnp.asarray(x), jnp.asarray(y),
-                             jnp.asarray(bits), jnp.asarray(mask)))
-    # cst: allow(host-sync-np): the MSM result leaves the device once
-    # per call, converted back to the oracle point representation
-    return cj.g1_limbs_to_oracle(tuple(np.asarray(co) for co in out))
+                             jnp.asarray(bits), jnp.asarray(mask)),
+                            block=block)
+    # the point leaves the device at settle time, once, in serve.futures
+    return value_future(out, convert=cj.g1_limbs_to_oracle)
+
+
+def g1_multi_exp_device(points, scalars):
+    """Synchronous facade over `g1_multi_exp_device_async`; returns the
+    oracle Jacobian point."""
+    return g1_multi_exp_device_async(points, scalars).result()
 
 
 def _prepare_rlc_inputs(tasks, rand, lanes: int, device_h2c: bool = False):
@@ -466,18 +504,22 @@ def _prepare_rlc_inputs(tasks, rand, lanes: int, device_h2c: bool = False):
             len(live))
 
 
-def batch_verify(tasks, rng=None, device_h2c: bool | None = None) -> bool:
+def batch_verify_async(tasks, rng=None, device_h2c: bool | None = None,
+                       block: bool = True) -> DeviceFuture:
     """tasks: [(g1_pubkey_jacobian, message_bytes, g2_sig_jacobian)].
 
     Verifies all FastAggregateVerify-style statements
     e(PK_i, H(m_i)) == e(G1, S_i) at once: random 128-bit coefficients
     r_i collapse them into   prod e(r_i PK_i, H_i) · e(-G1, Σ r_i S_i) == 1.
+    Returns a `DeviceFuture[bool]`: host prep + dispatch happen here,
+    the verdict crosses to the host only at `result()` — the serve
+    executor dispatches the NEXT batch while this one executes.
 
     With device_h2c (the default for 32-byte message roots; opt out with
     CST_BLS_DEVICE_H2C=0) the message hashing runs on device too, so the
     host only parses points and draws coefficients."""
     if not tasks:
-        return True
+        return DeviceFuture.settled(True)
     rand = rng if rng is not None else secrets.SystemRandom()
     if device_h2c is None:
         device_h2c = os.environ.get("CST_BLS_DEVICE_H2C", "1") != "0"
@@ -492,7 +534,7 @@ def batch_verify(tasks, rng=None, device_h2c: bool | None = None) -> bool:
         if arrays is None:
             # degenerate path: trivial skip or the per-task host
             # fallback — no statements reached the batched kernel
-            return bool(n)
+            return DeviceFuture.settled(bool(n))
         jnp = _jnp()
         # lanes=None above means _prepare_rlc_inputs padded to the
         # ladder shape for n live lanes — recompute it rather than
@@ -506,10 +548,15 @@ def batch_verify(tasks, rng=None, device_h2c: bool | None = None) -> bool:
         kernel = _rlc_kernel_h2c if device_h2c else _rlc_kernel
         name = f"rlc_{'h2c' if device_h2c else 'host_hash'}@{B}"
         out = _dispatch(name, kernel(B),
-                        tuple(jnp.asarray(a) for a in arrays))
-    # cst: allow(host-sync-coerce): single accept/reject bool fetched at
-    # the API boundary — callers need a host answer
-    return bool(out)
+                        tuple(jnp.asarray(a) for a in arrays), block=block)
+    return bool_future(out)
+
+
+def batch_verify(tasks, rng=None, device_h2c: bool | None = None) -> bool:
+    """Synchronous facade over `batch_verify_async` (the block
+    executor's settle call); the bool fetch lives in `serve.futures`."""
+    return batch_verify_async(tasks, rng=rng,
+                              device_h2c=device_h2c).result()
 
 
 @functools.lru_cache(maxsize=16)
@@ -571,22 +618,24 @@ def _rlc_kernel_sharded(n_devices: int, per_shard: int, axis: str):
     return jax.jit(sharded)
 
 
-def batch_verify_sharded(tasks, n_devices: int | None = None,
-                         rng=None, axis: str = "data") -> bool:
-    """`batch_verify` distributed over the device mesh: lanes shard
-    across `n_devices`, cross-device combination is two all_gathers
-    (partial G2 sums, partial Miller products), one replicated final
-    exponentiation.  Accept/reject is bit-identical to `batch_verify`."""
+def batch_verify_sharded_async(tasks, n_devices: int | None = None,
+                               rng=None,
+                               axis: str = "data") -> DeviceFuture:
+    """`batch_verify_async` distributed over the device mesh: lanes
+    shard across `n_devices`, cross-device combination is two
+    all_gathers (partial G2 sums, partial Miller products), one
+    replicated final exponentiation.  Accept/reject is bit-identical to
+    `batch_verify`."""
     import jax
 
     if not tasks:
-        return True
+        return DeviceFuture.settled(True)
     available = len(jax.devices())
     if n_devices is None:
         n_devices = available
     n_devices = min(n_devices, available)
     if n_devices <= 1:
-        return batch_verify(tasks, rng=rng)
+        return batch_verify_async(tasks, rng=rng)
     rand = rng if rng is not None else secrets.SystemRandom()
     # pad lanes to devices x power-of-two per-shard bucket
     n_tasks = len(tasks)
@@ -594,7 +643,7 @@ def batch_verify_sharded(tasks, n_devices: int | None = None,
     arrays, n = _prepare_rlc_inputs(tasks, rand,
                                     n_devices * per_shard)
     if arrays is None:
-        return bool(n)
+        return DeviceFuture.settled(bool(n))
     jnp = _jnp()
     with telemetry.span("bls.batch_verify_sharded", tasks=n_tasks,
                         devices=n_devices, per_shard=per_shard):
@@ -611,6 +660,11 @@ def batch_verify_sharded(tasks, n_devices: int | None = None,
     costmodel.capture(f"rlc_sharded@{n_devices}x{per_shard}",
                       kernel, jargs)
     costmodel.sample_watermark("bls.batch_verify_sharded")
-    # cst: allow(host-sync-coerce): single accept/reject bool fetched at
-    # the API boundary — callers need a host answer
-    return bool(out)
+    return bool_future(out)
+
+
+def batch_verify_sharded(tasks, n_devices: int | None = None,
+                         rng=None, axis: str = "data") -> bool:
+    """Synchronous facade over `batch_verify_sharded_async`."""
+    return batch_verify_sharded_async(tasks, n_devices=n_devices,
+                                      rng=rng, axis=axis).result()

@@ -230,9 +230,15 @@ def _roots_mont(roots_key):
     return FR.to_mont_batch(list(roots_key))
 
 
-def barycentric_eval(poly_ints, roots_brp_ints, z_int) -> int:
+def barycentric_eval_async(poly_ints, roots_brp_ints, z_int):
     """Device evaluation of an evaluation-form polynomial at an
-    out-of-domain z.  Inputs/outputs are canonical python ints."""
+    out-of-domain z, deferred: returns a `serve.futures.DeviceFuture`
+    settling to a canonical python int — the field element returns to
+    the host (and leaves Montgomery form) only at `result()`, so a
+    batch of blob evaluations pipelines instead of serializing on each
+    element."""
+    from ..serve.futures import value_future
+
     width = len(poly_ints)
     assert width == len(roots_brp_ints)
     jnp = _jnp()
@@ -250,6 +256,11 @@ def barycentric_eval(poly_ints, roots_brp_ints, z_int) -> int:
     # cost-capture seam (CST_COSTMODEL rounds), outside the span: the
     # AOT analysis pass must not contaminate the measured wall
     costmodel.capture(f"barycentric@{width}", kfn, (poly, roots, z))
-    # cst: allow(host-sync-np): the evaluated field element returns to
-    # the host KZG library — one fetch per evaluation by contract
-    return FR.from_mont(np.asarray(out))
+    return value_future(out, convert=FR.from_mont)
+
+
+def barycentric_eval(poly_ints, roots_brp_ints, z_int) -> int:
+    """Synchronous facade over `barycentric_eval_async` (the host KZG
+    library's call shape); the fetch lives in `serve.futures`."""
+    return barycentric_eval_async(poly_ints, roots_brp_ints,
+                                  z_int).result()

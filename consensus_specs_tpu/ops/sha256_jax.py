@@ -129,18 +129,34 @@ def merkle_root_pow2(words, depth: int, unroll: bool = False):
     return level[0]
 
 
-def merkleize_words_jax(words: np.ndarray, limit_depth: int,
-                        unroll: bool = False) -> np.ndarray:
-    """Device-side equivalent of sha256_np.merkleize_words (host API).
+def _fold_zero_levels(root: np.ndarray, depth: int,
+                      limit_depth: int) -> np.ndarray:
+    """Host-side tail of a merkleization: fold precomputed zero-subtree
+    hashes over a (8,) uint32 root up to `limit_depth`.  Runs at settle
+    time on the fetched root."""
+    for lvl in range(depth, limit_depth):
+        blk = np.concatenate([root, ZERO_HASH_WORDS[lvl]]).astype(np.uint32)
+        root = _host_sha256_64B(blk[None, :])[0]
+    return root
 
-    Pads the actual chunks to the next power of two on host (zero chunks),
-    reduces on device, then folds precomputed zero-subtree hashes up to
-    `limit_depth`.  Returns (8,) uint32 words on host.
-    """
+
+def merkleize_words_jax_async(words: np.ndarray, limit_depth: int,
+                              unroll: bool = False):
+    """Device-side equivalent of sha256_np.merkleize_words, deferred.
+
+    Pads the actual chunks to the next power of two on host (zero
+    chunks), dispatches the device reduction, and returns a
+    `serve.futures.DeviceFuture` settling to (8,) uint32 root words —
+    the root crosses to the host (and the zero-subtree fold runs) only
+    at `result()`, so callers can merkleize many subtrees back-to-back
+    without serializing the dispatch pipeline."""
+    from ..serve.futures import DeviceFuture, value_future
+
     n = words.shape[0]
     assert n <= (1 << limit_depth)
     if n == 0:
-        return np.array(ZERO_HASH_WORDS[limit_depth], copy=True)
+        return DeviceFuture.settled(
+            np.array(ZERO_HASH_WORDS[limit_depth], copy=True))
     d = max(n - 1, 0).bit_length()
     padded = np.zeros((1 << d, 8), dtype=np.uint32)
     padded[:n] = words
@@ -149,15 +165,19 @@ def merkleize_words_jax(words: np.ndarray, limit_depth: int,
         # cst: allow(recompile-unbucketed-dim): the static tree depth keys
         # the executable — log-bounded (<= limit_depth distinct compiles),
         # and each depth's program is a small rolled loop
-        # cst: allow(host-sync-np): single root fetch — this is the host
-        # API boundary of the device reduction
-        root = np.asarray(merkle_root_pow2(dev_words, d, unroll))
+        root = merkle_root_pow2(dev_words, d, unroll)
     # cost-capture seam (CST_COSTMODEL rounds): flop/byte budget of the
     # depth-d reduction, once per depth per process — outside the span
     # so the AOT analysis pass does not contaminate the measured wall
     costmodel.capture(f"sha256_merkle@d{d}", merkle_root_pow2,
                       (dev_words, d, unroll))
-    for lvl in range(d, limit_depth):
-        blk = np.concatenate([root, ZERO_HASH_WORDS[lvl]]).astype(np.uint32)
-        root = _host_sha256_64B(blk[None, :])[0]
-    return root
+    return value_future(
+        root, convert=lambda host: _fold_zero_levels(host, d, limit_depth))
+
+
+def merkleize_words_jax(words: np.ndarray, limit_depth: int,
+                        unroll: bool = False) -> np.ndarray:
+    """Synchronous facade over `merkleize_words_jax_async` (the host
+    API boundary of the device reduction); the root fetch lives in
+    `serve.futures`."""
+    return merkleize_words_jax_async(words, limit_depth, unroll).result()

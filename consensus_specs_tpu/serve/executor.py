@@ -1,0 +1,318 @@
+"""Batching serve executor — queue → topological device batches → settle.
+
+`ServeExecutor` is the serving counterpart of the block executor's
+`DeferredBatch`: requests (`submit_*`) enqueue and immediately return a
+`DeviceFuture`; `pump()` drains the queue into device batches on the
+`_bucket` shape ladder (so sustained traffic reuses the same AOT-warmed
+executables instead of compiling per batch size) and settles futures in
+arrival order WITHIN each request kind (batches themselves dispatch in
+fixed `KINDS` order per pump, so cross-kind ordering is not preserved).
+
+Pipelining contract (the "double-buffered host→device transfer"): the
+executor keeps up to `depth` dispatched batches in flight and settles
+the oldest only once newer work has been dispatched — so the host-side
+prep of batch N+1 (point→limb conversion, RLC coefficient draws,
+transfers) overlaps the device execution of batch N, and a `result()`
+on any handle finds the answer already materializing instead of
+stalling a cold pipeline.  `drain()` settles everything.
+
+Request kinds and their device paths:
+
+    verify     FastAggregateVerify-style statements, BATCHED: up to
+               `max_batch` statements per RLC dispatch
+               (`bls_batch.batch_verify_async`).  A batch verdict of
+               True settles every statement True; False triggers a
+               per-statement recheck (`pairing_check_device`) so each
+               handle gets its own verdict — all-or-nothing is a block
+               semantics, not a serving one.
+    pairing    one pairing-product check (`pairing_check_device_async`)
+    msm        one G1 MSM (`g1_multi_exp_device_async`)
+    sha256     one Merkle-root reduction (`merkleize_words_jax_async`)
+    fr         one barycentric evaluation (`barycentric_eval_async`)
+
+A device batch that RAISES settles the exception into every pending
+handle of that batch (callers see it at `result()`), and the executor
+keeps serving — one poisoned batch must not take the service down.
+
+Telemetry (env-gated like everything else): `serve.queue_depth` and
+`serve.inflight_batches` gauges (exported as Chrome-trace counter
+tracks next to the device-memory ones), spans per pump/settle, and
+submitted/settled/failed/recheck counters.  Queue-depth and latency
+accounting for the bench contract is kept independently in plain
+members (`stats()`, `latencies_s`) so the serve block never depends on
+CST_TELEMETRY.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+
+from .. import telemetry
+from .futures import DeviceFuture
+
+KINDS = ("verify", "pairing", "msm", "sha256", "fr")
+
+# batched-kind dispatchers resolve lazily: importing the executor must
+# not pull jax/numpy-heavy ops modules until the first dispatch
+
+
+def _ops_bls_batch():
+    from ..ops import bls_batch
+    return bls_batch
+
+
+class _Request:
+    __slots__ = ("kind", "payload", "future", "t_enqueue")
+
+    def __init__(self, kind, payload, future):
+        self.kind = kind
+        self.payload = payload
+        self.future = future
+        self.t_enqueue = time.perf_counter()
+
+
+class _Batch:
+    __slots__ = ("kind", "future", "reqs", "t_dispatch")
+
+    def __init__(self, kind, future, reqs):
+        self.kind = kind
+        self.future = future
+        self.reqs = reqs
+        self.t_dispatch = time.perf_counter()
+
+
+def _depth_bucket(n: int) -> str:
+    """Histogram label: 0 or the next power of two (1, 2, 4, 8, ...)."""
+    return "0" if n <= 0 else str(1 << (n - 1).bit_length())
+
+
+class ServeExecutor:
+    """See the module docstring.  `max_batch` caps statements per RLC
+    dispatch (a `_bucket` ladder rung keeps executables shared);
+    `depth` is the number of in-flight batches the pipeline holds
+    before settling the oldest."""
+
+    def __init__(self, max_batch: int = 512, depth: int = 2):
+        assert max_batch >= 1 and depth >= 1
+        self.max_batch = max_batch
+        self.depth = depth
+        self._queue: deque[_Request] = deque()
+        self._inflight: deque[_Batch] = deque()
+        self.latencies_s: list[float] = []
+        self._submitted = 0
+        self._settled = 0
+        self._failed = 0
+        self._rechecks = 0
+        self._dispatched_batches = 0
+        self._queue_hist: dict[str, int] = {}
+        self._queue_max = 0
+        self._inflight_max = 0
+
+    # --- submission ---------------------------------------------------------
+
+    def _submit(self, kind: str, payload) -> DeviceFuture:
+        assert kind in KINDS, kind
+        fut = DeviceFuture(waiter=self._settle_until)
+        self._queue.append(_Request(kind, payload, fut))
+        self._submitted += 1
+        telemetry.count("serve.submitted")
+        self._note_queue_depth()
+        return fut
+
+    def submit_verify_task(self, task) -> DeviceFuture:
+        """One pre-parsed FastAggregateVerify statement
+        (g1_pubkey_jacobian, message_bytes, g2_sig_jacobian) — the
+        `batch_verify` task shape.  Returns a bool handle."""
+        return self._submit("verify", task)
+
+    def submit_fast_aggregate_verify(self, pubkeys, message,
+                                     signature) -> DeviceFuture:
+        """Wire-format FastAggregateVerify: inputs validate eagerly
+        (same boundary as `DeferredBatch.record`), the pairing defers.
+        Invalid inputs settle False immediately."""
+        from ..ops.bls.ciphersuite import parse_fast_aggregate_task
+
+        task = parse_fast_aggregate_task(pubkeys, message, signature)
+        if task is None:
+            telemetry.count("serve.rejected_eager")
+            return DeviceFuture.settled(False)
+        return self.submit_verify_task(task)
+
+    def submit_pairing(self, pairs) -> DeviceFuture:
+        """One product-of-pairings check (sync-aggregate shape)."""
+        return self._submit("pairing", pairs)
+
+    def submit_msm(self, points, scalars) -> DeviceFuture:
+        """One G1 multiscalar multiplication; settles to an oracle
+        Jacobian point."""
+        return self._submit("msm", (points, scalars))
+
+    def submit_sha256_root(self, words, limit_depth: int) -> DeviceFuture:
+        """One Merkle-root reduction; settles to (8,) uint32 words."""
+        return self._submit("sha256", (words, limit_depth))
+
+    def submit_barycentric(self, poly_ints, roots_brp_ints,
+                           z_int) -> DeviceFuture:
+        """One evaluation-form polynomial evaluation; settles to int."""
+        return self._submit("fr", (poly_ints, roots_brp_ints, z_int))
+
+    # --- pipeline -----------------------------------------------------------
+
+    def pump(self, settle_all: bool = False) -> None:
+        """Dispatch everything queued, then settle in-flight batches
+        down to the pipeline depth (all of them with `settle_all`)."""
+        with telemetry.span("serve.pump", queued=len(self._queue),
+                            inflight=len(self._inflight)):
+            self._dispatch_queued()
+            self._settle_ready(settle_all)
+
+    def drain(self) -> None:
+        """Dispatch and settle everything; the queue and pipeline are
+        empty afterwards."""
+        self.pump(settle_all=True)
+
+    def outstanding(self) -> int:
+        """Requests submitted but not yet settled."""
+        return len(self._queue) + sum(len(b.reqs) for b in self._inflight)
+
+    # --- internals ----------------------------------------------------------
+
+    def _note_queue_depth(self) -> None:
+        n = len(self._queue)
+        self._queue_hist[_depth_bucket(n)] = \
+            self._queue_hist.get(_depth_bucket(n), 0) + 1
+        if n > self._queue_max:
+            self._queue_max = n
+        telemetry.gauge("serve.queue_depth", n)
+
+    def _note_inflight(self) -> None:
+        n = len(self._inflight)
+        if n > self._inflight_max:
+            self._inflight_max = n
+        telemetry.gauge("serve.inflight_batches", n)
+
+    def _dispatch_one(self, kind: str, reqs: list[_Request]) -> None:
+        try:
+            bb = _ops_bls_batch()
+            # block=False: the pipelined-dispatch contract — on
+            # instrumented rounds the telemetry seam must not
+            # block_until_ready between batches (see bls_batch._dispatch)
+            if kind == "verify":
+                fut = bb.batch_verify_async([r.payload for r in reqs],
+                                            block=False)
+            elif kind == "pairing":
+                fut = bb.pairing_check_device_async(reqs[0].payload,
+                                                    block=False)
+            elif kind == "msm":
+                fut = bb.g1_multi_exp_device_async(*reqs[0].payload,
+                                                   block=False)
+            elif kind == "sha256":
+                from ..ops.sha256_jax import merkleize_words_jax_async
+                fut = merkleize_words_jax_async(*reqs[0].payload)
+            else:   # fr
+                from ..ops.fr_batch import barycentric_eval_async
+                fut = barycentric_eval_async(*reqs[0].payload)
+        except Exception as exc:
+            # host prep can fail before the batch ever reaches the
+            # device (malformed payload); the keep-serving contract is
+            # the same as a failed device batch — fail THESE handles,
+            # keep dispatching the rest
+            for req in reqs:
+                req.future.set_exception(exc)
+            self._failed += len(reqs)
+            telemetry.count("serve.failed", len(reqs))
+            return
+        self._inflight.append(_Batch(kind, fut, reqs))
+        self._dispatched_batches += 1
+        telemetry.count(f"serve.dispatch.{kind}")
+        self._note_inflight()
+
+    def _dispatch_queued(self) -> None:
+        if not self._queue:
+            return
+        # partition the queue by kind, preserving arrival order within
+        # each kind (the topological batches the futures settle in)
+        by_kind: dict[str, list[_Request]] = {}
+        while self._queue:
+            req = self._queue.popleft()
+            by_kind.setdefault(req.kind, []).append(req)
+        self._note_queue_depth()
+        for kind in KINDS:
+            reqs = by_kind.get(kind)
+            if not reqs:
+                continue
+            if kind == "verify":
+                for i in range(0, len(reqs), self.max_batch):
+                    self._dispatch_one(kind, reqs[i:i + self.max_batch])
+            else:
+                for req in reqs:
+                    self._dispatch_one(kind, [req])
+
+    def _settle_ready(self, settle_all: bool) -> None:
+        while self._inflight and (settle_all
+                                  or len(self._inflight) > self.depth):
+            self._settle_batch(self._inflight.popleft())
+            self._note_inflight()
+
+    def _settle_until(self, fut: DeviceFuture) -> None:
+        """Waiter hook for request handles: pump until `fut` settles
+        (its batch may be queued, in flight, or already done)."""
+        self._dispatch_queued()
+        while self._inflight and not fut.done():
+            self._settle_batch(self._inflight.popleft())
+            self._note_inflight()
+
+    def _verify_single(self, task) -> bool:
+        """Per-statement verdict for a failed RLC batch (attribution)."""
+        from ..ops.bls.ciphersuite import fast_aggregate_pairs
+
+        return _ops_bls_batch().pairing_check_device(
+            fast_aggregate_pairs(task))
+
+    def _settle_batch(self, batch: _Batch) -> None:
+        with telemetry.span("serve.settle_batch", kind=batch.kind,
+                            requests=len(batch.reqs)):
+            try:
+                out = batch.future.result()
+                if batch.kind == "verify" and len(batch.reqs) > 1:
+                    if out:
+                        results = [True] * len(batch.reqs)
+                    else:
+                        self._rechecks += 1
+                        telemetry.count("serve.batch_recheck")
+                        results = [self._verify_single(r.payload)
+                                   for r in batch.reqs]
+                else:
+                    results = [out] * len(batch.reqs)
+            except Exception as exc:
+                # a failed device batch — or a failed per-statement
+                # recheck dispatch — fails EVERY pending handle; the
+                # executor itself keeps serving
+                for req in batch.reqs:
+                    req.future.set_exception(exc)
+                self._failed += len(batch.reqs)
+                telemetry.count("serve.failed", len(batch.reqs))
+                return
+            now = time.perf_counter()
+            for req, value in zip(batch.reqs, results):
+                req.future.set_result(value)
+                self.latencies_s.append(now - req.t_enqueue)
+            self._settled += len(batch.reqs)
+            telemetry.count("serve.settled", len(batch.reqs))
+
+    # --- accounting ---------------------------------------------------------
+
+    def stats(self) -> dict:
+        """Plain-dict accounting for the bench `"serve"` block (does not
+        depend on CST_TELEMETRY)."""
+        return {
+            "submitted": self._submitted,
+            "settled": self._settled,
+            "failed": self._failed,
+            "rechecks": self._rechecks,
+            "batches": self._dispatched_batches,
+            "queue_depth": {"max": self._queue_max,
+                            "hist": dict(self._queue_hist)},
+            "inflight_max": self._inflight_max,
+        }

@@ -1,0 +1,178 @@
+"""Deferred-result futures — the device→host settle seam.
+
+Every device computation in this repo used to end with a blocking
+coercion at its API boundary (`bool(out)`, `np.asarray(out)` — the
+`host-sync-*` seams the analyzer inventoried through PR 3).  This module
+replaces that pattern with ONE contract: device entry points return a
+`DeviceFuture` handle, callers keep issuing work (jax dispatch is
+asynchronous — the device keeps executing while Python runs ahead), and
+the blocking transfer happens exactly once, at `result()` time, HERE.
+
+This file is the analyzer's sanctioned settle seam: the
+`host-sync-outside-settle` rule fails `make lint` on any new blocking
+fetch added to a device module outside it, so the serialization points
+the ROADMAP's async item asked to retire cannot silently grow back.
+
+Three flavors of future, one class:
+
+- device-backed   (`value_future`, `bool_future`): wraps a live device
+                  value plus an optional host-side `convert`; `result()`
+                  fetches (the only sync), converts, caches.
+- immediate       (`DeviceFuture.settled` / `.failed`): degenerate paths
+                  that never reached a kernel still hand back the same
+                  handle type, so callers never branch on "was this
+                  deferred?".
+- externally settled (`DeviceFuture(waiter=...)`): the serve executor's
+                  per-request handles — `set_result`/`set_exception`
+                  settle them in topological batches; a `result()` call
+                  on a still-pending handle invokes the waiter (which
+                  pumps the owning executor) instead of deadlocking.
+
+Exception propagation is part of the contract: a failed device batch
+settles every pending handle with the exception, and `result()`
+re-raises it for each caller (`exception()` reads it without raising).
+
+Imports numpy only — never jax (fetching goes through `np.asarray`,
+which blocks on the device value's readiness via the array protocol),
+so importing this module can never initialize a backend.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_UNSET = object()
+
+PENDING = "pending"
+DONE = "done"
+
+
+class FutureError(RuntimeError):
+    """A future was used against its lifecycle (unsettled result() with
+    no waiter, double set_result, ...)."""
+
+
+def _fetch(value):
+    """Device value -> host numpy, recursing through point tuples.  The
+    one blocking transfer of the futures contract lives here."""
+    if isinstance(value, (tuple, list)):
+        return tuple(_fetch(v) for v in value)
+    return np.asarray(value)
+
+
+class DeviceFuture:
+    """Handle for a deferred device result.  See the module docstring
+    for the three construction flavors."""
+
+    __slots__ = ("_state", "_value", "_exc", "_device", "_convert",
+                 "_waiter")
+
+    def __init__(self, device=_UNSET, convert=None, waiter=None):
+        self._state = PENDING
+        self._value = None
+        self._exc = None
+        self._device = device
+        self._convert = convert
+        self._waiter = waiter
+
+    # --- construction helpers -----------------------------------------------
+
+    @classmethod
+    def settled(cls, value) -> "DeviceFuture":
+        """An already-resolved future (degenerate paths that never
+        dispatched)."""
+        fut = cls()
+        fut._state = DONE
+        fut._value = value
+        return fut
+
+    @classmethod
+    def failed(cls, exc: BaseException) -> "DeviceFuture":
+        fut = cls()
+        fut._state = DONE
+        fut._exc = exc
+        return fut
+
+    # --- settling (executor side) -------------------------------------------
+
+    def set_result(self, value) -> None:
+        if self._state is not PENDING:
+            raise FutureError("future already settled")
+        self._state = DONE
+        self._value = value
+        self._waiter = None      # release the executor/batch closure
+        self._convert = None
+
+    def set_exception(self, exc: BaseException) -> None:
+        if self._state is not PENDING:
+            raise FutureError("future already settled")
+        self._state = DONE
+        self._exc = exc
+        self._waiter = None
+        self._convert = None
+
+    # --- reading (caller side) ----------------------------------------------
+
+    def done(self) -> bool:
+        return self._state is DONE
+
+    def exception(self) -> BaseException | None:
+        """The settling exception, without raising; resolves a pending
+        device-backed future first (same as result()).  A handle that
+        cannot settle at all (no value, no waiter, or a waiter that
+        returns without settling) re-raises the lifecycle FutureError —
+        returning None there would misreport the future as succeeded."""
+        if self._state is PENDING:
+            try:
+                self.result()
+            except FutureError:
+                if self._state is PENDING:
+                    raise
+            except BaseException:
+                pass
+        return self._exc
+
+    def result(self):
+        """The host value.  Device-backed futures fetch-and-convert on
+        first call (the blocking transfer); externally settled futures
+        invoke their waiter until settled.  Cached thereafter; a failed
+        future re-raises its exception on every call."""
+        if self._state is PENDING:
+            if self._device is not _UNSET:
+                try:
+                    host = _fetch(self._device)
+                    self._value = (self._convert(host)
+                                   if self._convert is not None else host)
+                except BaseException as exc:
+                    self._exc = exc
+                finally:
+                    self._state = DONE
+                    self._device = None      # release the device ref
+                    self._convert = None
+            elif self._waiter is not None:
+                self._waiter(self)
+                if self._state is PENDING:
+                    raise FutureError(
+                        "waiter returned without settling the future")
+            else:
+                raise FutureError(
+                    "future is pending and has no device value or "
+                    "waiter — settle it via the serve executor")
+        if self._exc is not None:
+            raise self._exc
+        return self._value
+
+
+def value_future(device_value, convert=None) -> DeviceFuture:
+    """Future over a device value; `convert` runs host-side on the
+    fetched numpy value(s) at settle time."""
+    return DeviceFuture(device=device_value, convert=convert)
+
+
+def _as_bool(host) -> bool:
+    return bool(host)
+
+
+def bool_future(device_value) -> DeviceFuture:
+    """Future over a device predicate; `result()` is a python bool."""
+    return DeviceFuture(device=device_value, convert=_as_bool)

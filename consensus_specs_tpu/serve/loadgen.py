@@ -1,0 +1,292 @@
+"""Sustained-load generator — mainnet-rate traffic against ServeExecutor.
+
+Models the steady traffic a production verifier faces (ROADMAP's
+"sustained-load attestation-verification service benchmark"): ~1M
+validators' attestations arrive per epoch as per-slot aggregate
+statements, alongside one sync-committee aggregate, blob-KZG
+evaluations, and state-root merkleizations.  The generator feeds that
+mix — at a multiple of the mainnet arrival rate, or in closed-loop mode
+at whatever rate the device sustains — through one `ServeExecutor` and
+measures windowed throughput until it reaches steady state.
+
+Arrival model (per mainnet slot, 12 s):
+
+    64  attestation aggregate statements (MAX_COMMITTEES_PER_SLOT —
+        1,048,576 validators / 32 slots / ~512-strong committees)
+     1  sync-committee aggregate (pairing check)
+     6  blob-KZG barycentric evaluations (BASELINE config #5's blobs)
+     1  state-root sha256 merkleization
+
+`rate <= 0` switches to closed-loop mode: the generator keeps
+`max_batch * (depth + 1)` requests outstanding and the measured rate IS
+the device's sustained capacity — the mode the CPU smoke uses, since a
+fixed open-loop rate on an arbitrary CI host would either idle or grow
+the queue without bound.
+
+Steady state: windowed verifies/sec, steady when the last 3 windows sit
+within ±20% of their mean; the run extends past the configured window
+count (up to 3x) until that holds, so "reaches steady state" is a
+measured property, not an assumption.  Kernel warmup (AOT precompile of
+the `_bucket` rungs the load will hit) happens before the clock starts.
+
+Knobs (all `CST_SERVE_*`, see README "Serving"): duration, rate
+multiple, statement-pool size, committee size, window count, max batch,
+pipeline depth.
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import time
+from dataclasses import dataclass
+
+from .executor import ServeExecutor
+
+SLOT_SECONDS = 12.0
+MAINNET_VALIDATORS = 1_048_576          # the Wonderboom million-scale regime
+ATT_STATEMENTS_PER_SLOT = 64            # MAX_COMMITTEES_PER_SLOT aggregates
+SYNC_STATEMENTS_PER_SLOT = 1
+KZG_EVALS_PER_SLOT = 6
+SHA_ROOTS_PER_SLOT = 1
+STATEMENTS_PER_SLOT = (ATT_STATEMENTS_PER_SLOT + SYNC_STATEMENTS_PER_SLOT
+                       + KZG_EVALS_PER_SLOT + SHA_ROOTS_PER_SLOT)
+STEADY_TOL = 0.2
+
+
+@dataclass
+class LoadConfig:
+    duration_s: float = 45.0
+    rate: float = 4.0        # multiple of the mainnet arrival rate; <= 0
+                             # switches to closed-loop (device-capacity) mode
+    pool: int = 32           # distinct precomputed statements to cycle
+    committee: int = 64      # aggregated keys per attestation statement
+    windows: int = 6         # throughput windows inside duration_s
+    max_batch: int = 128     # statements per RLC dispatch (ladder rung)
+    depth: int = 2           # in-flight batches (double-buffer default)
+
+    def __post_init__(self):
+        # Steady-state needs 3 windows; the clamp lives here so every
+        # construction path (env, CLI flags, tests) gets it.
+        self.windows = max(3, int(self.windows))
+        self.pool = max(1, int(self.pool))
+        self.max_batch = max(1, int(self.max_batch))
+        self.depth = max(1, int(self.depth))
+
+
+def config_from_env() -> LoadConfig:
+    """LoadConfig with CST_SERVE_* overrides applied to the defaults."""
+    d = LoadConfig()
+    return LoadConfig(
+        duration_s=float(os.environ.get("CST_SERVE_DURATION_S",
+                                        d.duration_s)),
+        rate=float(os.environ.get("CST_SERVE_RATE", d.rate)),
+        pool=int(os.environ.get("CST_SERVE_POOL", d.pool)),
+        committee=int(os.environ.get("CST_SERVE_COMMITTEE", d.committee)),
+        windows=int(os.environ.get("CST_SERVE_WINDOWS", d.windows)),
+        max_batch=int(os.environ.get("CST_SERVE_MAX_BATCH", d.max_batch)),
+        depth=int(os.environ.get("CST_SERVE_DEPTH", d.depth)),
+    )
+
+
+def steady_state(rates, tol: float = STEADY_TOL) -> bool:
+    """True when the last 3 window rates sit within ±tol of their mean."""
+    if len(rates) < 3:
+        return False
+    last = rates[-3:]
+    mean = sum(last) / 3.0
+    if mean <= 0:
+        return False
+    return all(abs(r - mean) <= tol * mean for r in last)
+
+
+def percentile_ms(latencies_s, q: float) -> float | None:
+    """q-th percentile of a latency sample, in milliseconds (nearest-
+    rank on the sorted sample; None on empty input)."""
+    if not latencies_s:
+        return None
+    ordered = sorted(latencies_s)
+    idx = min(len(ordered) - 1, int(round(q * (len(ordered) - 1))))
+    return round(ordered[idx] * 1e3, 3)
+
+
+# --- request payload pools ---------------------------------------------------
+
+
+def build_statement_pool(n_tasks: int, keys_per_task: int,
+                         seed_base: int = 7000):
+    """Valid FastAggregateVerify statements as (agg_pk, msg, sig) oracle
+    points — the aggregate-secret-key shortcut (one scalar mult per
+    side), identical in shape to real per-key aggregation."""
+    from ..ops.bls import ciphersuite as cs
+    from ..ops.bls.curve import g1, g2
+    from ..ops.bls.hash_to_curve import DST_G2, hash_to_g2
+
+    tasks = []
+    for t in range(n_tasks):
+        msg = (seed_base + t).to_bytes(32, "little")
+        h = hash_to_g2(msg, DST_G2)
+        agg_sk = sum(seed_base + t * keys_per_task + i + 1
+                     for i in range(keys_per_task))
+        tasks.append((g1.mul(cs.G1_GEN, agg_sk), msg, g2.mul(h, agg_sk)))
+    return tasks
+
+
+def _pairing_payload(task):
+    """A sync-aggregate-shaped pairing check for one pool statement —
+    the shared FastAggregateVerify identity."""
+    from ..ops.bls.ciphersuite import fast_aggregate_pairs
+
+    return fast_aggregate_pairs(task)
+
+
+def _fr_payload(width: int = 4):
+    """A width-W barycentric evaluation (minimal-preset blob shape)."""
+    from ..ops.fr_batch import R_MODULUS
+
+    g = pow(7, (R_MODULUS - 1) // width, R_MODULUS)
+    roots = [pow(g, i, R_MODULUS) for i in range(width)]
+    poly = [(3 * i + 2) % R_MODULUS for i in range(width)]
+    return (poly, roots, 0x1234567)
+
+
+def _sha_payload():
+    import numpy as np
+
+    return (np.arange(64, dtype=np.uint32).reshape(8, 8), 3)
+
+
+# --- the load loop -----------------------------------------------------------
+
+
+def _warm_kernels(cfg: LoadConfig, pool, payloads) -> float:
+    """AOT-compile every executable the load will hit, OUTSIDE the
+    measured window; returns the warmup wall."""
+    from ..ops.bls_batch import (
+        _BUCKET_STEPS,
+        _bucket,
+        batch_verify_async,
+        pairing_check_device_async,
+    )
+    from ..ops.fr_batch import barycentric_eval_async
+    from ..ops.sha256_jax import merkleize_words_jax_async
+
+    t0 = time.perf_counter()
+    # verify chunks are `max_batch`-sized plus one arbitrary remainder,
+    # so EVERY ladder rung up to _bucket(max_batch) is reachable inside
+    # the measured window — warm them all (power-of-two rungs past the
+    # ladder top for oversized max_batch), or the first chunk landing
+    # on a cold rung pays XLA compile inside a throughput window
+    top = _bucket(cfg.max_batch)
+    rungs = {s for s in _BUCKET_STEPS if s <= top} | {top}
+    r = max(_BUCKET_STEPS)
+    while r < top:
+        r <<= 1
+        rungs.add(r)
+    for rung in sorted(rungs):
+        batch_verify_async([pool[0]] * rung).result()
+    pairing_check_device_async(payloads["pairing"]).result()
+    barycentric_eval_async(*payloads["fr"]).result()
+    merkleize_words_jax_async(*payloads["sha256"]).result()
+    return time.perf_counter() - t0
+
+
+def run_load(cfg: LoadConfig | None = None, executor=None) -> dict:
+    """Drive the serve executor with the configured load; returns the
+    bench `"serve"` block (schema pinned by
+    `telemetry.export.validate_serve_block`)."""
+    cfg = cfg if cfg is not None else config_from_env()
+    pool = build_statement_pool(cfg.pool, cfg.committee)
+    payloads = {"pairing": _pairing_payload(pool[0]),
+                "fr": _fr_payload(), "sha256": _sha_payload()}
+    warm_s = _warm_kernels(cfg, pool, payloads)
+
+    ex = executor if executor is not None \
+        else ServeExecutor(max_batch=cfg.max_batch, depth=cfg.depth)
+    # deterministic per-slot arrival mix (see module docstring)
+    schedule = itertools.cycle(
+        ["verify"] * ATT_STATEMENTS_PER_SLOT
+        + ["pairing"] * SYNC_STATEMENTS_PER_SLOT
+        + ["fr"] * KZG_EVALS_PER_SLOT
+        + ["sha256"] * SHA_ROOTS_PER_SLOT)
+    pool_iter = itertools.cycle(pool)
+    kinds_submitted = {k: 0 for k in ("verify", "pairing", "fr", "sha256")}
+
+    def submit_next():
+        kind = next(schedule)
+        kinds_submitted[kind] += 1
+        if kind == "verify":
+            ex.submit_verify_task(next(pool_iter))
+        elif kind == "pairing":
+            ex.submit_pairing(payloads["pairing"])
+        elif kind == "fr":
+            ex.submit_barycentric(*payloads["fr"])
+        else:
+            ex.submit_sha256_root(*payloads["sha256"])
+
+    closed_loop = cfg.rate <= 0
+    rate_per_s = cfg.rate * STATEMENTS_PER_SLOT / SLOT_SECONDS
+    target_outstanding = cfg.max_batch * (cfg.depth + 1)
+    window_s = cfg.duration_s / cfg.windows
+
+    rates: list[float] = []
+    t0 = time.perf_counter()
+    settled_prev = 0
+    arrived = 0
+    for wi in range(3 * cfg.windows):       # extend (≤3x) until steady
+        # Anchor each window at its actual start and divide by the wall
+        # it really spanned: a single pump that overruns the nominal
+        # boundary (one full RLC settle can) must not fabricate a
+        # zero-rate window that defeats the steady-state check.
+        win_t0 = time.perf_counter()
+        window_end = win_t0 + window_s
+        while time.perf_counter() < window_end:
+            if closed_loop:
+                while ex.outstanding() < target_outstanding:
+                    submit_next()
+                ex.pump()
+            else:
+                due = (time.perf_counter() - t0) * rate_per_s
+                while arrived < due:
+                    submit_next()
+                    arrived += 1
+                ex.pump()
+                time.sleep(0.002)
+        win_elapsed = time.perf_counter() - win_t0
+        settled_now = ex.stats()["settled"]
+        rates.append((settled_now - settled_prev) / win_elapsed)
+        settled_prev = settled_now
+        if wi + 1 >= cfg.windows and steady_state(rates):
+            break
+    measured_s = time.perf_counter() - t0
+    ex.drain()
+
+    st = ex.stats()
+    steady = steady_state(rates)
+    steady_rate = (sum(rates[-3:]) / 3.0 if len(rates) >= 3
+                   else (st["settled"] / measured_s if measured_s else 0.0))
+    return {
+        "verifies_per_s": round(steady_rate, 2),
+        "p50_ms": percentile_ms(ex.latencies_s, 0.50),
+        "p99_ms": percentile_ms(ex.latencies_s, 0.99),
+        "steady": steady,
+        "windows": [round(r, 2) for r in rates],
+        "window_s": round(window_s, 3),
+        "duration_s": round(measured_s, 3),
+        "warmup_s": round(warm_s, 3),
+        "mode": "closed" if closed_loop else "open",
+        "rate_multiple": cfg.rate,
+        "offered_per_s": None if closed_loop else round(rate_per_s, 3),
+        "pool": cfg.pool,
+        "committee": cfg.committee,
+        "max_batch": cfg.max_batch,
+        "depth": cfg.depth,
+        "kinds": kinds_submitted,
+        "submitted": st["submitted"],
+        "settled": st["settled"],
+        "failed": st["failed"],
+        "rechecks": st["rechecks"],
+        "batches": st["batches"],
+        "queue_depth": st["queue_depth"],
+        "inflight_max": st["inflight_max"],
+    }

@@ -23,6 +23,9 @@ Surface:
                           XLA device profiles
     count(name, n=1)      monotonic counter
     observe(name, v)      histogram sample (count/total/min/max)
+    gauge(name, v)        level sample (serve queue depth, in-flight
+                          batches): can go down, and each sample is a
+                          Chrome-trace 'C' counter event
     set_meta(k, v)        one-shot string/num metadata (cache dir, ...)
     add_event(name, dur)  record an externally-measured duration as a
                           closed span (derived phase accounting)
@@ -60,6 +63,7 @@ from .core import (
     counter_value,
     enabled,
     first_call,
+    gauge,
     observe,
     reset,
     set_meta,
@@ -73,14 +77,16 @@ from .export import (
     embed_bench_block,
     validate_bench_block,
     validate_costmodel_block,
+    validate_serve_block,
     write_chrome_trace,
     write_jsonl,
 )
 
 __all__ = [
     "add_event", "configure", "costmodel", "count", "counter_value",
-    "enabled", "first_call", "observe", "reset", "set_meta", "snapshot",
-    "span", "span_seconds", "bench_block", "chrome_trace",
+    "enabled", "first_call", "gauge", "observe", "reset", "set_meta",
+    "snapshot", "span", "span_seconds", "bench_block", "chrome_trace",
     "embed_bench_block", "validate_bench_block",
-    "validate_costmodel_block", "write_chrome_trace", "write_jsonl",
+    "validate_costmodel_block", "validate_serve_block",
+    "write_chrome_trace", "write_jsonl",
 ]

@@ -53,6 +53,9 @@ _events: list[dict] = []
 _events_dropped = 0
 _meta: dict[str, object] = {}
 _first_keys: set[str] = set()
+_gauges: dict[str, dict] = {}
+_gauge_events: list[dict] = []
+_gauge_events_dropped = 0
 
 
 def _env_enabled() -> bool:
@@ -113,16 +116,19 @@ def reset(full: bool = False) -> None:
     every config's export).  `full=True` wipes those too (test
     isolation).  The enabled flag and trace-file arming are always
     unaffected."""
-    global _events_dropped
+    global _events_dropped, _gauge_events_dropped
     with _lock:
         _counters.clear()
         _hists.clear()
         _spans.clear()
+        _gauges.clear()
         if full:
             _meta.clear()
             _events.clear()
             _first_keys.clear()
             _events_dropped = 0
+            _gauge_events.clear()
+            _gauge_events_dropped = 0
     if full:
         # cost records and watermarks are process-level facts (like the
         # first-call keys they attribute against): per-config resets
@@ -156,6 +162,37 @@ def observe(name: str, value: float) -> None:
                 h["min"] = v
             if v > h["max"]:
                 h["max"] = v
+
+
+def gauge(name: str, value) -> None:
+    """Point-in-time level sample (queue depth, in-flight batches):
+    unlike `count` it can go DOWN, and unlike `observe` each sample is
+    also a timeline event — the Chrome-trace exporter renders gauges as
+    'C' (counter) tracks next to the device-memory watermarks, so a
+    Perfetto capture of a serve run shows the queue breathing against
+    the span timeline.  Aggregates (last/min/max/count) land in
+    `snapshot()["gauges"]`."""
+    if not _enabled:
+        return
+    v = float(value)
+    t = time.perf_counter()
+    global _gauge_events_dropped
+    with _lock:
+        g = _gauges.get(name)
+        if g is None:
+            _gauges[name] = {"last": v, "min": v, "max": v, "count": 1}
+        else:
+            g["last"] = v
+            g["count"] += 1
+            if v < g["min"]:
+                g["min"] = v
+            if v > g["max"]:
+                g["max"] = v
+        if len(_gauge_events) < _MAX_EVENTS:
+            _gauge_events.append({"name": name, "value": v,
+                                  "ts": (t - _T0) * 1e6})
+        else:
+            _gauge_events_dropped += 1
 
 
 def set_meta(key: str, value) -> None:
@@ -356,6 +393,7 @@ def snapshot() -> dict:
          "counters":   {str: int},
          "histograms": {str: {"count","total","min","max"}},
          "spans":      {str: {"count","total_s","min_s","max_s"}},
+         "gauges":     {str: {"last","min","max","count"}},
          "events": int, "events_dropped": int,
          "costmodel": {"kernels": {...}, "watermarks": {...},
                        "wm_events": int, "wm_events_dropped": int}}
@@ -367,6 +405,7 @@ def snapshot() -> dict:
             "counters": dict(_counters),
             "histograms": {k: dict(v) for k, v in _hists.items()},
             "spans": {k: dict(v) for k, v in _spans.items()},
+            "gauges": {k: dict(v) for k, v in _gauges.items()},
             "events": len(_events),
             "events_dropped": _events_dropped,
         }
@@ -382,6 +421,12 @@ def _events_copy() -> tuple[list[dict], int]:
         return [dict(e) for e in _events], _events_dropped
 
 
+def _gauge_events_copy() -> tuple[list[dict], int]:
+    """Timeline gauge samples for the Chrome-trace exporter."""
+    with _lock:
+        return [dict(e) for e in _gauge_events], _gauge_events_dropped
+
+
 def _save_state():
     """Deep copy of the whole registry (test support: the telemetry
     suite must reset the process-global registry without destroying the
@@ -393,12 +438,16 @@ def _save_state():
                 [dict(e) for e in _events],
                 dict(_meta),
                 set(_first_keys),
-                _events_dropped)
+                _events_dropped,
+                {k: dict(v) for k, v in _gauges.items()},
+                [dict(e) for e in _gauge_events],
+                _gauge_events_dropped)
 
 
 def _restore_state(state) -> None:
-    global _events_dropped
-    counters, hists, spans, events, meta, first_keys, dropped = state
+    global _events_dropped, _gauge_events_dropped
+    (counters, hists, spans, events, meta, first_keys, dropped,
+     gauges, gauge_events, gauge_dropped) = state
     with _lock:
         _counters.clear()
         _counters.update(counters)
@@ -413,3 +462,8 @@ def _restore_state(state) -> None:
         _first_keys.clear()
         _first_keys.update(first_keys)
         _events_dropped = dropped
+        _gauges.clear()
+        _gauges.update(gauges)
+        _gauge_events.clear()
+        _gauge_events.extend(gauge_events)
+        _gauge_events_dropped = gauge_dropped

@@ -37,10 +37,12 @@ def write_jsonl(path: str) -> int:
 
 def chrome_trace() -> dict:
     """The trace-event JSON object: buffered spans as 'X' (complete)
-    events, cost-model watermark samples and per-kernel cost records as
-    'C' (counter) events — so the Perfetto timeline shows device-memory
-    pressure and kernel flop/byte budgets alongside the span track —
-    plus process/thread metadata, all on one pid."""
+    events; cost-model watermark samples, per-kernel cost records, and
+    gauge samples (serve queue depth / in-flight batches) as 'C'
+    (counter) events — so the Perfetto timeline shows device-memory
+    pressure, kernel flop/byte budgets, and the serve pipeline's
+    breathing alongside the span track — plus process/thread metadata,
+    all on one pid."""
     from . import costmodel
 
     events, dropped = core._events_copy()
@@ -75,9 +77,19 @@ def chrome_trace() -> dict:
             "args": {"flops": c.get("flops", 0.0),
                      "bytes_accessed": c.get("bytes_accessed", 0.0)},
         })
+    gauge_events, g_dropped = core._gauge_events_copy()
+    for g in gauge_events:
+        # one counter track per gauge name (serve.queue_depth,
+        # serve.inflight_batches, ...) next to device_memory_bytes
+        out.append({
+            "name": g["name"], "ph": "C", "cat": "cst",
+            "pid": pid, "tid": 0, "ts": round(g["ts"], 3),
+            "args": {"value": g["value"]},
+        })
     trace = {"traceEvents": out, "displayTimeUnit": "ms"}
-    if dropped or wm_dropped:
-        trace["otherData"] = {"events_dropped": dropped + wm_dropped}
+    if dropped or wm_dropped or g_dropped:
+        trace["otherData"] = {
+            "events_dropped": dropped + wm_dropped + g_dropped}
     return trace
 
 
@@ -233,6 +245,56 @@ def validate_costmodel_block(cm) -> list[str]:
                 and wm["last_bytes"] > wm["high_water_bytes"]:
             problems.append(f"costmodel watermark {dev!r}: high water "
                             f"below last sample")
+    return problems
+
+
+def validate_serve_block(obj) -> list[str]:
+    """Schema check for the bench `"serve"` sub-object (the sustained-
+    load block `serve.loadgen.run_load` returns and `bench_serve.py`
+    embeds); returns problems (empty == valid).  Pinned by
+    `bench_smoke.py`'s serve round and `tests/test_serve.py`."""
+    problems: list[str] = []
+    if not isinstance(obj, dict):
+        return [f"serve block is {type(obj).__name__}, not dict"]
+    vps = obj.get("verifies_per_s")
+    if not isinstance(vps, (int, float)) or isinstance(vps, bool) \
+            or vps < 0:
+        problems.append(f"'verifies_per_s' must be a non-negative "
+                        f"number, got {vps!r}")
+    for key in ("p50_ms", "p99_ms"):
+        v = obj.get(key)
+        if v is not None and (not isinstance(v, (int, float))
+                              or isinstance(v, bool) or v < 0):
+            problems.append(f"{key!r} must be a non-negative number or "
+                            f"null, got {v!r}")
+    p50, p99 = obj.get("p50_ms"), obj.get("p99_ms")
+    if isinstance(p50, (int, float)) and isinstance(p99, (int, float)) \
+            and p99 < p50:
+        problems.append(f"p99_ms ({p99}) below p50_ms ({p50})")
+    if not isinstance(obj.get("steady"), bool):
+        problems.append("'steady' must be a bool")
+    windows = obj.get("windows")
+    if not isinstance(windows, list) or not all(
+            isinstance(w, (int, float)) and not isinstance(w, bool)
+            for w in windows):
+        problems.append("'windows' must be a list of numbers")
+    for key in ("submitted", "settled", "failed"):
+        v = obj.get(key)
+        if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+            problems.append(f"{key!r} must be a non-negative int, "
+                            f"got {v!r}")
+    qd = obj.get("queue_depth")
+    if not isinstance(qd, dict) or not isinstance(qd.get("hist"), dict) \
+            or not isinstance(qd.get("max"), int):
+        problems.append("'queue_depth' must carry an int 'max' and a "
+                        "'hist' dict")
+    elif not all(isinstance(k, str) and isinstance(v, int)
+                 for k, v in qd["hist"].items()):
+        problems.append("queue_depth['hist'] must map str buckets to "
+                        "int counts")
+    if obj.get("mode") not in ("open", "closed"):
+        problems.append(f"'mode' must be 'open' or 'closed', "
+                        f"got {obj.get('mode')!r}")
     return problems
 
 

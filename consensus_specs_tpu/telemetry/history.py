@@ -33,6 +33,11 @@ Record schema (version `SCHEMA`; one JSON object per line):
                                  # (source "costmodel" only; metric
                                  # "costmodel::<kernel>" per kernel plus
                                  # "device_mem_high_water::<device>")
+     "serve": dict,              # compacted sustained-load block
+                                 # (source "serve" only; metric
+                                 # "serve::<metric>" — verifies/sec,
+                                 # p50/p99, queue-depth histogram,
+                                 # steady flag, window rates)
      "ts": float}                # wall-clock stamp (live emissions only)
 
 Robustness contract (pinned by tests/test_benchwatch.py): malformed or
@@ -56,7 +61,7 @@ from pathlib import Path
 SCHEMA = 1
 
 SOURCES = ("bench_round", "multichip_round", "baseline", "bench_emit",
-           "pytest_snapshot", "costmodel")
+           "pytest_snapshot", "costmodel", "serve")
 
 _ROUND_FILE_RE = re.compile(r"(?:BENCH|MULTICHIP)_r(\d+)\.json$")
 
@@ -145,6 +150,34 @@ def _compact_telemetry(tel) -> dict | None:
             and cm["watermarks"]:
         out["watermarks"] = cm["watermarks"]
     return out or None
+
+
+def serve_records(metric: str, serve, **context) -> list[dict]:
+    """`serve`-source history records mined from one metric line's
+    sustained-load `"serve"` sub-object (`serve.loadgen.run_load`'s
+    block): one scalar record each for the steady-state throughput and
+    the latency percentiles — the threshold-gate surface — with the
+    compacted block (steady flag, window rates, queue-depth histogram,
+    mode/shape knobs) riding on the throughput record.  Malformed
+    blocks yield zero records, never an exception."""
+    vps = serve.get("verifies_per_s") if isinstance(serve, dict) else None
+    if not isinstance(vps, (int, float)) or isinstance(vps, bool):
+        return []
+    compact = {k: serve[k] for k in (
+        "steady", "windows", "window_s", "duration_s", "mode",
+        "rate_multiple", "max_batch", "depth", "submitted", "settled",
+        "failed", "rechecks", "batches", "queue_depth", "inflight_max")
+        if k in serve}
+    records = [make_record(
+        "serve", "serve::verifies_per_s", serve["verifies_per_s"],
+        unit="verifies/s", serve=compact, via_metric=metric, **context)]
+    for key, unit in (("p50_ms", "ms"), ("p99_ms", "ms")):
+        v = serve.get(key)
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            records.append(make_record(
+                "serve", f"serve::{key}", v, unit=unit,
+                via_metric=metric, **context))
+    return records
 
 
 def costmodel_records(metric: str, tel, **context) -> list[dict]:
@@ -267,6 +300,9 @@ def parse_bench_round(path) -> tuple[list[dict], list[str]]:
         if name == "mainnet_epoch_sweep_1m_validators_wall" and fingerprint:
             rec["baseline_us_per_validator"] = fingerprint
         records.append(rec)
+        records.extend(serve_records(
+            name, obj.get("serve"), round=rnd, file=path.name,
+            rc=rc, platform=obj.get("platform")))
         for crec in costmodel_records(
                 name, obj.get("telemetry"), round=rnd, file=path.name,
                 rc=rc, platform=obj.get("platform")):
@@ -557,6 +593,10 @@ def emission_records(metric_line: dict, ts: float | None = None
             msm_device_min=obj.get("msm_device_min"),
             error=obj.get("error"),
             ts=round(ts, 1) if ts is not None else None))
+        for srec in serve_records(
+                name, obj.get("serve"), platform=platform,
+                ts=round(ts, 1) if ts is not None else None):
+            records.append(srec)
         for crec in costmodel_records(
                 name, obj.get("telemetry"), platform=platform,
                 ts=round(ts, 1) if ts is not None else None):

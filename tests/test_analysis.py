@@ -214,6 +214,88 @@ def test_np_asarray_of_dispatched_value_fires():
     assert ("host-sync-np", 11) in rules_at(report)
 
 
+def test_async_result_chain_outside_facade_fires():
+    # dispatch-then-immediately-block defeats the futures pipeline
+    report = run("""\
+        def settle_now(tasks):
+            return batch_verify_async(tasks).result()
+        """)
+    assert rules_at(report) == [("host-sync-outside-settle", 2)]
+
+
+def test_matching_sync_facade_is_clean():
+    # the ONE sanctioned compatibility shape: the synchronous facade
+    # over its own _async variant
+    report = run("""\
+        def batch_verify(tasks, rng=None):
+            return batch_verify_async(tasks, rng=rng).result()
+        """)
+    assert rules_at(report) == []
+
+
+def test_mismatched_facade_name_fires():
+    report = run("""\
+        def verify_all(tasks):
+            return batch_verify_async(tasks).result()
+        """)
+    assert rules_at(report) == [("host-sync-outside-settle", 2)]
+
+
+def test_block_until_ready_fires_both_forms():
+    report = run("""\
+        import jax
+
+        def f(x):
+            return jax.block_until_ready(x)
+
+        def g(out):
+            return out.block_until_ready()
+        """)
+    assert ("host-sync-outside-settle", 4) in rules_at(report)
+    assert ("host-sync-outside-settle", 7) in rules_at(report)
+
+
+def test_telemetry_gated_barrier_is_exempt():
+    # the compile-vs-run timing seam: the barrier exists only on
+    # instrumented rounds (the off-path dispatches without one)
+    report = run("""\
+        import jax
+
+        def _dispatch(fn, args):
+            if not telemetry.enabled():
+                return fn(*args)
+            return jax.block_until_ready(fn(*args))
+        """)
+    assert rules_at(report) == []
+
+
+def test_positive_telemetry_gate_exempts_barrier():
+    report = run("""\
+        import jax
+
+        def _probe(fn, args):
+            out = fn(*args)
+            if telemetry.enabled():
+                out = jax.block_until_ready(out)
+            return out
+        """)
+    assert rules_at(report) == []
+
+
+def test_nearby_enabled_call_does_not_exempt_unconditional_barrier():
+    # a counter guard elsewhere in the function must not whitelist an
+    # always-taken barrier — the gate has to cover the barrier itself
+    report = run("""\
+        import jax
+
+        def _dispatch(fn, args):
+            if telemetry.enabled():
+                telemetry.count("calls")
+            return jax.block_until_ready(fn(*args))
+        """)
+    assert ("host-sync-outside-settle", 6) in rules_at(report)
+
+
 def test_device_const_at_import_fires():
     # the live bug class: sha256_jax's import-time jnp constants became
     # leaked tracers when h2c_jax first imported it inside a jit trace
@@ -580,6 +662,9 @@ def test_cli_reports_each_seeded_bad_fixture(tmp_path, capsys):
             "def entry(xs):\n"
             "    return _kern(len(xs))(xs)\n"),
         "host-sync-item": "def g(x):\n    return x.item()\n",
+        "host-sync-outside-settle": (
+            "def settle_now(tasks):\n"
+            "    return batch_verify_async(tasks).result()\n"),
         "dtype-implicit-cast": (
             "def f(a):\n"
             "    import jax.numpy as jnp\n"

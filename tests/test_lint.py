@@ -197,6 +197,32 @@ def test_benchwatch_knob_mention_with_value_suffix_passes(tmp_path):
     assert lint_env_knobs(repo) == []
 
 
+def test_serve_knob_needs_serving_section_mention(tmp_path):
+    from consensus_specs_tpu.lint import lint_env_knobs
+
+    readme = ("## Serving\n\nno knob mention here\n\n"
+              "## Environment knobs\n\n"
+              "| `CST_SERVE_FOO` | unset | a knob |\n")
+    knob = "CST_" + "SERVE_FOO"
+    repo = _knob_repo(tmp_path, readme,
+                      "import os\nX = os.environ.get(%r)\n" % knob)
+    found = lint_env_knobs(repo)
+    assert len(found) == 1
+    assert "Serving" in found[0] and knob in found[0]
+
+
+def test_serve_knob_with_section_mention_passes(tmp_path):
+    from consensus_specs_tpu.lint import lint_env_knobs
+
+    readme = ("## Serving\n\nthe `CST_SERVE_FOO` knob tunes it\n\n"
+              "## Environment knobs\n\n"
+              "| `CST_SERVE_FOO` | unset | a knob |\n")
+    knob = "CST_" + "SERVE_FOO"
+    repo = _knob_repo(tmp_path, readme,
+                      "import os\nX = os.environ.get(%r)\n" % knob)
+    assert lint_env_knobs(repo) == []
+
+
 def test_undocumented_knob_still_caught(tmp_path):
     from consensus_specs_tpu.lint import lint_env_knobs
 

@@ -177,12 +177,14 @@ def test_snapshot_schema_stable():
         telemetry.count("c")
         telemetry.observe("h", 1.0)
         telemetry.set_meta("m", "v")
+        telemetry.gauge("g", 3)
     snap = telemetry.snapshot()
     assert set(snap) == {"enabled", "meta", "counters", "histograms",
-                         "spans", "events", "events_dropped",
+                         "spans", "gauges", "events", "events_dropped",
                          "costmodel"}
     assert snap["enabled"] is True
     assert set(snap["histograms"]["h"]) == {"count", "total", "min", "max"}
+    assert set(snap["gauges"]["g"]) == {"last", "min", "max", "count"}
     assert set(snap["spans"]["s"]) == {"count", "total_s", "min_s",
                                        "max_s"}
     assert set(snap["costmodel"]) == {"kernels", "watermarks",
