@@ -111,9 +111,11 @@ KERNEL_FILES = LIMB_FILES + (
 # (ops/bls) can credit calls into the already-covered bls_batch
 # entries; sha256_jax and fr_batch joined the surface with the
 # cost-capture rule (instr-uncovered-cost) — their device entry points
-# must stay visible to the roofline layer too
+# must stay visible to the roofline layer too; parallel/incremental.py
+# joined with the incremental-merkleization kernels (merkle_incr@…)
 INSTR_FILES = ("ops/bls_batch/__init__.py", "ops/bls/__init__.py",
-               "ops/sha256_jax.py", "ops/fr_batch.py")
+               "ops/sha256_jax.py", "ops/fr_batch.py",
+               "parallel/incremental.py")
 
 # shape-laundering functions: a value that went through one of these is
 # a bucketed compile key, not a raw dimension

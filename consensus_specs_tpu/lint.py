@@ -314,10 +314,12 @@ def lint_env_knobs(repo=None) -> list[str]:
     """Every `CST_*` env read in the tree needs a row in README.md's
     knob table, and every row needs a surviving read.  Benchwatch knobs
     (`CST_BENCHWATCH_*`) additionally need a mention in the README's
-    "Benchwatch" section, and serving knobs (`CST_SERVE_*`) in the
-    "Serving" section — a subsystem's configuration surface must be
-    documented where the subsystem is explained, not only in the flat
-    table.  `repo` overrides the tree root (tests)."""
+    "Benchwatch" section, serving knobs (`CST_SERVE_*`) in the
+    "Serving" section, and incremental-merkleization knobs
+    (`CST_MERKLE_*`) in the "Incremental merkleization" section — a
+    subsystem's configuration surface must be documented where the
+    subsystem is explained, not only in the flat table.  `repo`
+    overrides the tree root (tests)."""
     repo = Path(repo) if repo is not None else PKG_ROOT.parent
     readme = repo / "README.md"
     readme_text = readme.read_text()
@@ -330,7 +332,9 @@ def lint_env_knobs(repo=None) -> list[str]:
 
     sectioned_prefixes = (("CST_BENCHWATCH_", "Benchwatch",
                            section("Benchwatch")),
-                          ("CST_SERVE_", "Serving", section("Serving")))
+                          ("CST_SERVE_", "Serving", section("Serving")),
+                          ("CST_MERKLE_", "Incremental merkleization",
+                           section("Incremental merkleization")))
 
     used: dict[str, str] = {}
     for path in sorted(repo.rglob("*.py")):

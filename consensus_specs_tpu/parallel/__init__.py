@@ -21,6 +21,20 @@ from .bridge import (  # noqa: F401
     validator_static_leaf_words,
 )
 from .epoch import EpochParams, EpochScalars, RegistryArrays, epoch_sweep  # noqa: F401
+from .incremental import (  # noqa: F401
+    MerkleForest,
+    SSZProof,
+    balances_forest,
+    dirty_balance_leaves,
+    dirty_chunks_from_validators,
+    emit_proofs,
+    emit_proofs_async,
+    merkleize_dirty,
+    merkleize_dirty_async,
+    pad_dirty_idx,
+    registry_forest,
+    verify_proof,
+)
 from .merkle import (  # noqa: F401
     ValidatorLeaves,
     balances_list_root,
@@ -50,6 +64,10 @@ __all__ = [
     "make_epoch_step", "make_sharded_epoch_step",
     "registry_arrays_from_state", "validator_static_leaf_words",
     "participation_from_pending", "pad_pow2",
+    "MerkleForest", "SSZProof", "balances_forest", "registry_forest",
+    "merkleize_dirty", "merkleize_dirty_async", "emit_proofs",
+    "emit_proofs_async", "dirty_balance_leaves",
+    "dirty_chunks_from_validators", "pad_dirty_idx", "verify_proof",
 ]
 
 

@@ -1,0 +1,494 @@
+"""Incremental device-resident merkleization — persistent layer stacks.
+
+`parallel.merkle` re-hashes the full registry tree from the leaves on
+every epoch step, yet an epoch transition dirties a small, known subset
+of validators: the dominant sha256 cost of the flagship hot loop is
+redundant.  The reference amortizes exactly this with remerkleable's
+cached pointer-tree (`eth2spec/utils/ssz/ssz_impl.py:25` — unchanged
+subtrees keep their cached roots); this module is the TPU-native
+equivalent over flat arrays.
+
+`MerkleForest` persists EVERY interior layer of one SSZ List tree as a
+flat device array (layer k holds the 2**(data_depth-k) node words of
+level k), so two operations become cheap:
+
+- `update_dirty(layers, dirty_idx, new_leaf_words, depth)`: scatter the
+  new leaf words, then per level deduplicate the dirty indices
+  (`idx >> 1` cascade: sort, mask repeats to the level's sentinel),
+  gather the touched sibling pairs, re-hash ONLY those nodes with the
+  batched sha256 kernel, and scatter them back — O(dirty · log N)
+  hashing instead of O(N).  Dirty counts are padded on the `_bucket`
+  ladder so compiled shapes stay bounded.
+- `gather_proof_paths(layers, indices, depth)`: batch-gather the
+  root-to-leaf sibling paths for a set of leaf indices; the host-side
+  settle assembles full SSZ single-proofs (zero-subtree ladder up to
+  the List limit depth + the length mix-in chunk) verifiable by the
+  `utils.ssz` oracle's `is_valid_merkle_branch` — the stateless-client
+  / light-client proof-serving workload.
+
+Layer-stack layout (data_depth = 3 example, shapes in chunks):
+
+    layer 0   (8, 8) uint32   leaf chunk words
+    layer 1   (4, 8)          H(leaf 2i ‖ leaf 2i+1)
+    layer 2   (2, 8)
+    layer 3   (1, 8)          data-subtree root
+    ── above the stack, at result(): zero-subtree fold to limit_depth,
+       then the SSZ length mix-in (both host-side, log-bounded)
+
+Settle contract: entry points return `serve.futures.DeviceFuture`
+handles (`*_async`); the one blocking fetch happens at `result()`,
+matching the analyzer's `host-sync-outside-settle` rule.  Updates
+themselves never sync — they replace the layer stack with freshly
+dispatched device arrays.
+
+Parity oracles: `parallel.merkle.balances_list_root` /
+`validator_registry_root` (device full rebuild) and
+`utils.ssz.ssz_impl.hash_tree_root` + `utils.ssz.gindex`
+(`tests/test_incremental_merkle.py`).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import telemetry
+from ..ops.sha256_jax import _fold_zero_levels, sha256_64B_words
+from ..ops.sha256_np import ZERO_HASH_WORDS
+from ..ops.sha256_np import sha256_64B_words as _host_sha256_64B
+from ..telemetry import costmodel
+from .merkle import pack_u64_chunks
+
+# uint64 packing needs x64; entry points enable it (see parallel.require_x64)
+
+# dirty-count ladder: every update/proof batch compiles at most these
+# shapes for realistic dirty sets (larger sets fall back to powers of
+# two).  Ratio-16 rungs: the rung cost is log N hash batches of M lanes,
+# so over-padding is cheap sha work, and the flagship's 1% regime
+# (10k dirty chunks @ 2**18) lands on the 16384 rung exactly.
+_DIRTY_STEPS = (64, 1024, 16384)
+
+
+def _bucket(n: int) -> int:
+    """Padded dirty-count shape for n live indices: next power of two,
+    quantized UP to the ladder so jit caches stay tiny.  Padded lanes
+    carry the out-of-range sentinel and are dropped by the scatters, so
+    correctness never depends on n."""
+    b = 1 if n <= 1 else 1 << (n - 1).bit_length()
+    for step in _DIRTY_STEPS:
+        if b <= step:
+            return step
+    return b
+
+
+def pad_dirty_idx(dirty_idx, capacity: int) -> np.ndarray:
+    """Sentinel-pad a host-known dirty index set to its `_bucket` rung:
+    rows beyond the live count carry `capacity` (out of range for the
+    tree, dropped by the device scatters).  The ONE definition of the
+    pad convention — `MerkleForest.update` and callers that pre-pad
+    (the flagship keeps its padded index arrays device-resident) must
+    agree on rung and sentinel, so both go through here."""
+    idx = np.asarray(dirty_idx, dtype=np.uint32)
+    out = np.full((_bucket(idx.shape[0]),), capacity, dtype=np.uint32)
+    out[:idx.shape[0]] = idx
+    return out
+
+
+def _hash_blocks(blocks):
+    """The one sha256 seam of this module — tests monkeypatch it to
+    count hash invocations per traced update (the hashes-per-update
+    scaling contract)."""
+    return sha256_64B_words(blocks)
+
+
+def _build_layers_impl(leaves, depth: int):
+    """Full reduction that KEEPS every level: (2**depth, 8) leaf words
+    -> tuple of depth+1 layers (leaves first, data root last).
+    Unjitted body, so the tests' `_hash_blocks` lane counter sees it."""
+    layers = [leaves]
+    for _ in range(depth):
+        layers.append(_hash_blocks(layers[-1].reshape(-1, 16)))
+    return tuple(layers)
+
+
+_build_layers = jax.jit(_build_layers_impl, static_argnames=("depth",))
+
+
+def _update_dirty_impl(layers, dirty_idx, new_leaf_words, depth: int):
+    """See `update_dirty`.  Unjitted body, traceable by the tests'
+    hashes-per-update check.
+
+    Two regimes per level, chosen statically from the padded dirty
+    rung M (the `idx >> 1` cascade deduplicates dirty paths in both):
+
+    - sparse (level wider than M): gather the M touched sibling pairs,
+      hash M lanes, scatter the parents back.  Duplicate parents (two
+      dirty children) gather the same pair and scatter the same hash —
+      the cascade collapses them by idempotence, no sort needed; the
+      sentinel index cascades out of range and is dropped.
+    - dense (level no wider than M): re-hash the WHOLE level from its
+      (already updated) children.  Cheaper than gather/scatter at that
+      width, needs no index bookkeeping, and makes the all-dirty case
+      degrade to ~full-rebuild cost (2N lanes) instead of depth*N.
+
+    Total hash lanes: M per sparse level + the dense-tail geometric sum
+    (< 2M) — O(dirty * log N), vs 2N for a full rebuild.
+    """
+    rung = dirty_idx.shape[0]
+    out = [layers[0].at[dirty_idx].set(new_leaf_words, mode="drop")]
+    cur = dirty_idx
+    for lvl in range(depth):
+        size = 1 << (depth - lvl - 1)       # nodes in level lvl+1
+        if size <= rung:
+            # dense tail: every level from here up is narrower than
+            # the rung — once dense, always dense
+            out.append(_hash_blocks(out[lvl].reshape(-1, 16)))
+            continue
+        # idx >> 1 cascade: each parent's (left ‖ right) children are
+        # contiguous in the child layer, so reshaping to (size, 16)
+        # makes the sibling-pair gather one row read per dirty path
+        parents = cur >> jnp.uint32(1)
+        pairs = out[lvl].reshape(-1, 16)
+        blk = pairs[jnp.minimum(parents, jnp.uint32(size - 1))]
+        hashed = _hash_blocks(blk)
+        out.append(layers[lvl + 1].at[parents].set(hashed, mode="drop"))
+        cur = parents
+    return tuple(out)
+
+
+_update_dirty_jit = jax.jit(_update_dirty_impl,
+                            static_argnames=("depth",))
+
+
+def update_dirty(layers, dirty_idx, new_leaf_words, depth: int):
+    """Re-hash the dirty root-to-leaf paths of a persisted layer stack.
+
+    layers: tuple of depth+1 device arrays (`_build_layers` shape);
+    dirty_idx: (M,) uint32 leaf indices, padded with the sentinel
+    2**depth (out-of-range rows are dropped); new_leaf_words: (M, 8)
+    uint32 chunk words.  Returns the new layer tuple — a pure O(M·depth)
+    device dispatch, no host sync."""
+    m = int(dirty_idx.shape[0])
+    with telemetry.span("parallel.merkle_incr.update_dirty",
+                        rung=m, depth=depth):
+        out = _update_dirty_jit(layers, dirty_idx, new_leaf_words, depth)
+    # cost-capture seam (CST_COSTMODEL rounds): the dirty-rung kernel's
+    # flop/byte budget, once per (rung, depth) per process — outside the
+    # span so the AOT analysis pass does not contaminate the wall
+    costmodel.capture(f"merkle_incr@u{m}d{depth}", _update_dirty_jit,
+                      (out, dirty_idx, new_leaf_words, depth))
+    return out
+
+
+@partial(jax.jit, static_argnames=("depth",))
+def _gather_proof_paths(layers, idx, depth: int):
+    """(M,) leaf indices -> ((M, 8) leaf words, (M, depth, 8) sibling
+    words bottom-up) gathered from the persisted layers."""
+    leaves = layers[0][jnp.minimum(idx, jnp.uint32(layers[0].shape[0] - 1))]
+    sibs = []
+    cur = idx
+    for lvl in range(depth):
+        size = 1 << (depth - lvl)           # nodes in level lvl
+        sib = jnp.minimum(cur ^ jnp.uint32(1), jnp.uint32(size - 1))
+        sibs.append(layers[lvl][sib])
+        cur = cur >> jnp.uint32(1)
+    if not sibs:
+        path = jnp.zeros((idx.shape[0], 0, 8), jnp.uint32)
+    else:
+        path = jnp.stack(sibs, axis=1)
+    return leaves, path
+
+
+def gather_proof_paths(layers, idx, depth: int):
+    """Instrumented facade over the proof-path gather kernel (the
+    device half of `emit_proofs`)."""
+    m = int(idx.shape[0])
+    with telemetry.span("parallel.merkle_incr.gather_proofs",
+                        rung=m, depth=depth):
+        out = _gather_proof_paths(layers, idx, depth)
+    costmodel.capture(f"merkle_proof@p{m}d{depth}", _gather_proof_paths,
+                      (layers, idx, depth))
+    return out
+
+
+# --- host-side finishing (runs at DeviceFuture settle time) ------------------
+
+
+def _words_to_bytes(words: np.ndarray) -> bytes:
+    """(8,) big-endian uint32 chunk words -> 32 bytes."""
+    return np.asarray(words, dtype=np.uint32).astype(">u4").tobytes()
+
+
+def _length_chunk(length: int) -> bytes:
+    return int(length).to_bytes(8, "little") + b"\x00" * 24
+
+
+def _finish_root(data_root: np.ndarray, data_depth: int,
+                 limit_depth: int, length: int) -> np.ndarray:
+    """Zero-subtree fold + SSZ length mix-in over a fetched (8,) uint32
+    data root — the log-bounded host tail of a list merkleization."""
+    root = _fold_zero_levels(data_root, data_depth, limit_depth)
+    tail = np.frombuffer(_length_chunk(length), dtype=">u4").astype(np.uint32)
+    blk = np.concatenate([root, tail]).astype(np.uint32)
+    return _host_sha256_64B(blk[None, :])[0]
+
+
+class SSZProof(NamedTuple):
+    """One SSZ single-proof for a leaf chunk of a List tree.
+
+    `branch` runs bottom-up: `limit_depth` data-tree siblings followed
+    by the length mix-in chunk, so the proof verifies with the spec's
+    `is_valid_merkle_branch(leaf, branch, limit_depth + 1, index,
+    root)` — `gindex` is the generalized index of the chunk within the
+    List type (`utils.ssz.gindex` algebra: data tree at gindex 2)."""
+
+    index: int
+    gindex: int
+    leaf: bytes
+    branch: tuple[bytes, ...]
+
+    @property
+    def depth(self) -> int:
+        return len(self.branch)
+
+
+def _assemble_proofs(host, indices, data_depth: int, limit_depth: int,
+                     length: int) -> list[SSZProof]:
+    """Device gather (leaves, sibling paths) -> full SSZProofs: the
+    persisted-path siblings, then the zero-subtree ladder up to
+    `limit_depth`, then the length chunk."""
+    leaves, paths = host
+    zero_tail = [_words_to_bytes(ZERO_HASH_WORDS[lvl])
+                 for lvl in range(data_depth, limit_depth)]
+    len_chunk = _length_chunk(length)
+    proofs = []
+    for row, i in enumerate(indices):
+        branch = [_words_to_bytes(paths[row, lvl])
+                  for lvl in range(data_depth)]
+        branch.extend(zero_tail)
+        branch.append(len_chunk)
+        proofs.append(SSZProof(
+            index=int(i),
+            gindex=(2 << limit_depth) + int(i),
+            leaf=_words_to_bytes(leaves[row]),
+            branch=tuple(branch)))
+    return proofs
+
+
+def verify_proof(proof: SSZProof, root: bytes) -> bool:
+    """Host oracle check: spec-level branch verification of one emitted
+    proof against a 32-byte list root (pure Python, no jax)."""
+    from ..utils.ssz.gindex import is_valid_merkle_branch
+
+    return is_valid_merkle_branch(proof.leaf, proof.branch, proof.depth,
+                                  proof.index, root)
+
+
+# --- the forest --------------------------------------------------------------
+
+
+class MerkleForest:
+    """Persistent device-resident merkleization state for one SSZ List.
+
+    Holds every interior layer of the (power-of-two padded) data tree
+    as flat device arrays; `update_async` re-hashes only the dirty
+    root-to-leaf paths, `root_async`/`emit_proofs_async` settle through
+    `serve.futures.DeviceFuture` handles (the sanctioned sync seam).
+
+    `leaf_words` is the (n, 8) uint32 chunk-word array of the list's
+    bottom layer (already packed: 4 uint64 per chunk for a balances
+    list, one record root per chunk for the validator registry);
+    `length` is the true SSZ element count for the length mix-in.
+    """
+
+    def __init__(self, leaf_words, limit_depth: int, length: int):
+        leaf_words = np.asarray(leaf_words, dtype=np.uint32)
+        n = leaf_words.shape[0]
+        assert n <= (1 << limit_depth)
+        d = max(n - 1, 0).bit_length()
+        padded = np.zeros((1 << d, 8), dtype=np.uint32)
+        padded[:n] = leaf_words
+        self.data_depth = d
+        self.limit_depth = limit_depth
+        self.length = int(length)
+        self.n_chunks = n
+        with telemetry.span("parallel.merkle_incr.build", depth=d):
+            # cst: allow(recompile-unbucketed-dim): the static tree depth
+            # keys the executable — log-bounded (<= limit_depth distinct
+            # compiles), same contract as merkleize_words_jax
+            self.layers = _build_layers(jnp.asarray(padded), d)
+        costmodel.capture(f"merkle_build@d{d}", _build_layers,
+                          (self.layers[0], d))
+
+    @property
+    def capacity(self) -> int:
+        """Leaf slots the persisted stack can address (padded pow2)."""
+        return 1 << self.data_depth
+
+    def update(self, dirty_idx, new_leaf_words) -> None:
+        """Scatter `new_leaf_words` at `dirty_idx` (HOST-known leaf
+        chunk indices, any order; duplicate indices are allowed ONLY
+        when they carry identical leaf values — XLA scatter order for
+        colliding rows is implementation-defined, so dedup divergent
+        duplicates host-side first, as `dirty_chunks_from_validators`
+        does) and re-hash the touched paths.  Indices >= `capacity`
+        are the sentinel convention — those rows are dropped, so
+        callers may pre-pad to a `_bucket` rung themselves via
+        `pad_dirty_idx` (the flagship does, to keep its gathered leaf
+        arrays on device).  `new_leaf_words` may be a host or device
+        array; padding happens without a device fetch.  Pure dispatch:
+        the layer stack is replaced with not-yet-materialized device
+        arrays, no host sync."""
+        m = len(dirty_idx)
+        if m == 0:
+            return
+        idx = pad_dirty_idx(dirty_idx, self.capacity)
+        rung = idx.shape[0]
+        leaves = jnp.asarray(new_leaf_words, dtype=jnp.uint32)
+        if leaves.shape[0] < rung:      # device-safe pad (no host fetch)
+            leaves = jnp.concatenate(
+                [leaves, jnp.zeros((rung - m, 8), dtype=jnp.uint32)])
+        self.layers = update_dirty(self.layers, jnp.asarray(idx),
+                                   leaves, self.data_depth)
+
+    def root_async(self):
+        """DeviceFuture settling to the (8,) uint32 words of the full
+        List hash_tree_root (zero-ladder + length mix-in run host-side
+        at result())."""
+        from ..serve.futures import value_future
+
+        d, limit, length = self.data_depth, self.limit_depth, self.length
+        return value_future(
+            self.layers[-1][0],
+            convert=lambda host: _finish_root(host, d, limit, length))
+
+    def root(self) -> np.ndarray:
+        """Synchronous facade over `root_async` (the host API boundary
+        of the incremental reduction)."""
+        return self.root_async().result()
+
+    def root_bytes(self) -> bytes:
+        """The list root as the oracle's 32-byte form."""
+        return _words_to_bytes(self.root())
+
+    def emit_proofs_async(self, indices):
+        """Batch-emit SSZ single-proofs for `indices` (leaf chunk
+        positions).  Device work is one bucketed sibling-path gather;
+        the zero-ladder tail and length chunk are appended host-side at
+        settle.  Settles to a list of `SSZProof`."""
+        from ..serve.futures import DeviceFuture, value_future
+
+        indices = [int(i) for i in indices]
+        if not indices:
+            return DeviceFuture.settled([])
+        assert max(indices) < self.n_chunks, (
+            "proof index beyond the list's real chunk count")
+        rung = _bucket(len(indices))
+        idx = np.zeros((rung,), dtype=np.uint32)
+        idx[:len(indices)] = indices
+        gathered = gather_proof_paths(self.layers, jnp.asarray(idx),
+                                      self.data_depth)
+        d, limit, length = self.data_depth, self.limit_depth, self.length
+        return value_future(
+            gathered,
+            convert=lambda host: _assemble_proofs(host, indices, d,
+                                                  limit, length))
+
+    def emit_proofs(self, indices) -> list[SSZProof]:
+        """Synchronous facade over `emit_proofs_async`."""
+        return self.emit_proofs_async(indices).result()
+
+
+# --- module-level async facades (the serve executor's dispatch shape) --------
+
+
+def merkleize_dirty_async(forest: MerkleForest, dirty_idx,
+                          new_leaf_words):
+    """Apply a dirty-set update and return the root future — the
+    deferred-result entry point the flagship step and the serve
+    executor consume (`host-sync-outside-settle` contract: dispatch
+    here, block only at `result()`)."""
+    with telemetry.span("parallel.merkle_incr.merkleize_dirty",
+                        dirty=len(dirty_idx)):
+        forest.update(dirty_idx, new_leaf_words)
+        return forest.root_async()
+
+
+def merkleize_dirty(forest: MerkleForest, dirty_idx,
+                    new_leaf_words) -> np.ndarray:
+    """Synchronous facade over `merkleize_dirty_async`."""
+    return merkleize_dirty_async(forest, dirty_idx, new_leaf_words).result()
+
+
+def emit_proofs_async(forest: MerkleForest, indices):
+    """Module-level facade over `MerkleForest.emit_proofs_async` (the
+    serve executor's proof-request dispatch target)."""
+    return forest.emit_proofs_async(indices)
+
+
+def emit_proofs(forest: MerkleForest, indices) -> list[SSZProof]:
+    """Synchronous facade over `emit_proofs_async`."""
+    return emit_proofs_async(forest, indices).result()
+
+
+# --- flagship glue: registry-scale forests over the sweep arrays -------------
+
+
+def balances_forest(balances, length, limit_depth: int = 38) -> MerkleForest:
+    """Forest over `List[uint64, 2**40]` (4 values per 32-byte chunk,
+    limit 2**38 chunks) from a host uint64 balances array."""
+    from . import require_x64
+    require_x64()
+    chunks = np.asarray(pack_u64_chunks(jnp.asarray(balances)))
+    return MerkleForest(chunks, limit_depth, length)
+
+
+def registry_forest(record_roots, length,
+                    limit_depth: int = 40) -> MerkleForest:
+    """Forest over `List[Validator, 2**40]` from per-record root words
+    ((n, 8) uint32, e.g. `merkle.validator_records_root` output).  Pad
+    rows beyond `length` must already be zero chunks (SSZ pads the leaf
+    level with zero chunks, not zero-validator roots)."""
+    return MerkleForest(record_roots, limit_depth, length)
+
+
+def dirty_chunks_from_validators(dirty_validator_idx) -> np.ndarray:
+    """Dirty balance-chunk indices for a set of dirty validator
+    indices (4 uint64 per chunk; host-side, deduplicated, sorted)."""
+    return np.unique(np.asarray(dirty_validator_idx,
+                                dtype=np.uint64) >> np.uint64(2)
+                     ).astype(np.uint32)
+
+
+@jax.jit
+def _gather_balance_chunks(balances, chunk_idx):
+    """((N,) uint64 balances, (M,) chunk indices) -> (M, 8) uint32
+    chunk words: gather each dirty chunk's 4 values and pack them with
+    the SSZ little-endian layout."""
+    flat = (chunk_idx.astype(jnp.uint64)[:, None] * jnp.uint64(4)
+            + jnp.arange(4, dtype=jnp.uint64)[None, :]).reshape(-1)
+    vals = balances[jnp.minimum(flat,
+                                jnp.uint64(balances.shape[0] - 1))]
+    # beyond-end gathers clamp; zero them so pad chunks stay SSZ zero
+    vals = jnp.where(flat < jnp.uint64(balances.shape[0]), vals,
+                     jnp.uint64(0))
+    return pack_u64_chunks(vals)
+
+
+def dirty_balance_leaves(balances, chunk_idx):
+    """Instrumented facade over the dirty-chunk gather/pack kernel —
+    the flagship's bridge from a swept balances array to
+    `update_dirty` leaf words."""
+    from . import require_x64
+    require_x64()
+    m = int(chunk_idx.shape[0])
+    with telemetry.span("parallel.merkle_incr.dirty_balance_leaves",
+                        rung=m):
+        out = _gather_balance_chunks(balances, chunk_idx)
+    costmodel.capture(f"merkle_leafpack@{m}", _gather_balance_chunks,
+                      (balances, chunk_idx))
+    return out

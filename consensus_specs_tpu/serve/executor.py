@@ -29,6 +29,10 @@ Request kinds and their device paths:
     msm        one G1 MSM (`g1_multi_exp_device_async`)
     sha256     one Merkle-root reduction (`merkleize_words_jax_async`)
     fr         one barycentric evaluation (`barycentric_eval_async`)
+    proof      one batched SSZ single-proof emission from a persistent
+               `parallel.incremental.MerkleForest`
+               (`incremental.emit_proofs_async`) — the stateless-client
+               proof-serving workload riding the same futures pipeline
 
 A device batch that RAISES settles the exception into every pending
 handle of that batch (callers see it at `result()`), and the executor
@@ -51,7 +55,7 @@ from collections import deque
 from .. import telemetry
 from .futures import DeviceFuture
 
-KINDS = ("verify", "pairing", "msm", "sha256", "fr")
+KINDS = ("verify", "pairing", "msm", "sha256", "fr", "proof")
 
 # batched-kind dispatchers resolve lazily: importing the executor must
 # not pull jax/numpy-heavy ops modules until the first dispatch
@@ -157,6 +161,14 @@ class ServeExecutor:
         """One evaluation-form polynomial evaluation; settles to int."""
         return self._submit("fr", (poly_ints, roots_brp_ints, z_int))
 
+    def submit_proof_request(self, forest, indices) -> DeviceFuture:
+        """Batched SSZ single-proof emission from a persistent
+        `parallel.incremental.MerkleForest` (the stateless-client
+        serving workload): one bucketed sibling-path gather rides the
+        pipeline; settles to `list[SSZProof]`.  Out-of-range indices
+        fail eagerly at dispatch and poison only their own handle."""
+        return self._submit("proof", (forest, list(indices)))
+
     # --- pipeline -----------------------------------------------------------
 
     def pump(self, settle_all: bool = False) -> None:
@@ -210,9 +222,12 @@ class ServeExecutor:
             elif kind == "sha256":
                 from ..ops.sha256_jax import merkleize_words_jax_async
                 fut = merkleize_words_jax_async(*reqs[0].payload)
-            else:   # fr
+            elif kind == "fr":
                 from ..ops.fr_batch import barycentric_eval_async
                 fut = barycentric_eval_async(*reqs[0].payload)
+            else:   # proof
+                from ..parallel.incremental import emit_proofs_async
+                fut = emit_proofs_async(*reqs[0].payload)
         except Exception as exc:
             # host prep can fail before the batch ever reaches the
             # device (malformed payload); the keep-serving contract is

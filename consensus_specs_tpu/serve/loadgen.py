@@ -16,6 +16,9 @@ Arrival model (per mainnet slot, 12 s):
      1  sync-committee aggregate (pairing check)
      6  blob-KZG barycentric evaluations (BASELINE config #5's blobs)
      1  state-root sha256 merkleization
+     2  batched SSZ single-proof emissions from a persistent
+        `parallel.incremental.MerkleForest` (`submit_proof_request` —
+        the stateless-client proof queries light clients issue)
 
 `rate <= 0` switches to closed-loop mode: the generator keeps
 `max_batch * (depth + 1)` requests outstanding and the measured rate IS
@@ -49,8 +52,10 @@ ATT_STATEMENTS_PER_SLOT = 64            # MAX_COMMITTEES_PER_SLOT aggregates
 SYNC_STATEMENTS_PER_SLOT = 1
 KZG_EVALS_PER_SLOT = 6
 SHA_ROOTS_PER_SLOT = 1
+PROOF_REQUESTS_PER_SLOT = 2             # stateless-client proof queries
 STATEMENTS_PER_SLOT = (ATT_STATEMENTS_PER_SLOT + SYNC_STATEMENTS_PER_SLOT
-                       + KZG_EVALS_PER_SLOT + SHA_ROOTS_PER_SLOT)
+                       + KZG_EVALS_PER_SLOT + SHA_ROOTS_PER_SLOT
+                       + PROOF_REQUESTS_PER_SLOT)
 STEADY_TOL = 0.2
 
 
@@ -156,6 +161,23 @@ def _sha_payload():
     return (np.arange(64, dtype=np.uint32).reshape(8, 8), 3)
 
 
+def _proof_payload(n_leaves: int = 256, batch: int = 16):
+    """A persistent `MerkleForest` plus one index batch — the
+    `submit_proof_request` payload shape (the forest is built once and
+    shared across every proof request of the run, exactly the
+    stateless-client serving posture)."""
+    import numpy as np
+
+    from ..parallel.incremental import MerkleForest
+
+    rng = np.random.RandomState(31)
+    words = rng.randint(0, 2**32, (n_leaves, 8),
+                        dtype=np.uint64).astype(np.uint32)
+    forest = MerkleForest(words, 10, n_leaves)
+    return (forest, [int(i) for i in rng.choice(n_leaves, batch,
+                                                replace=False)])
+
+
 # --- the load loop -----------------------------------------------------------
 
 
@@ -170,6 +192,7 @@ def _warm_kernels(cfg: LoadConfig, pool, payloads) -> float:
     )
     from ..ops.fr_batch import barycentric_eval_async
     from ..ops.sha256_jax import merkleize_words_jax_async
+    from ..parallel.incremental import emit_proofs_async
 
     t0 = time.perf_counter()
     # verify chunks are `max_batch`-sized plus one arbitrary remainder,
@@ -188,6 +211,7 @@ def _warm_kernels(cfg: LoadConfig, pool, payloads) -> float:
     pairing_check_device_async(payloads["pairing"]).result()
     barycentric_eval_async(*payloads["fr"]).result()
     merkleize_words_jax_async(*payloads["sha256"]).result()
+    emit_proofs_async(*payloads["proof"]).result()
     return time.perf_counter() - t0
 
 
@@ -198,7 +222,8 @@ def run_load(cfg: LoadConfig | None = None, executor=None) -> dict:
     cfg = cfg if cfg is not None else config_from_env()
     pool = build_statement_pool(cfg.pool, cfg.committee)
     payloads = {"pairing": _pairing_payload(pool[0]),
-                "fr": _fr_payload(), "sha256": _sha_payload()}
+                "fr": _fr_payload(), "sha256": _sha_payload(),
+                "proof": _proof_payload()}
     warm_s = _warm_kernels(cfg, pool, payloads)
 
     ex = executor if executor is not None \
@@ -208,9 +233,11 @@ def run_load(cfg: LoadConfig | None = None, executor=None) -> dict:
         ["verify"] * ATT_STATEMENTS_PER_SLOT
         + ["pairing"] * SYNC_STATEMENTS_PER_SLOT
         + ["fr"] * KZG_EVALS_PER_SLOT
-        + ["sha256"] * SHA_ROOTS_PER_SLOT)
+        + ["sha256"] * SHA_ROOTS_PER_SLOT
+        + ["proof"] * PROOF_REQUESTS_PER_SLOT)
     pool_iter = itertools.cycle(pool)
-    kinds_submitted = {k: 0 for k in ("verify", "pairing", "fr", "sha256")}
+    kinds_submitted = {k: 0 for k in ("verify", "pairing", "fr",
+                                      "sha256", "proof")}
 
     def submit_next():
         kind = next(schedule)
@@ -221,8 +248,10 @@ def run_load(cfg: LoadConfig | None = None, executor=None) -> dict:
             ex.submit_pairing(payloads["pairing"])
         elif kind == "fr":
             ex.submit_barycentric(*payloads["fr"])
-        else:
+        elif kind == "sha256":
             ex.submit_sha256_root(*payloads["sha256"])
+        else:
+            ex.submit_proof_request(*payloads["proof"])
 
     closed_loop = cfg.rate <= 0
     rate_per_s = cfg.rate * STATEMENTS_PER_SLOT / SLOT_SECONDS

@@ -100,6 +100,14 @@ THRESHOLDS = (
      "title": "serve p99 batch latency (ms)",
      "metric": r"serve::p99_ms",
      "field": "value", "op": "<", "target": 500.0, "tpu_only": True},
+    # incremental merkleization (ROADMAP stateless-client item): the
+    # persisted-layer dirty-path re-hash must beat a full re-merkleize
+    # by >= 5x at 1% dirty — measurable on the CPU smoke (the ratio is
+    # shape-, not platform-, bound), so not TPU-gated.
+    {"id": "merkle-incremental-speedup",
+     "title": "incremental vs full re-merkleize @ 1% dirty",
+     "metric": r"merkle_incr::update@frac0\.01",
+     "field": "vs_baseline", "op": ">=", "target": 5.0, "tpu_only": False},
 )
 
 FLAGSHIP = "mainnet_epoch_sweep_1m_validators_wall"

@@ -53,6 +53,119 @@ def pytest_addoption(parser):
         help="BLS backend: py | jax")
 
 
+# --- session-scoped oracle reuse (the tier-1 870 s budget) ------------------
+# The ROADMAP's standing trim candidate was "session-scoped spec-build
+# reuse", but the benchwatch tier1-attribution table shows spec builds
+# are ALREADY session-cached (`models.builder._SPEC_CACHE`: <1% of
+# suite wall lands in the spec-build phase) — the budget is eaten by
+# the pure-Python BLS oracle recomputing deterministic work across
+# tests: hash-to-curve of repeated messages, subgroup checks of the
+# same genesis pubkeys in every verify loop, and re-signing identical
+# (privkey, message) pairs.  All of these are pure functions of their
+# byte/int inputs, so the session scope memoizes them here, test-suite
+# only — bench paths must keep measuring real oracle work, and the
+# pairing check itself (the verification verdict) is never cached.
+
+
+def _memo(fn, key_fn, cache=None):
+    """Session memo over a pure function; `cache` may be shared across
+    wrappers (the KZG layer shares one store across fork namespaces).
+    Exceptions propagate uncached."""
+    cache = {} if cache is None else cache
+
+    def wrapper(*args, **kw):
+        key = key_fn(*args, **kw)
+        if key not in cache:
+            cache[key] = fn(*args, **kw)
+        else:
+            wrapper.hits += 1
+        return cache[key]
+
+    wrapper.hits = 0
+    wrapper.cache = cache
+    wrapper.__wrapped__ = fn
+    return wrapper
+
+
+# KZG polynomial-commitment results are likewise pure functions of
+# (trusted setup, argument bytes) — and the blob helpers' default rng
+# seeds mean the SAME sample blobs recur across the deneb/electra/fulu
+# corpus, each costing a ~5 s pure-Python commitment MSM per test (a
+# cells+proofs computation is >570 s — those tests are @slow).  The
+# reuse installs at spec-build time (wrapping the builder's
+# per-namespace cache layer, so every build path gets it) with a
+# GLOBAL key on the preset's trusted-setup dir: deneb/electra/fulu
+# namespaces of one preset share one result per blob.  Verification
+# verdicts are never cached.
+
+_KZG_MEMO_FNS = (
+    ("blob_to_kzg_commitment", lambda blob: bytes(blob)),
+    ("compute_kzg_proof", lambda blob, z: (bytes(blob), bytes(z))),
+    ("compute_blob_kzg_proof",
+     lambda blob, commitment: (bytes(blob), bytes(commitment))),
+    ("compute_cells_and_kzg_proofs", lambda blob: bytes(blob)),
+)
+
+
+@pytest.fixture(autouse=True, scope="session")
+def _session_kzg_reuse():
+    from consensus_specs_tpu.models import builder
+
+    orig_install = builder._install_caches
+    shared: dict = {}
+
+    def install_with_kzg_memo(ns):
+        orig_install(ns)
+        setup_dir = ns.get("TRUSTED_SETUPS_DIR")
+        for name, key_fn in _KZG_MEMO_FNS:
+            if name in ns:
+                ns[name] = _memo(
+                    ns[name],
+                    (lambda kf, nm: lambda *a: (setup_dir, nm, kf(*a)))(
+                        key_fn, name),
+                    cache=shared)
+
+    builder._install_caches = install_with_kzg_memo
+    try:
+        yield
+    finally:
+        builder._install_caches = orig_install
+
+
+@pytest.fixture(autouse=True, scope="session")
+def _session_oracle_reuse():
+    from consensus_specs_tpu.ops.bls import ciphersuite, hash_to_curve
+
+    h2g2 = _memo(hash_to_curve.hash_to_g2,
+                 lambda msg, dst=hash_to_curve.DST_G2:
+                 (bytes(msg), bytes(dst)))
+    patches = [
+        # both refs: ciphersuite imported hash_to_g2 by value
+        (hash_to_curve, "hash_to_g2", h2g2),
+        (ciphersuite, "hash_to_g2", h2g2),
+        (ciphersuite, "Sign",
+         _memo(ciphersuite.Sign,
+               lambda sk, msg: (int(sk), bytes(msg)))),
+        (ciphersuite, "SkToPk",
+         _memo(ciphersuite.SkToPk, lambda sk: int(sk))),
+        # point parse + subgroup check, keyed by the wire bytes
+        # (successes only: a ValueError falls through uncached)
+        (ciphersuite, "_pk_to_point",
+         _memo(ciphersuite._pk_to_point, lambda b: bytes(b))),
+        (ciphersuite, "_sig_to_point",
+         _memo(ciphersuite._sig_to_point, lambda b: bytes(b))),
+    ]
+    originals = [(mod, name, getattr(mod, name))
+                 for mod, name, _ in patches]
+    for mod, name, wrapped in patches:
+        setattr(mod, name, wrapped)
+    try:
+        yield
+    finally:
+        for mod, name, orig in originals:
+            setattr(mod, name, orig)
+
+
 @pytest.fixture(autouse=True, scope="session")
 def _configure_backends(request):
     from consensus_specs_tpu.ops import bls

@@ -3,6 +3,8 @@
 
 import random
 
+import pytest
+
 from consensus_specs_tpu.debug.random_value import (
     RandomizationMode,
     get_random_ssz_object,
@@ -71,6 +73,14 @@ def run_blob_kzg_commitments_merkle_proof_test(spec, state, rng=None,
     assert spec.verify_data_column_sidecar_inclusion_proof(column_sidecar)
 
 
+# The real-blob variants each pay `compute_cells_and_kzg_proofs` on a
+# random blob — 128 pure-Python cell-proof MSMs, measured at >570 s for
+# ONE call on this oracle, more than the whole tier-1 870 s budget.
+# They stay in the corpus under the long-running-real-crypto marker
+# (the DAS-on-device ROADMAP item is the path to un-marking them); the
+# closed-form test below keeps the inclusion-proof contract in tier-1.
+
+@pytest.mark.slow
 @with_test_suite_name("BeaconBlockBody")
 @with_fulu_and_later
 @spec_state_test
@@ -78,9 +88,56 @@ def test_blob_kzg_commitments_merkle_proof__basic(spec, state):
     yield from run_blob_kzg_commitments_merkle_proof_test(spec, state)
 
 
+@pytest.mark.slow
 @with_test_suite_name("BeaconBlockBody")
 @with_fulu_and_later
 @spec_state_test
 def test_blob_kzg_commitments_merkle_proof__random_block_1(spec, state):
     yield from run_blob_kzg_commitments_merkle_proof_test(
         spec, state, rng=random.Random(1111))
+
+
+@with_test_suite_name("BeaconBlockBody")
+@with_fulu_and_later
+@spec_state_test
+def test_blob_kzg_commitments_merkle_proof__zero_blob_closed_form(
+        spec, state):
+    """The ZERO blob's cells and proofs are known in closed form (every
+    cell is zero bytes, the commitment and every per-cell quotient
+    commitment is the point at infinity), so the commitment-list
+    inclusion proof — the contract this suite pins — is exercised
+    without a single MSM.  Real-pairing verification of the same
+    closed-form sidecars is covered by
+    `tests/fulu/networking/test_data_column_sidecar.py::
+    test_sidecar_kzg_proofs_verify`."""
+    g1_infinity = b"\xc0" + b"\x00" * 47
+    n_cells = int(spec.CELLS_PER_EXT_BLOB)
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.blob_kzg_commitments = [spec.KZGCommitment(g1_infinity)]
+    signed_block = sign_block(spec, state, block)
+    cells_and_kzg_proofs = [([spec.Cell()] * n_cells,
+                             [spec.KZGProof(g1_infinity)] * n_cells)]
+    column_sidecar = spec.get_data_column_sidecars_from_block(
+        signed_block, cells_and_kzg_proofs)[0]
+
+    yield "object", block.body
+
+    inclusion_proof = column_sidecar.kzg_commitments_inclusion_proof
+    gindex = spec.get_generalized_index(
+        spec.BeaconBlockBody, "blob_kzg_commitments")
+    yield "proof", {
+        "leaf": "0x" + spec.hash_tree_root(
+            column_sidecar.kzg_commitments).hex(),
+        "leaf_index": int(gindex),
+        "branch": ["0x" + bytes(root).hex() for root in inclusion_proof],
+    }
+
+    assert spec.is_valid_merkle_branch(
+        leaf=spec.hash_tree_root(column_sidecar.kzg_commitments),
+        branch=column_sidecar.kzg_commitments_inclusion_proof,
+        depth=spec.floorlog2(gindex),
+        index=spec.get_subtree_index(gindex),
+        root=column_sidecar.signed_block_header.message.body_root,
+    )
+    assert spec.verify_data_column_sidecar(column_sidecar)
+    assert spec.verify_data_column_sidecar_inclusion_proof(column_sidecar)

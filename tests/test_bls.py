@@ -192,3 +192,29 @@ def test_key_validate():
     assert bls.KeyValidate(bls.SkToPk(99))
     assert not bls.KeyValidate(bls.G1_POINT_AT_INFINITY)
     assert not bls.KeyValidate(b"\x01" * 48)
+
+
+def test_session_oracle_reuse_is_memoized_and_transparent():
+    """The conftest session scope memoizes the deterministic oracle
+    seams (ROADMAP tier-1 budget item): repeated Sign/hash-to-curve/
+    point-parse calls must be cache hits with bit-identical results,
+    and verification verdicts — never cached — must still reject
+    tampered inputs."""
+    from consensus_specs_tpu.ops.bls import ciphersuite
+
+    assert hasattr(ciphersuite.Sign, "__wrapped__"), \
+        "session reuse layer not installed"
+    sk, msg = 4242, b"\x24" * 32
+    sig = ciphersuite.Sign(sk, msg)
+    hits0 = ciphersuite.Sign.hits
+    assert ciphersuite.Sign(sk, msg) == sig
+    assert ciphersuite.Sign.hits == hits0 + 1
+    # memo result matches the unwrapped oracle bit-for-bit
+    assert ciphersuite.Sign.__wrapped__(sk, msg) == sig
+    pk = ciphersuite.SkToPk(sk)
+    assert bls.Verify(pk, msg, sig)
+    assert not bls.Verify(pk, b"\x25" * 32, sig)      # verdicts uncached
+    # parse failures fall through the memo uncached and still raise
+    with pytest.raises(ValueError):
+        ciphersuite._pk_to_point(b"\x01" * 48)
+    assert b"\x01" * 48 not in ciphersuite._pk_to_point.cache

@@ -223,6 +223,33 @@ def test_serve_knob_with_section_mention_passes(tmp_path):
     assert lint_env_knobs(repo) == []
 
 
+def test_merkle_knob_needs_incremental_section_mention(tmp_path):
+    from consensus_specs_tpu.lint import lint_env_knobs
+
+    readme = ("## Incremental merkleization\n\nno knob mention here\n\n"
+              "## Environment knobs\n\n"
+              "| `CST_MERKLE_FOO` | unset | a knob |\n")
+    knob = "CST_" + "MERKLE_FOO"
+    repo = _knob_repo(tmp_path, readme,
+                      "import os\nX = os.environ.get(%r)\n" % knob)
+    found = lint_env_knobs(repo)
+    assert len(found) == 1
+    assert "Incremental merkleization" in found[0] and knob in found[0]
+
+
+def test_merkle_knob_with_section_mention_passes(tmp_path):
+    from consensus_specs_tpu.lint import lint_env_knobs
+
+    readme = ("## Incremental merkleization\n\nsweep via "
+              "`CST_MERKLE_FOO=0.01,1.0` records\n\n"
+              "## Environment knobs\n\n"
+              "| `CST_MERKLE_FOO` | unset | a knob |\n")
+    knob = "CST_" + "MERKLE_FOO"
+    repo = _knob_repo(tmp_path, readme,
+                      "import os\nX = os.environ.get(%r)\n" % knob)
+    assert lint_env_knobs(repo) == []
+
+
 def test_undocumented_knob_still_caught(tmp_path):
     from consensus_specs_tpu.lint import lint_env_knobs
 

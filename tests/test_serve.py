@@ -433,10 +433,14 @@ def test_warm_kernels_covers_every_reachable_rung(monkeypatch):
                         lambda p, r, z: DeviceFuture.settled(0))
     monkeypatch.setattr(sha256_jax, "merkleize_words_jax_async",
                         lambda w, d: DeviceFuture.settled("root"))
+    from consensus_specs_tpu.parallel import incremental
+
+    monkeypatch.setattr(incremental, "emit_proofs_async",
+                        lambda forest, idx: DeviceFuture.settled([]))
     cfg = loadgen.LoadConfig(max_batch=512)
     loadgen._warm_kernels(cfg, [("pk", b"m", "sig")],
                           {"pairing": [("p", "q")], "fr": ([1], [1], 0),
-                           "sha256": (None, 3)})
+                           "sha256": (None, 3), "proof": ("forest", [0])})
     assert sorted(warmed) == [8, 32, 128, 512]
 
 
@@ -511,6 +515,13 @@ def test_run_load_closed_loop_reaches_steady_state(stub_ops, monkeypatch):
                         lambda w, d: DeviceFuture.settled(("root", d)))
     monkeypatch.setattr(fr_batch, "barycentric_eval_async",
                         lambda p, r, z: DeviceFuture.settled(0))
+    from consensus_specs_tpu.parallel import incremental
+
+    monkeypatch.setattr(loadgen, "_proof_payload",
+                        lambda: ("forest", [1, 2]))
+    monkeypatch.setattr(incremental, "emit_proofs_async",
+                        lambda forest, idx: DeviceFuture.settled(
+                            [("proof", i) for i in idx]))
     cfg = loadgen.LoadConfig(duration_s=0.9, rate=0.0, pool=2,
                              committee=2, windows=3, max_batch=4, depth=2)
     block = loadgen.run_load(cfg)
@@ -525,6 +536,8 @@ def test_run_load_closed_loop_reaches_steady_state(stub_ops, monkeypatch):
     kinds = block["kinds"]
     assert kinds["verify"] > kinds["fr"] > 0
     assert kinds["pairing"] >= 1 and kinds["sha256"] >= 1
+    # the stateless-client lane rides the same pipeline
+    assert kinds["proof"] >= 1
 
 
 # --- serve block schema ------------------------------------------------------
@@ -725,6 +738,10 @@ def test_gauge_disabled_is_noop():
     was_enabled = telemetry.enabled()
     telemetry.configure(enabled=False)
     try:
+        # a CST_TELEMETRY session reaches here with gauges already
+        # recorded by earlier serve tests — wipe the registry so the
+        # no-op assertion sees only THIS gauge() call
+        core.reset(full=True)
         telemetry.gauge("serve.queue_depth", 9)
         assert "serve.queue_depth" not in \
             telemetry.snapshot().get("gauges", {})
