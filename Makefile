@@ -8,7 +8,7 @@ CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 VECTOR_OUT ?= out/vectors
 
 .PHONY: test test-fast test-all test-bls lint vectors kzg_setups bench \
-	bench-smoke bench-report serve serve-smoke multichip help
+	bench-smoke bench-report serve serve-smoke chaos-smoke multichip help
 
 help:
 	@echo "targets: test (fast suite) | test-all (incl. slow crypto) |"
@@ -21,8 +21,10 @@ help:
 	@echo "  out/bench_history.jsonl; exits nonzero on regression) |"
 	@echo "  serve (sustained-load verification service, real TPU) |"
 	@echo "  serve-smoke (short closed-loop CPU serve round, emits the"
-	@echo "  serve bench JSON + benchwatch history) | multichip (8-dev"
-	@echo "  CPU dryrun)"
+	@echo "  serve bench JSON + benchwatch history) | chaos-smoke (serve"
+	@echo "  round under a canned fault plan: breaker/oracle-fallback"
+	@echo "  degraded mode, recovery-to-steady, resilience records) |"
+	@echo "  multichip (8-dev CPU dryrun)"
 
 test:
 	$(PYTHON) -m pytest tests/ -q -m "not slow"
@@ -86,6 +88,15 @@ serve-smoke:
 	@$(CPU_ENV) CST_SERVE_DURATION_S=12 CST_SERVE_RATE=0 CST_SERVE_POOL=4 \
 		CST_SERVE_COMMITTEE=4 CST_SERVE_MAX_BATCH=8 CST_SERVE_WINDOWS=3 \
 		$(PYTHON) bench_serve.py
+
+# no TPU required: the chaos round — bench_serve under CST_SERVE_CHAOS=1
+# with a canned fault plan injecting dispatch failures into the RLC
+# kernel.  Asserts zero wrong results, breaker trip -> oracle-fallback
+# degraded mode -> re-close, finite recovery latency, the "resilience"
+# block schema, the resilience::* history round-trip, and the report's
+# Resilience section + chaos-recovery threshold row (CI gates on this)
+chaos-smoke:
+	$(CPU_ENV) $(PYTHON) bench_smoke.py --chaos
 
 multichip:
 	$(CPU_ENV) $(PYTHON) -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('ok')"

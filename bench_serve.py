@@ -19,12 +19,28 @@ the persisted pure-Python oracle's single-verify rate
 (bench_bls_baseline.json) — the per-core signatures/sec framing of
 PAPERS.md's EdDSA-vs-BLS committee-consensus paper.
 
+Exit-code contract: nonzero when loadgen never reached steady state
+within its ≤3x window extension — the metric line then carries an
+explicit `"error"` naming the non-convergence (and `serve.steady` is
+false), instead of reporting the last unconverged window as if it were
+a steady-state rate.
+
+Resilience: `CST_FAULTS` installs a fault plan before the load runs
+(the seams stay zero-overhead without it), and `CST_SERVE_CHAOS=1`
+switches to the chaos harness (`resilience.chaos.run_chaos_load`):
+baseline → faults live (breaker/oracle-fallback degraded mode) →
+recovery-to-steady, with the `"resilience"` sub-object (schema
+`validate_resilience_block`) embedded in the metric line and mined into
+`resilience::*` benchwatch records for the `chaos-recovery` /
+`chaos-correctness` threshold rows.  A chaos round additionally exits
+nonzero on any wrong result or when the service never recovers.
+
 Knobs are the CST_SERVE_* family (README "Serving"); the CPU smoke runs
 closed-loop (`CST_SERVE_RATE=0`) so the measured rate is the host's
 capacity instead of an idle fixed-rate clock.  With CST_TELEMETRY=1 the
 line also carries the standard `"telemetry"` block, and
-CST_BENCHWATCH_HISTORY lands `serve::*` history records for the
-benchwatch threshold rows (steady-state throughput, p99 latency).
+CST_BENCHWATCH_HISTORY lands `serve::*` (and `resilience::*`) history
+records for the benchwatch threshold rows.
 """
 
 from __future__ import annotations
@@ -44,6 +60,7 @@ if os.environ.get("JAX_PLATFORMS"):
     jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
 from consensus_specs_tpu import telemetry  # noqa: E402
+from consensus_specs_tpu.resilience import faults  # noqa: E402
 from consensus_specs_tpu.telemetry import history as benchwatch  # noqa: E402
 from consensus_specs_tpu.utils.jaxtools import enable_compile_cache  # noqa: E402
 
@@ -79,36 +96,73 @@ def _emit(record: dict) -> None:
 
 def main() -> int:
     from consensus_specs_tpu.serve.loadgen import config_from_env, run_load
-    from consensus_specs_tpu.telemetry import validate_serve_block
+    from consensus_specs_tpu.telemetry import (
+        validate_resilience_block,
+        validate_serve_block,
+    )
 
+    chaos = os.environ.get("CST_SERVE_CHAOS", "0") not in ("", "0")
     cfg = config_from_env()
     log(f"serve bench: {cfg} on "
-        f"{jax.devices()[0].platform}:{len(jax.devices())}")
+        f"{jax.devices()[0].platform}:{len(jax.devices())}"
+        + (" [CHAOS]" if chaos else ""))
+    if not chaos and faults.plan_from_env_source():
+        # run_load installs the plan itself, after kernel warmup (the
+        # chaos harness instead owns install/clear phase by phase); the
+        # executor arms retry/breaker/fallback automatically
+        log(f"serve bench: fault plan ARMED: "
+            f"{faults.load_plan(faults.plan_from_env_source()).describe()}")
     block = run_load(cfg)
     problems = validate_serve_block(block)
+    res = block.get("resilience")
+    if chaos:
+        problems += validate_resilience_block(res)
     if problems:
         log(f"serve bench: INVALID serve block: {problems}")
         return 1
     oracle_rate = _oracle_verifies_per_s()
     vs_baseline = (round(block["verifies_per_s"] / oracle_rate, 2)
                    if oracle_rate else None)
-    _emit({
+    record = {
         "metric": "serve_sustained_load",
         "value": block["verifies_per_s"],
         "unit": "verifies/s",
         "vs_baseline": vs_baseline,
-        "serve": block,
-    })
+        "serve": {k: v for k, v in block.items() if k != "resilience"},
+    }
+    if res is not None:
+        record["resilience"] = res
+    rc = 0
+    if not block["steady"]:
+        # the exit-code contract: an unconverged run must not pass for
+        # a steady-state measurement — say so IN the metric line too
+        record["error"] = ("loadgen never reached steady state within "
+                           "the 3x window extension")
+        rc = 1
+    if chaos and (res["wrong_results"] > 0 or not res["recovered"]):
+        record["error"] = (f"chaos round failed: "
+                           f"{res['wrong_results']} wrong result(s), "
+                           f"recovered={res['recovered']}")
+        rc = 1
+    _emit(record)
     log(f"serve bench: {block['verifies_per_s']} verifies/s "
         f"(steady={block['steady']}, {block['mode']} loop), "
         f"p50 {block['p50_ms']} ms / p99 {block['p99_ms']} ms, "
         f"{block['settled']} settled in {block['duration_s']}s"
         + (f", {vs_baseline}x oracle" if vs_baseline else ""))
-    if not block["steady"]:
-        log("serve bench: WARNING — did not reach steady state "
-            "(windows: " + ", ".join(str(w) for w in block["windows"])
-            + ")")
-    return 0
+    if chaos:
+        log(f"serve bench: chaos — {res['faults_injected']} fault(s), "
+            f"{res['wrong_results']} wrong / {res['checked_results']} "
+            f"checked, {res['fallbacks']} oracle-fallback, "
+            f"{res['retries']} retried, breaker trips "
+            f"{res['breaker']['trips']}, recovery "
+            f"{res['recovery_latency_s']}s, degraded "
+            f"{res['degraded_verifies_per_s']} verifies/s "
+            f"(baseline {res['baseline_verifies_per_s']}), merkle heal "
+            f"{res['heal']['recovery_s']}s")
+    if rc:
+        log(f"serve bench: FAILED — {record['error']}")
+    return rc
 
 
 if __name__ == "__main__":

@@ -27,6 +27,16 @@ asserts the serving contract: a steady-state `"serve"` sub-object
 `validate_serve_block`), the `serve::*` benchwatch history records,
 and the queue-depth / in-flight gauge counter tracks in the Chrome
 trace.
+
+`bench_smoke.py --chaos` (the `make chaos-smoke` / CI chaos-smoke
+lane) runs ONLY the chaos round: bench_serve.py under
+CST_SERVE_CHAOS=1 with a canned fault plan injecting dispatch failures
+into the RLC kernel, asserting the resilience contract end to end —
+zero wrong results, breaker trip → oracle fallback → re-close, finite
+recovery latency, a schema-valid `"resilience"` block
+(`validate_resilience_block`), the `resilience::*` history-record
+round-trip, and the benchwatch report's Resilience section +
+`chaos-recovery` threshold row rendering from those records.
 """
 
 from __future__ import annotations
@@ -396,5 +406,119 @@ def main():
     print("bench smoke: PASS")
 
 
+def chaos_main():
+    """The chaos-smoke lane (see module docstring): one bench_serve.py
+    chaos round on tiny CPU shapes under a canned fault plan, then the
+    resilience record/report contract checks."""
+    from consensus_specs_tpu.telemetry import validate_resilience_block
+
+    hist_env = os.environ.get("CST_BENCHWATCH_HISTORY")
+    hist_file = Path(hist_env) if hist_env \
+        else HERE / "out" / "smoke_chaos_history.jsonl"
+    hist_file.parent.mkdir(exist_ok=True)
+    if not hist_env and hist_file.exists():
+        hist_file.unlink()
+    chaos_t0 = time.time()
+    # the canned plan: deterministic dispatch failures into the RLC
+    # verify kernel (the acceptance shape — resilience.chaos's default,
+    # spelled out here so the smoke pins the spec-string form too)
+    out = _run(["bench_serve.py"],
+               {"CST_SERVE_CHAOS": "1",
+                "CST_FAULTS": "seed=1234;dispatch:raise:key=rlc_*:count=4",
+                "CST_SERVE_DURATION_S": "9", "CST_SERVE_RATE": "0",
+                "CST_SERVE_POOL": "4", "CST_SERVE_COMMITTEE": "4",
+                "CST_SERVE_MAX_BATCH": "8", "CST_SERVE_WINDOWS": "3",
+                "CST_TELEMETRY": "1",
+                "CST_BENCHWATCH_HISTORY": str(hist_file)},
+               timeout=900)
+    lines = [o for o in out if o.get("metric") == "serve_sustained_load"]
+    assert len(lines) == 1, out
+    sl = lines[0]
+    assert "error" not in sl, sl.get("error")
+    res = sl.get("resilience")
+    problems = validate_resilience_block(res)
+    assert not problems, (problems, json.dumps(res)[:500])
+    # the acceptance arc: faults fired, zero wrong answers, the breaker
+    # tripped into oracle-fallback degraded mode and re-closed, the
+    # service returned to steady state with a finite recovery latency,
+    # and the diverged Merkle forest healed back to the oracle root
+    assert res["faults_injected"] >= 1, res
+    assert res["injected_sites"].get("dispatch", 0) >= 1, res
+    assert res["wrong_results"] == 0, res
+    assert res["failed_requests"] == 0, res
+    assert res["checked_results"] > 0, res
+    assert res["fallbacks"] >= 1 and res["retries"] >= 1, res
+    br = res["breaker"]
+    assert br["trips"] >= 1, br
+    tos = [t["to"] for t in br["transitions"]]
+    assert "open" in tos and "half_open" in tos and "closed" in tos, br
+    assert all(s == "closed" for s in br["states"].values()), br
+    assert res["recovered"] and res["recovery_latency_s"] is not None, res
+    assert 0 < res["recovery_latency_s"] < 300, res
+    assert res["heal"]["diverged"] and res["heal"]["detected"], res
+    assert res["heal"]["recovery_s"] > 0, res
+    serve = sl["serve"]
+    assert serve["steady"], serve["windows"]
+    assert serve["failed"] == 0, serve
+    print("chaos round OK:", json.dumps(
+        {k: res[k] for k in ("faults_injected", "wrong_results",
+                             "fallbacks", "retries",
+                             "recovery_latency_s",
+                             "degraded_verifies_per_s",
+                             "baseline_verifies_per_s")}))
+
+    # resilience history round-trip: the emission lands as resilience-
+    # source records, schema-valid, with the compact block riding the
+    # recovery-latency record
+    hist_records, _, _ = benchwatch.load_history(hist_file)
+    fresh = {r["metric"]: r for r in hist_records
+             if isinstance(r.get("ts"), (int, float))
+             and r["ts"] >= chaos_t0 - 5}
+    for name in ("resilience::recovery_latency_s",
+                 "resilience::wrong_results",
+                 "resilience::degraded_verifies_per_s",
+                 "resilience::faults_injected",
+                 "resilience::breaker_transitions",
+                 "resilience::merkle_heal_s"):
+        rec = fresh.get(name)
+        assert rec is not None, (name, sorted(fresh))
+        assert rec["source"] == "resilience", rec
+        assert not benchwatch.validate_record(rec), rec
+    rrec = fresh["resilience::recovery_latency_s"]
+    assert rrec["value"] > 0 and rrec["resilience"]["recovered"], rrec
+    assert fresh["resilience::wrong_results"]["value"] == 0
+    print(f"resilience history OK: {len(fresh)} records this run -> "
+          f"{hist_file}")
+
+    # the report renders the Resilience section and evaluates the
+    # chaos-recovery / chaos-correctness threshold rows from the store
+    from consensus_specs_tpu.telemetry import report as bw_report
+
+    report_md = HERE / "out" / "smoke_chaos_report.md"
+    rc = bw_report.main(["--repo", str(HERE), "--history", str(hist_file),
+                         "--out", str(report_md), "--no-update"])
+    assert rc == 0, f"benchwatch report exited {rc}"
+    text = report_md.read_text()
+    assert "## Resilience (chaos rounds)" in text, text[:2000]
+    assert "`resilience::recovery_latency_s`" in text
+    assert "Latest chaos round:" in text
+    result = bw_report.build_report(
+        repo=HERE, history_path=hist_file, snapshots=[],
+        durations_path=None, top_n=5, strict=False,
+        max_regress_pct=0.0, update_history=False)
+    rows = {t["id"]: t for t in result["thresholds"]}
+    assert rows["chaos-recovery"]["status"] == "PASS", rows["chaos-recovery"]
+    assert rows["chaos-recovered"]["status"] == "PASS", \
+        rows["chaos-recovered"]
+    assert rows["chaos-correctness"]["status"] == "PASS", \
+        rows["chaos-correctness"]
+    print(f"chaos report OK: chaos-recovery + chaos-correctness PASS -> "
+          f"{report_md}")
+    print("chaos smoke: PASS")
+
+
 if __name__ == "__main__":
-    main()
+    if "--chaos" in sys.argv:
+        chaos_main()
+    else:
+        main()

@@ -14,7 +14,12 @@ families over `ops/bls_batch`, `ops/bls`, `ops/sha256_jax`,
     host-sync-item/-coerce/-np/-device-get/
         -outside-settle, device-const-at-import         (hostsync.py)
     dtype-int-literal/-float/-implicit-cast             (dtype.py)
-    instr-uncovered-entry                               (instrumentation.py)
+    instr-uncovered-entry, instr-uncovered-cost         (instrumentation.py)
+    exc-swallow-device                                  (excswallow.py)
+
+(`exc-swallow-device` also scans `serve/` and `resilience/` — modules
+where a swallowed exception turns a failed request into a healthy-
+looking one.)
 
 Findings print as `file:line: rule-id: message`; intentional cases are
 annotated in-source with `# cst: allow(<rule-id>): <reason>` — the

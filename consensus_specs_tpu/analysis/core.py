@@ -80,6 +80,10 @@ RULE_IDS = {
         "public device-kernel entry point that never passes through "
         "the cost-capture seam (_dispatch or costmodel.capture) — the "
         "kernel stays invisible to the roofline/utilization layer",
+    "exc-swallow-device":
+        "bare/over-broad except in a device or serve module that "
+        "neither re-raises nor poisons/records the exception — device "
+        "failures must stay typed and visible, not read as success",
 }
 
 # --- file roles (which rule families run where) ------------------------------
@@ -88,13 +92,23 @@ ROLE_DEVICE = "device"   # host-sync + recompile (jit surface) rules
 ROLE_KERNEL = "kernel"   # traced-branch applies to EVERY function
 ROLE_LIMB = "limb"       # dtype discipline rules
 ROLE_INSTR = "instr"     # instrumentation coverage rules
-ALL_ROLES = frozenset((ROLE_DEVICE, ROLE_KERNEL, ROLE_LIMB, ROLE_INSTR))
+ROLE_EXC = "exc"         # exception-swallow discipline (serve +
+                         # resilience modules; device files get it via
+                         # ROLE_DEVICE)
+ALL_ROLES = frozenset((ROLE_DEVICE, ROLE_KERNEL, ROLE_LIMB, ROLE_INSTR,
+                       ROLE_EXC))
 
 # the device path named by the north star: every module that builds or
 # dispatches XLA programs (oracle siblings under ops/bls are scanned too;
 # they produce no findings because nothing in them touches jax)
 DEVICE_GLOBS = ("ops/bls_batch/*.py", "ops/bls/*.py", "parallel/*.py")
 DEVICE_FILES = ("ops/sha256_jax.py", "ops/fr_batch.py", "executor.py")
+# exception-swallow discipline beyond the device files: the serving
+# subsystem (where a swallowed error reads as a healthy request) and
+# the resilience layer itself (which exists to keep failures typed).
+# NOT merged into DEVICE_GLOBS — the host-sync/recompile families
+# would misfire on serve/loadgen's sanctioned warmup settles.
+EXC_GLOBS = ("serve/*.py", "resilience/*.py")
 # limb-arithmetic modules under the dtype discipline
 LIMB_FILES = (
     "ops/bls_batch/fq.py", "ops/bls_batch/tower.py",
@@ -629,13 +643,15 @@ def analyze_source(src: str, path: str = "<snippet>",
     suppression-resolved report; `external_covered`/`external_device`/
     `external_cost` feed the instrumentation rules' cross-module
     resolution."""
-    from . import dtype, hostsync, instrumentation, recompile
+    from . import dtype, excswallow, hostsync, instrumentation, recompile
 
     model = ModuleModel(src, path, roles)
     findings: list[Finding] = []
     if ROLE_DEVICE in roles:
         findings += recompile.check(model)
         findings += hostsync.check(model)
+    if ROLE_DEVICE in roles or ROLE_EXC in roles:
+        findings += excswallow.check(model)
     if ROLE_LIMB in roles:
         findings += dtype.check(model)
     if ROLE_INSTR in roles:
@@ -661,6 +677,9 @@ def _tree_files(root: Path) -> list[tuple[Path, frozenset]]:
         p = root / rel
         if p.exists():
             files.setdefault(p, set()).add(ROLE_KERNEL)
+    for pattern in EXC_GLOBS:
+        for p in sorted(root.glob(pattern)):
+            files.setdefault(p, set()).add(ROLE_EXC)
     return [(p, frozenset(r)) for p, r in sorted(files.items())]
 
 

@@ -1,0 +1,87 @@
+"""Rule family 6 — swallowed exceptions in device + serve modules.
+
+A bare `except:` (or an over-broad `except Exception:` /
+`except BaseException:`) that neither re-raises nor records the error
+turns a device failure into silence: a poisoned batch reads as healthy,
+a failed dispatch as a slow one, and the resilience layer's whole
+premise — every failure is either recovered or VISIBLE as a typed
+error — quietly breaks.  This rule flags exactly that shape in the
+device path and the serving subsystem.
+
+A broad handler is fine when it demonstrably handles:
+
+- it (re-)raises somewhere in its own scope, or
+- it binds the exception (`except Exception as exc:`) and actually USES
+  the bound name — poisoning a future (`set_exception(exc)` /
+  `DeviceFuture.failed(exc)`), storing it for the read side
+  (`self._exc = exc`), wrapping it, or recording it.  A bound-but-
+  unused name is a swallow with extra steps.
+
+Narrow handlers (`except ValueError:` etc.) are out of scope — catching
+a specific expected error and defaulting is a normal host-side pattern
+(the wire-format parsers do it throughout).  Intentional broad
+swallows carry the usual `# cst: allow(exc-swallow-device): reason`
+annotation, which doubles as the inventory of deliberate
+error-suppression points.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, ModuleModel
+
+_BROAD_NAMES = frozenset({"Exception", "BaseException"})
+
+
+def _names_in_type(node) -> set[str]:
+    """Exception-class names a handler's type expression mentions
+    (follows tuples; dotted names use their last component)."""
+    if node is None:
+        return set()
+    out: set[str] = set()
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name):
+            out.add(sub.id)
+        elif isinstance(sub, ast.Attribute):
+            out.add(sub.attr)
+    return out
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    if handler.type is None:
+        return True
+    return bool(_names_in_type(handler.type) & _BROAD_NAMES)
+
+
+def _handles_it(handler: ast.ExceptHandler) -> bool:
+    """Does the handler body re-raise, or use the bound exception?"""
+    for node in ast.walk(ast.Module(body=handler.body,
+                                    type_ignores=[])):
+        if isinstance(node, ast.Raise):
+            return True
+        if handler.name and isinstance(node, ast.Name) \
+                and isinstance(node.ctx, ast.Load) \
+                and node.id == handler.name:
+            return True
+    return False
+
+
+def check(model: ModuleModel) -> list[Finding]:
+    findings: list[Finding] = []
+    for node in ast.walk(model.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if not _is_broad(node) or _handles_it(node):
+            continue
+        what = "bare except" if node.type is None else \
+            "over-broad except " + "/".join(
+                sorted(_names_in_type(node.type) & _BROAD_NAMES))
+        findings.append(Finding(
+            model.path, node.lineno, "exc-swallow-device",
+            f"{what} swallows device/serve errors without re-raising, "
+            f"poisoning a handle, or recording the exception — "
+            f"failures must stay typed and visible (narrow the except, "
+            f"use the bound exception, or annotate why the swallow is "
+            f"deliberate)"))
+    return findings

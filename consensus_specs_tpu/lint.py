@@ -315,8 +315,9 @@ def lint_env_knobs(repo=None) -> list[str]:
     knob table, and every row needs a surviving read.  Benchwatch knobs
     (`CST_BENCHWATCH_*`) additionally need a mention in the README's
     "Benchwatch" section, serving knobs (`CST_SERVE_*`) in the
-    "Serving" section, and incremental-merkleization knobs
-    (`CST_MERKLE_*`) in the "Incremental merkleization" section — a
+    "Serving" section, incremental-merkleization knobs
+    (`CST_MERKLE_*`) in the "Incremental merkleization" section, and
+    fault-plan knobs (`CST_FAULTS*`) in the "Resilience" section — a
     subsystem's configuration surface must be documented where the
     subsystem is explained, not only in the flat table.  `repo`
     overrides the tree root (tests)."""
@@ -334,7 +335,9 @@ def lint_env_knobs(repo=None) -> list[str]:
                            section("Benchwatch")),
                           ("CST_SERVE_", "Serving", section("Serving")),
                           ("CST_MERKLE_", "Incremental merkleization",
-                           section("Incremental merkleization")))
+                           section("Incremental merkleization")),
+                          ("CST_FAULTS", "Resilience",
+                           section("Resilience")))
 
     used: dict[str, str] = {}
     for path in sorted(repo.rglob("*.py")):

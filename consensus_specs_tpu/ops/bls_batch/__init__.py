@@ -72,6 +72,7 @@ import time
 import numpy as np
 
 from ... import telemetry
+from ...resilience import faults
 from ...serve.futures import DeviceFuture, bool_future, value_future
 from ...telemetry import costmodel
 from ..bls import curve as _pycurve
@@ -130,9 +131,21 @@ def _dispatch(kernel: str, fn, args, block: bool = True):
     This is also the cost-capture seam: on CST_COSTMODEL rounds the
     first dispatch of each (kernel, shape) additionally records XLA's
     cost/memory analysis for the compiled executable and samples the
-    per-device memory watermark (both no-op flag checks otherwise)."""
+    per-device memory watermark (both no-op flag checks otherwise).
+
+    And it is the resilience fault seam (`resilience.faults`, OFF by
+    default — one module-global read): an installed fault plan can
+    raise here (dispatch exception / compile-fail-on-first-call /
+    mesh-device loss, keyed by kernel name), inject latency, or corrupt
+    the dispatched output (bit-flip/NaN, applied on device) — the
+    deterministic chaos machinery the serve executor's recovery
+    policies are tested against."""
+    if faults.active():
+        faults.maybe_inject("dispatch", kernel)
     if not telemetry.enabled():
-        return fn(*args)
+        out = fn(*args)
+        return faults.corrupt("dispatch", kernel, out) \
+            if faults.active() else out
     import jax
 
     first = telemetry.first_call(f"kernel.{kernel}")
@@ -152,6 +165,8 @@ def _dispatch(kernel: str, fn, args, block: bool = True):
         # contaminate the compile-vs-run attribution above
         costmodel.capture(kernel, fn, args)
     costmodel.sample_watermark(f"kernel.{kernel}")
+    if faults.active():
+        out = faults.corrupt("dispatch", kernel, out)
     return out
 
 

@@ -24,6 +24,7 @@ import numpy as np
 from jax import lax
 
 from .. import telemetry
+from ..resilience import faults
 from ..telemetry import costmodel
 from .sha256_np import _IV, _K, _PAD64, ZERO_HASH_WORDS
 from .sha256_np import sha256_64B_words as _host_sha256_64B
@@ -158,6 +159,10 @@ def merkleize_words_jax_async(words: np.ndarray, limit_depth: int,
         return DeviceFuture.settled(
             np.array(ZERO_HASH_WORDS[limit_depth], copy=True))
     d = max(n - 1, 0).bit_length()
+    # resilience fault seam (same contract as bls_batch._dispatch —
+    # this module dispatches its own kernel, so it hooks its own key)
+    if faults.active():
+        faults.maybe_inject("dispatch", f"sha256_merkle@d{d}")
     padded = np.zeros((1 << d, 8), dtype=np.uint32)
     padded[:n] = words
     with telemetry.span("sha256.merkleize_words", depth=d):
@@ -171,6 +176,8 @@ def merkleize_words_jax_async(words: np.ndarray, limit_depth: int,
     # so the AOT analysis pass does not contaminate the measured wall
     costmodel.capture(f"sha256_merkle@d{d}", merkle_root_pow2,
                       (dev_words, d, unroll))
+    if faults.active():
+        root = faults.corrupt("dispatch", f"sha256_merkle@d{d}", root)
     return value_future(
         root, convert=lambda host: _fold_zero_levels(host, d, limit_depth))
 

@@ -58,6 +58,7 @@ import numpy as np
 
 from .. import telemetry
 from ..ops.sha256_jax import _fold_zero_levels, sha256_64B_words
+from ..resilience import faults
 from ..ops.sha256_np import ZERO_HASH_WORDS
 from ..ops.sha256_np import sha256_64B_words as _host_sha256_64B
 from ..telemetry import costmodel
@@ -173,6 +174,11 @@ def update_dirty(layers, dirty_idx, new_leaf_words, depth: int):
     uint32 chunk words.  Returns the new layer tuple — a pure O(M·depth)
     device dispatch, no host sync."""
     m = int(dirty_idx.shape[0])
+    # resilience fault seam: an installed plan can fail/slow the dirty
+    # re-hash or corrupt its output layers (the self-healing detector's
+    # chaos input) — one module-global read when no plan is active
+    if faults.active():
+        faults.maybe_inject("merkle_update", f"u{m}d{depth}")
     with telemetry.span("parallel.merkle_incr.update_dirty",
                         rung=m, depth=depth):
         out = _update_dirty_jit(layers, dirty_idx, new_leaf_words, depth)
@@ -181,6 +187,8 @@ def update_dirty(layers, dirty_idx, new_leaf_words, depth: int):
     # span so the AOT analysis pass does not contaminate the wall
     costmodel.capture(f"merkle_incr@u{m}d{depth}", _update_dirty_jit,
                       (out, dirty_idx, new_leaf_words, depth))
+    if faults.active():
+        out = faults.corrupt("merkle_update", f"u{m}d{depth}", out)
     return out
 
 
@@ -316,6 +324,9 @@ class MerkleForest:
         self.limit_depth = limit_depth
         self.length = int(length)
         self.n_chunks = n
+        # toggled by resilience.healing while a diverged stack rebuilds
+        # (serving code must not emit roots/proofs from quarantined state)
+        self.quarantined = False
         with telemetry.span("parallel.merkle_incr.build", depth=d):
             # cst: allow(recompile-unbucketed-dim): the static tree depth
             # keys the executable — log-bounded (<= limit_depth distinct

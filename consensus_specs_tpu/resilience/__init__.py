@@ -1,0 +1,56 @@
+"""Resilience layer — deterministic fault injection, recovery policies,
+self-healing Merkle state.
+
+The serving stack (PR 6) poisons the handles of a failed batch and the
+incremental flagship (PR 7) asserts Merkle parity — but nothing HEALS:
+one failed compile, a poisoned-batch storm, or a silently diverged
+`MerkleForest` has no recovery story.  Production committee-consensus
+measurement work (arXiv:2302.00418) and censorship-resilient
+million-scale aggregation (Wonderboom, arXiv:2602.06655) both treat
+verification as a service that must keep answering *correctly* under
+partial failure; this package gives the repo that property and the
+machinery to prove it:
+
+    faults.py    deterministic, seeded, schema-validated fault injection
+                 at the four sanctioned seams (`ops.bls_batch._dispatch`,
+                 `serve.futures.DeviceFuture` settle,
+                 `ServeExecutor._dispatch_one`, `incremental.update_dirty`)
+                 — dispatch exceptions, injected latency, compile failure
+                 on first call, corrupted device output (bit-flip/NaN),
+                 mesh-device loss.  OFF by default; the disabled path is
+                 one module-global read (no-op bound pinned by
+                 tests/test_resilience.py, the telemetry pattern).
+    policies.py  per-kernel retry with capped exponential backoff, a
+                 per-(kernel, rung) circuit breaker that trips to the
+                 pure-Python oracle fallback (correct-but-slow degraded
+                 mode, half-open probes to re-close), and typed
+                 `DeadlineExceeded` request shedding.
+    healing.py   divergence detector + quarantine/rebuild for a
+                 `parallel.incremental.MerkleForest` (recovery latency
+                 recorded).
+    chaos.py     the chaos-round harness (`CST_SERVE_CHAOS=1`): mainnet
+                 arrival mix under an active fault plan, requiring the
+                 service to return to steady state — emits the
+                 `resilience` benchwatch record kind the `chaos-recovery`
+                 threshold row gates on.
+
+Import discipline: `faults` and `policies` are stdlib-only (+ telemetry,
+itself stdlib-only) so the hot-path seams can import them eagerly
+without touching numpy/jax; `healing` and `chaos` import the heavy
+modules lazily, at call time.
+"""
+
+from . import faults
+from .faults import FaultInjected, FaultPlan, MeshDeviceLost
+from .policies import (
+    BreakerRegistry,
+    CircuitBreaker,
+    DeadlineExceeded,
+    RetryPolicy,
+)
+
+__all__ = [
+    "BreakerRegistry", "CircuitBreaker", "DeadlineExceeded",
+    "FaultInjected", "FaultPlan", "MeshDeviceLost", "RetryPolicy",
+    "faults",
+]

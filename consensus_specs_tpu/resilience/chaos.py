@@ -1,0 +1,287 @@
+"""Chaos rounds: the mainnet arrival mix under an active fault plan.
+
+`run_chaos_load` (armed by `CST_SERVE_CHAOS=1` in `bench_serve.py`)
+drives the serve executor through three phases and requires the service
+to come back:
+
+    baseline    closed-loop windows until throughput is steady — the
+                healthy-rate reference.
+    chaos       the fault plan (CST_FAULTS, or the canned
+                `DEFAULT_CHAOS_SPEC` injecting dispatch failures into
+                the RLC kernel) is installed; the executor runs with
+                the recovery policies armed (retry + per-(kind, rung)
+                breakers + oracle fallback), so every request still
+                answers CORRECTLY — degraded throughput is measured,
+                wrong answers are counted (and must be zero).
+    recovery    the plan is cleared; the run continues until throughput
+                is steady again.  `recovery_latency_s` — fault stop to
+                steady-state re-detection — is the `chaos-recovery`
+                benchwatch threshold row's metric.
+
+Every submitted request is tracked with its EXPECTED outcome (the pool
+statements are valid → True; sha256/fr expectations precomputed on the
+host oracle), so "zero wrong verification results" is a measured
+property of the whole round, not an assumption.  A final self-healing
+segment corrupts a `MerkleForest` update under a corrupt fault and
+drives the detect→quarantine→rebuild loop (`healing.heal_forest`),
+recording its recovery wall.
+
+Returns `serve.loadgen.run_load`'s block shape (schema:
+`telemetry.export.validate_serve_block`) plus a `"resilience"`
+sub-object (schema: `validate_resilience_block`) that `bench_serve.py`
+embeds and `telemetry.history` mines into `resilience::*` records.
+"""
+
+from __future__ import annotations
+
+import time
+
+from .. import telemetry
+from . import faults, healing
+from .policies import BreakerRegistry, RetryPolicy
+
+# the canned plan (used when CST_FAULTS is unset): four dispatch
+# failures into the RLC verify kernel — enough to trip a threshold-2
+# breaker through retry, exercise the oracle-fallback degraded mode,
+# fail at least one half-open probe, and then let the device recover
+DEFAULT_CHAOS_SPEC = "seed=1234;dispatch:raise:key=rlc_*:count=4"
+
+# chaos-round policy shape: trip fast, probe fast — the smoke must see
+# the full open→half-open→closed arc inside a handful of windows
+CHAOS_RETRY = dict(max_attempts=2, base_backoff_s=0.01, max_backoff_s=0.1)
+CHAOS_BREAKER = dict(threshold=2, cooldown_s=0.5)
+
+_TRACK_CAP = 200_000     # correctness-tracking memory bound
+
+
+def _expectations(payloads):
+    """Host-oracle expected values for the checkable request kinds."""
+    import numpy as np
+
+    from ..ops.sha256_np import merkleize_words
+    from ..serve.executor import _oracle_barycentric
+
+    words, limit_depth = payloads["sha256"]
+    return {
+        "sha256": merkleize_words(np.asarray(words, dtype=np.uint32),
+                                  limit_depth),
+        "fr": _oracle_barycentric(*payloads["fr"]),
+    }
+
+
+def _check_results(tracked, expected) -> dict:
+    """Settle accounting over the tracked (kind, future) pairs: wrong
+    values vs the oracle expectations, and exception-settled requests
+    (typed failures — visible, but not wrong answers)."""
+    import numpy as np
+
+    wrong = 0
+    failed = 0
+    checked = 0
+    for kind, fut in tracked:
+        exc = fut.exception()
+        if exc is not None:
+            failed += 1
+            continue
+        value = fut.result()
+        checked += 1
+        if kind in ("verify", "pairing"):
+            if value is not True:
+                wrong += 1
+        elif kind == "sha256":
+            if not np.array_equal(np.asarray(value),
+                                  expected["sha256"]):
+                wrong += 1
+        elif kind == "fr":
+            if int(value) != expected["fr"]:
+                wrong += 1
+        elif kind == "proof":
+            if not isinstance(value, list) or not value:
+                wrong += 1
+    return {"wrong": wrong, "failed": failed, "checked": checked}
+
+
+def _heal_segment() -> dict:
+    """The self-healing Merkle arc, run deterministically: one update
+    under a corrupt fault diverges a small forest; the detector
+    quarantines it, the rebuild re-serves, the recovery wall is
+    recorded."""
+    import numpy as np
+
+    from ..parallel.incremental import MerkleForest
+
+    rng = np.random.RandomState(97)
+    n = 256
+    words = rng.randint(0, 2**32, (n, 8), dtype=np.uint64).astype(np.uint32)
+    forest = MerkleForest(words, 10, n)
+    faults.install({"seed": 5, "faults": [
+        {"site": "merkle_update", "kind": "corrupt", "count": 1}]})
+    try:
+        forest.update([3], rng.randint(0, 2**32, (1, 8),
+                                       dtype=np.uint64).astype(np.uint32))
+    finally:
+        faults.clear()
+    detected = healing.forest_diverged(forest)
+    report = healing.heal_forest(forest)
+    return {
+        "detected": bool(detected),
+        "diverged": bool(report.diverged),
+        "recovery_s": (round(report.recovery_s, 6)
+                       if report.recovery_s is not None else None),
+        "n_chunks": n,
+    }
+
+
+def run_chaos_load(cfg=None, plan=None) -> dict:
+    """See the module docstring.  `cfg` is a `serve.loadgen.LoadConfig`
+    (env defaults otherwise); chaos rounds are always closed-loop (an
+    open-loop clock under faults measures the clock, not the service).
+    `plan` overrides CST_FAULTS / the canned default."""
+    from ..serve.executor import ServeExecutor
+    from ..serve.loadgen import (
+        _fr_payload,
+        _pairing_payload,
+        _proof_payload,
+        _sha_payload,
+        _warm_kernels,
+        build_statement_pool,
+        config_from_env,
+        drive_closed_loop,
+        make_submitter,
+        percentile_ms,
+        steady_state,
+    )
+
+    cfg = cfg if cfg is not None else config_from_env()
+    if plan is None:
+        plan = faults.plan_from_env_source() or DEFAULT_CHAOS_SPEC
+    plan = faults.load_plan(plan)
+
+    pool = build_statement_pool(cfg.pool, cfg.committee)
+    payloads = {"pairing": _pairing_payload(pool[0]),
+                "fr": _fr_payload(), "sha256": _sha_payload(),
+                "proof": _proof_payload()}
+    expected = _expectations(payloads)
+    warm_s = _warm_kernels(cfg, pool, payloads)
+
+    breakers = BreakerRegistry(**CHAOS_BREAKER)
+    ex = ServeExecutor(max_batch=cfg.max_batch, depth=cfg.depth,
+                       retry=RetryPolicy(**CHAOS_RETRY),
+                       breakers=breakers)
+    tracked: list[tuple] = []
+
+    def track(kind, fut):
+        if len(tracked) < _TRACK_CAP:
+            tracked.append((kind, fut))
+
+    # the shared mainnet arrival mix + closed-loop drive (loadgen owns
+    # both — the chaos round must measure the same traffic shape
+    # run_load does, just phased around the fault plan)
+    submit_next, kinds_submitted = make_submitter(ex, pool, payloads,
+                                                  track=track)
+    target_outstanding = cfg.max_batch * (cfg.depth + 1)
+    window_s = cfg.duration_s / cfg.windows
+    rates: list[float] = []
+    settled_prev = 0
+
+    def run_window():
+        nonlocal settled_prev
+        win_t0 = time.perf_counter()
+        drive_closed_loop(ex, submit_next, target_outstanding,
+                          win_t0 + window_s)
+        settled_now = ex.stats()["settled"]
+        rates.append((settled_now - settled_prev)
+                     / (time.perf_counter() - win_t0))
+        settled_prev = settled_now
+
+    t0 = time.perf_counter()
+    with telemetry.span("resilience.chaos_round"):
+        # phase 1: healthy baseline, until steady (≤3x extension)
+        for _ in range(3 * cfg.windows):
+            run_window()
+            if len(rates) >= 3 and steady_state(rates):
+                break
+        baseline_rate = (sum(rates[-3:]) / 3.0 if len(rates) >= 3
+                         else (rates[-1] if rates else 0.0))
+        baseline_windows = len(rates)
+
+        # phase 2: the fault plan is live
+        faults.install(plan)
+        try:
+            for _ in range(cfg.windows):
+                run_window()
+        finally:
+            injected = faults.injections()
+            faults.clear()
+        chaos_rates = rates[baseline_windows:]
+        degraded_rate = (min(chaos_rates) if chaos_rates else None)
+
+        # phase 3: recovery — run until steady again
+        t_clear = time.perf_counter()
+        recovery_latency_s = None
+        for _ in range(3 * cfg.windows):
+            run_window()
+            if steady_state(rates):
+                recovery_latency_s = time.perf_counter() - t_clear
+                break
+    measured_s = time.perf_counter() - t0
+    ex.drain()
+
+    heal = _heal_segment()
+    check = _check_results(tracked, expected)
+    st = ex.stats()
+    recovered = recovery_latency_s is not None
+    steady = recovered and steady_state(rates)
+    steady_rate = (sum(rates[-3:]) / 3.0 if len(rates) >= 3 else 0.0)
+
+    by_site: dict[str, int] = {}
+    for rec in injected:
+        by_site[rec["site"]] = by_site.get(rec["site"], 0) + 1
+
+    block = {
+        "verifies_per_s": round(steady_rate, 2),
+        "p50_ms": percentile_ms(ex.latencies_s, 0.50),
+        "p99_ms": percentile_ms(ex.latencies_s, 0.99),
+        "steady": steady,
+        "windows": [round(r, 2) for r in rates],
+        "window_s": round(window_s, 3),
+        "duration_s": round(measured_s, 3),
+        "warmup_s": round(warm_s, 3),
+        "mode": "closed",
+        "rate_multiple": 0.0,
+        "offered_per_s": None,
+        "pool": cfg.pool,
+        "committee": cfg.committee,
+        "max_batch": cfg.max_batch,
+        "depth": cfg.depth,
+        "kinds": kinds_submitted,
+        "submitted": st["submitted"],
+        "settled": st["settled"],
+        "failed": st["failed"],
+        "rechecks": st["rechecks"],
+        "batches": st["batches"],
+        "queue_depth": st["queue_depth"],
+        "inflight_max": st["inflight_max"],
+        "resilience": {
+            "chaos": True,
+            "plan": plan.describe(),
+            "faults_injected": len(injected),
+            "injected_sites": by_site,
+            "wrong_results": check["wrong"],
+            "failed_requests": check["failed"],
+            "checked_results": check["checked"],
+            "baseline_verifies_per_s": round(baseline_rate, 2),
+            "degraded_verifies_per_s": (round(degraded_rate, 2)
+                                        if degraded_rate is not None
+                                        else None),
+            "recovery_latency_s": (round(recovery_latency_s, 3)
+                                   if recovered else None),
+            "recovered": recovered,
+            "breaker": breakers.summary(),
+            "retries": st["retries"],
+            "fallbacks": st["fallbacks"],
+            "shed": st["shed"],
+            "heal": heal,
+        },
+    }
+    return block

@@ -1,0 +1,109 @@
+"""Self-healing Merkle state — divergence detection, quarantine, rebuild.
+
+PR 7's flagship asserts incremental-vs-full-rebuild root parity and then
+CRASHES on mismatch; a serving system must instead detect the
+divergence, stop serving from the poisoned state, rebuild, and resume.
+This module promotes that parity check into exactly that loop for a
+`parallel.incremental.MerkleForest`:
+
+    detect      `forest_diverged(forest)`: recompute the data-tree root
+                from the PERSISTED LEAF LAYER with an independent
+                rebuild and compare against the incremental stack's
+                root — a corrupted interior layer (bit-flipped device
+                output, a lost scatter) shows up as a mismatch.
+    quarantine  `heal_forest` marks the forest quarantined (serving
+                code must not emit proofs/roots from a quarantined
+                stack) for the duration of the rebuild.
+    rebuild     the layer stack is rebuilt from the leaves (or from
+                caller-supplied authoritative `leaf_words` when the
+                leaf layer itself is suspect), the forest re-serves,
+                and the recovery latency is recorded
+                (`resilience.heal` span + the returned `HealReport` —
+                the chaos round's `heal` block).
+
+The detector is leaf-layer-trusting by design: interior layers are
+DERIVED state (re-derivable at O(N) sha cost), leaves are SOURCE state —
+when the source itself may be corrupt, pass the authority through
+`leaf_words` and the rebuild heals both.  Roots verified against the
+SSZ oracle in tests/test_resilience.py.
+
+Heavy imports (numpy, the incremental module, and through it jax) stay
+inside the functions: importing the resilience package must not
+initialize a backend.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import NamedTuple
+
+from .. import telemetry
+
+
+class HealReport(NamedTuple):
+    """Outcome of one detect/quarantine/rebuild pass."""
+
+    diverged: bool
+    recovery_s: float | None     # rebuild wall when diverged, else None
+    root: bytes                  # the (healed) full SSZ list root
+
+
+def _reference_root_bytes(forest, leaf_words=None) -> bytes:
+    """The root an honest stack would serve: an independent rebuild
+    from the leaf layer (host side, the pure-numpy sha path — it must
+    not share the possibly-faulted device path it is checking)."""
+    import numpy as np
+
+    from ..ops.sha256_np import merkleize_words
+    from ..parallel.incremental import (
+        _length_chunk,
+        _words_to_bytes,
+    )
+    from ..ops.sha256_np import sha256_64B_words as _host_sha256
+
+    if leaf_words is None:
+        leaf_words = np.asarray(forest.layers[0])[:forest.n_chunks]
+    leaf_words = np.asarray(leaf_words, dtype=np.uint32)
+    data_root = merkleize_words(leaf_words, forest.limit_depth)
+    tail = np.frombuffer(_length_chunk(forest.length),
+                         dtype=">u4").astype(np.uint32)
+    blk = np.concatenate([data_root, tail]).astype(np.uint32)
+    return _words_to_bytes(_host_sha256(blk[None, :])[0])
+
+
+def forest_diverged(forest, leaf_words=None) -> bool:
+    """The divergence detector: does the incremental stack's root
+    disagree with an independent rebuild from the leaves?"""
+    return forest.root_bytes() != _reference_root_bytes(forest, leaf_words)
+
+
+def heal_forest(forest, leaf_words=None) -> HealReport:
+    """Detect / quarantine / rebuild / re-serve, returning the
+    `HealReport` (recovery latency is the quarantine wall).  A clean
+    forest returns immediately with `diverged=False`.  `leaf_words`
+    optionally supplies authoritative leaves when the persisted leaf
+    layer itself is suspect."""
+    import numpy as np
+
+    reference = _reference_root_bytes(forest, leaf_words)
+    if forest.root_bytes() == reference:
+        forest.quarantined = False
+        return HealReport(False, None, reference)
+
+    telemetry.count("resilience.heal.diverged")
+    forest.quarantined = True
+    t0 = time.perf_counter()
+    with telemetry.span("resilience.heal", chunks=forest.n_chunks):
+        from ..parallel.incremental import MerkleForest
+
+        if leaf_words is None:
+            leaf_words = np.asarray(forest.layers[0])[:forest.n_chunks]
+        rebuilt = MerkleForest(np.asarray(leaf_words, dtype=np.uint32),
+                               forest.limit_depth, forest.length)
+        forest.layers = rebuilt.layers
+        root = forest.root_bytes()
+    recovery_s = time.perf_counter() - t0
+    forest.quarantined = False
+    telemetry.observe("resilience.heal.recovery_s", recovery_s)
+    assert root == reference, "rebuild did not converge to the oracle root"
+    return HealReport(True, recovery_s, root)

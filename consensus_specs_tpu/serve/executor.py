@@ -34,26 +34,49 @@ Request kinds and their device paths:
                (`incremental.emit_proofs_async`) — the stateless-client
                proof-serving workload riding the same futures pipeline
 
-A device batch that RAISES settles the exception into every pending
-handle of that batch (callers see it at `result()`), and the executor
-keeps serving — one poisoned batch must not take the service down.
+Failure semantics are LAYERED (PR 8, the resilience layer):
+
+- Base contract (always on): a device batch that RAISES settles the
+  exception into every pending handle of that batch — and ONLY that
+  batch — and the executor keeps serving.  One poisoned batch must not
+  take the service down.
+- `retry=RetryPolicy(...)`: a failed batch re-dispatches with capped
+  exponential backoff before the failure is final.
+- `breakers=BreakerRegistry(...)`: consecutive failures per
+  (kind, rung) trip a circuit breaker; while OPEN, matching batches
+  route to the PURE-PYTHON ORACLE fallback (`_oracle_compute` —
+  bit-identical results, orders of magnitude slower: the degraded mode
+  that keeps answers correct while the device path is sick), and
+  half-open probes re-close the breaker once the device recovers.
+  Kinds without an oracle (`proof`) keep trying the device.
+- `deadline_ms` (default `CST_SERVE_DEADLINE_MS`): queued requests
+  older than the deadline are shed at the next pump with a typed
+  `resilience.DeadlineExceeded` — oldest first, so overload degrades
+  into explicit failures instead of unbounded queue growth.
+
+Fault injection (`resilience.faults`, OFF by default): the
+`serve_pump` seam fires inside `_dispatch_one`'s try block, so an
+injected fault has exactly a real host-prep failure's blast radius.
 
 Telemetry (env-gated like everything else): `serve.queue_depth` and
 `serve.inflight_batches` gauges (exported as Chrome-trace counter
 tracks next to the device-memory ones), spans per pump/settle, and
-submitted/settled/failed/recheck counters.  Queue-depth and latency
-accounting for the bench contract is kept independently in plain
-members (`stats()`, `latencies_s`) so the serve block never depends on
-CST_TELEMETRY.
+submitted/settled/failed/recheck/retry/fallback/shed counters.
+Queue-depth and latency accounting for the bench contract is kept
+independently in plain members (`stats()`, `latencies_s`) so the serve
+block never depends on CST_TELEMETRY.
 """
 
 from __future__ import annotations
 
+import os
 import time
 from collections import deque
 
 from .. import telemetry
-from .futures import DeviceFuture
+from ..resilience import faults
+from ..resilience.policies import DeadlineExceeded
+from .futures import DeviceFuture, FutureTimeout
 
 KINDS = ("verify", "pairing", "msm", "sha256", "fr", "proof")
 
@@ -77,13 +100,14 @@ class _Request:
 
 
 class _Batch:
-    __slots__ = ("kind", "future", "reqs", "t_dispatch")
+    __slots__ = ("kind", "future", "reqs", "t_dispatch", "attempt")
 
-    def __init__(self, kind, future, reqs):
+    def __init__(self, kind, future, reqs, attempt=1):
         self.kind = kind
         self.future = future
         self.reqs = reqs
         self.t_dispatch = time.perf_counter()
+        self.attempt = attempt
 
 
 def _depth_bucket(n: int) -> str:
@@ -91,16 +115,123 @@ def _depth_bucket(n: int) -> str:
     return "0" if n <= 0 else str(1 << (n - 1).bit_length())
 
 
+def _breaker_key(kind: str, n: int) -> str:
+    """Per-(kind, rung) breaker key: a verify batch of 100 and one of
+    128 share executables (the `_bucket` ladder) and share health."""
+    return f"{kind}@{_depth_bucket(n)}"
+
+
+# --- pure-Python oracle fallback (degraded mode) -----------------------------
+#
+# One oracle per kind that has one; results are BIT-IDENTICAL to the
+# device path (pinned by tests/test_resilience.py), just slow.  The
+# verify oracle memoizes on the statement's canonical serialization:
+# sustained traffic cycles a finite statement pool, so a tripped
+# breaker costs one pure-Python pairing check per DISTINCT statement,
+# not per request.
+
+_ORACLE_VERIFY_CACHE: dict = {}
+_ORACLE_VERIFY_CACHE_MAX = 4096
+
+
+def _oracle_verify(task) -> bool:
+    from ..ops.bls.ciphersuite import _pairing_check, fast_aggregate_pairs
+    from ..ops.bls.curve import g1_to_bytes, g2_to_bytes
+
+    pk, msg, sig = task
+    try:
+        key = (g1_to_bytes(pk), bytes(msg), g2_to_bytes(sig))
+    except (TypeError, ValueError):
+        key = None      # unserializable point: verify uncached
+    if key is not None and key in _ORACLE_VERIFY_CACHE:
+        telemetry.count("resilience.fallback.verify_cache_hit")
+        return _ORACLE_VERIFY_CACHE[key]
+    ok = _pairing_check(fast_aggregate_pairs(task))
+    if key is not None:
+        if len(_ORACLE_VERIFY_CACHE) >= _ORACLE_VERIFY_CACHE_MAX:
+            _ORACLE_VERIFY_CACHE.clear()
+        _ORACLE_VERIFY_CACHE[key] = ok
+    return ok
+
+
+def _oracle_barycentric(poly_ints, roots_brp_ints, z_int) -> int:
+    """The closed-form host evaluation `fr_batch` mirrors: f(z) =
+    (z^W - 1)/W * sum_i f_i * w_i / (z - w_i) mod r, with the in-domain
+    short-circuit."""
+    from ..ops.fr_batch import R_MODULUS as r
+
+    width = len(poly_ints)
+    z = int(z_int) % r
+    roots = [int(w) % r for w in roots_brp_ints]
+    poly = [int(f) % r for f in poly_ints]
+    for f, w in zip(poly, roots):
+        if (z - w) % r == 0:
+            return f
+    total = 0
+    for f, w in zip(poly, roots):
+        total = (total + f * w % r * pow((z - w) % r, r - 2, r)) % r
+    factor = (pow(z, width, r) - 1) % r
+    inv_width = pow(width, r - 2, r)
+    return total * factor % r * inv_width % r
+
+
+def _oracle_compute(kind: str, payload):
+    """Dispatch one request on the pure-Python oracle.  Raises KeyError
+    for kinds without an oracle (`proof`)."""
+    if kind == "verify":
+        return _oracle_verify(payload)
+    if kind == "pairing":
+        from ..ops.bls.ciphersuite import _pairing_check
+
+        return _pairing_check(payload)
+    if kind == "sha256":
+        import numpy as np
+
+        from ..ops.sha256_np import merkleize_words
+
+        words, limit_depth = payload
+        return merkleize_words(np.asarray(words, dtype=np.uint32),
+                               limit_depth)
+    if kind == "fr":
+        return _oracle_barycentric(*payload)
+    if kind == "msm":
+        from ..ops.bls import curve as pycurve
+
+        points, scalars = payload
+        acc = pycurve.g1.infinity()
+        for p, s in zip(points, scalars):
+            acc = pycurve.g1.add(acc, pycurve.g1.mul(p, int(s)
+                                                     % pycurve.R))
+        return acc
+    raise KeyError(f"no oracle fallback for request kind {kind!r}")
+
+
+ORACLE_KINDS = frozenset({"verify", "pairing", "msm", "sha256", "fr"})
+
+
 class ServeExecutor:
     """See the module docstring.  `max_batch` caps statements per RLC
     dispatch (a `_bucket` ladder rung keeps executables shared);
     `depth` is the number of in-flight batches the pipeline holds
-    before settling the oldest."""
+    before settling the oldest.  `retry`/`breakers`/`deadline_ms` arm
+    the resilience policies (all off by default; `deadline_ms` falls
+    back to the CST_SERVE_DEADLINE_MS knob)."""
 
-    def __init__(self, max_batch: int = 512, depth: int = 2):
+    def __init__(self, max_batch: int = 512, depth: int = 2,
+                 retry=None, breakers=None,
+                 deadline_ms: float | None = None):
         assert max_batch >= 1 and depth >= 1
         self.max_batch = max_batch
         self.depth = depth
+        self.retry = retry
+        self.breakers = breakers
+        if deadline_ms is None:
+            try:
+                deadline_ms = float(
+                    os.environ.get("CST_SERVE_DEADLINE_MS", "0")) or None
+            except ValueError:
+                deadline_ms = None
+        self.deadline_s = deadline_ms / 1e3 if deadline_ms else None
         self._queue: deque[_Request] = deque()
         self._inflight: deque[_Batch] = deque()
         self.latencies_s: list[float] = []
@@ -109,6 +240,9 @@ class ServeExecutor:
         self._failed = 0
         self._rechecks = 0
         self._dispatched_batches = 0
+        self._retries = 0
+        self._fallbacks = 0
+        self._shed = 0
         self._queue_hist: dict[str, int] = {}
         self._queue_max = 0
         self._inflight_max = 0
@@ -172,10 +306,12 @@ class ServeExecutor:
     # --- pipeline -----------------------------------------------------------
 
     def pump(self, settle_all: bool = False) -> None:
-        """Dispatch everything queued, then settle in-flight batches
-        down to the pipeline depth (all of them with `settle_all`)."""
+        """Shed aged-out requests, dispatch everything queued, then
+        settle in-flight batches down to the pipeline depth (all of
+        them with `settle_all`)."""
         with telemetry.span("serve.pump", queued=len(self._queue),
                             inflight=len(self._inflight)):
+            self._shed_expired()
             self._dispatch_queued()
             self._settle_ready(settle_all)
 
@@ -204,8 +340,39 @@ class ServeExecutor:
             self._inflight_max = n
         telemetry.gauge("serve.inflight_batches", n)
 
-    def _dispatch_one(self, kind: str, reqs: list[_Request]) -> None:
+    def _shed_expired(self) -> None:
+        """The deadline policy: fail queued requests older than the
+        per-request deadline with a typed `DeadlineExceeded`, OLDEST
+        first (the queue is FIFO, so the head is always the oldest) —
+        an overloaded service sheds explicitly instead of letting the
+        queue grow without bound."""
+        if self.deadline_s is None or not self._queue:
+            return
+        now = time.perf_counter()
+        while self._queue:
+            age = now - self._queue[0].t_enqueue
+            if age <= self.deadline_s:
+                break
+            req = self._queue.popleft()
+            req.future.set_exception(
+                DeadlineExceeded(req.kind, age, self.deadline_s))
+            self._shed += 1
+            self._failed += 1
+            telemetry.count("serve.shed")
+        self._note_queue_depth()
+
+    def _dispatch_one(self, kind: str, reqs: list[_Request],
+                      attempt: int = 1) -> None:
+        key = _breaker_key(kind, len(reqs))
+        if self.breakers is not None and kind in ORACLE_KINDS \
+                and not self.breakers.get(key).allow():
+            self._serve_fallback(kind, reqs)
+            return
         try:
+            # resilience seam: an injected fault here has exactly a real
+            # host-prep failure's blast radius (THESE handles, no others)
+            if faults.active():
+                faults.maybe_inject("serve_pump", kind)
             bb = _ops_bls_batch()
             # block=False: the pipelined-dispatch contract — on
             # instrumented rounds the telemetry seam must not
@@ -230,15 +397,11 @@ class ServeExecutor:
                 fut = emit_proofs_async(*reqs[0].payload)
         except Exception as exc:
             # host prep can fail before the batch ever reaches the
-            # device (malformed payload); the keep-serving contract is
-            # the same as a failed device batch — fail THESE handles,
-            # keep dispatching the rest
-            for req in reqs:
-                req.future.set_exception(exc)
-            self._failed += len(reqs)
-            telemetry.count("serve.failed", len(reqs))
+            # device (malformed payload, injected fault); same recovery
+            # ladder as a failed device batch
+            self._batch_failed(kind, reqs, exc, attempt, key)
             return
-        self._inflight.append(_Batch(kind, fut, reqs))
+        self._inflight.append(_Batch(kind, fut, reqs, attempt=attempt))
         self._dispatched_batches += 1
         telemetry.count(f"serve.dispatch.{kind}")
         self._note_inflight()
@@ -270,12 +433,25 @@ class ServeExecutor:
             self._settle_batch(self._inflight.popleft())
             self._note_inflight()
 
-    def _settle_until(self, fut: DeviceFuture) -> None:
+    def _settle_until(self, fut: DeviceFuture, timeout=None) -> None:
         """Waiter hook for request handles: pump until `fut` settles
-        (its batch may be queued, in flight, or already done)."""
+        (its batch may be queued, in flight, or already done).  With a
+        `timeout` the wait is bounded: batch settles use the remaining
+        budget and an exhausted budget returns with `fut` still pending
+        (the future raises the typed `FutureTimeout`)."""
+        deadline = None if timeout is None \
+            else time.perf_counter() + float(timeout)
+        self._shed_expired()
         self._dispatch_queued()
         while self._inflight and not fut.done():
-            self._settle_batch(self._inflight.popleft())
+            remaining = None
+            if deadline is not None:
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    return
+            if not self._settle_batch(self._inflight.popleft(),
+                                      timeout=remaining):
+                return          # batch itself timed out (re-queued)
             self._note_inflight()
 
     def _verify_single(self, task) -> bool:
@@ -285,11 +461,66 @@ class ServeExecutor:
         return _ops_bls_batch().pairing_check_device(
             fast_aggregate_pairs(task))
 
-    def _settle_batch(self, batch: _Batch) -> None:
+    def _serve_fallback(self, kind: str, reqs: list[_Request]) -> None:
+        """Degraded mode: answer on the pure-Python oracle (correct but
+        slow) while the breaker holds the device path open.  Each
+        request settles independently — an oracle failure poisons only
+        its own handle."""
+        with telemetry.span("serve.fallback", kind=kind,
+                            requests=len(reqs)):
+            now_latencies = []
+            for req in reqs:
+                try:
+                    value = _oracle_compute(kind, req.payload)
+                except Exception as exc:
+                    req.future.set_exception(exc)
+                    self._failed += 1
+                    telemetry.count("serve.failed")
+                    continue
+                req.future.set_result(value)
+                now_latencies.append(req.t_enqueue)
+                self._settled += 1
+            now = time.perf_counter()
+            self.latencies_s.extend(now - t for t in now_latencies)
+            self._fallbacks += len(reqs)
+            telemetry.count(f"serve.fallback.{kind}", len(reqs))
+
+    def _batch_failed(self, kind: str, reqs: list[_Request],
+                      exc: Exception, attempt: int, key: str) -> None:
+        """The recovery ladder for one failed batch: record the breaker
+        failure, retry with backoff while the policy allows, then
+        degrade to the oracle when the breaker is open — poisoning the
+        handles only when no recovery path remains."""
+        telemetry.count("serve.batch_failed")
+        breaker = self.breakers.get(key) if self.breakers is not None \
+            else None
+        if breaker is not None:
+            breaker.record_failure()
+        if self.retry is not None and self.retry.should_retry(attempt):
+            time.sleep(self.retry.backoff_s(attempt))
+            self._retries += 1
+            telemetry.count("serve.retry")
+            self._dispatch_one(kind, reqs, attempt=attempt + 1)
+            return
+        if breaker is not None and breaker.state != "closed" \
+                and kind in ORACLE_KINDS:
+            self._serve_fallback(kind, reqs)
+            return
+        for req in reqs:
+            req.future.set_exception(exc)
+        self._failed += len(reqs)
+        telemetry.count("serve.failed", len(reqs))
+
+    def _settle_batch(self, batch: _Batch, timeout=None) -> bool:
+        """Settle one in-flight batch; returns False (re-queueing the
+        batch at the pipeline head) when a bounded wait ran out before
+        the device answered."""
         with telemetry.span("serve.settle_batch", kind=batch.kind,
                             requests=len(batch.reqs)):
+            key = _breaker_key(batch.kind, len(batch.reqs))
             try:
-                out = batch.future.result()
+                out = batch.future.result() if timeout is None \
+                    else batch.future.result(timeout=timeout)
                 if batch.kind == "verify" and len(batch.reqs) > 1:
                     if out:
                         results = [True] * len(batch.reqs)
@@ -300,34 +531,44 @@ class ServeExecutor:
                                    for r in batch.reqs]
                 else:
                     results = [out] * len(batch.reqs)
+            except FutureTimeout:
+                self._inflight.appendleft(batch)
+                return False
             except Exception as exc:
                 # a failed device batch — or a failed per-statement
-                # recheck dispatch — fails EVERY pending handle; the
+                # recheck dispatch — walks the recovery ladder; the
                 # executor itself keeps serving
-                for req in batch.reqs:
-                    req.future.set_exception(exc)
-                self._failed += len(batch.reqs)
-                telemetry.count("serve.failed", len(batch.reqs))
-                return
+                self._batch_failed(batch.kind, batch.reqs, exc,
+                                   batch.attempt, key)
+                return True
+            if self.breakers is not None:
+                self.breakers.get(key).record_success()
             now = time.perf_counter()
             for req, value in zip(batch.reqs, results):
                 req.future.set_result(value)
                 self.latencies_s.append(now - req.t_enqueue)
             self._settled += len(batch.reqs)
             telemetry.count("serve.settled", len(batch.reqs))
+            return True
 
     # --- accounting ---------------------------------------------------------
 
     def stats(self) -> dict:
         """Plain-dict accounting for the bench `"serve"` block (does not
         depend on CST_TELEMETRY)."""
-        return {
+        out = {
             "submitted": self._submitted,
             "settled": self._settled,
             "failed": self._failed,
             "rechecks": self._rechecks,
             "batches": self._dispatched_batches,
+            "retries": self._retries,
+            "fallbacks": self._fallbacks,
+            "shed": self._shed,
             "queue_depth": {"max": self._queue_max,
                             "hist": dict(self._queue_hist)},
             "inflight_max": self._inflight_max,
         }
+        if self.breakers is not None:
+            out["breakers"] = self.breakers.states()
+        return out

@@ -28,9 +28,26 @@ Three flavors of future, one class:
                   on a still-pending handle invokes the waiter (which
                   pumps the owning executor) instead of deadlocking.
 
+Bounded waits: `result(timeout=...)` / `exception(timeout=...)` raise a
+typed `FutureTimeout` instead of blocking forever — a wedged executor
+(or a device fetch that never completes) was the one un-boundable wait
+in the serve path.  A device-backed fetch under a timeout runs on a
+daemon thread: the caller gets `FutureTimeout` when the budget runs
+out, the fetch keeps going, and a later `result()` joins the SAME
+fetch (never a second transfer).  Timeout-aware waiters (the serve
+executor's `_settle_until`) receive the remaining budget; a plain
+single-argument waiter is invoked untimed (best effort) and the
+timeout contract still raises if it returns without settling.  A
+timeout never settles the future — retrying is always legal.
+
 Exception propagation is part of the contract: a failed device batch
 settles every pending handle with the exception, and `result()`
 re-raises it for each caller (`exception()` reads it without raising).
+
+Fault-injection seam (`resilience.faults`, OFF by default): the
+device-backed settle is the `future_settle` site — an injected fault
+settles THIS future with the typed `FaultInjected`, exactly like a real
+failed transfer.
 
 Imports numpy only — never jax (fetching goes through `np.asarray`,
 which blocks on the device value's readiness via the array protocol),
@@ -40,6 +57,8 @@ so importing this module can never initialize a backend.
 from __future__ import annotations
 
 import numpy as np
+
+from ..resilience import faults
 
 _UNSET = object()
 
@@ -52,6 +71,11 @@ class FutureError(RuntimeError):
     no waiter, double set_result, ...)."""
 
 
+class FutureTimeout(FutureError, TimeoutError):
+    """A bounded `result(timeout=...)` ran out before the future
+    settled.  The future stays PENDING — the caller may retry."""
+
+
 def _fetch(value):
     """Device value -> host numpy, recursing through point tuples.  The
     one blocking transfer of the futures contract lives here."""
@@ -60,12 +84,27 @@ def _fetch(value):
     return np.asarray(value)
 
 
+def _waiter_accepts_timeout(waiter) -> bool:
+    import inspect
+
+    try:
+        sig = inspect.signature(waiter)
+    except (TypeError, ValueError):
+        return False
+    for p in sig.parameters.values():
+        if p.kind in (p.VAR_POSITIONAL, p.VAR_KEYWORD):
+            return True
+        if p.name == "timeout":
+            return True
+    return False
+
+
 class DeviceFuture:
     """Handle for a deferred device result.  See the module docstring
     for the three construction flavors."""
 
     __slots__ = ("_state", "_value", "_exc", "_device", "_convert",
-                 "_waiter")
+                 "_waiter", "_fetcher")
 
     def __init__(self, device=_UNSET, convert=None, waiter=None):
         self._state = PENDING
@@ -74,6 +113,7 @@ class DeviceFuture:
         self._device = device
         self._convert = convert
         self._waiter = waiter
+        self._fetcher = None
 
     # --- construction helpers -----------------------------------------------
 
@@ -98,16 +138,16 @@ class DeviceFuture:
     def set_result(self, value) -> None:
         if self._state is not PENDING:
             raise FutureError("future already settled")
-        self._state = DONE
         self._value = value
+        self._state = DONE
         self._waiter = None      # release the executor/batch closure
         self._convert = None
 
     def set_exception(self, exc: BaseException) -> None:
         if self._state is not PENDING:
             raise FutureError("future already settled")
-        self._state = DONE
         self._exc = exc
+        self._state = DONE
         self._waiter = None
         self._convert = None
 
@@ -116,44 +156,79 @@ class DeviceFuture:
     def done(self) -> bool:
         return self._state is DONE
 
-    def exception(self) -> BaseException | None:
+    def exception(self, timeout: float | None = None) -> BaseException | None:
         """The settling exception, without raising; resolves a pending
         device-backed future first (same as result()).  A handle that
         cannot settle at all (no value, no waiter, or a waiter that
         returns without settling) re-raises the lifecycle FutureError —
-        returning None there would misreport the future as succeeded."""
+        returning None there would misreport the future as succeeded —
+        and a `timeout` that runs out re-raises the `FutureTimeout`
+        (the future is still pending: there IS no outcome to read)."""
         if self._state is PENDING:
             try:
-                self.result()
+                self.result(timeout=timeout)
             except FutureError:
                 if self._state is PENDING:
                     raise
+            # cst: allow(exc-swallow-device): the settling exception was
+            # already stored in _exc by result(); this read-side probe
+            # must report it via the return value, not re-raise it
             except BaseException:
                 pass
         return self._exc
 
-    def result(self):
+    # --- the device-backed settle (the ONE blocking transfer) ---------------
+
+    def _settle_from_device(self) -> None:
+        try:
+            # resilience seam: an injected settle fault poisons exactly
+            # this future, like a real failed transfer
+            if faults.active():
+                faults.maybe_inject("future_settle", "device")
+            host = _fetch(self._device)
+            self._value = (self._convert(host)
+                           if self._convert is not None else host)
+        except BaseException as exc:
+            self._exc = exc
+        finally:
+            self._state = DONE
+            self._device = None      # release the device ref
+            self._convert = None
+
+    def result(self, timeout: float | None = None):
         """The host value.  Device-backed futures fetch-and-convert on
         first call (the blocking transfer); externally settled futures
         invoke their waiter until settled.  Cached thereafter; a failed
-        future re-raises its exception on every call."""
+        future re-raises its exception on every call.  With `timeout`
+        (seconds) the wait is bounded by the typed `FutureTimeout`."""
         if self._state is PENDING:
-            if self._device is not _UNSET:
-                try:
-                    host = _fetch(self._device)
-                    self._value = (self._convert(host)
-                                   if self._convert is not None else host)
-                except BaseException as exc:
-                    self._exc = exc
-                finally:
-                    self._state = DONE
-                    self._device = None      # release the device ref
-                    self._convert = None
+            if self._fetcher is not None or self._device is not _UNSET:
+                self._await_device(timeout)
             elif self._waiter is not None:
-                self._waiter(self)
-                if self._state is PENDING:
-                    raise FutureError(
-                        "waiter returned without settling the future")
+                if timeout is None:
+                    self._waiter(self)
+                    if self._state is PENDING:
+                        raise FutureError(
+                            "waiter returned without settling the future")
+                else:
+                    import time
+
+                    t0 = time.perf_counter()
+                    if _waiter_accepts_timeout(self._waiter):
+                        self._waiter(self, timeout=float(timeout))
+                    else:
+                        self._waiter(self)
+                    if self._state is PENDING:
+                        # a waiter that gave back with budget LEFT hit
+                        # the lifecycle wall (nothing can ever settle
+                        # this handle) — FutureTimeout there would send
+                        # retry loops spinning on a dead future
+                        if time.perf_counter() - t0 + 1e-3 \
+                                >= float(timeout):
+                            raise FutureTimeout(
+                                f"future still pending after {timeout}s")
+                        raise FutureError(
+                            "waiter returned without settling the future")
             else:
                 raise FutureError(
                     "future is pending and has no device value or "
@@ -161,6 +236,26 @@ class DeviceFuture:
         if self._exc is not None:
             raise self._exc
         return self._value
+
+    def _await_device(self, timeout: float | None) -> None:
+        """Settle a device-backed future, optionally within `timeout`
+        seconds.  The bounded path moves the fetch to a daemon thread
+        so an unready device value cannot wedge the caller; repeated
+        calls join the same in-flight fetch."""
+        if timeout is None and self._fetcher is None:
+            self._settle_from_device()
+            return
+        if self._fetcher is None:
+            import threading
+
+            self._fetcher = threading.Thread(
+                target=self._settle_from_device, daemon=True)
+            self._fetcher.start()
+        self._fetcher.join(timeout)
+        if self._state is PENDING:
+            raise FutureTimeout(
+                f"device fetch still pending after {timeout}s")
+        self._fetcher = None
 
 
 def value_future(device_value, convert=None) -> DeviceFuture:

@@ -181,6 +181,55 @@ def _proof_payload(n_leaves: int = 256, batch: int = 16):
 # --- the load loop -----------------------------------------------------------
 
 
+def make_submitter(ex, pool, payloads, track=None):
+    """The ONE implementation of the mainnet per-slot arrival mix (see
+    module docstring): returns `(submit_next, kinds_submitted)` where
+    each `submit_next()` call submits the next request of the cycled
+    slot schedule to `ex`.  `track(kind, future)`, when given, sees
+    every submitted handle — the chaos harness's correctness-tracking
+    hook.  Shared by `run_load` and `resilience.chaos.run_chaos_load`
+    so the two drives cannot diverge on the traffic shape."""
+    schedule = itertools.cycle(
+        ["verify"] * ATT_STATEMENTS_PER_SLOT
+        + ["pairing"] * SYNC_STATEMENTS_PER_SLOT
+        + ["fr"] * KZG_EVALS_PER_SLOT
+        + ["sha256"] * SHA_ROOTS_PER_SLOT
+        + ["proof"] * PROOF_REQUESTS_PER_SLOT)
+    pool_iter = itertools.cycle(pool)
+    kinds_submitted = {k: 0 for k in ("verify", "pairing", "fr",
+                                      "sha256", "proof")}
+
+    def submit_next():
+        kind = next(schedule)
+        kinds_submitted[kind] += 1
+        if kind == "verify":
+            fut = ex.submit_verify_task(next(pool_iter))
+        elif kind == "pairing":
+            fut = ex.submit_pairing(payloads["pairing"])
+        elif kind == "fr":
+            fut = ex.submit_barycentric(*payloads["fr"])
+        elif kind == "sha256":
+            fut = ex.submit_sha256_root(*payloads["sha256"])
+        else:
+            fut = ex.submit_proof_request(*payloads["proof"])
+        if track is not None:
+            track(kind, fut)
+
+    return submit_next, kinds_submitted
+
+
+def drive_closed_loop(ex, submit_next, target_outstanding: int,
+                      window_end: float) -> None:
+    """One closed-loop drive window: keep `target_outstanding`
+    requests outstanding and pump until `window_end`
+    (`time.perf_counter()` deadline) — the device-capacity mode both
+    the CPU smoke and the chaos phases measure."""
+    while time.perf_counter() < window_end:
+        while ex.outstanding() < target_outstanding:
+            submit_next()
+        ex.pump()
+
+
 def _warm_kernels(cfg: LoadConfig, pool, payloads) -> float:
     """AOT-compile every executable the load will hit, OUTSIDE the
     measured window; returns the warmup wall."""
@@ -215,43 +264,57 @@ def _warm_kernels(cfg: LoadConfig, pool, payloads) -> float:
     return time.perf_counter() - t0
 
 
+def _default_executor(cfg: LoadConfig) -> ServeExecutor:
+    """The load's executor.  With a fault plan active
+    (`resilience.faults`), the recovery policies arm automatically —
+    retry with backoff plus per-(kind, rung) breakers routing to the
+    oracle fallback — so a faulted `make serve-smoke` degrades to
+    correct-but-slow answers instead of poisoning requests.  Without a
+    plan the executor keeps the plain fail-fast shape (zero resilience
+    machinery on the healthy path)."""
+    from ..resilience import faults
+
+    retry = breakers = None
+    if faults.active():
+        from ..resilience.chaos import CHAOS_BREAKER, CHAOS_RETRY
+        from ..resilience.policies import BreakerRegistry, RetryPolicy
+
+        retry = RetryPolicy(**CHAOS_RETRY)
+        breakers = BreakerRegistry(**CHAOS_BREAKER)
+    return ServeExecutor(max_batch=cfg.max_batch, depth=cfg.depth,
+                         retry=retry, breakers=breakers)
+
+
 def run_load(cfg: LoadConfig | None = None, executor=None) -> dict:
     """Drive the serve executor with the configured load; returns the
     bench `"serve"` block (schema pinned by
-    `telemetry.export.validate_serve_block`)."""
+    `telemetry.export.validate_serve_block`).
+
+    `CST_SERVE_CHAOS=1` delegates to the chaos harness
+    (`resilience.chaos.run_chaos_load`: baseline → fault plan live →
+    recovery-to-steady), whose block additionally carries the
+    `"resilience"` sub-object."""
     cfg = cfg if cfg is not None else config_from_env()
+    if executor is None \
+            and os.environ.get("CST_SERVE_CHAOS", "0") not in ("", "0"):
+        from ..resilience.chaos import run_chaos_load
+
+        return run_chaos_load(cfg)
     pool = build_statement_pool(cfg.pool, cfg.committee)
     payloads = {"pairing": _pairing_payload(pool[0]),
                 "fr": _fr_payload(), "sha256": _sha_payload(),
                 "proof": _proof_payload()}
     warm_s = _warm_kernels(cfg, pool, payloads)
+    # a CST_FAULTS plan goes live only AFTER warmup: AOT precompile is
+    # setup, not served traffic — the plan's fault budget must land on
+    # the measured load (where the executor's recovery ladder answers),
+    # not crash the warmup's direct kernel settles
+    from ..resilience import faults
 
-    ex = executor if executor is not None \
-        else ServeExecutor(max_batch=cfg.max_batch, depth=cfg.depth)
+    faults.install_from_env()
+    ex = executor if executor is not None else _default_executor(cfg)
     # deterministic per-slot arrival mix (see module docstring)
-    schedule = itertools.cycle(
-        ["verify"] * ATT_STATEMENTS_PER_SLOT
-        + ["pairing"] * SYNC_STATEMENTS_PER_SLOT
-        + ["fr"] * KZG_EVALS_PER_SLOT
-        + ["sha256"] * SHA_ROOTS_PER_SLOT
-        + ["proof"] * PROOF_REQUESTS_PER_SLOT)
-    pool_iter = itertools.cycle(pool)
-    kinds_submitted = {k: 0 for k in ("verify", "pairing", "fr",
-                                      "sha256", "proof")}
-
-    def submit_next():
-        kind = next(schedule)
-        kinds_submitted[kind] += 1
-        if kind == "verify":
-            ex.submit_verify_task(next(pool_iter))
-        elif kind == "pairing":
-            ex.submit_pairing(payloads["pairing"])
-        elif kind == "fr":
-            ex.submit_barycentric(*payloads["fr"])
-        elif kind == "sha256":
-            ex.submit_sha256_root(*payloads["sha256"])
-        else:
-            ex.submit_proof_request(*payloads["proof"])
+    submit_next, kinds_submitted = make_submitter(ex, pool, payloads)
 
     closed_loop = cfg.rate <= 0
     rate_per_s = cfg.rate * STATEMENTS_PER_SLOT / SLOT_SECONDS
@@ -269,12 +332,11 @@ def run_load(cfg: LoadConfig | None = None, executor=None) -> dict:
         # zero-rate window that defeats the steady-state check.
         win_t0 = time.perf_counter()
         window_end = win_t0 + window_s
-        while time.perf_counter() < window_end:
-            if closed_loop:
-                while ex.outstanding() < target_outstanding:
-                    submit_next()
-                ex.pump()
-            else:
+        if closed_loop:
+            drive_closed_loop(ex, submit_next, target_outstanding,
+                              window_end)
+        else:
+            while time.perf_counter() < window_end:
                 due = (time.perf_counter() - t0) * rate_per_s
                 while arrived < due:
                     submit_next()

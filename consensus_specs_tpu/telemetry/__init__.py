@@ -77,6 +77,7 @@ from .export import (
     embed_bench_block,
     validate_bench_block,
     validate_costmodel_block,
+    validate_resilience_block,
     validate_serve_block,
     write_chrome_trace,
     write_jsonl,
@@ -87,6 +88,6 @@ __all__ = [
     "enabled", "first_call", "gauge", "observe", "reset", "set_meta",
     "snapshot", "span", "span_seconds", "bench_block", "chrome_trace",
     "embed_bench_block", "validate_bench_block",
-    "validate_costmodel_block", "validate_serve_block",
-    "write_chrome_trace", "write_jsonl",
+    "validate_costmodel_block", "validate_resilience_block",
+    "validate_serve_block", "write_chrome_trace", "write_jsonl",
 ]

@@ -298,6 +298,66 @@ def validate_serve_block(obj) -> list[str]:
     return problems
 
 
+def validate_resilience_block(obj) -> list[str]:
+    """Schema check for a chaos round's `"resilience"` sub-object
+    (`resilience.chaos.run_chaos_load`); returns problems (empty ==
+    valid).  Pinned by `bench_smoke.py --chaos` and
+    tests/test_resilience.py."""
+    problems: list[str] = []
+    if not isinstance(obj, dict):
+        return [f"resilience block is {type(obj).__name__}, not dict"]
+    if not isinstance(obj.get("chaos"), bool):
+        problems.append("'chaos' must be a bool")
+    for key in ("faults_injected", "wrong_results", "failed_requests",
+                "checked_results", "retries", "fallbacks", "shed"):
+        v = obj.get(key)
+        if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+            problems.append(f"{key!r} must be a non-negative int, "
+                            f"got {v!r}")
+    for key in ("degraded_verifies_per_s", "recovery_latency_s",
+                "baseline_verifies_per_s"):
+        v = obj.get(key)
+        if v is not None and (not isinstance(v, (int, float))
+                              or isinstance(v, bool) or v < 0):
+            problems.append(f"{key!r} must be a non-negative number or "
+                            f"null, got {v!r}")
+    if not isinstance(obj.get("recovered"), bool):
+        problems.append("'recovered' must be a bool")
+    if obj.get("recovered") and obj.get("recovery_latency_s") is None:
+        problems.append("'recovered' is true but 'recovery_latency_s' "
+                        "is null")
+    br = obj.get("breaker")
+    if not isinstance(br, dict) or not isinstance(
+            br.get("transitions"), list) \
+            or not isinstance(br.get("states"), dict):
+        problems.append("'breaker' must carry a 'transitions' list and "
+                        "a 'states' dict")
+    else:
+        for t in br["transitions"]:
+            if not isinstance(t, dict) or not {"key", "from",
+                                               "to"} <= set(t):
+                problems.append(f"breaker transition {t!r} must carry "
+                                f"key/from/to")
+                break
+    heal = obj.get("heal")
+    if heal is not None:
+        if not isinstance(heal, dict) \
+                or not isinstance(heal.get("diverged"), bool):
+            problems.append("'heal' must be a dict with a bool "
+                            "'diverged'")
+        elif heal["diverged"]:
+            rs = heal.get("recovery_s")
+            if not isinstance(rs, (int, float)) or isinstance(rs, bool) \
+                    or rs < 0:
+                problems.append("heal['recovery_s'] must be a "
+                                "non-negative number when diverged")
+    plan = obj.get("plan")
+    if plan is not None and (not isinstance(plan, dict)
+                             or not isinstance(plan.get("faults"), list)):
+        problems.append("'plan' must be a fault-plan summary dict")
+    return problems
+
+
 def embed_bench_block(record: dict) -> dict:
     """The shared per-config bench protocol: attach the current
     `"telemetry"` block to a metric record and reset the per-config

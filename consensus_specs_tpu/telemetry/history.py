@@ -38,6 +38,12 @@ Record schema (version `SCHEMA`; one JSON object per line):
                                  # "serve::<metric>" — verifies/sec,
                                  # p50/p99, queue-depth histogram,
                                  # steady flag, window rates)
+     "resilience": dict,         # compacted chaos-round block (source
+                                 # "resilience" only; metric
+                                 # "resilience::<metric>" — recovery
+                                 # latency, wrong-result count, degraded
+                                 # throughput, breaker transitions,
+                                 # Merkle heal wall)
      "ts": float}                # wall-clock stamp (live emissions only)
 
 Robustness contract (pinned by tests/test_benchwatch.py): malformed or
@@ -61,7 +67,7 @@ from pathlib import Path
 SCHEMA = 1
 
 SOURCES = ("bench_round", "multichip_round", "baseline", "bench_emit",
-           "pytest_snapshot", "costmodel", "serve")
+           "pytest_snapshot", "costmodel", "serve", "resilience")
 
 _ROUND_FILE_RE = re.compile(r"(?:BENCH|MULTICHIP)_r(\d+)\.json$")
 
@@ -166,7 +172,8 @@ def serve_records(metric: str, serve, **context) -> list[dict]:
     compact = {k: serve[k] for k in (
         "steady", "windows", "window_s", "duration_s", "mode",
         "rate_multiple", "max_batch", "depth", "submitted", "settled",
-        "failed", "rechecks", "batches", "queue_depth", "inflight_max")
+        "failed", "rechecks", "batches", "queue_depth", "inflight_max",
+        "retries", "fallbacks", "shed")
         if k in serve}
     records = [make_record(
         "serve", "serve::verifies_per_s", serve["verifies_per_s"],
@@ -177,6 +184,68 @@ def serve_records(metric: str, serve, **context) -> list[dict]:
             records.append(make_record(
                 "serve", f"serve::{key}", v, unit=unit,
                 via_metric=metric, **context))
+    return records
+
+
+def resilience_records(metric: str, res, **context) -> list[dict]:
+    """`resilience`-source history records mined from one metric line's
+    chaos-round `"resilience"` sub-object
+    (`resilience.chaos.run_chaos_load`): one scalar record per recovery
+    metric — `resilience::recovery_latency_s` (the `chaos-recovery`
+    threshold row's surface, carrying the compacted block),
+    `resilience::wrong_results` (the correctness gate),
+    degraded/baseline throughput, fault/transition counts, and the
+    Merkle heal wall.  Malformed blocks yield zero records, never an
+    exception."""
+    if not isinstance(res, dict) or not isinstance(res.get("chaos"), bool):
+        return []
+    compact = {k: res[k] for k in (
+        "chaos", "faults_injected", "injected_sites", "wrong_results",
+        "failed_requests", "checked_results", "recovered", "retries",
+        "fallbacks", "shed") if k in res}
+    br = res.get("breaker")
+    if isinstance(br, dict):
+        compact["breaker_states"] = br.get("states")
+        compact["breaker_trips"] = br.get("trips")
+    records = [make_record(
+        "resilience", "resilience::recovery_latency_s",
+        res.get("recovery_latency_s"), unit="s", resilience=compact,
+        via_metric=metric, **context)]
+
+    def scalar(key, name, unit):
+        v = res.get(key)
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            records.append(make_record(
+                "resilience", name, v, unit=unit, via_metric=metric,
+                **context))
+
+    # recovered as its own 0/1 record (the chaos-recovered threshold
+    # row): the latency record above carries value None on an
+    # unrecovered round, which a numeric threshold cannot see — without
+    # this, a failed round would silently leave the previous successful
+    # round's PASS on the dashboard
+    if isinstance(res.get("recovered"), bool):
+        records.append(make_record(
+            "resilience", "resilience::recovered",
+            1.0 if res["recovered"] else 0.0, unit="bool",
+            via_metric=metric, **context))
+    scalar("wrong_results", "resilience::wrong_results", "count")
+    scalar("degraded_verifies_per_s",
+           "resilience::degraded_verifies_per_s", "verifies/s")
+    scalar("baseline_verifies_per_s",
+           "resilience::baseline_verifies_per_s", "verifies/s")
+    scalar("faults_injected", "resilience::faults_injected", "count")
+    if isinstance(br, dict) and isinstance(br.get("transitions"), list):
+        records.append(make_record(
+            "resilience", "resilience::breaker_transitions",
+            len(br["transitions"]), unit="count", via_metric=metric,
+            **context))
+    heal = res.get("heal")
+    if isinstance(heal, dict) and isinstance(heal.get("recovery_s"),
+                                             (int, float)):
+        records.append(make_record(
+            "resilience", "resilience::merkle_heal_s",
+            heal["recovery_s"], unit="s", via_metric=metric, **context))
     return records
 
 
@@ -302,6 +371,9 @@ def parse_bench_round(path) -> tuple[list[dict], list[str]]:
         records.append(rec)
         records.extend(serve_records(
             name, obj.get("serve"), round=rnd, file=path.name,
+            rc=rc, platform=obj.get("platform")))
+        records.extend(resilience_records(
+            name, obj.get("resilience"), round=rnd, file=path.name,
             rc=rc, platform=obj.get("platform")))
         for crec in costmodel_records(
                 name, obj.get("telemetry"), round=rnd, file=path.name,
@@ -597,6 +669,10 @@ def emission_records(metric_line: dict, ts: float | None = None
                 name, obj.get("serve"), platform=platform,
                 ts=round(ts, 1) if ts is not None else None):
             records.append(srec)
+        for rrec in resilience_records(
+                name, obj.get("resilience"), platform=platform,
+                ts=round(ts, 1) if ts is not None else None):
+            records.append(rrec)
         for crec in costmodel_records(
                 name, obj.get("telemetry"), platform=platform,
                 ts=round(ts, 1) if ts is not None else None):

@@ -12,8 +12,10 @@ one markdown dashboard:
   aggregate >= 5x, `verify_blob_kzg_proof_batch` >= 2x, compile+first
   < 40s, tier-1 wall < 870s, multichip dryrun ok, serve steady-state
   throughput >= 10k verifies/s and p99 batch latency < 500ms — the
-  sustained-load `serve::*` records `bench_serve.py` emits) evaluated
-  against the latest data;
+  sustained-load `serve::*` records `bench_serve.py` emits — plus the
+  chaos-round gates: fault-stop → steady-state recovery < 60s and zero
+  wrong results, from the `resilience::*` records) evaluated against
+  the latest data;
 - a generic round-over-round regression rule (no TPU metric may
   regress more than CST_BENCHWATCH_MAX_REGRESS_PCT percent);
 - the `_MSM_DEVICE_MIN` break-even recommendation from the
@@ -108,6 +110,24 @@ THRESHOLDS = (
      "title": "incremental vs full re-merkleize @ 1% dirty",
      "metric": r"merkle_incr::update@frac0\.01",
      "field": "vs_baseline", "op": ">=", "target": 5.0, "tpu_only": False},
+    # resilience (chaos rounds, CST_SERVE_CHAOS=1): after an active
+    # fault plan stops firing, the service must return to steady state
+    # within a bounded wall — and must have answered every checked
+    # request correctly while degraded (the breaker/oracle-fallback
+    # path).  Shape-, not platform-, bound: evaluated on the CPU chaos
+    # smoke too.
+    {"id": "chaos-recovered",
+     "title": "chaos round: service returned to steady state",
+     "metric": r"resilience::recovered",
+     "field": "value", "op": ">=", "target": 1.0, "tpu_only": False},
+    {"id": "chaos-recovery",
+     "title": "chaos round: fault-stop → steady-state recovery (s)",
+     "metric": r"resilience::recovery_latency_s",
+     "field": "value", "op": "<", "target": 60.0, "tpu_only": False},
+    {"id": "chaos-correctness",
+     "title": "chaos round: wrong verification results",
+     "metric": r"resilience::wrong_results",
+     "field": "value", "op": "<", "target": 1.0, "tpu_only": False},
 )
 
 FLAGSHIP = "mainnet_epoch_sweep_1m_validators_wall"
@@ -606,6 +626,48 @@ def render_utilization(util: dict, msm: dict) -> list[str]:
     return lines
 
 
+def render_resilience(records) -> list[str]:
+    """The chaos-round read side: latest `resilience::*` records (one
+    row per metric) plus the latest round's breaker/heal summary from
+    the compact block riding the recovery-latency record."""
+    lines = ["## Resilience (chaos rounds)\n"]
+    recs = [r for r in records if r.get("source") == "resilience"]
+    if not recs:
+        lines.append("No resilience records — run a chaos round "
+                     "(`CST_SERVE_CHAOS=1 make serve` / "
+                     "`make chaos-smoke`) to exercise fault injection, "
+                     "breaker/fallback degraded mode, and recovery.\n")
+        return lines
+    lines.append("| metric | latest | where |")
+    lines.append("|---|---|---|")
+    latest_by_metric = {}
+    for metric, series in sorted(_by_metric(recs).items()):
+        latest = series[-1]
+        latest_by_metric[metric] = latest
+        val = "—" if latest.get("value") is None else \
+            f"{_fmt(latest['value'])} {latest.get('unit', '')}".rstrip()
+        lines.append(f"| `{metric}` | {val} | {_where(latest)} |")
+    lines.append("")
+    rec = latest_by_metric.get("resilience::recovery_latency_s")
+    compact = rec.get("resilience") if rec else None
+    if isinstance(compact, dict):
+        recovered = compact.get("recovered")
+        sites = ", ".join(f"{k}: {v}" for k, v in sorted(
+            (compact.get("injected_sites") or {}).items())) or "—"
+        lines.append(
+            f"Latest chaos round: {compact.get('faults_injected', '?')} "
+            f"fault(s) injected ({sites}), "
+            f"{compact.get('wrong_results', '?')} wrong result(s) over "
+            f"{compact.get('checked_results', '?')} checked, "
+            f"{compact.get('retries', 0)} retried / "
+            f"{compact.get('fallbacks', 0)} oracle-fallback / "
+            f"{compact.get('shed', 0)} shed; breaker trips: "
+            f"{compact.get('breaker_trips', 0)}, final states: "
+            f"{compact.get('breaker_states') or {}}; "
+            f"{'recovered' if recovered else 'DID NOT RECOVER'}.\n")
+    return lines
+
+
 def render_msm(msm: dict) -> list[str]:
     lines = ["## `_MSM_DEVICE_MIN` break-even\n", msm["text"] + "\n"]
     if msm.get("sizes"):
@@ -671,6 +733,7 @@ def render_report(result: dict) -> str:
     lines.extend(render_thresholds(result["thresholds"], result["strict"]))
     lines.extend(render_regressions(result["regressions"],
                                     result["max_regress_pct"]))
+    lines.extend(render_resilience(result["records"]))
     lines.extend(render_msm(result["msm"]))
     lines.extend(render_utilization(result["utilization"], result["msm"]))
     lines.extend(render_trend_tables(result["records"]))
