@@ -8,7 +8,8 @@ CPU_ENV = JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8
 VECTOR_OUT ?= out/vectors
 
 .PHONY: test test-fast test-all test-bls lint vectors kzg_setups bench \
-	bench-smoke bench-report serve serve-smoke chaos-smoke multichip help
+	bench-smoke bench-report serve serve-smoke chaos-smoke \
+	chaos-mesh-smoke multichip help
 
 help:
 	@echo "targets: test (fast suite) | test-all (incl. slow crypto) |"
@@ -23,7 +24,9 @@ help:
 	@echo "  serve-smoke (short closed-loop CPU serve round, emits the"
 	@echo "  serve bench JSON + benchwatch history) | chaos-smoke (serve"
 	@echo "  round under a canned fault plan: breaker/oracle-fallback"
-	@echo "  degraded mode, recovery-to-steady, resilience records) |"
+	@echo "  degraded mode, checkpoint kill/restore, flagship breaker,"
+	@echo "  recovery-to-steady, resilience records) | chaos-mesh-smoke"
+	@echo "  (same + shard-loss recovery on a simulated 8-device mesh) |"
 	@echo "  multichip (8-dev CPU dryrun)"
 
 test:
@@ -97,6 +100,16 @@ serve-smoke:
 # Resilience section + chaos-recovery threshold row (CI gates on this)
 chaos-smoke:
 	$(CPU_ENV) $(PYTHON) bench_smoke.py --chaos
+
+# no TPU required: the simulated-mesh chaos round — CPU_ENV forces 8
+# host devices, CST_CHAOS_MESH arms the shard-loss segment: one
+# injected device_loss into batch_verify_sharded, the lost shard's
+# statements re-bucket over the surviving 7 devices (zero wrong or
+# dropped), an invalid statement still rejects while degraded, and the
+# half-open probe re-admits the full mesh.  Asserts the mesh::* record
+# round-trip + the mesh-recovery / mesh-lost-statements threshold rows
+chaos-mesh-smoke:
+	$(CPU_ENV) $(PYTHON) bench_smoke.py --chaos-mesh
 
 multichip:
 	$(CPU_ENV) $(PYTHON) -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('ok')"

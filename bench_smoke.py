@@ -36,7 +36,23 @@ zero wrong results, breaker trip → oracle fallback → re-close, finite
 recovery latency, a schema-valid `"resilience"` block
 (`validate_resilience_block`), the `resilience::*` history-record
 round-trip, and the benchwatch report's Resilience section +
-`chaos-recovery` threshold row rendering from those records.
+`chaos-recovery` threshold row rendering from those records.  Since
+PR 9 the round also carries the checkpoint kill-and-resurrect segment
+(restore+replay ≥5x over a full rebuild, root parity, the
+`checkpoint::*` records and `checkpoint-restore` threshold row), the
+flagship breaker arc (`flagship::degraded_steps`), and the heal path
+record (`heal["path"] == "checkpoint"` — recovery restored from the
+snapshot, not the O(N) rebuild).
+
+`bench_smoke.py --chaos-mesh` (the `make chaos-mesh-smoke` lane) runs
+the same round with CST_CHAOS_MESH=1 on the simulated 8-host-device
+CPU mesh (XLA_FLAGS=--xla_force_host_platform_device_count=8): a
+`device_loss` fault into `batch_verify_sharded` must re-bucket the
+lost shard's statements over the surviving devices — zero wrong or
+dropped statements, an invalid statement still rejected while
+degraded, the half-open probe re-admitting the full mesh — and the
+`mesh::*` records must round-trip with the `mesh-recovery` /
+`mesh-lost-statements` threshold rows PASSing.
 """
 
 from __future__ import annotations
@@ -406,10 +422,12 @@ def main():
     print("bench smoke: PASS")
 
 
-def chaos_main():
+def chaos_main(mesh: bool = False):
     """The chaos-smoke lane (see module docstring): one bench_serve.py
     chaos round on tiny CPU shapes under a canned fault plan, then the
-    resilience record/report contract checks."""
+    resilience record/report contract checks.  `mesh=True` (the
+    chaos-mesh lane) additionally arms the simulated-mesh shard-loss
+    segment and asserts its contract."""
     from consensus_specs_tpu.telemetry import validate_resilience_block
 
     hist_env = os.environ.get("CST_BENCHWATCH_HISTORY")
@@ -421,16 +439,23 @@ def chaos_main():
     chaos_t0 = time.time()
     # the canned plan: deterministic dispatch failures into the RLC
     # verify kernel (the acceptance shape — resilience.chaos's default,
-    # spelled out here so the smoke pins the spec-string form too)
-    out = _run(["bench_serve.py"],
-               {"CST_SERVE_CHAOS": "1",
-                "CST_FAULTS": "seed=1234;dispatch:raise:key=rlc_*:count=4",
-                "CST_SERVE_DURATION_S": "9", "CST_SERVE_RATE": "0",
-                "CST_SERVE_POOL": "4", "CST_SERVE_COMMITTEE": "4",
-                "CST_SERVE_MAX_BATCH": "8", "CST_SERVE_WINDOWS": "3",
-                "CST_TELEMETRY": "1",
-                "CST_BENCHWATCH_HISTORY": str(hist_file)},
-               timeout=900)
+    # spelled out here so the smoke pins the spec-string form too).
+    # `key=rlc_h*` matches the single-chip RLC kernels (rlc_h2c /
+    # rlc_host_hash) but NOT rlc_sharded@… — the mesh segment owns its
+    # own device_loss plan and must not eat the serve round's faults.
+    env = {"CST_SERVE_CHAOS": "1",
+           "CST_FAULTS": "seed=1234;dispatch:raise:key=rlc_h*:count=4",
+           "CST_SERVE_DURATION_S": "9", "CST_SERVE_RATE": "0",
+           "CST_SERVE_POOL": "4", "CST_SERVE_COMMITTEE": "4",
+           "CST_SERVE_MAX_BATCH": "8", "CST_SERVE_WINDOWS": "3",
+           "CST_TELEMETRY": "1",
+           "CST_BENCHWATCH_HISTORY": str(hist_file)}
+    if mesh:
+        env["CST_CHAOS_MESH"] = "1"
+        env.setdefault(
+            "XLA_FLAGS", os.environ.get("XLA_FLAGS")
+            or "--xla_force_host_platform_device_count=8")
+    out = _run(["bench_serve.py"], env, timeout=1800 if mesh else 1200)
     lines = [o for o in out if o.get("metric") == "serve_sustained_load"]
     assert len(lines) == 1, out
     sl = lines[0]
@@ -452,20 +477,62 @@ def chaos_main():
     assert br["trips"] >= 1, br
     tos = [t["to"] for t in br["transitions"]]
     assert "open" in tos and "half_open" in tos and "closed" in tos, br
-    assert all(s == "closed" for s in br["states"].values()), br
+    # every breaker that saw post-fault traffic re-closed; a rung the
+    # closed-loop batching never revisited after the fault window keeps
+    # its open breaker (no probe traffic) — that is not a failed
+    # recovery, which the recovery-latency/steady asserts below pin
+    reclosed = [t["key"] for t in br["transitions"]
+                if t["from"] == "half_open" and t["to"] == "closed"]
+    assert reclosed, br
+    assert any(s == "closed" for s in br["states"].values()), br
     assert res["recovered"] and res["recovery_latency_s"] is not None, res
     assert 0 < res["recovery_latency_s"] < 300, res
     assert res["heal"]["diverged"] and res["heal"]["detected"], res
     assert res["heal"]["recovery_s"] > 0, res
+    # the heal routed through checkpoint restore (snapshot valid), not
+    # the O(N) rebuild floor
+    assert res["heal"]["path"] == "checkpoint", res["heal"]
+    # checkpoint kill-and-resurrect: root parity held and restore+replay
+    # beat the full rebuild (the >=5x gate is the threshold row below)
+    cp = res["checkpoint"]
+    assert cp["parity"], cp
+    assert cp["restore_s"] > 0 and cp["rebuild_s"] > 0, cp
+    assert cp["journal_entries"] >= 1 and cp["snapshot_bytes"] > 0, cp
+    assert cp["journal_frac"] <= 0.01, cp
+    assert cp["speedup"] is not None and cp["speedup"] >= 5.0, cp
+    # flagship breaker arc: the settle degraded onto the spec oracle
+    # (trip + open settle), answered correctly, and re-closed
+    fl = res["flagship"]
+    assert fl["degraded_steps"] >= 2, fl
+    assert fl["wrong_results"] == 0 and fl["checked_settles"] >= 4, fl
+    assert fl["recovered"], fl
+    assert fl["breaker"]["trips"] >= 1, fl
     serve = sl["serve"]
     assert serve["steady"], serve["windows"]
     assert serve["failed"] == 0, serve
+    if mesh:
+        mb = res["mesh"]
+        assert "skipped" not in mb, mb
+        assert mb["devices"] >= 2, mb
+        assert mb["device_lost_events"] >= 1, mb
+        assert mb["redispatches"] >= 1, mb
+        assert mb["readmissions"] >= 1 and mb["readmitted"], mb
+        assert mb["lost_statements"] == 0, mb
+        assert mb["wrong_results"] == 0 and mb["checked_statements"] > 0, mb
+        assert mb["recovery_latency_s"] is not None, mb
+        assert mb["max_degraded_lanes"] >= 1, mb
+        assert mb["recovered"], mb
+        print("mesh segment OK:", json.dumps(mb))
     print("chaos round OK:", json.dumps(
         {k: res[k] for k in ("faults_injected", "wrong_results",
                              "fallbacks", "retries",
                              "recovery_latency_s",
                              "degraded_verifies_per_s",
                              "baseline_verifies_per_s")}))
+    print("checkpoint segment OK:", json.dumps(cp))
+    print("flagship segment OK:", json.dumps(
+        {k: fl[k] for k in ("degraded_steps", "wrong_results",
+                            "recovered")}))
 
     # resilience history round-trip: the emission lands as resilience-
     # source records, schema-valid, with the compact block riding the
@@ -487,6 +554,40 @@ def chaos_main():
     rrec = fresh["resilience::recovery_latency_s"]
     assert rrec["value"] > 0 and rrec["resilience"]["recovered"], rrec
     assert fresh["resilience::wrong_results"]["value"] == 0
+    # the heal record carries the taken recovery path
+    assert fresh["resilience::merkle_heal_s"]["heal_path"] == "checkpoint"
+    # the checkpoint record kind round-trips: restore wall with the
+    # restore-vs-rebuild speedup riding as vs_baseline
+    crec = fresh.get("checkpoint::restore")
+    assert crec is not None, sorted(fresh)
+    assert crec["source"] == "checkpoint", crec
+    assert not benchwatch.validate_record(crec), crec
+    assert crec["value"] > 0 and crec["vs_baseline"] >= 5.0, crec
+    assert crec["checkpoint"]["parity"], crec
+    for name in ("checkpoint::journal_entries",
+                 "checkpoint::snapshot_bytes"):
+        rec = fresh.get(name)
+        assert rec is not None and rec["source"] == "checkpoint", \
+            (name, sorted(fresh))
+    # the flagship degraded-steps record
+    frec = fresh.get("resilience::flagship_degraded_steps")
+    assert frec is not None and frec["value"] >= 2, frec
+    assert frec["flagship"]["wrong_results"] == 0, frec
+    if mesh:
+        for name in ("mesh::recovery_latency_s", "mesh::recovered",
+                     "mesh::lost_statements",
+                     "mesh::wrong_results", "mesh::degraded_lanes",
+                     "mesh::device_lost_events", "mesh::readmissions"):
+            rec = fresh.get(name)
+            assert rec is not None, (name, sorted(fresh))
+            assert rec["source"] == "mesh", rec
+            assert not benchwatch.validate_record(rec), rec
+        mrec = fresh["mesh::recovery_latency_s"]
+        assert mrec["value"] is not None and mrec["value"] > 0, mrec
+        assert mrec["mesh"]["device_lost_events"] >= 1, mrec
+        assert fresh["mesh::lost_statements"]["value"] == 0
+        assert fresh["mesh::wrong_results"]["value"] == 0
+        assert fresh["mesh::recovered"]["value"] == 1.0
     print(f"resilience history OK: {len(fresh)} records this run -> "
           f"{hist_file}")
 
@@ -512,13 +613,25 @@ def chaos_main():
         rows["chaos-recovered"]
     assert rows["chaos-correctness"]["status"] == "PASS", \
         rows["chaos-correctness"]
-    print(f"chaos report OK: chaos-recovery + chaos-correctness PASS -> "
-          f"{report_md}")
+    assert rows["checkpoint-restore"]["status"] == "PASS", \
+        rows["checkpoint-restore"]
+    assert "Latest checkpoint restore:" in text
+    if mesh:
+        for row_id in ("mesh-recovered", "mesh-recovery",
+                       "mesh-lost-statements", "mesh-wrong-results"):
+            assert rows[row_id]["status"] == "PASS", rows[row_id]
+        assert "Latest mesh segment:" in text
+        print("mesh report OK: mesh-recovered + mesh-recovery + "
+              "mesh-lost-statements + mesh-wrong-results PASS")
+    print(f"chaos report OK: chaos-recovery + chaos-correctness + "
+          f"checkpoint-restore PASS -> {report_md}")
     print("chaos smoke: PASS")
 
 
 if __name__ == "__main__":
-    if "--chaos" in sys.argv:
+    if "--chaos-mesh" in sys.argv:
+        chaos_main(mesh=True)
+    elif "--chaos" in sys.argv:
         chaos_main()
     else:
         main()

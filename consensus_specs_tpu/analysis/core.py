@@ -126,10 +126,14 @@ KERNEL_FILES = LIMB_FILES + (
 # entries; sha256_jax and fr_batch joined the surface with the
 # cost-capture rule (instr-uncovered-cost) — their device entry points
 # must stay visible to the roofline layer too; parallel/incremental.py
-# joined with the incremental-merkleization kernels (merkle_incr@…)
+# joined with the incremental-merkleization kernels (merkle_incr@…);
+# resilience/mesh.py + checkpoint.py joined with the recovery surfaces
+# (their public entries must stay span-covered like every other path
+# that can reach a device dispatch)
 INSTR_FILES = ("ops/bls_batch/__init__.py", "ops/bls/__init__.py",
                "ops/sha256_jax.py", "ops/fr_batch.py",
-               "parallel/incremental.py")
+               "parallel/incremental.py", "resilience/mesh.py",
+               "resilience/checkpoint.py")
 
 # shape-laundering functions: a value that went through one of these is
 # a bucketed compile key, not a raw dimension

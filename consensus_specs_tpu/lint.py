@@ -316,11 +316,12 @@ def lint_env_knobs(repo=None) -> list[str]:
     (`CST_BENCHWATCH_*`) additionally need a mention in the README's
     "Benchwatch" section, serving knobs (`CST_SERVE_*`) in the
     "Serving" section, incremental-merkleization knobs
-    (`CST_MERKLE_*`) in the "Incremental merkleization" section, and
-    fault-plan knobs (`CST_FAULTS*`) in the "Resilience" section — a
-    subsystem's configuration surface must be documented where the
-    subsystem is explained, not only in the flat table.  `repo`
-    overrides the tree root (tests)."""
+    (`CST_MERKLE_*`) in the "Incremental merkleization" section,
+    fault-plan knobs (`CST_FAULTS*`) in the "Resilience" section, and
+    checkpoint knobs (`CST_CHECKPOINT_*`) in the "Mesh resilience &
+    checkpointing" section — a subsystem's configuration surface must
+    be documented where the subsystem is explained, not only in the
+    flat table.  `repo` overrides the tree root (tests)."""
     repo = Path(repo) if repo is not None else PKG_ROOT.parent
     readme = repo / "README.md"
     readme_text = readme.read_text()
@@ -337,7 +338,11 @@ def lint_env_knobs(repo=None) -> list[str]:
                           ("CST_MERKLE_", "Incremental merkleization",
                            section("Incremental merkleization")),
                           ("CST_FAULTS", "Resilience",
-                           section("Resilience")))
+                           section("Resilience")),
+                          ("CST_CHECKPOINT_",
+                           "Mesh resilience & checkpointing",
+                           section(re.escape(
+                               "Mesh resilience & checkpointing"))))
 
     used: dict[str, str] = {}
     for path in sorted(repo.rglob("*.py")):

@@ -575,17 +575,26 @@ def batch_verify(tasks, rng=None, device_h2c: bool | None = None) -> bool:
 
 
 @functools.lru_cache(maxsize=16)
-def _rlc_kernel_sharded(n_devices: int, per_shard: int, axis: str):
+def _rlc_kernel_sharded(n_devices: int, per_shard: int, axis: str,
+                        device_ids: tuple | None = None):
     """shard_map'd RLC batch over a `Mesh`: every device scalar-muls and
     Miller-loops its own lane shard, partial signature sums and partial
     Miller products ride one `all_gather` each across the mesh (ICI, not
     host), and the single final exponentiation runs replicated.  The
-    multi-chip form of `_rlc_kernel` — same predicate, same soundness."""
+    multi-chip form of `_rlc_kernel` — same predicate, same soundness.
+
+    `device_ids` (a tuple of `jax.devices()` indices) builds the mesh
+    from exactly those devices instead of the first `n_devices` — the
+    mesh-resilience layer's shrunken-mesh form (`resilience.mesh`): a
+    lost shard's statements re-bucket across the SURVIVING devices, not
+    a renumbered prefix that might include the dead one."""
     import jax
     from jax.sharding import Mesh, PartitionSpec as P
     jnp = _jnp()
 
-    mesh_devs = jax.devices()[:n_devices]
+    all_devs = jax.devices()
+    mesh_devs = (all_devs[:n_devices] if device_ids is None
+                 else [all_devs[i] for i in device_ids])
     mesh = Mesh(np.array(mesh_devs), (axis,))
     neg_g1 = cj.g1_affine_to_limbs([_pycurve.g1.neg(_pycurve.G1_GEN)])
 
@@ -634,27 +643,46 @@ def _rlc_kernel_sharded(n_devices: int, per_shard: int, axis: str):
 
 
 def batch_verify_sharded_async(tasks, n_devices: int | None = None,
-                               rng=None,
-                               axis: str = "data") -> DeviceFuture:
+                               rng=None, axis: str = "data",
+                               device_ids=None) -> DeviceFuture:
     """`batch_verify_async` distributed over the device mesh: lanes
     shard across `n_devices`, cross-device combination is two
     all_gathers (partial G2 sums, partial Miller products), one
     replicated final exponentiation.  Accept/reject is bit-identical to
-    `batch_verify`."""
+    `batch_verify`.
+
+    `device_ids` pins the mesh to specific `jax.devices()` indices (the
+    resilience layer's surviving-device set after a `device_loss`);
+    when given it overrides `n_devices`.  A one-device set degrades to
+    the single-chip `batch_verify_async` path."""
     import jax
 
     if not tasks:
         return DeviceFuture.settled(True)
     available = len(jax.devices())
+    if device_ids is not None:
+        device_ids = tuple(int(i) for i in device_ids)
+        assert device_ids and max(device_ids) < available, device_ids
+        n_devices = len(device_ids)
     if n_devices is None:
         n_devices = available
     n_devices = min(n_devices, available)
-    if n_devices <= 1:
+    if n_devices <= 1 and device_ids is None:
+        # a 1-wide IMPLICIT request degrades to the single-chip path;
+        # an explicit one-survivor device set must keep the mesh form —
+        # batch_verify_async has no device pinning, and the default
+        # device may be exactly the dead one the caller is avoiding
         return batch_verify_async(tasks, rng=rng)
     rand = rng if rng is not None else secrets.SystemRandom()
     # pad lanes to devices x power-of-two per-shard bucket
     n_tasks = len(tasks)
     per_shard = _bucket((n_tasks + n_devices - 1) // n_devices)
+    # resilience fault seam (one module-global read when idle): the
+    # mesh chaos rounds inject `device_loss` here — the same boundary a
+    # real XlaRuntimeError from a dead mesh device surfaces at
+    if faults.active():
+        faults.maybe_inject("dispatch",
+                            f"rlc_sharded@{n_devices}x{per_shard}")
     arrays, n = _prepare_rlc_inputs(tasks, rand,
                                     n_devices * per_shard)
     if arrays is None:
@@ -667,7 +695,8 @@ def batch_verify_sharded_async(tasks, n_devices: int | None = None,
         jargs = tuple(jnp.asarray(a) for a in arrays)
         # cst: allow(recompile-unbucketed-dim): the device count keys
         # the executable — one value per host topology, not per batch
-        kernel = _rlc_kernel_sharded(n_devices, per_shard, axis)
+        kernel = _rlc_kernel_sharded(n_devices, per_shard, axis,
+                                     device_ids)
         out = kernel(*jargs)
     # cost-capture seam, outside the span so the AOT analysis pass does
     # not contaminate the measured wall (capture degrades to an error
@@ -679,7 +708,9 @@ def batch_verify_sharded_async(tasks, n_devices: int | None = None,
 
 
 def batch_verify_sharded(tasks, n_devices: int | None = None,
-                         rng=None, axis: str = "data") -> bool:
+                         rng=None, axis: str = "data",
+                         device_ids=None) -> bool:
     """Synchronous facade over `batch_verify_sharded_async`."""
     return batch_verify_sharded_async(tasks, n_devices=n_devices,
-                                      rng=rng, axis=axis).result()
+                                      rng=rng, axis=axis,
+                                      device_ids=device_ids).result()

@@ -327,6 +327,9 @@ class MerkleForest:
         # toggled by resilience.healing while a diverged stack rebuilds
         # (serving code must not emit roots/proofs from quarantined state)
         self.quarantined = False
+        # attach point for a resilience.checkpoint.CheckpointManager:
+        # while set, every update() also journals its leaf delta there
+        self.checkpoint = None
         with telemetry.span("parallel.merkle_incr.build", depth=d):
             # cst: allow(recompile-unbucketed-dim): the static tree depth
             # keys the executable — log-bounded (<= limit_depth distinct
@@ -334,6 +337,37 @@ class MerkleForest:
             self.layers = _build_layers(jnp.asarray(padded), d)
         costmodel.capture(f"merkle_build@d{d}", _build_layers,
                           (self.layers[0], d))
+
+    @classmethod
+    def from_layers(cls, layers, limit_depth: int, length: int,
+                    n_chunks: int) -> "MerkleForest":
+        """Reconstruct a forest from an already-computed layer stack
+        with ZERO hashing — device puts only.  The checkpoint-restore
+        path (`resilience.checkpoint`): the snapshot persisted every
+        interior layer, so restore must not pay the O(N) re-merkleize
+        `__init__` would.  Shapes are validated (each level halves);
+        content correctness is the caller's checksum contract."""
+        depth = len(layers) - 1
+        assert depth >= 0 and layers[0].shape[0] == 1 << depth, (
+            depth, layers[0].shape)
+        for lvl, lay in enumerate(layers):
+            assert tuple(lay.shape) == (1 << (depth - lvl), 8), (
+                lvl, lay.shape)
+        self = cls.__new__(cls)
+        self.data_depth = depth
+        self.limit_depth = int(limit_depth)
+        self.length = int(length)
+        self.n_chunks = int(n_chunks)
+        assert self.n_chunks <= (1 << self.limit_depth)
+        self.quarantined = False
+        self.checkpoint = None
+        with telemetry.span("parallel.merkle_incr.from_layers",
+                            depth=depth):
+            self.layers = tuple(
+                jnp.asarray(np.asarray(lay, dtype=np.uint32),
+                            dtype=jnp.uint32)
+                for lay in layers)
+        return self
 
     @property
     def capacity(self) -> int:
@@ -363,6 +397,12 @@ class MerkleForest:
         if leaves.shape[0] < rung:      # device-safe pad (no host fetch)
             leaves = jnp.concatenate(
                 [leaves, jnp.zeros((rung - m, 8), dtype=jnp.uint32)])
+        if self.checkpoint is not None:
+            # leaf-delta journal (resilience.checkpoint): recorded
+            # BEFORE the dispatch so snapshot+journal always covers
+            # exactly the applied updates; the manager materializes the
+            # delta host-side — the one sync checkpointing opts into
+            self.checkpoint.on_update(self, idx, leaves)
         self.layers = update_dirty(self.layers, jnp.asarray(idx),
                                    leaves, self.data_depth)
 

@@ -25,23 +25,39 @@ machinery to prove it:
                  pure-Python oracle fallback (correct-but-slow degraded
                  mode, half-open probes to re-close), and typed
                  `DeadlineExceeded` request shedding.
-    healing.py   divergence detector + quarantine/rebuild for a
+    healing.py   divergence detector + quarantine/recovery for a
                  `parallel.incremental.MerkleForest` (recovery latency
-                 recorded).
+                 recorded); recovery routes through checkpoint restore
+                 when a valid snapshot exists, else a full rebuild —
+                 the taken path rides the `heal` block.
+    mesh.py      per-shard recovery for `batch_verify_sharded`: a lost
+                 mesh device (`MeshDeviceLost` or a real
+                 XlaRuntimeError) re-buckets the SAME statements over
+                 the surviving devices (degraded n-1 mode), with a
+                 half-open re-admission probe once the device answers
+                 again — zero wrong or dropped statements.
+    checkpoint.py versioned, checksummed host-side snapshots of
+                 `MerkleForest` layer stacks plus a leaf-delta journal
+                 appended at the `update_dirty` seam; restore = load
+                 snapshot + replay journal instead of the O(N)
+                 re-merkleize (`CST_CHECKPOINT_DIR` /
+                 `CST_CHECKPOINT_EVERY`).
     chaos.py     the chaos-round harness (`CST_SERVE_CHAOS=1`): mainnet
                  arrival mix under an active fault plan, requiring the
                  service to return to steady state — emits the
                  `resilience` benchwatch record kind the `chaos-recovery`
                  threshold row gates on.
 
-Import discipline: `faults` and `policies` are stdlib-only (+ telemetry,
-itself stdlib-only) so the hot-path seams can import them eagerly
-without touching numpy/jax; `healing` and `chaos` import the heavy
-modules lazily, at call time.
+Import discipline: `faults`, `policies`, `mesh` and `checkpoint` are
+stdlib-only at import (+ telemetry, itself stdlib-only) so the hot-path
+seams can import them eagerly without touching numpy/jax; `healing` and
+`chaos` import the heavy modules lazily, at call time.
 """
 
-from . import faults
+from . import checkpoint, faults, mesh
+from .checkpoint import CheckpointCorrupt, CheckpointManager
 from .faults import FaultInjected, FaultPlan, MeshDeviceLost
+from .mesh import MeshState, MeshVerifier
 from .policies import (
     BreakerRegistry,
     CircuitBreaker,
@@ -50,7 +66,8 @@ from .policies import (
 )
 
 __all__ = [
-    "BreakerRegistry", "CircuitBreaker", "DeadlineExceeded",
-    "FaultInjected", "FaultPlan", "MeshDeviceLost", "RetryPolicy",
-    "faults",
+    "BreakerRegistry", "CheckpointCorrupt", "CheckpointManager",
+    "CircuitBreaker", "DeadlineExceeded", "FaultInjected", "FaultPlan",
+    "MeshDeviceLost", "MeshState", "MeshVerifier", "RetryPolicy",
+    "checkpoint", "faults", "mesh",
 ]

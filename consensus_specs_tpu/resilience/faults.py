@@ -9,7 +9,11 @@ injection site and key).
 Sites (`SITES`) — the four seams the hooks live at:
 
     dispatch        `ops.bls_batch._dispatch` (key = kernel name, e.g.
-                    `rlc_h2c@8`) and `ops.sha256_jax` (key =
+                    `rlc_h2c@8`), the mesh-sharded entry point
+                    `batch_verify_sharded_async` (key =
+                    `rlc_sharded@<devices>x<per_shard>` — the
+                    `device_loss` chaos target `resilience.mesh`
+                    recovers from), and `ops.sha256_jax` (key =
                     `sha256_merkle@d<depth>`) — the jitted-kernel
                     dispatch boundary
     future_settle   `serve.futures.DeviceFuture` device-backed settle

@@ -46,6 +46,10 @@ class HealReport(NamedTuple):
     diverged: bool
     recovery_s: float | None     # rebuild wall when diverged, else None
     root: bytes                  # the (healed) full SSZ list root
+    path: str = "none"           # which recovery ran: "none" (clean),
+                                 # "checkpoint" (snapshot+journal
+                                 # restore), or "rebuild" (full
+                                 # re-merkleize from persisted leaves)
 
 
 def _reference_root_bytes(forest, leaf_words=None) -> bytes:
@@ -77,33 +81,60 @@ def forest_diverged(forest, leaf_words=None) -> bool:
     return forest.root_bytes() != _reference_root_bytes(forest, leaf_words)
 
 
-def heal_forest(forest, leaf_words=None) -> HealReport:
+def heal_forest(forest, leaf_words=None, checkpoint=None) -> HealReport:
     """Detect / quarantine / rebuild / re-serve, returning the
     `HealReport` (recovery latency is the quarantine wall).  A clean
     forest returns immediately with `diverged=False`.  `leaf_words`
     optionally supplies authoritative leaves when the persisted leaf
-    layer itself is suspect."""
+    layer itself is suspect.
+
+    `checkpoint` (a `resilience.checkpoint.CheckpointManager`, or the
+    forest's attached one by default) makes recovery try snapshot +
+    journal-replay restore FIRST — O(journal · log N) instead of the
+    O(N) rebuild — falling back to the rebuild when the checkpoint is
+    missing/corrupt or its restored root disagrees with the reference
+    (a stale snapshot must never win over the leaves).  The taken path
+    is recorded in `HealReport.path` (the resilience block's `heal`
+    surface).  With authoritative `leaf_words` the checkpoint is
+    bypassed: the caller asserted the persisted state — snapshot
+    included — is suspect."""
     import numpy as np
 
     reference = _reference_root_bytes(forest, leaf_words)
     if forest.root_bytes() == reference:
         forest.quarantined = False
-        return HealReport(False, None, reference)
+        return HealReport(False, None, reference, "none")
 
     telemetry.count("resilience.heal.diverged")
     forest.quarantined = True
+    if checkpoint is None:
+        checkpoint = getattr(forest, "checkpoint", None)
     t0 = time.perf_counter()
+    path = "rebuild"
     with telemetry.span("resilience.heal", chunks=forest.n_chunks):
         from ..parallel.incremental import MerkleForest
 
-        if leaf_words is None:
-            leaf_words = np.asarray(forest.layers[0])[:forest.n_chunks]
-        rebuilt = MerkleForest(np.asarray(leaf_words, dtype=np.uint32),
-                               forest.limit_depth, forest.length)
-        forest.layers = rebuilt.layers
+        restored = None
+        if checkpoint is not None and leaf_words is None:
+            restored = checkpoint.restore_or_none()
+            if restored is not None \
+                    and restored.root_bytes() == reference:
+                forest.layers = restored.layers
+                path = "checkpoint"
+                telemetry.count("resilience.heal.from_checkpoint")
+            else:
+                restored = None     # corrupt/stale — fall back
+        if restored is None:
+            if leaf_words is None:
+                leaf_words = np.asarray(forest.layers[0])[:forest.n_chunks]
+            rebuilt = MerkleForest(
+                np.asarray(leaf_words, dtype=np.uint32),
+                forest.limit_depth, forest.length)
+            forest.layers = rebuilt.layers
+            telemetry.count("resilience.heal.from_rebuild")
         root = forest.root_bytes()
     recovery_s = time.perf_counter() - t0
     forest.quarantined = False
     telemetry.observe("resilience.heal.recovery_s", recovery_s)
     assert root == reference, "rebuild did not converge to the oracle root"
-    return HealReport(True, recovery_s, root)
+    return HealReport(True, recovery_s, root, path)

@@ -53,6 +53,11 @@ Failure semantics are LAYERED (PR 8, the resilience layer):
   older than the deadline are shed at the next pump with a typed
   `resilience.DeadlineExceeded` — oldest first, so overload degrades
   into explicit failures instead of unbounded queue growth.
+- `mesh=MeshVerifier(...)` (PR 9): verify batches dispatch sharded
+  over the device mesh with per-shard loss recovery — a dead device
+  re-buckets the batch over the survivors inside the mesh layer, so
+  the retry/breaker ladder here only sees failures the mesh could not
+  absorb (`resilience.mesh`; its counters ride `stats()["mesh"]`).
 
 Fault injection (`resilience.faults`, OFF by default): the
 `serve_pump` seam fires inside `_dispatch_one`'s try block, so an
@@ -219,12 +224,17 @@ class ServeExecutor:
 
     def __init__(self, max_batch: int = 512, depth: int = 2,
                  retry=None, breakers=None,
-                 deadline_ms: float | None = None):
+                 deadline_ms: float | None = None, mesh=None):
         assert max_batch >= 1 and depth >= 1
         self.max_batch = max_batch
         self.depth = depth
         self.retry = retry
         self.breakers = breakers
+        # a resilience.mesh.MeshVerifier: verify batches dispatch over
+        # the device mesh with the per-shard recovery ladder (a lost
+        # device re-buckets the batch over the survivors before the
+        # retry/breaker ladder here ever sees a failure)
+        self.mesh = mesh
         if deadline_ms is None:
             try:
                 deadline_ms = float(
@@ -378,8 +388,12 @@ class ServeExecutor:
             # instrumented rounds the telemetry seam must not
             # block_until_ready between batches (see bls_batch._dispatch)
             if kind == "verify":
-                fut = bb.batch_verify_async([r.payload for r in reqs],
-                                            block=False)
+                if self.mesh is not None:
+                    fut = self.mesh.verify_async(
+                        [r.payload for r in reqs])
+                else:
+                    fut = bb.batch_verify_async(
+                        [r.payload for r in reqs], block=False)
             elif kind == "pairing":
                 fut = bb.pairing_check_device_async(reqs[0].payload,
                                                     block=False)
@@ -571,4 +585,6 @@ class ServeExecutor:
         }
         if self.breakers is not None:
             out["breakers"] = self.breakers.states()
+        if self.mesh is not None:
+            out["mesh"] = self.mesh.block()
         return out

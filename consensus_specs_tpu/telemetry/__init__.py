@@ -76,7 +76,9 @@ from .export import (
     chrome_trace,
     embed_bench_block,
     validate_bench_block,
+    validate_checkpoint_block,
     validate_costmodel_block,
+    validate_mesh_block,
     validate_resilience_block,
     validate_serve_block,
     write_chrome_trace,
@@ -88,6 +90,7 @@ __all__ = [
     "enabled", "first_call", "gauge", "observe", "reset", "set_meta",
     "snapshot", "span", "span_seconds", "bench_block", "chrome_trace",
     "embed_bench_block", "validate_bench_block",
-    "validate_costmodel_block", "validate_resilience_block",
+    "validate_checkpoint_block", "validate_costmodel_block",
+    "validate_mesh_block", "validate_resilience_block",
     "validate_serve_block", "write_chrome_trace", "write_jsonl",
 ]

@@ -355,6 +355,73 @@ def validate_resilience_block(obj) -> list[str]:
     if plan is not None and (not isinstance(plan, dict)
                              or not isinstance(plan.get("faults"), list)):
         problems.append("'plan' must be a fault-plan summary dict")
+    problems.extend(validate_checkpoint_block(obj.get("checkpoint")))
+    problems.extend(validate_mesh_block(obj.get("mesh")))
+    fl = obj.get("flagship")
+    if fl is not None:
+        if not isinstance(fl, dict) \
+                or not isinstance(fl.get("degraded_steps"), int) \
+                or not isinstance(fl.get("wrong_results"), int):
+            problems.append("'flagship' must carry int degraded_steps "
+                            "and wrong_results")
+    return problems
+
+
+def validate_checkpoint_block(cp) -> list[str]:
+    """Schema check for the chaos round's `"checkpoint"` sub-object
+    (`resilience.chaos._checkpoint_segment`).  None is valid — the
+    segment is part of chaos rounds only."""
+    if cp is None:
+        return []
+    if not isinstance(cp, dict):
+        return [f"checkpoint block is {type(cp).__name__}, not dict"]
+    problems: list[str] = []
+    for key in ("n_chunks", "journal_entries", "snapshot_bytes"):
+        v = cp.get(key)
+        if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+            problems.append(f"checkpoint[{key!r}] must be a "
+                            f"non-negative int, got {v!r}")
+    for key in ("restore_s", "rebuild_s", "journal_frac"):
+        v = cp.get(key)
+        if not isinstance(v, (int, float)) or isinstance(v, bool) \
+                or v < 0:
+            problems.append(f"checkpoint[{key!r}] must be a "
+                            f"non-negative number, got {v!r}")
+    if not isinstance(cp.get("parity"), bool):
+        problems.append("checkpoint['parity'] must be a bool")
+    sp = cp.get("speedup")
+    if sp is not None and (not isinstance(sp, (int, float))
+                           or isinstance(sp, bool) or sp < 0):
+        problems.append(f"checkpoint['speedup'] must be a non-negative "
+                        f"number or null, got {sp!r}")
+    return problems
+
+
+def validate_mesh_block(mesh) -> list[str]:
+    """Schema check for the chaos round's `"mesh"` sub-object
+    (`resilience.mesh.MeshVerifier.block` + the segment's correctness
+    counters).  None and a `skipped` block (too few devices) are
+    valid."""
+    if mesh is None:
+        return []
+    if not isinstance(mesh, dict):
+        return [f"mesh block is {type(mesh).__name__}, not dict"]
+    if "skipped" in mesh:
+        return []
+    problems: list[str] = []
+    for key in ("devices", "degraded_lanes", "max_degraded_lanes",
+                "device_lost_events", "readmissions", "redispatches",
+                "verified_statements", "lost_statements",
+                "wrong_results", "checked_statements"):
+        v = mesh.get(key)
+        if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+            problems.append(f"mesh[{key!r}] must be a non-negative "
+                            f"int, got {v!r}")
+    rl = mesh.get("recovery_latency_s")
+    if rl is not None and (not isinstance(rl, (int, float))
+                           or isinstance(rl, bool) or rl < 0):
+        problems.append(f"mesh['recovery_latency_s'] must be a "
+                        f"non-negative number or null, got {rl!r}")
     return problems
 
 

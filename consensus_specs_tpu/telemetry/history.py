@@ -44,6 +44,17 @@ Record schema (version `SCHEMA`; one JSON object per line):
                                  # latency, wrong-result count, degraded
                                  # throughput, breaker transitions,
                                  # Merkle heal wall)
+     "mesh": dict,               # compacted shard-loss recovery block
+                                 # (source "mesh"; metric
+                                 # "mesh::<metric>" — recovery latency,
+                                 # lost/wrong statements, degraded
+                                 # lanes, re-admissions)
+     "checkpoint": dict,         # compacted restore block (source
+                                 # "checkpoint"; metric
+                                 # "checkpoint::<metric>" — restore wall
+                                 # w/ restore-vs-rebuild speedup as
+                                 # vs_baseline, journal depth, snapshot
+                                 # bytes)
      "ts": float}                # wall-clock stamp (live emissions only)
 
 Robustness contract (pinned by tests/test_benchwatch.py): malformed or
@@ -67,7 +78,8 @@ from pathlib import Path
 SCHEMA = 1
 
 SOURCES = ("bench_round", "multichip_round", "baseline", "bench_emit",
-           "pytest_snapshot", "costmodel", "serve", "resilience")
+           "pytest_snapshot", "costmodel", "serve", "resilience",
+           "mesh", "checkpoint")
 
 _ROUND_FILE_RE = re.compile(r"(?:BENCH|MULTICHIP)_r(\d+)\.json$")
 
@@ -245,7 +257,99 @@ def resilience_records(metric: str, res, **context) -> list[dict]:
                                              (int, float)):
         records.append(make_record(
             "resilience", "resilience::merkle_heal_s",
-            heal["recovery_s"], unit="s", via_metric=metric, **context))
+            heal["recovery_s"], unit="s", via_metric=metric,
+            heal_path=heal.get("path"), **context))
+    fl = res.get("flagship")
+    if isinstance(fl, dict) and isinstance(fl.get("degraded_steps"), int) \
+            and not isinstance(fl.get("degraded_steps"), bool):
+        records.append(make_record(
+            "resilience", "resilience::flagship_degraded_steps",
+            fl["degraded_steps"], unit="count", via_metric=metric,
+            flagship={k: fl[k] for k in ("wrong_results",
+                                         "checked_settles", "recovered")
+                      if k in fl},
+            **context))
+    records.extend(mesh_records(metric, res.get("mesh"), **context))
+    records.extend(checkpoint_records(metric, res.get("checkpoint"),
+                                      **context))
+    return records
+
+
+def mesh_records(metric: str, mesh, **context) -> list[dict]:
+    """`mesh`-source history records mined from a chaos round's
+    `"mesh"` sub-object (`resilience.mesh.MeshVerifier.block` plus the
+    segment's correctness counters): the shard-loss recovery latency
+    (carrying the compact block — the `mesh-recovery` threshold row's
+    surface), lost/wrong statement counts (the zero-loss gate), and
+    the degradation/re-admission counters.  Skipped segments (too few
+    devices) and malformed blocks yield zero records."""
+    if not isinstance(mesh, dict) or "skipped" in mesh \
+            or not isinstance(mesh.get("devices"), int):
+        return []
+    compact = {k: mesh[k] for k in (
+        "devices", "degraded_lanes", "max_degraded_lanes",
+        "device_lost_events", "readmissions", "retrips", "redispatches",
+        "recoveries", "verified_statements", "lost_statements",
+        "wrong_results", "checked_statements", "readmitted",
+        "recovered") if k in mesh}
+    records = [make_record(
+        "mesh", "mesh::recovery_latency_s",
+        mesh.get("recovery_latency_s"), unit="s", mesh=compact,
+        via_metric=metric, **context)]
+    # recovered as its own 0/1 record (the mesh-recovered threshold
+    # row): an unrecovered round's latency record carries value null,
+    # which a numeric threshold skips — without this the previous
+    # round's PASS would stand (same fix as resilience::recovered)
+    if isinstance(mesh.get("recovered"), bool):
+        records.append(make_record(
+            "mesh", "mesh::recovered",
+            1.0 if mesh["recovered"] else 0.0, unit="bool",
+            via_metric=metric, **context))
+
+    def scalar(key, name, unit="count"):
+        v = mesh.get(key)
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            records.append(make_record(
+                "mesh", name, v, unit=unit, via_metric=metric,
+                **context))
+
+    scalar("lost_statements", "mesh::lost_statements")
+    scalar("wrong_results", "mesh::wrong_results")
+    scalar("max_degraded_lanes", "mesh::degraded_lanes")
+    scalar("device_lost_events", "mesh::device_lost_events")
+    scalar("readmissions", "mesh::readmissions")
+    return records
+
+
+def checkpoint_records(metric: str, cp, **context) -> list[dict]:
+    """`checkpoint`-source history records mined from a chaos round's
+    `"checkpoint"` sub-object (`resilience.chaos._checkpoint_segment`):
+    the restore wall with the restore-vs-rebuild speedup as its
+    `vs_baseline` (the `checkpoint-restore` threshold row evaluates
+    that field), plus journal depth and snapshot size.  Malformed
+    blocks yield zero records."""
+    if not isinstance(cp, dict) \
+            or not isinstance(cp.get("restore_s"), (int, float)) \
+            or isinstance(cp.get("restore_s"), bool):
+        return []
+    compact = {k: cp[k] for k in (
+        "n_chunks", "journal_entries", "journal_replayed",
+        "journal_frac", "snapshot_bytes", "rebuild_s", "parity")
+        if k in cp}
+    speedup = cp.get("speedup")
+    records = [make_record(
+        "checkpoint", "checkpoint::restore", cp["restore_s"], unit="s",
+        vs_baseline=(speedup if isinstance(speedup, (int, float))
+                     and not isinstance(speedup, bool) else None),
+        checkpoint=compact, via_metric=metric, **context)]
+    for key, name, unit in (
+            ("journal_entries", "checkpoint::journal_entries", "count"),
+            ("snapshot_bytes", "checkpoint::snapshot_bytes", "bytes")):
+        v = cp.get(key)
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            records.append(make_record(
+                "checkpoint", name, v, unit=unit, via_metric=metric,
+                **context))
     return records
 
 

@@ -14,8 +14,11 @@ one markdown dashboard:
   throughput >= 10k verifies/s and p99 batch latency < 500ms — the
   sustained-load `serve::*` records `bench_serve.py` emits — plus the
   chaos-round gates: fault-stop → steady-state recovery < 60s and zero
-  wrong results, from the `resilience::*` records) evaluated against
-  the latest data;
+  wrong results, from the `resilience::*` records; the mesh shard-loss
+  gates — recovery < 60s, zero lost/wrong statements — from the
+  `mesh::*` records; and checkpoint restore+replay >= 5x over a full
+  rebuild from the `checkpoint::*` records) evaluated against the
+  latest data;
 - a generic round-over-round regression rule (no TPU metric may
   regress more than CST_BENCHWATCH_MAX_REGRESS_PCT percent);
 - the `_MSM_DEVICE_MIN` break-even recommendation from the
@@ -128,6 +131,40 @@ THRESHOLDS = (
      "title": "chaos round: wrong verification results",
      "metric": r"resilience::wrong_results",
      "field": "value", "op": "<", "target": 1.0, "tpu_only": False},
+    # mesh resilience (PR 9): a device_loss against the sharded verify
+    # path must re-bucket onto the survivors within a bounded wall and
+    # lose ZERO statements — CI-testable on the 8-host-device simulated
+    # mesh (`make chaos-mesh-smoke`), so not TPU-gated.
+    {"id": "mesh-recovered",
+     "title": "mesh chaos: every shard loss produced a recovered verdict",
+     "metric": r"mesh::recovered",
+     "field": "value", "op": ">=", "target": 1.0, "tpu_only": False},
+    {"id": "mesh-recovery",
+     "title": "mesh chaos: shard-loss → recovered verdict (s)",
+     "metric": r"mesh::recovery_latency_s",
+     "field": "value", "op": "<", "target": 60.0, "tpu_only": False},
+    # two rows, not one alternation: the threshold engine evaluates
+    # ONE latest record per row, and the two metrics are emitted by the
+    # same round with the same timestamp — an alternation would gate
+    # whichever record happened to sort first and silently ignore the
+    # other
+    {"id": "mesh-lost-statements",
+     "title": "mesh chaos: statements dropped by a shard loss",
+     "metric": r"mesh::lost_statements",
+     "field": "value", "op": "<", "target": 1.0, "tpu_only": False},
+    {"id": "mesh-wrong-results",
+     "title": "mesh chaos: statements answered wrong while degraded",
+     "metric": r"mesh::wrong_results",
+     "field": "value", "op": "<", "target": 1.0, "tpu_only": False},
+    # checkpoint restore (PR 9): snapshot + journal replay must beat
+    # the full O(N) re-merkleize >= 5x at <= 1% journal depth (the
+    # speedup rides the restore record's vs_baseline).  Shape-, not
+    # platform-, bound — evaluated on the CPU chaos smoke.
+    {"id": "checkpoint-restore",
+     "title": "checkpoint restore+replay vs full rebuild",
+     "metric": r"checkpoint::restore",
+     "field": "vs_baseline", "op": ">=", "target": 5.0,
+     "tpu_only": False},
 )
 
 FLAGSHIP = "mainnet_epoch_sweep_1m_validators_wall"
@@ -631,12 +668,16 @@ def render_resilience(records) -> list[str]:
     row per metric) plus the latest round's breaker/heal summary from
     the compact block riding the recovery-latency record."""
     lines = ["## Resilience (chaos rounds)\n"]
-    recs = [r for r in records if r.get("source") == "resilience"]
+    recs = [r for r in records
+            if r.get("source") in ("resilience", "mesh", "checkpoint")]
     if not recs:
         lines.append("No resilience records — run a chaos round "
                      "(`CST_SERVE_CHAOS=1 make serve` / "
-                     "`make chaos-smoke`) to exercise fault injection, "
-                     "breaker/fallback degraded mode, and recovery.\n")
+                     "`make chaos-smoke`, mesh arc: "
+                     "`make chaos-mesh-smoke`) to exercise fault "
+                     "injection, breaker/fallback degraded mode, "
+                     "shard-loss recovery, checkpoint restore, and "
+                     "recovery-to-steady.\n")
         return lines
     lines.append("| metric | latest | where |")
     lines.append("|---|---|---|")
@@ -665,6 +706,31 @@ def render_resilience(records) -> list[str]:
             f"{compact.get('breaker_trips', 0)}, final states: "
             f"{compact.get('breaker_states') or {}}; "
             f"{'recovered' if recovered else 'DID NOT RECOVER'}.\n")
+    mrec = latest_by_metric.get("mesh::recovery_latency_s")
+    mesh = mrec.get("mesh") if mrec else None
+    if isinstance(mesh, dict):
+        lines.append(
+            f"Latest mesh segment: {mesh.get('devices', '?')} devices, "
+            f"{mesh.get('device_lost_events', 0)} lost "
+            f"(max {mesh.get('max_degraded_lanes', 0)} degraded "
+            f"lane(s)), {mesh.get('redispatches', 0)} re-bucketed "
+            f"re-dispatch(es), {mesh.get('readmissions', 0)} "
+            f"re-admission(s); {mesh.get('lost_statements', 0)} lost / "
+            f"{mesh.get('wrong_results', 0)} wrong of "
+            f"{mesh.get('checked_statements', '?')} checked "
+            f"statements.\n")
+    crec = latest_by_metric.get("checkpoint::restore")
+    cp = crec.get("checkpoint") if crec else None
+    if isinstance(cp, dict):
+        sp = crec.get("vs_baseline")
+        lines.append(
+            f"Latest checkpoint restore: {cp.get('n_chunks', '?')} "
+            f"chunks, {cp.get('journal_entries', 0)} journal "
+            f"entr(ies) at {cp.get('journal_frac', '?')} depth, "
+            f"restore {_fmt(crec.get('value'), 4)} s vs rebuild "
+            f"{_fmt(cp.get('rebuild_s'), 4)} s "
+            f"({_fmt(sp, 1)}x), parity "
+            f"{'OK' if cp.get('parity') else 'FAILED'}.\n")
     return lines
 
 
