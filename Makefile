@@ -9,7 +9,7 @@ VECTOR_OUT ?= out/vectors
 
 .PHONY: test test-fast test-all test-bls lint vectors kzg_setups bench \
 	bench-smoke bench-report serve serve-smoke chaos-smoke \
-	chaos-mesh-smoke multichip help
+	chaos-mesh-smoke shard-smoke multichip help
 
 help:
 	@echo "targets: test (fast suite) | test-all (incl. slow crypto) |"
@@ -27,7 +27,9 @@ help:
 	@echo "  degraded mode, checkpoint kill/restore, flagship breaker,"
 	@echo "  recovery-to-steady, resilience records) | chaos-mesh-smoke"
 	@echo "  (same + shard-loss recovery on a simulated 8-device mesh) |"
-	@echo "  multichip (8-dev CPU dryrun)"
+	@echo "  shard-smoke (tiny mesh-sharded flagship scaling rung on the"
+	@echo "  simulated 8-device mesh, asserts the scaling::* record"
+	@echo "  round-trip + report) | multichip (8-dev CPU dryrun)"
 
 test:
 	$(PYTHON) -m pytest tests/ -q -m "not slow"
@@ -110,6 +112,15 @@ chaos-smoke:
 # round-trip + the mesh-recovery / mesh-lost-statements threshold rows
 chaos-mesh-smoke:
 	$(CPU_ENV) $(PYTHON) bench_smoke.py --chaos-mesh
+
+# no TPU required: a tiny mesh-sharded flagship scaling rung on the
+# simulated 8-device mesh (the partition-registry epoch pipeline),
+# asserting the "scaling" block schema, the scaling::* history-record
+# round-trip, and the report's Scaling section.  The TPU-gated
+# scaling-efficiency / flagship-8m threshold rows read 'no data' here —
+# the smoke pins the plumbing, the chip pins the number
+shard-smoke:
+	$(CPU_ENV) $(PYTHON) bench_smoke.py --shard
 
 multichip:
 	$(CPU_ENV) $(PYTHON) -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('ok')"

@@ -476,6 +476,153 @@ def worker_merkle() -> None:
     print(json.dumps(out), flush=True)
 
 
+def worker_scaling() -> None:
+    """Mesh-sharded flagship rungs (the ROADMAP scale-out item): the
+    partition-registry epoch step (`parallel.partition`: sweep with
+    psum totals + sharded balances/registry merkle roots, shard_map
+    specs from the rule table) measured at 2M/8M/16M validators, each
+    rung gated on the device count keeping the per-chip shard at or
+    under the single-chip flagship's 2**21 validators.
+
+    Per rung the worker measures the sharded step wall over the full
+    mesh AND a single-chip reference at the same per-chip shard size
+    (weak scaling), so the record carries per-chip throughput and the
+    scaling efficiency the `scaling-efficiency` benchwatch row gates
+    (>= 70% retention at the full mesh).  An 8M+ rung that completes
+    flips `ok_8m` — the `flagship-8m` no-OOM gate.
+
+    Knobs: CST_SHARD_RUNGS (comma list of validator counts, default
+    2M,8M,16M), CST_SHARD_DEVICES (cap the mesh width; quantized to a
+    power of two via `mesh_rung`), CST_SHARD_ITERS (steady-state
+    iterations per rung)."""
+    import numpy as np
+
+    from consensus_specs_tpu import telemetry
+
+    jax = _worker_setup_jax()
+    from consensus_specs_tpu.models.builder import build_spec
+    from consensus_specs_tpu.parallel import (
+        EpochParams, EpochScalars, partition)
+
+    from __graft_entry__ import _synthetic_registry
+
+    raw = os.environ.get("CST_SHARD_RUNGS",
+                         f"{1 << 21},{1 << 23},{1 << 24}")
+    rungs = [int(r) for r in raw.split(",") if r.strip()]
+    assert rungs and all(r & (r - 1) == 0 for r in rungs), (
+        f"CST_SHARD_RUNGS wants power-of-two validator counts: {raw}")
+    iters = max(1, int(os.environ.get("CST_SHARD_ITERS", 3)))
+    cap = int(os.environ.get("CST_SHARD_DEVICES", 0)) or None
+
+    dev = jax.devices()[0]
+    pool = partition.available_devices()
+    n_dev = partition.mesh_rung(min(pool, cap) if cap else pool)
+    # per-chip shard cap: the single-chip flagship shape (2**21 on the
+    # real chip; tiny smoke rungs always pass)
+    per_chip_cap = max(1 << 21, rungs[0])
+    params = EpochParams.from_spec(build_spec("phase0", "mainnet"))
+    sc = EpochScalars(current_epoch=np.uint64(100_000),
+                      finality_delay=np.uint64(2),
+                      slashings_sum=np.uint64(32_000_000_000))
+    sc = jax.device_put(sc)
+
+    def measure(step, reg_s, length, pk_s, cred_s):
+        t0 = time.perf_counter()
+        out = jax.block_until_ready(step(reg_s, sc, length, pk_s, cred_s))
+        compile_dt = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = jax.block_until_ready(
+                step(reg_s, sc, length, pk_s, cred_s))
+        return (time.perf_counter() - t0) / iters, compile_dt, out
+
+    def build_inputs(n, mesh):
+        rng = np.random.RandomState(7)
+        reg = _synthetic_registry(n)
+        pk = rng.randint(0, 2**32, (n, 8),
+                         dtype=np.uint64).astype(np.uint32)
+        cred = rng.randint(0, 2**32, (n, 8),
+                           dtype=np.uint64).astype(np.uint32)
+        rules = partition.epoch_state_rules()
+        reg_s = partition.shard_tree(mesh, reg, rules)
+        leaves = partition.shard_tree(
+            mesh, {"pubkey_root": pk, "credentials": cred}, rules)
+        return reg_s, leaves["pubkey_root"], leaves["credentials"]
+
+    block = {"n_devices": n_dev, "rungs": [], "ok_8m": None}
+    # single-chip reference per distinct per-chip shard size (weak
+    # scaling baseline: same step machinery on a 1-device mesh)
+    single_cache: dict[int, float] = {}
+    mesh1 = partition.build_mesh(n_devices=1, require_pow2=True)
+    step1 = partition.sharded_epoch_step(mesh1, params)
+    mesh = partition.build_mesh(n_devices=n_dev, require_pow2=True)
+    step = partition.sharded_epoch_step(mesh, params)
+    # the worker must hand back whatever it measured instead of eating
+    # the whole extras budget: stop ADDING rungs once ~60% of the
+    # per-attempt timeout is gone (a timed-out subprocess would lose
+    # every completed rung AND starve the later extras workers)
+    worker_t0 = time.perf_counter()
+    rung_deadline = 0.6 * ATTEMPT_TIMEOUT
+    for n in rungs:
+        needed = max(1, n // per_chip_cap)
+        if n_dev < needed:
+            log(f"rung {n}: skipped (needs >= {needed} devices, "
+                f"have {n_dev})")
+            continue
+        if block["rungs"] and \
+                time.perf_counter() - worker_t0 > rung_deadline:
+            log(f"rung {n}: skipped (scaling budget "
+                f"{rung_deadline:.0f}s spent)")
+            break
+        try:
+            n_local = n // n_dev
+            if n_local not in single_cache:
+                r1, p1, c1 = build_inputs(n_local, mesh1)
+                dt1, cdt1, _ = measure(step1, r1, np.uint64(n_local),
+                                       p1, c1)
+                single_cache[n_local] = dt1
+                log(f"single-chip reference @ {n_local}: {dt1 * 1e3:.1f} "
+                    f"ms/step (compile+first {cdt1:.1f}s)")
+            dt1 = single_cache[n_local]
+            reg_s, pk_s, cred_s = build_inputs(n, mesh)
+            dt, cdt, out = measure(step, reg_s, np.uint64(n),
+                                   pk_s, cred_s)
+            per_chip = n / dt / n_dev
+            single_vps = n_local / dt1
+            eff = per_chip / single_vps if single_vps > 0 else 0.0
+            log(f"rung {n} @ {n_dev} devices: {dt * 1e3:.1f} ms/step "
+                f"(compile+first {cdt:.1f}s), {per_chip:.0f} "
+                f"validators/s/chip, efficiency {eff * 100:.0f}% "
+                f"(root {np.asarray(out[2])[:2]})")
+            rung = {"n_validators": n, "n_devices": n_dev,
+                    "wall_s": round(dt, 5),
+                    "per_chip_vps": round(per_chip, 1),
+                    "total_vps": round(n / dt, 1),
+                    "single_chip_wall_s": round(dt1, 5),
+                    "single_chip_vps": round(single_vps, 1),
+                    "efficiency": round(eff, 4)}
+            block["rungs"].append(rung)
+            if n >= (1 << 23):
+                block["ok_8m"] = True
+        except Exception as e:               # OOM / compile failure
+            log(f"rung {n} FAILED: {type(e).__name__}: {e}")
+            if n >= (1 << 23) and block["ok_8m"] is None:
+                block["ok_8m"] = False
+            break
+    assert block["rungs"], "no scaling rung completed"
+    telemetry.costmodel.sample_watermark("bench.scaling")
+    top = block["rungs"][-1]
+    _stop_profile_trace()
+    out = {"flagship_scaling": {
+        "value": top["per_chip_vps"], "unit": "validators/s/chip",
+        "vs_baseline": top["efficiency"], "scaling": block}}
+    if telemetry.enabled():
+        out["flagship_scaling"] = telemetry.embed_bench_block(
+            out["flagship_scaling"])
+    out["platform"] = dev.platform
+    print(json.dumps(out), flush=True)
+
+
 def worker_bls() -> None:
     """Configs #2/#3: attestation RLC batch + sync-aggregate pairing.
     With CST_TELEMETRY=1 each metric carries per-config compile/run,
@@ -755,13 +902,14 @@ def main():
     print(json.dumps(out), flush=True)
     benchwatch.append_emission(out, ts=time.time())
 
-    # extras — the incremental-merkleization dirty-fraction sweep
-    # (merkle), then BASELINE configs #2/#3 (bls), #5 (kzg blob batch),
-    # #1 (minimal full transition): each runs only while comfortably
-    # inside the budget and only when the flagship ran on the real chip;
-    # each success re-prints a superset JSON line (drivers parsing the
+    # extras — the mesh-sharded flagship scaling rungs (scaling), the
+    # incremental-merkleization dirty-fraction sweep (merkle), then
+    # BASELINE configs #2/#3 (bls), #5 (kzg blob batch), #1 (minimal
+    # full transition): each runs only while comfortably inside the
+    # budget and only when the flagship ran on the real chip; each
+    # success re-prints a superset JSON line (drivers parsing the
     # first or the last line both see the flagship metric)
-    for mode in ("merkle", "bls", "kzg", "spec"):
+    for mode in ("scaling", "merkle", "bls", "kzg", "spec"):
         elapsed = time.time() - start
         if (result is None or platform is not None
                 or elapsed >= EXTRAS_DEADLINE):
@@ -785,6 +933,8 @@ if __name__ == "__main__":
     if len(sys.argv) >= 3 and sys.argv[1] == "--worker":
         if sys.argv[2] == "epoch":
             worker_epoch(N_VALIDATORS)
+        elif sys.argv[2] == "scaling":
+            worker_scaling()
         elif sys.argv[2] == "merkle":
             worker_merkle()
         elif sys.argv[2] == "bls":

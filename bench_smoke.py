@@ -628,10 +628,119 @@ def chaos_main(mesh: bool = False):
     print("chaos smoke: PASS")
 
 
+def shard_main():
+    """The shard-smoke lane (`make shard-smoke` / CI): a tiny
+    mesh-sharded flagship scaling round on the simulated 8-host-device
+    mesh, asserting the `"scaling"` block schema, the `scaling::*`
+    history-record round-trip, and the benchwatch report's Scaling
+    section + threshold rows ('no data' on CPU — the
+    scaling-efficiency / flagship-8m gates are TPU acceptance
+    criteria, so the smoke pins the plumbing, not the number)."""
+    from consensus_specs_tpu.telemetry import validate_scaling_block
+
+    hist_env = os.environ.get("CST_BENCHWATCH_HISTORY")
+    hist_file = Path(hist_env) if hist_env \
+        else HERE / "out" / "smoke_shard_history.jsonl"
+    hist_file.parent.mkdir(exist_ok=True)
+    if not hist_env and hist_file.exists():
+        hist_file.unlink()
+    shard_t0 = time.time()
+    out = _run(["bench.py", "--worker", "scaling"],
+               {"CST_SHARD_RUNGS": "4096,8192", "CST_SHARD_ITERS": "2",
+                "CST_NO_COMPILE_CACHE": "1", "CST_TELEMETRY": "1",
+                "XLA_FLAGS": os.environ.get("XLA_FLAGS")
+                or "--xla_force_host_platform_device_count=8"},
+               timeout=900)
+    last = out[-1]
+    fs = last.get("flagship_scaling")
+    assert isinstance(fs, dict) and fs.get("value", 0) > 0, last
+    assert fs["unit"] == "validators/s/chip", fs
+    block = fs.get("scaling")
+    problems = validate_scaling_block(block)
+    assert not problems, (problems, json.dumps(block)[:500])
+    assert block["n_devices"] == 8, block
+    assert len(block["rungs"]) == 2, block
+    for rung in block["rungs"]:
+        assert rung["n_devices"] == 8 and rung["wall_s"] > 0, rung
+        assert 0 < rung["efficiency"], rung
+    # no 8M rung attempted at smoke shapes: the flagship-8m gate must
+    # read 'no data', not a stale PASS/FAIL
+    assert block["ok_8m"] is None, block
+    _check_telemetry(fs, "scaling worker")
+    print("scaling worker JSON OK:", json.dumps(
+        {k: v for k, v in fs.items() if k != "telemetry"}))
+
+    # the scaling record kind round-trips through the store: per-rung
+    # flagship + efficiency records and the efficiency summary, all
+    # schema-valid, cpu-stamped, mined from the ONE metric line (the
+    # parent appends, like the driver does for extras workers)
+    prev_hist = os.environ.get("CST_BENCHWATCH_HISTORY")
+    os.environ["CST_BENCHWATCH_HISTORY"] = str(hist_file)
+    try:
+        benchwatch.append_emission(
+            dict(fs, metric="flagship_scaling",
+                 platform=last.get("platform", "cpu")),
+            ts=time.time())
+    finally:
+        if prev_hist is None:
+            os.environ.pop("CST_BENCHWATCH_HISTORY", None)
+        else:
+            os.environ["CST_BENCHWATCH_HISTORY"] = prev_hist
+    hist_records, skipped, warns = benchwatch.load_history(hist_file)
+    fresh = {r["metric"]: r for r in hist_records
+             if isinstance(r.get("ts"), (int, float))
+             and r["ts"] >= shard_t0 - 5}
+    for name in ("flagship_scaling", "scaling::flagship@4096",
+                 "scaling::flagship@8192", "scaling::efficiency@4096",
+                 "scaling::efficiency@8192", "scaling::efficiency"):
+        rec = fresh.get(name)
+        assert rec is not None, (name, sorted(fresh))
+        assert not benchwatch.validate_record(rec), rec
+        assert rec["platform"] == "cpu", rec
+        if name.startswith("scaling::"):
+            assert rec["source"] == "scaling", rec
+    srec = fresh["scaling::flagship@8192"]
+    assert srec["scaling"]["n_devices"] == 8, srec
+    assert srec["value"] > 0, srec
+    # the summary efficiency record carries the LARGEST rung's block
+    erec = fresh["scaling::efficiency"]
+    assert erec["scaling"]["n_validators"] == 8192, erec
+    assert "scaling::flagship_8m_ok" not in fresh, sorted(fresh)
+    print(f"scaling history OK: {len(fresh)} records this run -> "
+          f"{hist_file}")
+
+    # the report renders the Scaling section (per-n_devices trend
+    # table) and the TPU-gated threshold rows read 'no data' on CPU
+    from consensus_specs_tpu.telemetry import report as bw_report
+
+    report_md = HERE / "out" / "smoke_shard_report.md"
+    rc = bw_report.main(["--repo", str(HERE), "--history",
+                         str(hist_file), "--out", str(report_md),
+                         "--no-update"])
+    assert rc == 0, f"benchwatch report exited {rc}"
+    text = report_md.read_text()
+    assert "## Scaling (mesh-sharded flagship)" in text, text[:2000]
+    assert "| 8192 | 8 |" in text, text
+    assert "Latest full-mesh efficiency:" in text
+    result = bw_report.build_report(
+        repo=HERE, history_path=hist_file, snapshots=[],
+        durations_path=None, top_n=5, strict=False,
+        max_regress_pct=0.0, update_history=False)
+    rows = {t["id"]: t for t in result["thresholds"]}
+    assert rows["scaling-efficiency"]["status"] == "no data", \
+        rows["scaling-efficiency"]
+    assert rows["flagship-8m"]["status"] == "no data", rows["flagship-8m"]
+    print(f"shard report OK: Scaling section rendered, TPU-gated rows "
+          f"read 'no data' on CPU -> {report_md}")
+    print("shard smoke: PASS")
+
+
 if __name__ == "__main__":
     if "--chaos-mesh" in sys.argv:
         chaos_main(mesh=True)
     elif "--chaos" in sys.argv:
         chaos_main()
+    elif "--shard" in sys.argv:
+        shard_main()
     else:
         main()

@@ -40,8 +40,10 @@ PKG_ROOT = Path(__file__).resolve().parent.parent
 # rule-id -> what it catches (the README table mirrors this registry)
 RULE_IDS = {
     "recompile-unbucketed-dim":
-        "raw len()/shape value used as a jit compile key without the "
-        "_bucket shape ladder — every distinct value compiles a new "
+        "raw len()/shape value — or a mesh-shape device-count read "
+        "(jax.device_count(), len(jax.devices())) — used as a jit "
+        "compile key without the _bucket shape ladder / mesh_rung "
+        "mesh-width ladder — every distinct value compiles a new "
         "XLA executable",
     "recompile-traced-branch":
         "Python if/while/assert on a traced value inside a jitted "
@@ -129,15 +131,25 @@ KERNEL_FILES = LIMB_FILES + (
 # joined with the incremental-merkleization kernels (merkle_incr@…);
 # resilience/mesh.py + checkpoint.py joined with the recovery surfaces
 # (their public entries must stay span-covered like every other path
-# that can reach a device dispatch)
+# that can reach a device dispatch); parallel/partition.py joined with
+# the partition-rule registry (the sharded epoch step's dispatch
+# surface must stay observable like the kernels it wires up)
 INSTR_FILES = ("ops/bls_batch/__init__.py", "ops/bls/__init__.py",
                "ops/sha256_jax.py", "ops/fr_batch.py",
-               "parallel/incremental.py", "resilience/mesh.py",
-               "resilience/checkpoint.py")
+               "parallel/incremental.py", "parallel/partition.py",
+               "resilience/mesh.py", "resilience/checkpoint.py")
 
 # shape-laundering functions: a value that went through one of these is
-# a bucketed compile key, not a raw dimension
-BUCKET_FUNCS = frozenset({"_bucket"})
+# a bucketed compile key, not a raw dimension.  `mesh_rung` is the
+# mesh-width form (parallel.partition): device-count reads are
+# mesh-shape compile keys, quantized to the power-of-two ladder
+BUCKET_FUNCS = frozenset({"_bucket", "mesh_rung"})
+
+# device-pool probes whose results are mesh-shape compile keys: a jit
+# factory keyed by a raw device count recompiles per topology without
+# the mesh_rung ladder (len(jax.devices()) is caught by the generic
+# len() taint)
+DEVICE_COUNT_FUNCS = frozenset({"device_count", "local_device_count"})
 
 # annotations that mark a parameter as a static (compile-time) value
 _STATIC_TYPE_NAMES = frozenset({"int", "bool", "str", "bytes", "float"})
@@ -541,6 +553,10 @@ class ModuleModel:
                 if (isinstance(node, ast.Call)
                         and _dotted(node.func) == "len"):
                     return True
+                if (isinstance(node, ast.Call)
+                        and (_dotted(node.func) or "").split(".")[-1]
+                        in DEVICE_COUNT_FUNCS):
+                    return True         # mesh-shape compile key
                 if (isinstance(node, ast.Attribute)
                         and node.attr == "shape"):
                     return True

@@ -8,9 +8,12 @@ any batch dimension that reaches a kernel must be quantized through it.
 recompile-unbucketed-dim
     A call into a jit factory (all of whose arguments are compile keys)
     or into a jit-decorated function's *static* parameters, where the
-    argument is a raw dimension: a `len(...)`/`.shape` expression, or a
-    local name data-flow-derived from one, that was never routed
-    through a `BUCKET_FUNCS` call.
+    argument is a raw dimension: a `len(...)`/`.shape` expression, a
+    mesh-shape device-count read (`jax.device_count()`,
+    `jax.local_device_count()` — `len(jax.devices())` rides the
+    generic len() taint), or a local name data-flow-derived from one,
+    that was never routed through a `BUCKET_FUNCS` call (`_bucket` for
+    batch shapes, `mesh_rung` for mesh widths).
 
 recompile-traced-branch
     Python `if`/`while`/`assert`/conditional-expression tests that
@@ -28,6 +31,7 @@ import ast
 
 from .core import (
     BUCKET_FUNCS,
+    DEVICE_COUNT_FUNCS,
     Finding,
     ModuleModel,
     ROLE_KERNEL,
@@ -60,6 +64,10 @@ def _check_unbucketed(model: ModuleModel, fn) -> list[Finding]:
             if (isinstance(node, ast.Call)
                     and _dotted(node.func) == "len"):
                 found = True
+            elif (isinstance(node, ast.Call)
+                    and (_dotted(node.func) or "").split(".")[-1]
+                    in DEVICE_COUNT_FUNCS):
+                found = True            # mesh-shape compile key
             elif isinstance(node, ast.Attribute) and node.attr == "shape":
                 found = True
             elif (isinstance(node, ast.Name)

@@ -317,11 +317,12 @@ def lint_env_knobs(repo=None) -> list[str]:
     "Benchwatch" section, serving knobs (`CST_SERVE_*`) in the
     "Serving" section, incremental-merkleization knobs
     (`CST_MERKLE_*`) in the "Incremental merkleization" section,
-    fault-plan knobs (`CST_FAULTS*`) in the "Resilience" section, and
+    fault-plan knobs (`CST_FAULTS*`) in the "Resilience" section,
     checkpoint knobs (`CST_CHECKPOINT_*`) in the "Mesh resilience &
-    checkpointing" section — a subsystem's configuration surface must
-    be documented where the subsystem is explained, not only in the
-    flat table.  `repo` overrides the tree root (tests)."""
+    checkpointing" section, and mesh-sharding knobs (`CST_SHARD_*`) in
+    the "Mesh sharding" section — a subsystem's configuration surface
+    must be documented where the subsystem is explained, not only in
+    the flat table.  `repo` overrides the tree root (tests)."""
     repo = Path(repo) if repo is not None else PKG_ROOT.parent
     readme = repo / "README.md"
     readme_text = readme.read_text()
@@ -342,7 +343,9 @@ def lint_env_knobs(repo=None) -> list[str]:
                           ("CST_CHECKPOINT_",
                            "Mesh resilience & checkpointing",
                            section(re.escape(
-                               "Mesh resilience & checkpointing"))))
+                               "Mesh resilience & checkpointing"))),
+                          ("CST_SHARD_", "Mesh sharding",
+                           section("Mesh sharding")))
 
     used: dict[str, str] = {}
     for path in sorted(repo.rglob("*.py")):

@@ -463,6 +463,122 @@ def g1_multi_exp_device(points, scalars):
     return g1_multi_exp_device_async(points, scalars).result()
 
 
+@functools.lru_cache(maxsize=16)
+def _msm_sharded_kernel(n_devices: int, per_shard: int, c: int,
+                        axis: str, device_ids: tuple | None = None):
+    """shard_map'd Pippenger MSM over a `Mesh` (built by the shared
+    partition-registry builder): each device runs the bucket
+    accumulation + window combine over its own point shard, the D
+    partial points ride one `all_gather` across the mesh (the
+    psum-style final fold — point addition has no hardware psum, so the
+    log-depth `pt_sum` tree over the gathered partials is the exact
+    group-sum equivalent), replicated output.  Zero digits (padding
+    lanes) land in bucket 0 which the reduction skips, so no mask
+    crosses the mesh.
+
+    `device_ids` pins the mesh to the surviving-device subset
+    (`resilience.mesh` form), same contract as `_rlc_kernel_sharded`."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from ...parallel.partition import build_mesh
+    jnp = _jnp()
+
+    mesh = build_mesh(n_devices=n_devices, device_ids=device_ids,
+                      axis=axis)
+
+    def local(x, y, digits):
+        one1 = jnp.broadcast_to(jnp.asarray(_fq.ONE_MONT),
+                                x.shape).astype(jnp.int32)
+        partial = cj.pt_msm_pippenger(cj.F1, (x, y, one1), digits, c)
+        gathered = jax.tree_util.tree_map(
+            lambda co: jax.lax.all_gather(co, axis), partial)
+        return cj.pt_sum(cj.F1, gathered, n_devices)
+
+    from ...utils.jaxtools import shard_map_compat
+    sharded = shard_map_compat(
+        local, mesh=mesh, in_specs=(P(axis), P(axis), P(axis)),
+        out_specs=P())
+    return jax.jit(sharded)
+
+
+def g1_multi_exp_sharded_async(points, scalars,
+                               n_devices: int | None = None,
+                               axis: str = "data",
+                               device_ids=None,
+                               block: bool = True) -> DeviceFuture:
+    """`g1_multi_exp_device_async` distributed over the device mesh:
+    points shard across `n_devices`, each device accumulates its own
+    Pippenger buckets, and one all_gather + log-depth point-sum fold
+    combines the partial results.  The settled oracle point is
+    identical to the single-chip path (group addition is associative —
+    only the summation schedule differs).
+
+    `device_ids` pins the mesh to specific `jax.devices()` indices (the
+    resilience layer's surviving-device set); when given it overrides
+    `n_devices`.  A one-device request degrades to the single-chip
+    path."""
+    import jax
+    import jax.numpy as jnp
+
+    assert len(points) == len(scalars) and len(points) > 0
+    available = len(jax.devices())
+    if device_ids is not None:
+        device_ids = tuple(int(i) for i in device_ids)
+        assert device_ids and max(device_ids) < available, device_ids
+        n_devices = len(device_ids)
+    if n_devices is None:
+        n_devices = available
+    n_devices = min(n_devices, available)
+    if n_devices <= 1 and device_ids is None:
+        return g1_multi_exp_device_async(points, scalars, block=block)
+
+    live = []
+    for p, s in zip(points, scalars):
+        s = int(s) % _pycurve.R
+        if s == 0 or _pycurve.g1.is_inf(p):
+            continue
+        live.append((p, s))
+    if not live:
+        return DeviceFuture.settled(_pycurve.g1.infinity())
+
+    per_shard = _bucket((len(live) + n_devices - 1) // n_devices)
+    lanes = n_devices * per_shard
+    c = _msm_window(per_shard)
+    with telemetry.span("bls.g1_multi_exp_sharded", live=len(live),
+                        devices=n_devices, per_shard=per_shard):
+        telemetry.count("msm.sharded.calls")
+        _count_lanes(len(live), lanes)
+        x, y = cj.g1_affine_to_limbs([p for p, _ in live])
+        digits = cj.scalars_to_digits([s for _, s in live],
+                                      SCALAR_BITS, c)
+        pad = lanes - len(live)
+        if pad:
+            # padded lanes repeat point 0 with ZERO digits: bucket 0 is
+            # never read, so they contribute nothing — no mask needed
+            x = np.concatenate([x, np.repeat(x[:1], pad, 0)])
+            y = np.concatenate([y, np.repeat(y[:1], pad, 0)])
+            digits = np.concatenate(
+                [digits, np.zeros((pad,) + digits.shape[1:], np.int32)])
+        # cst: allow(recompile-unbucketed-dim): the device count keys
+        # the executable — one value per host topology, not per batch
+        kernel = _msm_sharded_kernel(n_devices, per_shard, c, axis,
+                                     device_ids)
+        out = _dispatch(f"msm_sharded@{n_devices}x{per_shard}w{c}",
+                        kernel,
+                        (jnp.asarray(x), jnp.asarray(y),
+                         jnp.asarray(digits)), block=block)
+    return value_future(out, convert=cj.g1_limbs_to_oracle)
+
+
+def g1_multi_exp_sharded(points, scalars, n_devices: int | None = None,
+                         axis: str = "data", device_ids=None):
+    """Synchronous facade over `g1_multi_exp_sharded_async`."""
+    return g1_multi_exp_sharded_async(
+        points, scalars, n_devices=n_devices, axis=axis,
+        device_ids=device_ids).result()
+
+
 def _prepare_rlc_inputs(tasks, rand, lanes: int, device_h2c: bool = False):
     """Host-side prep shared by the single-device and sharded RLC paths:
     drop trivial pairs, hash messages (host) or pack them as uint32 words
@@ -587,15 +703,19 @@ def _rlc_kernel_sharded(n_devices: int, per_shard: int, axis: str,
     from exactly those devices instead of the first `n_devices` — the
     mesh-resilience layer's shrunken-mesh form (`resilience.mesh`): a
     lost shard's statements re-bucket across the SURVIVING devices, not
-    a renumbered prefix that might include the dead one."""
+    a renumbered prefix that might include the dead one.  The mesh
+    itself comes from the shared partition-registry builder
+    (`parallel.partition.build_mesh`) — one mesh-construction path for
+    the RLC batch, the sharded MSM, the epoch step, and the sharded
+    forests."""
     import jax
-    from jax.sharding import Mesh, PartitionSpec as P
+    from jax.sharding import PartitionSpec as P
+
+    from ...parallel.partition import build_mesh
     jnp = _jnp()
 
-    all_devs = jax.devices()
-    mesh_devs = (all_devs[:n_devices] if device_ids is None
-                 else [all_devs[i] for i in device_ids])
-    mesh = Mesh(np.array(mesh_devs), (axis,))
+    mesh = build_mesh(n_devices=n_devices, device_ids=device_ids,
+                      axis=axis)
     neg_g1 = cj.g1_affine_to_limbs([_pycurve.g1.neg(_pycurve.G1_GEN)])
 
     def local(pk_x, pk_y, sig_x, sig_y, h_x, h_y, r_bits, mask):

@@ -1,18 +1,21 @@
 """jax.sharding mesh layouts + the sharded epoch step.
 
 The scale axes of this domain (SURVEY.md §5.7) are validator count and
-attestation count; both shard on one `data` mesh axis.  `sharded_epoch_step`
-is the "full training step" of this framework: the per-validator epoch sweep
-(rewards, slashings, effective balances) fused with the balances- and
-registry-list merkleization, `shard_map`ped over the mesh with psum /
-all_gather collectives over ICI.
+attestation count; both shard on one `data` mesh axis.  Which array
+rides that axis is decided ONCE, by the partition-rule registry
+(`parallel.partition`: regex path -> PartitionSpec over the epoch state
+pytree, the `match_partition_rules` pattern).  `sharded_epoch_step` is
+the "full training step" of this framework: the per-validator epoch
+sweep (rewards, slashings, effective balances) fused with the balances-
+and registry-list merkleization, `shard_map`ped over the mesh with
+psum / all_gather collectives over ICI — its in_specs come from the
+rule table, and `partition.partitioned_epoch_step` re-buckets the same
+step onto a `device_ids` subset for the mesh-resilience ladder.
 """
 
 from __future__ import annotations
 
 import jax
-import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .bridge import (  # noqa: F401
     pad_pow2,
@@ -23,6 +26,7 @@ from .bridge import (  # noqa: F401
 from .epoch import EpochParams, EpochScalars, RegistryArrays, epoch_sweep  # noqa: F401
 from .incremental import (  # noqa: F401
     MerkleForest,
+    ShardedMerkleForest,
     SSZProof,
     balances_forest,
     dirty_balance_leaves,
@@ -33,6 +37,7 @@ from .incremental import (  # noqa: F401
     merkleize_dirty_async,
     pad_dirty_idx,
     registry_forest,
+    sharded_balances_forest,
     verify_proof,
 )
 from .merkle import (  # noqa: F401
@@ -42,6 +47,22 @@ from .merkle import (  # noqa: F401
     u64_leaf_words,
     validator_records_root,
     validator_registry_root,
+)
+from .partition import (  # noqa: F401
+    DATA_AXIS,
+    EPOCH_STATE_RULES,
+    available_devices,
+    build_mesh,
+    epoch_state_rules,
+    epoch_step_dispatcher,
+    epoch_step_specs,
+    gather_tree,
+    match_partition_rules,
+    mesh_rung,
+    named_tree_leaves,
+    partitioned_epoch_step,
+    shard_tree,
+    sharded_epoch_step,
 )
 
 
@@ -68,25 +89,28 @@ __all__ = [
     "merkleize_dirty", "merkleize_dirty_async", "emit_proofs",
     "emit_proofs_async", "dirty_balance_leaves",
     "dirty_chunks_from_validators", "pad_dirty_idx", "verify_proof",
+    # partition-rule registry (parallel.partition)
+    "DATA_AXIS", "EPOCH_STATE_RULES", "available_devices", "build_mesh",
+    "epoch_state_rules", "epoch_step_dispatcher", "epoch_step_specs",
+    "gather_tree", "match_partition_rules", "mesh_rung",
+    "named_tree_leaves", "partitioned_epoch_step", "shard_tree",
+    "sharded_epoch_step", "ShardedMerkleForest",
+    "sharded_balances_forest",
 ]
 
 
-def make_mesh(n_devices: int | None = None, axis: str = "data") -> Mesh:
-    import numpy as np
-    devs = jax.devices()
-    if n_devices is not None:
-        devs = devs[:n_devices]
-    n = len(devs)
-    assert n & (n - 1) == 0, (
-        f"mesh must be a power of two for the sharded merkle reduction, "
-        f"got {n} devices (pass n_devices=<largest pow2>)")
-    return Mesh(np.array(devs), (axis,))
+def make_mesh(n_devices: int | None = None, axis: str = DATA_AXIS):
+    """1-axis device mesh (delegates to `partition.build_mesh`, the one
+    mesh builder).  Power-of-two width enforced: the sharded merkle
+    reduction needs it (quantize with `mesh_rung`)."""
+    return build_mesh(n_devices=n_devices, axis=axis, require_pow2=True)
 
 
-def shard_registry(mesh: Mesh, reg: RegistryArrays, axis: str = "data"):
-    """Place each (N,) registry array sharded on the mesh's data axis."""
-    sh = NamedSharding(mesh, P(axis))
-    return RegistryArrays(*(jax.device_put(a, sh) for a in reg))
+def shard_registry(mesh, reg: RegistryArrays, axis: str = DATA_AXIS):
+    """Place each (N,) registry array sharded on the mesh's data axis —
+    the placements come from the partition-rule registry, not per-field
+    code."""
+    return shard_tree(mesh, reg, epoch_state_rules(axis))
 
 
 def make_epoch_step(params: EpochParams):
@@ -108,36 +132,15 @@ def make_epoch_step(params: EpochParams):
     return step
 
 
-def make_sharded_epoch_step(mesh: Mesh, params: EpochParams,
-                            axis: str = "data"):
-    """Mesh-sharded full step: sweep with psum totals + cross-shard
-    proposer-reward scatter + sharded balances/registry merkle roots.
+def make_sharded_epoch_step(mesh, params: EpochParams,
+                            axis: str = DATA_AXIS):
+    """Mesh-sharded full step (facade over
+    `partition.sharded_epoch_step`; the shard_map specs come from the
+    partition-rule registry).
 
-    Inputs are sharded (N,) arrays (N divisible by mesh size, power of two);
-    `pubkey_root`/`credentials` are the (N, 8) static leaf words.  Outputs:
-    (new_bal, new_eff, balances_root, registry_root) with the roots
-    replicated.
+    Inputs are sharded (N,) arrays (N divisible by mesh size, power of
+    two); `pubkey_root`/`credentials` are the (N, 8) static leaf words.
+    Outputs: (new_bal, new_eff, balances_root, registry_root) with the
+    roots replicated.
     """
-    require_x64()
-    from ..utils.jaxtools import shard_map_compat
-
-    def _step(reg: RegistryArrays, sc: EpochScalars, length,
-              pubkey_root, credentials):
-        new_bal, new_eff = epoch_sweep(reg, sc, params, axis_name=axis)
-        bal_root = balances_list_root(new_bal, length, axis_name=axis)
-        rec_roots = validator_records_root(
-            ValidatorLeaves(pubkey_root, credentials), new_eff, reg.slashed,
-            reg.activation_eligibility_epoch, reg.activation_epoch,
-            reg.exit_epoch, reg.withdrawable_epoch)
-        reg_root = validator_registry_root(rec_roots, length, axis_name=axis)
-        return new_bal, new_eff, bal_root, reg_root
-
-    data = P(axis)
-    repl = P()
-    sharded = shard_map_compat(
-        _step, mesh=mesh,
-        in_specs=(RegistryArrays(*([data] * len(RegistryArrays._fields))),
-                  EpochScalars(*([repl] * len(EpochScalars._fields))),
-                  repl, data, data),
-        out_specs=(data, data, repl, repl))
-    return jax.jit(sharded)
+    return sharded_epoch_step(mesh, params, axis=axis)

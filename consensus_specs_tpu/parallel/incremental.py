@@ -486,6 +486,242 @@ def emit_proofs(forest: MerkleForest, indices) -> list[SSZProof]:
     return emit_proofs_async(forest, indices).result()
 
 
+# --- mesh-sharded forests ----------------------------------------------------
+
+
+def _top_tree_levels(shard_roots: np.ndarray) -> list[np.ndarray]:
+    """All levels of the replicated top tree (log S host hashes — the
+    'top join' of the sharded mode), shard-root level first: the proof
+    assembly's sibling source above the per-shard stacks, and
+    `[-1][0]` is the global data root (`_fold_shard_roots`)."""
+    levels = [np.asarray(shard_roots, dtype=np.uint32)]
+    while levels[-1].shape[0] > 1:
+        levels.append(_host_sha256_64B(levels[-1].reshape(-1, 16)))
+    return levels
+
+
+def _fold_shard_roots(shard_roots: np.ndarray) -> np.ndarray:
+    """(S, 8) per-shard data-subtree roots -> (8,) global data root —
+    the ONE top-join fold, shared with the sibling levels
+    `emit_proofs` assembles from."""
+    return _top_tree_levels(shard_roots)[-1][0]
+
+
+class ShardedMerkleForest:
+    """Mesh-sharded `MerkleForest`: per-shard subtree layer stacks, each
+    resident on its OWN device, plus a small replicated top tree.
+
+    The global 2**data_depth-leaf data tree splits at level
+    `local_depth` into `n_shards` contiguous subtrees; shard i's full
+    layer stack (every interior level of its subtree) lives on device
+    i.  `update` and `emit_proofs` stay shard-local — a dirty set only
+    dispatches to the shards it touches, and a proof gather reads one
+    shard's layers — until the top join: the log(n_shards) host hashes
+    that fold the per-shard data roots into the global root (then the
+    zero-subtree ladder and the SSZ length mix-in, exactly like the
+    single-chip forest).
+
+    Root parity contract: bit-exact vs `MerkleForest` over the same
+    leaves (and hence vs the SSZ oracle) — the tree is identical, only
+    the storage is split at `local_depth`
+    (`tests/test_partition.py`)."""
+
+    def __init__(self, leaf_words, limit_depth: int, length: int,
+                 n_shards: int | None = None, device_ids=None):
+        import jax as _jax
+
+        from .partition import build_mesh, mesh_rung
+
+        leaf_words = np.asarray(leaf_words, dtype=np.uint32)
+        n = leaf_words.shape[0]
+        assert n <= (1 << limit_depth)
+        # device placement comes from the shared mesh builder (one
+        # device list for the whole sharded path)
+        mesh = build_mesh(n_devices=n_shards, device_ids=device_ids)
+        devices = list(mesh.devices.flat)
+        if n_shards is None and device_ids is None:
+            devices = devices[:mesh_rung(len(devices))]
+        s = len(devices)
+        assert s >= 1 and s & (s - 1) == 0, (
+            f"sharded forest needs a power-of-two shard count, got {s} "
+            f"(quantize with mesh_rung)")
+        self.shard_depth = (s - 1).bit_length()
+        d = max(max(n - 1, 0).bit_length(), self.shard_depth)
+        self.data_depth = d
+        self.local_depth = d - self.shard_depth
+        self.limit_depth = int(limit_depth)
+        self.length = int(length)
+        self.n_chunks = n
+        self.n_shards = s
+        self.devices = devices
+        padded = np.zeros((1 << d, 8), dtype=np.uint32)
+        padded[:n] = leaf_words
+        local = 1 << self.local_depth
+        self.shard_layers = []
+        with telemetry.span("parallel.merkle_incr.sharded_build",
+                            depth=d, shards=s):
+            for i, dev in enumerate(devices):
+                sl = _jax.device_put(padded[i * local:(i + 1) * local],
+                                     dev)
+                # cst: allow(recompile-unbucketed-dim): the static local
+                # tree depth keys the executable — log-bounded, same
+                # contract as MerkleForest.__init__
+                self.shard_layers.append(
+                    _build_layers(sl, self.local_depth))
+        costmodel.capture(f"merkle_build@d{self.local_depth}",
+                          _build_layers,
+                          (self.shard_layers[0][0], self.local_depth))
+
+    @property
+    def capacity(self) -> int:
+        return 1 << self.data_depth
+
+    @property
+    def shard_capacity(self) -> int:
+        return 1 << self.local_depth
+
+    def update(self, dirty_idx, new_leaf_words) -> None:
+        """Scatter `new_leaf_words` at GLOBAL leaf indices `dirty_idx`
+        and re-hash the touched paths, shard-locally: each touched
+        shard gets one `update_dirty` dispatch on its own device (its
+        local indices padded to the `_bucket` rung), untouched shards
+        dispatch nothing.  The top tree is not materialized here — it
+        re-folds lazily at `root()` from the (replaced) shard roots."""
+        import jax as _jax
+
+        idx = np.asarray(dirty_idx, dtype=np.uint32)
+        if idx.shape[0] == 0:
+            return
+        leaves = np.asarray(new_leaf_words, dtype=np.uint32)
+        assert leaves.shape[0] >= idx.shape[0], (leaves.shape, idx.shape)
+        # rung-padded callers (the MerkleForest.update convention) may
+        # hand leaves LONGER than the index set — the extra rows pair
+        # with sentinel indices and must not desync the boolean mask
+        leaves = leaves[:idx.shape[0]]
+        shard_of = idx >> np.uint32(self.local_depth)
+        with telemetry.span("parallel.merkle_incr.sharded_update",
+                            dirty=int(idx.shape[0]),
+                            shards=self.n_shards):
+            for s in range(self.n_shards):
+                hit = shard_of == s
+                if not hit.any():
+                    continue
+                local_idx = idx[hit] & np.uint32(self.shard_capacity - 1)
+                dev = self.devices[s]
+                padded_idx = pad_dirty_idx(local_idx, self.shard_capacity)
+                rung = padded_idx.shape[0]
+                shard_leaves = np.zeros((rung, 8), dtype=np.uint32)
+                shard_leaves[:local_idx.shape[0]] = leaves[hit]
+                self.shard_layers[s] = update_dirty(
+                    self.shard_layers[s],
+                    _jax.device_put(padded_idx, dev),
+                    _jax.device_put(shard_leaves, dev),
+                    self.local_depth)
+
+    def _shard_roots_dev(self):
+        return tuple(layers[-1][0] for layers in self.shard_layers)
+
+    def root_async(self):
+        """DeviceFuture settling to the (8,) uint32 words of the full
+        List hash_tree_root: the per-shard data roots cross to the host
+        at result(), where the replicated top tree, zero ladder, and
+        length mix-in finish the root (all log-bounded)."""
+        from ..serve.futures import value_future
+
+        d, limit, length = self.data_depth, self.limit_depth, self.length
+
+        def finish(host_roots):
+            data_root = _fold_shard_roots(np.stack(host_roots))
+            return _finish_root(data_root, d, limit, length)
+
+        return value_future(self._shard_roots_dev(), convert=finish)
+
+    def root(self) -> np.ndarray:
+        """Synchronous facade over `root_async`."""
+        return self.root_async().result()
+
+    def root_bytes(self) -> bytes:
+        return _words_to_bytes(self.root())
+
+    def emit_proofs_async(self, indices):
+        """Batch-emit SSZ single-proofs for GLOBAL leaf indices: one
+        shard-local sibling-path gather per touched shard (on that
+        shard's device), then the host settle appends the top-tree
+        siblings (shard-root levels), the zero-subtree ladder, and the
+        length chunk.  Settles to a list of `SSZProof` in input
+        order."""
+        import jax as _jax
+
+        from ..serve.futures import DeviceFuture, value_future
+
+        indices = [int(i) for i in indices]
+        if not indices:
+            return DeviceFuture.settled([])
+        assert max(indices) < self.n_chunks, (
+            "proof index beyond the list's real chunk count")
+        by_shard: dict[int, list[int]] = {}
+        for i in indices:
+            by_shard.setdefault(i >> self.local_depth, []).append(i)
+        gathers = {}
+        with telemetry.span("parallel.merkle_incr.sharded_proofs",
+                            batch=len(indices),
+                            shards=len(by_shard)):
+            for s, idxs in sorted(by_shard.items()):
+                local = [i & (self.shard_capacity - 1) for i in idxs]
+                rung = _bucket(len(local))
+                arr = np.zeros((rung,), dtype=np.uint32)
+                arr[:len(local)] = local
+                gathers[s] = gather_proof_paths(
+                    self.shard_layers[s],
+                    _jax.device_put(arr, self.devices[s]),
+                    self.local_depth)
+        d, limit, length = self.data_depth, self.limit_depth, self.length
+        local_depth, shard_depth = self.local_depth, self.shard_depth
+        shard_order = sorted(by_shard)
+        payload = (tuple(gathers[s] for s in shard_order),
+                   self._shard_roots_dev())
+
+        def finish(host):
+            shard_gathers, shard_roots = host
+            top = _top_tree_levels(np.stack(shard_roots))
+            proofs_by_index = {}
+            for pos, s in enumerate(shard_order):
+                leaves_h, paths_h = shard_gathers[pos]
+                for row, g in enumerate(by_shard[s]):
+                    branch = [_words_to_bytes(paths_h[row, lvl])
+                              for lvl in range(local_depth)]
+                    for lvl in range(shard_depth):
+                        sib = (s >> lvl) ^ 1
+                        branch.append(_words_to_bytes(top[lvl][sib]))
+                    branch.extend(
+                        _words_to_bytes(ZERO_HASH_WORDS[lvl])
+                        for lvl in range(d, limit))
+                    branch.append(_length_chunk(length))
+                    proofs_by_index[g] = SSZProof(
+                        index=g, gindex=(2 << limit) + g,
+                        leaf=_words_to_bytes(leaves_h[row]),
+                        branch=tuple(branch))
+            return [proofs_by_index[i] for i in indices]
+
+        return value_future(payload, convert=finish)
+
+    def emit_proofs(self, indices) -> list[SSZProof]:
+        """Synchronous facade over `emit_proofs_async`."""
+        return self.emit_proofs_async(indices).result()
+
+
+def sharded_balances_forest(balances, length, limit_depth: int = 38,
+                            n_shards: int | None = None,
+                            device_ids=None) -> ShardedMerkleForest:
+    """Sharded forest over `List[uint64, 2**40]` from a host uint64
+    balances array (the flagship's multi-chip balances-tree mode)."""
+    from . import require_x64
+    require_x64()
+    chunks = np.asarray(pack_u64_chunks(jnp.asarray(balances)))
+    return ShardedMerkleForest(chunks, limit_depth, length,
+                               n_shards=n_shards, device_ids=device_ids)
+
+
 # --- flagship glue: registry-scale forests over the sweep arrays -------------
 
 

@@ -137,12 +137,18 @@ class MeshVerifier:
 
     def __init__(self, n_devices: int | None = None,
                  readmit_cooldown_s: float = 1.0, clock=time.monotonic,
-                 dispatch_fn=None, available_fn=None):
+                 dispatch_fn=None, available_fn=None, result_cast=bool):
         self._requested = n_devices
         self._clock = clock
         self._cooldown = float(readmit_cooldown_s)
         self._dispatch_fn = dispatch_fn
         self._available_fn = available_fn
+        # what a settled verdict is coerced to: bool for the RLC verify
+        # path (the default), identity (None) for payload dispatchers
+        # whose result is structured — e.g. the sharded epoch step's
+        # (balances, eff, roots) tuple via `sharded_epoch_verifier`
+        self._result_cast = result_cast if result_cast is not None \
+            else (lambda out: out)
         self._state: MeshState | None = None
         self.redispatches = 0
         self.verified_statements = 0
@@ -155,9 +161,9 @@ class MeshVerifier:
     def _available(self) -> int:
         if self._available_fn is not None:
             return int(self._available_fn())
-        import jax
+        from ..parallel.partition import available_devices
 
-        return len(jax.devices())
+        return available_devices()
 
     @property
     def state(self) -> MeshState:
@@ -255,7 +261,7 @@ class MeshVerifier:
                     self._on_device_failure(attempt, exc)
                     continue
                 self._on_success(attempt, len(tasks))
-                fut.set_result(bool(ok))
+                fut.set_result(self._result_cast(ok))
                 return
 
         return DeviceFuture(waiter=settle)
@@ -263,6 +269,14 @@ class MeshVerifier:
     def verify(self, tasks, rng=None) -> bool:
         """Synchronous facade over `verify_async`."""
         return self.verify_async(tasks, rng=rng).result()
+
+    def dispatch(self, payload):
+        """Payload-shaped facade over the same recovery ladder for
+        non-RLC dispatchers (the sharded epoch step): `payload` is
+        whatever the injected `dispatch_fn` consumes, and the settled
+        value passes through `result_cast` (identity for structured
+        results).  Statement accounting counts payload items."""
+        return self.verify_async(payload, rng=None).result()
 
     def _on_device_failure(self, attempt: dict, exc: BaseException) -> None:
         state = self.state
@@ -328,3 +342,21 @@ class MeshVerifier:
             "verified_statements": self.verified_statements,
             "lost_statements": self.lost_statements,
         }
+
+
+def sharded_epoch_verifier(params, n_devices: int | None = None,
+                           axis: str = "data", **kw) -> MeshVerifier:
+    """`MeshVerifier` over the partition-registry sharded epoch step:
+    the `device_ids`-subset fallback covers the flagship step, not just
+    the RLC batch.  `verify_async`/`dispatch` takes the epoch-step
+    payload `(reg, sc, length, pubkey_root, credentials)` (host/global
+    arrays) and settles to the host `(new_bal, new_eff, balances_root,
+    registry_root)` tuple; a lost device re-shards the SAME state over
+    the surviving `mesh_rung` power-of-two subset
+    (`parallel.partition.epoch_step_dispatcher`)."""
+    from ..parallel.partition import epoch_step_dispatcher
+
+    return MeshVerifier(n_devices=n_devices,
+                        dispatch_fn=epoch_step_dispatcher(params,
+                                                          axis=axis),
+                        result_cast=None, **kw)

@@ -80,6 +80,7 @@ from .export import (
     validate_costmodel_block,
     validate_mesh_block,
     validate_resilience_block,
+    validate_scaling_block,
     validate_serve_block,
     write_chrome_trace,
     write_jsonl,
@@ -92,5 +93,6 @@ __all__ = [
     "embed_bench_block", "validate_bench_block",
     "validate_checkpoint_block", "validate_costmodel_block",
     "validate_mesh_block", "validate_resilience_block",
-    "validate_serve_block", "write_chrome_trace", "write_jsonl",
+    "validate_scaling_block", "validate_serve_block",
+    "write_chrome_trace", "write_jsonl",
 ]

@@ -425,6 +425,42 @@ def validate_mesh_block(mesh) -> list[str]:
     return problems
 
 
+def validate_scaling_block(obj) -> list[str]:
+    """Schema check for the bench `"scaling"` sub-object (the
+    mesh-sharded flagship rung ladder `bench.py --worker scaling`
+    emits); returns problems (empty == valid).  Pinned by
+    `bench_smoke.py --shard`."""
+    if not isinstance(obj, dict):
+        return [f"scaling block is {type(obj).__name__}, not dict"]
+    problems: list[str] = []
+    nd = obj.get("n_devices")
+    if not isinstance(nd, int) or isinstance(nd, bool) or nd < 1:
+        problems.append(f"'n_devices' must be a positive int, got {nd!r}")
+    rungs = obj.get("rungs")
+    if not isinstance(rungs, list) or not rungs:
+        return problems + ["'rungs' must be a non-empty list"]
+    for i, r in enumerate(rungs):
+        if not isinstance(r, dict):
+            problems.append(f"rungs[{i}] is not a dict")
+            continue
+        for key in ("n_validators", "n_devices"):
+            v = r.get(key)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 1:
+                problems.append(f"rungs[{i}][{key!r}] must be a "
+                                f"positive int, got {v!r}")
+        for key in ("wall_s", "per_chip_vps", "single_chip_wall_s",
+                    "single_chip_vps", "efficiency"):
+            v = r.get(key)
+            if not isinstance(v, (int, float)) or isinstance(v, bool) \
+                    or v < 0:
+                problems.append(f"rungs[{i}][{key!r}] must be a "
+                                f"non-negative number, got {v!r}")
+    ok8 = obj.get("ok_8m")
+    if ok8 is not None and not isinstance(ok8, bool):
+        problems.append(f"'ok_8m' must be a bool or null, got {ok8!r}")
+    return problems
+
+
 def embed_bench_block(record: dict) -> dict:
     """The shared per-config bench protocol: attach the current
     `"telemetry"` block to a metric record and reset the per-config

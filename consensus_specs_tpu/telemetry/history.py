@@ -55,6 +55,12 @@ Record schema (version `SCHEMA`; one JSON object per line):
                                  # w/ restore-vs-rebuild speedup as
                                  # vs_baseline, journal depth, snapshot
                                  # bytes)
+     "scaling": dict,            # compacted mesh-sharded flagship rung
+                                 # (source "scaling"; metric
+                                 # "scaling::flagship@<n>" per rung wall
+                                 # + "scaling::efficiency[@<n>]" per-chip
+                                 # throughput retention +
+                                 # "scaling::flagship_8m_ok")
      "ts": float}                # wall-clock stamp (live emissions only)
 
 Robustness contract (pinned by tests/test_benchwatch.py): malformed or
@@ -79,7 +85,7 @@ SCHEMA = 1
 
 SOURCES = ("bench_round", "multichip_round", "baseline", "bench_emit",
            "pytest_snapshot", "costmodel", "serve", "resilience",
-           "mesh", "checkpoint")
+           "mesh", "checkpoint", "scaling")
 
 _ROUND_FILE_RE = re.compile(r"(?:BENCH|MULTICHIP)_r(\d+)\.json$")
 
@@ -353,6 +359,58 @@ def checkpoint_records(metric: str, cp, **context) -> list[dict]:
     return records
 
 
+def scaling_records(metric: str, sc, **context) -> list[dict]:
+    """`scaling`-source history records mined from one metric line's
+    mesh-sharded flagship `"scaling"` sub-object (`bench.py --worker
+    scaling`): per rung a `scaling::flagship@<n_validators>` wall
+    record (carrying the compact rung block — n_devices, per-chip and
+    single-chip throughput) and a `scaling::efficiency@<n>` per-chip
+    retention record; one `scaling::efficiency` summary record (the
+    LARGEST completed rung at the widest mesh — the threshold-gate
+    surface, so a small rung's tie can never outrank it) and a
+    `scaling::flagship_8m_ok` 0/1 record when an 8M-validator rung was
+    attempted.  Malformed blocks yield zero records, never an
+    exception."""
+    if not isinstance(sc, dict) or not isinstance(sc.get("rungs"), list):
+        return []
+    records: list[dict] = []
+    best = None
+    for r in sc["rungs"]:
+        if not isinstance(r, dict):
+            continue
+        n = r.get("n_validators")
+        wall = r.get("wall_s")
+        if not isinstance(n, int) or isinstance(n, bool) \
+                or not isinstance(wall, (int, float)) \
+                or isinstance(wall, bool):
+            continue
+        compact = {k: r[k] for k in (
+            "n_validators", "n_devices", "per_chip_vps", "total_vps",
+            "single_chip_wall_s", "single_chip_vps", "efficiency")
+            if k in r}
+        records.append(make_record(
+            "scaling", f"scaling::flagship@{n}", wall, unit="s",
+            scaling=compact, via_metric=metric, **context))
+        eff = r.get("efficiency")
+        if isinstance(eff, (int, float)) and not isinstance(eff, bool):
+            records.append(make_record(
+                "scaling", f"scaling::efficiency@{n}", eff,
+                unit="ratio", via_metric=metric, **context))
+            key = (n, r.get("n_devices") or 0)
+            if best is None or key > best[0]:
+                best = (key, eff, compact)
+    if best is not None:
+        records.append(make_record(
+            "scaling", "scaling::efficiency", best[1], unit="ratio",
+            scaling=best[2], via_metric=metric, **context))
+    if isinstance(sc.get("ok_8m"), bool):
+        records.append(make_record(
+            "scaling", "scaling::flagship_8m_ok",
+            1.0 if sc["ok_8m"] else 0.0, unit="bool",
+            via_metric=metric, **context))
+    return records
+
+
 def costmodel_records(metric: str, tel, **context) -> list[dict]:
     """Per-kernel `costmodel`-source history records mined from one
     metric line's telemetry sub-object (joined roofline records from
@@ -478,6 +536,9 @@ def parse_bench_round(path) -> tuple[list[dict], list[str]]:
             rc=rc, platform=obj.get("platform")))
         records.extend(resilience_records(
             name, obj.get("resilience"), round=rnd, file=path.name,
+            rc=rc, platform=obj.get("platform")))
+        records.extend(scaling_records(
+            name, obj.get("scaling"), round=rnd, file=path.name,
             rc=rc, platform=obj.get("platform")))
         for crec in costmodel_records(
                 name, obj.get("telemetry"), round=rnd, file=path.name,
@@ -777,6 +838,10 @@ def emission_records(metric_line: dict, ts: float | None = None
                 name, obj.get("resilience"), platform=platform,
                 ts=round(ts, 1) if ts is not None else None):
             records.append(rrec)
+        for srec in scaling_records(
+                name, obj.get("scaling"), platform=platform,
+                ts=round(ts, 1) if ts is not None else None):
+            records.append(srec)
         for crec in costmodel_records(
                 name, obj.get("telemetry"), platform=platform,
                 ts=round(ts, 1) if ts is not None else None):

@@ -16,9 +16,11 @@ one markdown dashboard:
   chaos-round gates: fault-stop → steady-state recovery < 60s and zero
   wrong results, from the `resilience::*` records; the mesh shard-loss
   gates — recovery < 60s, zero lost/wrong statements — from the
-  `mesh::*` records; and checkpoint restore+replay >= 5x over a full
-  rebuild from the `checkpoint::*` records) evaluated against the
-  latest data;
+  `mesh::*` records; checkpoint restore+replay >= 5x over a full
+  rebuild from the `checkpoint::*` records; and the mesh-sharded
+  flagship gates — >= 70% per-chip throughput retention at the full
+  mesh and the 8M-validator rung completing, from the `scaling::*`
+  records) evaluated against the latest data;
 - a generic round-over-round regression rule (no TPU metric may
   regress more than CST_BENCHWATCH_MAX_REGRESS_PCT percent);
 - the `_MSM_DEVICE_MIN` break-even recommendation from the
@@ -156,6 +158,20 @@ THRESHOLDS = (
      "title": "mesh chaos: statements answered wrong while degraded",
      "metric": r"mesh::wrong_results",
      "field": "value", "op": "<", "target": 1.0, "tpu_only": False},
+    # mesh-sharded flagship scaling (the partition-registry epoch
+    # pipeline): per-chip throughput at the full mesh must retain >=
+    # 70% of the single-chip per-chip throughput at the same per-chip
+    # shard size (weak scaling), and the 8M-validator rung must
+    # complete without OOM.  TPU acceptance criteria — the CPU shard
+    # smoke's simulated 8-host-device numbers read "no data" here.
+    {"id": "scaling-efficiency",
+     "title": "per-chip throughput retention at full mesh",
+     "metric": r"scaling::efficiency",
+     "field": "value", "op": ">=", "target": 0.70, "tpu_only": True},
+    {"id": "flagship-8m",
+     "title": "8M-validator flagship rung completes (no OOM)",
+     "metric": r"scaling::flagship_8m_ok",
+     "field": "value", "op": ">=", "target": 1.0, "tpu_only": True},
     # checkpoint restore (PR 9): snapshot + journal replay must beat
     # the full O(N) re-merkleize >= 5x at <= 1% journal depth (the
     # speedup rides the restore record's vs_baseline).  Shape-, not
@@ -734,6 +750,70 @@ def render_resilience(records) -> list[str]:
     return lines
 
 
+def render_scaling(records) -> list[str]:
+    """The mesh-sharded flagship read side: per-rung × per-n_devices
+    trend table from the latest `scaling::flagship@<n>` records (the
+    compact rung block rides each record), plus the latest efficiency
+    summary."""
+    lines = ["## Scaling (mesh-sharded flagship)\n"]
+    recs = [r for r in records if r.get("source") == "scaling"]
+    if not recs:
+        lines.append("No scaling records — run the sharded flagship "
+                     "rungs (`python bench.py --worker scaling` on the "
+                     "mesh, or `make shard-smoke` for the simulated "
+                     "8-host-device contract check) to produce "
+                     "`scaling::*` records.\n")
+        return lines
+    # latest rung record per (n_validators, n_devices) — the
+    # per-n_devices trend: the same rung re-measured on a wider mesh
+    # lands its own row instead of overwriting the narrow one
+    rows: dict[tuple[int, int], dict] = {}
+    for r in sorted((r for r in recs
+                     if r["metric"].startswith("scaling::flagship@")
+                     and isinstance(r.get("scaling"), dict)),
+                    key=_order_key):
+        blk = r["scaling"]
+        n = blk.get("n_validators")
+        d = blk.get("n_devices")
+        if isinstance(n, int) and isinstance(d, int):
+            rows[(n, d)] = r
+    if rows:
+        lines.append("| validators | devices | step wall | "
+                     "per-chip vps | single-chip vps | efficiency | "
+                     "platform | where |")
+        lines.append("|---|---|---|---|---|---|---|---|")
+        for (n, d), r in sorted(rows.items()):
+            blk = r["scaling"]
+            eff = blk.get("efficiency")
+            lines.append(
+                f"| {n} | {d} | {_fmt(r.get('value'), 4)} s "
+                f"| {_si(blk.get('per_chip_vps'))} "
+                f"| {_si(blk.get('single_chip_vps'))} "
+                f"| {'—' if eff is None else f'{eff * 100:.0f}%'} "
+                f"| {_platform_group(r)} | {_where(r)} |")
+        lines.append("")
+    eff_recs = [r for r in recs if r["metric"] == "scaling::efficiency"]
+    if eff_recs:
+        latest = max(eff_recs, key=_order_key)
+        blk = latest.get("scaling") or {}
+        lines.append(
+            f"Latest full-mesh efficiency: "
+            f"{float(latest['value']) * 100:.0f}% per-chip throughput "
+            f"retention at {blk.get('n_validators', '?')} validators "
+            f"over {blk.get('n_devices', '?')} device(s) "
+            f"({_where(latest)}, platform "
+            f"{_platform_group(latest)}).\n")
+    ok8 = [r for r in recs if r["metric"] == "scaling::flagship_8m_ok"]
+    if ok8:
+        latest = max(ok8, key=_order_key)
+        lines.append(
+            ("8M-validator rung: completed.\n"
+             if latest.get("value") else
+             "8M-validator rung: ATTEMPTED AND FAILED (OOM or crash — "
+             "see the round log).\n"))
+    return lines
+
+
 def render_msm(msm: dict) -> list[str]:
     lines = ["## `_MSM_DEVICE_MIN` break-even\n", msm["text"] + "\n"]
     if msm.get("sizes"):
@@ -800,6 +880,7 @@ def render_report(result: dict) -> str:
     lines.extend(render_regressions(result["regressions"],
                                     result["max_regress_pct"]))
     lines.extend(render_resilience(result["records"]))
+    lines.extend(render_scaling(result["records"]))
     lines.extend(render_msm(result["msm"]))
     lines.extend(render_utilization(result["utilization"], result["msm"]))
     lines.extend(render_trend_tables(result["records"]))

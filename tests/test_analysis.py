@@ -117,6 +117,55 @@ def test_inline_bucket_call_is_clean():
     assert rules_at(report) == []
 
 
+def test_device_count_into_jit_factory_fires():
+    # mesh-shape compile keys: a jit factory keyed by a raw device
+    # count recompiles per topology — jax.device_count() and the
+    # local_device_count() spelling both taint, directly and through
+    # a data-flow-derived name
+    report = run("""\
+        import jax
+
+        def _kern(n_devices):
+            def body(x):
+                return x
+            return jax.jit(body)
+
+        def _entry(xs):
+            return _kern(jax.device_count())(xs)
+
+        def _entry2(xs):
+            n = jax.local_device_count()
+            return _kern(n)(xs)
+        """)
+    assert ("recompile-unbucketed-dim", 9) in rules_at(report)
+    assert ("recompile-unbucketed-dim", 13) in rules_at(report)
+
+
+def test_mesh_rung_launders_device_count():
+    # the mesh-width ladder is the sanctioned quantizer, like _bucket
+    # for batch shapes — inline and via rebinding
+    report = run("""\
+        import jax
+
+        def mesh_rung(n):
+            return 1 << (n.bit_length() - 1)
+
+        def _kern(n_devices):
+            def body(x):
+                return x
+            return jax.jit(body)
+
+        def _entry(xs):
+            return _kern(mesh_rung(jax.device_count()))(xs)
+
+        def _entry2(xs):
+            n = len(jax.devices())
+            n = mesh_rung(n)
+            return _kern(n)(xs)
+        """)
+    assert rules_at(report) == []
+
+
 def test_static_arg_of_jitted_fn_fires():
     report = run("""\
         import jax
