@@ -9,7 +9,7 @@ VECTOR_OUT ?= out/vectors
 
 .PHONY: test test-fast test-all test-bls lint vectors kzg_setups bench \
 	bench-smoke bench-report serve serve-smoke chaos-smoke \
-	chaos-mesh-smoke shard-smoke multichip help
+	chaos-mesh-smoke shard-smoke das-smoke multichip help
 
 help:
 	@echo "targets: test (fast suite) | test-all (incl. slow crypto) |"
@@ -29,7 +29,10 @@ help:
 	@echo "  (same + shard-loss recovery on a simulated 8-device mesh) |"
 	@echo "  shard-smoke (tiny mesh-sharded flagship scaling rung on the"
 	@echo "  simulated 8-device mesh, asserts the scaling::* record"
-	@echo "  round-trip + report) | multichip (8-dev CPU dryrun)"
+	@echo "  round-trip + report) | das-smoke (PeerDAS cell-proof sweep"
+	@echo "  at the 128x8 sampling matrix on CPU: das block schema,"
+	@echo "  >=2x speedup vs the pure-Python oracle, das::* round-trip"
+	@echo "  + report) | multichip (8-dev CPU dryrun)"
 
 test:
 	$(PYTHON) -m pytest tests/ -q -m "not slow"
@@ -121,6 +124,17 @@ chaos-mesh-smoke:
 # the smoke pins the plumbing, the chip pins the number
 shard-smoke:
 	$(CPU_ENV) $(PYTHON) bench_smoke.py --shard
+
+# no TPU required: the PeerDAS cell-proof sweep at the full 128x8
+# sampling matrix (1024 cells in ONE RLC pairing equation — the
+# largest device batch in the repo).  Asserts the "das" block schema,
+# the >= 2x das-speedup acceptance vs the pure-Python fulu oracle
+# (oracle measured on a cell subset and scaled — its per-cell Lagrange
+# interpolation makes a full-matrix oracle run hours), the
+# mixed-invalid isolation arc, the coset-barycentric cross-check, and
+# the das::* history/report/threshold wiring (CI gates on this)
+das-smoke:
+	$(CPU_ENV) $(PYTHON) bench_smoke.py --das
 
 multichip:
 	$(CPU_ENV) $(PYTHON) -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('ok')"

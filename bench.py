@@ -623,6 +623,148 @@ def worker_scaling() -> None:
     print(json.dumps(out), flush=True)
 
 
+def worker_das() -> None:
+    """The PeerDAS workload: batched cell-proof verification over a
+    full sampling matrix (CST_DAS_MATRIX, default 128x2 and 128x8 —
+    128 columns x N blobs, the largest device batch in the repo; the
+    old config #5 verified six blobs).  Per matrix the device route
+    (`das.verify`: one fr_batch coset-interpolation dispatch, Pippenger
+    MSMs, one multi-pairing) is measured steady-state and compared
+    against the pure-Python fulu oracle
+    (`spec.verify_cell_kzg_proof_batch`), which pays a Lagrange
+    interpolation per cell — the oracle wall is measured on
+    CST_DAS_ORACLE_CELLS cells (default 16) and scaled linearly, the
+    same subset-scaling the flagship baseline uses.
+
+    The matrix rows are closed-form degree-65 polynomials
+    (`das.ciphersuite.closed_form_matrix`): real, distinct commitments
+    and non-infinity proofs from three scalar multiplications per row,
+    so matrix construction never dominates the measured verification.
+    Each sweep also runs the mixed-invalid isolation arc (one bad cell
+    fails the RLC batch, the per-statement recheck isolates exactly
+    it) and the coset-barycentric evaluation cross-check."""
+    from consensus_specs_tpu import telemetry
+
+    _worker_setup_jax()
+    from consensus_specs_tpu.das import ciphersuite as das_cs
+    from consensus_specs_tpu.das import verify as das_verify
+    from consensus_specs_tpu.models.builder import build_spec
+    from consensus_specs_tpu.ops import bls
+
+    import jax
+
+    dev = jax.devices()[0]
+    raw = os.environ.get("CST_DAS_MATRIX", "128x2,128x8")
+    shapes = []
+    for part in raw.split(","):
+        if not part.strip():
+            continue
+        cols, blobs = part.lower().split("x")
+        shapes.append((int(cols), int(blobs)))
+    assert shapes and all(1 <= c <= 128 and b >= 1 for c, b in shapes), raw
+    oracle_cells = max(1, int(os.environ.get("CST_DAS_ORACLE_CELLS", 16)))
+    iters = 3
+
+    spec = build_spec("fulu", "mainnet")
+    prev_active = bls.bls_active
+    bls.bls_active = True
+    out = {}
+    try:
+        max_cols = max(c for c, _ in shapes)
+        max_blobs = max(b for _, b in shapes)
+        t0 = time.perf_counter()
+        matrix = das_cs.closed_form_matrix(
+            max_blobs, columns=range(max_cols))
+        log(f"closed-form matrix {max_cols}x{max_blobs}: "
+            f"{time.perf_counter() - t0:.1f}s")
+
+        def cut(cols, blobs):
+            # the matrix is row-major: entry r * max_cols + c is
+            # (row r, column c)
+            com, idx, cells, proofs = matrix
+            keep = [r * max_cols + c
+                    for r in range(blobs) for c in range(cols)]
+            return ([com[k] for k in keep], [idx[k] for k in keep],
+                    [cells[k] for k in keep], [proofs[k] for k in keep])
+
+        # ONE oracle measurement (per-cell cost is shape-independent),
+        # scaled per matrix below — the pure-python interpolation makes
+        # a full-matrix oracle run minutes-to-hours
+        com, idx, cells, proofs = cut(max_cols, max_blobs)
+        n_o = min(oracle_cells, len(idx))
+        bls.use_backend("py")
+        t0 = time.perf_counter()
+        assert spec.verify_cell_kzg_proof_batch(
+            com[:n_o], idx[:n_o],
+            [spec.Cell(c) for c in cells[:n_o]], proofs[:n_o])
+        oracle_sub = time.perf_counter() - t0
+        log(f"oracle verify @ {n_o} cells: {oracle_sub:.1f}s")
+
+        if telemetry.enabled():
+            telemetry.reset()   # count only the device-backend phase
+        for cols, blobs in shapes:
+            com, idx, cells, proofs = cut(cols, blobs)
+            n = len(idx)
+            t0 = time.perf_counter()
+            assert das_verify.verify_cell_proof_batch(
+                com, idx, cells, proofs, device=True)
+            compile_first = time.perf_counter() - t0
+            log(f"das {cols}x{blobs} compile+first: {compile_first:.1f}s")
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                assert das_verify.verify_cell_proof_batch(
+                    com, idx, cells, proofs, device=True)
+            wall = (time.perf_counter() - t0) / iters
+            oracle_wall = oracle_sub / n_o * n
+            speedup = oracle_wall / wall
+            log(f"das {cols}x{blobs} ({n} cells): {wall:.2f}s device "
+                f"vs {oracle_wall:.1f}s oracle ({speedup:.1f}x)")
+
+            # mixed-invalid isolation arc on a small slice (rung 16)
+            s_com, s_idx, s_cells, s_proofs = (com[:8], idx[:8],
+                                               list(cells[:8]),
+                                               proofs[:8])
+            bad = 3
+            s_cells[bad] = s_cells[bad][:-32] + int.to_bytes(
+                7, 32, "big")
+            batch_ok, per = das_verify.verify_and_isolate(
+                s_com, s_idx, s_cells, s_proofs, device=True)
+            isolated = (not batch_ok
+                        and [i for i, v in enumerate(per) if not v]
+                        == [bad])
+            # coset-evaluation cross-check: device barycentric over the
+            # shifted domain vs the host interpolant
+            z = 0xDA5_0001
+            crosscheck = (das_verify.evaluate_cells_at(
+                cells[:4], idx[:4], z, device=True)
+                == das_verify.evaluate_cells_at(
+                    cells[:4], idx[:4], z, device=False))
+
+            block = {
+                "matrix": {"columns": cols, "blobs": blobs, "cells": n},
+                "verify_wall_s": round(wall, 4),
+                "cells_per_s": round(n / wall, 1),
+                "oracle_wall_s": round(oracle_wall, 2),
+                "oracle_cells_measured": n_o,
+                "speedup": round(speedup, 1),
+                "rung": das_verify.das_rung(n),
+                "compile_first_s": round(compile_first, 2),
+                "batch_verdict": True,
+                "isolate": {"bad_cells": 1, "isolated": isolated},
+                "eval_crosscheck": bool(crosscheck),
+            }
+            rec = {"value": round(wall, 4), "unit": "s",
+                   "vs_baseline": round(speedup, 1), "das": block}
+            if telemetry.enabled():
+                rec = telemetry.embed_bench_block(rec)
+            out[f"das_cell_proof_batch_{cols}x{blobs}_verify_wall"] = rec
+    finally:
+        bls.bls_active = prev_active
+    out["platform"] = dev.platform
+    _stop_profile_trace()
+    print(json.dumps(out), flush=True)
+
+
 def worker_bls() -> None:
     """Configs #2/#3: attestation RLC batch + sync-aggregate pairing.
     With CST_TELEMETRY=1 each metric carries per-config compile/run,
@@ -909,7 +1051,7 @@ def main():
     # budget and only when the flagship ran on the real chip; each
     # success re-prints a superset JSON line (drivers parsing the
     # first or the last line both see the flagship metric)
-    for mode in ("scaling", "merkle", "bls", "kzg", "spec"):
+    for mode in ("scaling", "merkle", "das", "bls", "kzg", "spec"):
         elapsed = time.time() - start
         if (result is None or platform is not None
                 or elapsed >= EXTRAS_DEADLINE):
@@ -937,6 +1079,8 @@ if __name__ == "__main__":
             worker_scaling()
         elif sys.argv[2] == "merkle":
             worker_merkle()
+        elif sys.argv[2] == "das":
+            worker_das()
         elif sys.argv[2] == "bls":
             worker_bls()
         elif sys.argv[2] == "kzg":

@@ -44,6 +44,14 @@ flagship breaker arc (`flagship::degraded_steps`), and the heal path
 record (`heal["path"] == "checkpoint"` — recovery restored from the
 snapshot, not the O(N) rebuild).
 
+`bench_smoke.py --das` (the `make das-smoke` lane) runs the PeerDAS
+cell-proof sweep at the 128x8 sampling matrix on CPU: the `"das"`
+block schema (`validate_das_block`), the >= 2x das-speedup acceptance
+criterion vs the pure-Python oracle (shape-bound — the oracle pays a
+per-cell Lagrange interpolation), the mixed-invalid isolation arc, the
+coset-barycentric cross-check, the `das::*` history round-trip, and
+the report's DAS section + threshold-row wiring.
+
 `bench_smoke.py --chaos-mesh` (the `make chaos-mesh-smoke` lane) runs
 the same round with CST_CHAOS_MESH=1 on the simulated 8-host-device
 CPU mesh (XLA_FLAGS=--xla_force_host_platform_device_count=8): a
@@ -735,6 +743,110 @@ def shard_main():
     print("shard smoke: PASS")
 
 
+def das_main():
+    """The das-smoke lane (`make das-smoke` / CI): the PeerDAS
+    cell-proof sweep at the 128x8 sampling matrix on CPU, asserting
+    the `"das"` block schema, the `das::*` history-record round-trip,
+    the report's DAS section render, and the threshold-row wiring —
+    `das-speedup` must PASS on CPU (the >= 2x acceptance criterion is
+    shape-bound: the oracle pays a per-cell Lagrange interpolation the
+    device route never does), `das-throughput` must read 'no data'
+    (a chip number)."""
+    from consensus_specs_tpu.telemetry import validate_das_block
+
+    hist_env = os.environ.get("CST_BENCHWATCH_HISTORY")
+    hist_file = Path(hist_env) if hist_env \
+        else HERE / "out" / "smoke_das_history.jsonl"
+    hist_file.parent.mkdir(exist_ok=True)
+    if not hist_env and hist_file.exists():
+        hist_file.unlink()
+    das_t0 = time.time()
+    out = _run(["bench.py", "--worker", "das"],
+               {"CST_DAS_MATRIX": "128x8", "CST_DAS_ORACLE_CELLS": "8",
+                "CST_NO_COMPILE_CACHE": "1", "CST_TELEMETRY": "1"},
+               timeout=1800)
+    last = out[-1]
+    rec = last.get("das_cell_proof_batch_128x8_verify_wall")
+    assert isinstance(rec, dict) and rec.get("value", 0) > 0, last
+    block = rec.get("das")
+    problems = validate_das_block(block)
+    assert not problems, (problems, json.dumps(block)[:500])
+    assert block["matrix"] == {"columns": 128, "blobs": 8,
+                               "cells": 1024}, block
+    assert block["rung"] == 1024, block
+    # the acceptance criterion: >= 2x over the pure-Python oracle at
+    # the 128x8 matrix, on this CPU
+    assert block["speedup"] >= 2.0, block
+    assert rec["vs_baseline"] == block["speedup"], rec
+    # the mixed-invalid arc isolated exactly the bad cell, and the
+    # coset-barycentric evaluation cross-check agreed
+    assert block["isolate"]["isolated"] is True, block
+    assert block["eval_crosscheck"] is True, block
+    _check_telemetry(rec, "das worker")
+    print("das worker JSON OK:", json.dumps(
+        {k: v for k, v in rec.items() if k != "telemetry"}))
+
+    # the das record kind round-trips through the store (the parent
+    # appends, like the driver does for extras workers)
+    prev_hist = os.environ.get("CST_BENCHWATCH_HISTORY")
+    os.environ["CST_BENCHWATCH_HISTORY"] = str(hist_file)
+    try:
+        benchwatch.append_emission(
+            dict(rec, metric="das_cell_proof_batch_128x8_verify_wall",
+                 platform=last.get("platform", "cpu")),
+            ts=time.time())
+    finally:
+        if prev_hist is None:
+            os.environ.pop("CST_BENCHWATCH_HISTORY", None)
+        else:
+            os.environ["CST_BENCHWATCH_HISTORY"] = prev_hist
+    hist_records, skipped, warns = benchwatch.load_history(hist_file)
+    fresh = {r["metric"]: r for r in hist_records
+             if isinstance(r.get("ts"), (int, float))
+             and r["ts"] >= das_t0 - 5}
+    for name in ("das_cell_proof_batch_128x8_verify_wall",
+                 "das::verify_wall@128x8", "das::speedup",
+                 "das::cells_per_s"):
+        hrec = fresh.get(name)
+        assert hrec is not None, (name, sorted(fresh))
+        assert not benchwatch.validate_record(hrec), hrec
+        assert hrec["platform"] == "cpu", hrec
+        if name.startswith("das::"):
+            assert hrec["source"] == "das", hrec
+    wrec = fresh["das::verify_wall@128x8"]
+    assert wrec["das"]["matrix"]["cells"] == 1024, wrec
+    assert wrec["vs_baseline"] >= 2.0, wrec
+    print(f"das history OK: {len(fresh)} records this run -> "
+          f"{hist_file}")
+
+    # the report renders the DAS section and the threshold rows wire
+    # up: das-speedup PASSes from the CPU record, das-throughput (a
+    # chip number) reads 'no data'
+    from consensus_specs_tpu.telemetry import report as bw_report
+
+    report_md = HERE / "out" / "smoke_das_report.md"
+    rc = bw_report.main(["--repo", str(HERE), "--history",
+                         str(hist_file), "--out", str(report_md),
+                         "--no-update"])
+    assert rc == 0, f"benchwatch report exited {rc}"
+    text = report_md.read_text()
+    assert "## DAS (PeerDAS cell-proof sampling)" in text, text[:2000]
+    assert "| 128x8 | 1024 |" in text, text
+    assert "Latest speedup over the pure-Python oracle:" in text
+    result = bw_report.build_report(
+        repo=HERE, history_path=hist_file, snapshots=[],
+        durations_path=None, top_n=5, strict=False,
+        max_regress_pct=0.0, update_history=False)
+    rows = {t["id"]: t for t in result["thresholds"]}
+    assert rows["das-speedup"]["status"] == "PASS", rows["das-speedup"]
+    assert rows["das-throughput"]["status"] == "no data", \
+        rows["das-throughput"]
+    print(f"das report OK: DAS section rendered, das-speedup PASS, "
+          f"TPU-gated das-throughput reads 'no data' on CPU -> "
+          f"{report_md}")
+    print("das smoke: PASS")
+
+
 if __name__ == "__main__":
     if "--chaos-mesh" in sys.argv:
         chaos_main(mesh=True)
@@ -742,5 +854,7 @@ if __name__ == "__main__":
         chaos_main()
     elif "--shard" in sys.argv:
         shard_main()
+    elif "--das" in sys.argv:
+        das_main()
     else:
         main()

@@ -133,17 +133,22 @@ KERNEL_FILES = LIMB_FILES + (
 # (their public entries must stay span-covered like every other path
 # that can reach a device dispatch); parallel/partition.py joined with
 # the partition-rule registry (the sharded epoch step's dispatch
-# surface must stay observable like the kernels it wires up)
+# surface must stay observable like the kernels it wires up);
+# das/verify.py joined with the DAS workload (its batched cell-proof
+# entries chain fr_batch + bls_batch dispatches and must stay
+# span/cost-covered like the kernels they compose)
 INSTR_FILES = ("ops/bls_batch/__init__.py", "ops/bls/__init__.py",
                "ops/sha256_jax.py", "ops/fr_batch.py",
                "parallel/incremental.py", "parallel/partition.py",
-               "resilience/mesh.py", "resilience/checkpoint.py")
+               "resilience/mesh.py", "resilience/checkpoint.py",
+               "das/verify.py")
 
 # shape-laundering functions: a value that went through one of these is
 # a bucketed compile key, not a raw dimension.  `mesh_rung` is the
 # mesh-width form (parallel.partition): device-count reads are
-# mesh-shape compile keys, quantized to the power-of-two ladder
-BUCKET_FUNCS = frozenset({"_bucket", "mesh_rung"})
+# mesh-shape compile keys, quantized to the power-of-two ladder;
+# `das_rung` is the DAS cell-batch form (ops.fr_batch)
+BUCKET_FUNCS = frozenset({"_bucket", "mesh_rung", "das_rung"})
 
 # device-pool probes whose results are mesh-shape compile keys: a jit
 # factory keyed by a raw device count recompiles per topology without

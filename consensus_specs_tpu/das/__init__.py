@@ -1,0 +1,55 @@
+"""DAS workload subsystem — device-batched PeerDAS cell-proof checks.
+
+The fulu data-availability-sampling workload (`specs/fulu/
+polynomial-commitments-sampling.md`) verifies many
+(row_commitment, cell_index, cell, proof) tuples at once through ONE
+random-linear-combination pairing equation.  This package lifts that
+verification onto the device path the earlier PRs built — the
+`ops/fr_batch` scalar-field kernels for the coset interpolation work,
+the Pippenger G1 MSM (`ops/bls_batch.g1_multi_exp_device`, sharded
+variant on a mesh) for every linear combination of points, and the
+shared-accumulator multi-pairing for the single final check — while the
+pure-Python spec oracle in `models/fulu/polynomial_commitments_sampling
+.py` stays the bit-exactness reference.
+
+Modules:
+
+    ciphersuite   host-side parse/validate of cell statements against
+                  the fulu spec semantics (coset-shift handling,
+                  cell -> field-element unpack, the Fiat-Shamir
+                  challenge), plus the closed-form sampling matrices
+                  the bench/smoke rounds use.
+    verify        `verify_cell_proof_batch[_async]` — the batched RLC
+                  verification itself, host oracle route and device
+                  route, `_bucket`-style rung ladder over batch size.
+    compute       cell/proof computation: `compute_cells` (one FFT
+                  extension, bit-exact vs the spec) and the
+                  residue-grouped quotient route that makes per-column
+                  proofs affordable (the un-`@slow` fulu merkle-proof
+                  tests ride it).
+    sampling      a full data-column sampling round: commitment
+                  inclusion proof on the host + batched cell checks on
+                  device, the `submit_das_sample` serve payload.
+
+See README "DAS / PeerDAS" and tests/test_das.py.
+"""
+
+from .ciphersuite import (  # noqa: F401
+    CELLS_PER_EXT_BLOB,
+    FIELD_ELEMENTS_PER_CELL,
+    CellBatch,
+    closed_form_matrix,
+    parse_cell_batch,
+)
+from .sampling import (  # noqa: F401
+    DasSample,
+    sample_from_matrix,
+    verify_sample,
+    verify_sample_async,
+)
+from .verify import (  # noqa: F401
+    das_rung,
+    verify_cell_proof_batch,
+    verify_cell_proof_batch_async,
+    verify_cell_proof_batch_host,
+)

@@ -1,0 +1,199 @@
+"""Cell/proof computation for the DAS workload — the producer side.
+
+The naive spec path (`compute_cells_and_kzg_proofs`) pays, PER CELL, a
+Horner evaluation over all 4096 coefficients, a 64-step long division,
+and a 4032-point MSM — measured at >570 s for ONE blob on the
+pure-Python oracle (the reason the fulu real-blob merkle-proof tests
+sat behind `@slow` until this subsystem).  Two structural identities
+remove almost all of it, bit-exactly:
+
+1. Cells come from ONE size-8192 FFT of the coefficient form (the
+   extension evaluated over the whole bit-reversed extended domain) —
+   the same fast path the spec's `compute_cells` already uses.
+
+2. Because every cell coset satisfies x^64 = h_k^64 =: a_k, the
+   quotient of f by Z_k = X^64 - a_k needs NO long division — grouping
+   coefficients by residue mod 64,
+
+       Q_k[t] = sum_{u >= 1} f[t + 64u] * a_k^(u-1)
+
+   so a single column's proof is one scalar pass plus ONE MSM, and the
+   all-columns form factors through the k-independent partials
+   D_u = sum_t f[t + 64u] * [s^t]  (63 MSMs total — half a full MSM of
+   work per 2 columns instead of one per column) with
+   W_k = sum_u a_k^(u-1) * D_u a 63-point MSM each.
+
+MSMs route through the active BLS backend (`device=None`): the device
+Pippenger under "jax" (`ops.bls_batch` via `bls.multi_exp`), the host
+Pippenger otherwise — both bit-exact vs `g1_lincomb`, so the proofs
+equal the oracle's byte-for-byte (pinned by tests/test_das.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+from .. import telemetry
+from ..ops.bls import curve as _curve
+from . import ciphersuite as cs
+
+M = cs.FIELD_ELEMENTS_PER_BLOB
+L = cs.FIELD_ELEMENTS_PER_CELL
+P = cs.BLS_MODULUS
+
+
+# --- field FFTs (host ints, the oracle's recursive shape) -------------------
+
+
+def _fft(vals, roots):
+    if len(vals) == 1:
+        return vals
+    left = _fft(vals[::2], roots[::2])
+    right = _fft(vals[1::2], roots[::2])
+    out = [0] * len(vals)
+    half = len(left)
+    for i, (x, y) in enumerate(zip(left, right)):
+        yr = y * roots[i] % P
+        out[i] = (x + yr) % P
+        out[i + half] = (x - yr) % P
+    return out
+
+
+def _ifft(vals, roots):
+    inv_len = pow(len(vals), P - 2, P)
+    rev = [roots[0]] + list(roots[:0:-1])
+    return [v * inv_len % P for v in _fft(vals, rev)]
+
+
+def blob_to_poly_ints(blob: bytes) -> list[int]:
+    """The blob's evaluation form as validated ints (`blob_to
+    _polynomial`)."""
+    blob = bytes(blob)
+    assert len(blob) == M * cs.BYTES_PER_FIELD_ELEMENT
+    out = []
+    for i in range(M):
+        v = int.from_bytes(blob[i * 32:(i + 1) * 32], cs.KZG_ENDIANNESS)
+        assert v < P
+        out.append(v)
+    return out
+
+
+def poly_coefficients(blob: bytes) -> list[int]:
+    """Coefficient form of the blob polynomial
+    (`polynomial_eval_to_coeff`: un-brp, inverse FFT)."""
+    evals = blob_to_poly_ints(blob)
+    brp = [evals[cs.reverse_bits(i, M)] for i in range(M)]
+    return _ifft(brp, list(cs.roots_of_unity(M)))
+
+
+def compute_cells(blob: bytes) -> list[bytes]:
+    """All 128 cells of the extended blob via one size-8192 FFT —
+    bit-exact vs the spec's `compute_cells`."""
+    with telemetry.span("das.compute_cells"):
+        telemetry.count("das.compute.cells_calls")
+        coeffs = poly_coefficients(blob)
+        ext = _fft(coeffs + [0] * M,
+                   list(cs.roots_of_unity(2 * M)))
+        ext_brp = [ext[cs.reverse_bits(i, 2 * M)] for i in range(2 * M)]
+        return [cs._encode_evals(ext_brp[k * L:(k + 1) * L])
+                for k in range(cs.CELLS_PER_EXT_BLOB)]
+
+
+# --- proofs ------------------------------------------------------------------
+
+
+def _a_k(cell_index: int) -> int:
+    return pow(cs.coset_shift(cell_index), L, P)
+
+
+def _msm(points, scalars, device: bool | None):
+    """Backend-routed MSM returning an oracle Jacobian point.  `None`
+    follows the active BLS backend (the spec's `g1_lincomb` routing
+    seam); True forces the device Pippenger, False the host one."""
+    if device is None:
+        from ..ops import bls
+
+        device = bls.backend_name() == "jax"
+    live = [(p, int(s) % P) for p, s in zip(points, scalars)
+            if int(s) % P != 0 and not _curve.g1.is_inf(p)]
+    if not live:
+        return _curve.g1.infinity()
+    if device:
+        from ..ops.bls_batch import g1_multi_exp_device
+
+        return g1_multi_exp_device([p for p, _ in live],
+                                   [s for _, s in live])
+    return _curve.g1.msm([p for p, _ in live], [s for _, s in live])
+
+
+def _quotient_scalars(coeffs, a_k: int) -> list[int]:
+    """Q_k's 4032 coefficients via the residue-mod-64 grouping (no
+    long division) — identical to `divide_polynomialcoeff(f, Z_k)`."""
+    out = [0] * (M - L)
+    for c in range(L):
+        # walk residue class c from the top so each step is one
+        # multiply: Q[t] = f[t + 64] + a_k * Q[t + 64]
+        acc = 0
+        for v in range((M - L) // L - 1, -1, -1):
+            t = c + v * L
+            acc = (coeffs[t + L] + a_k * acc) % P
+            out[t] = acc
+    return out
+
+
+def cell_proof_for_column(blob: bytes, cell_index: int,
+                          device: bool | None = None) -> bytes:
+    """One column's KZG multiproof for `blob` — one scalar pass + one
+    MSM (the sampled-column producer path the un-@slow fulu
+    merkle-proof tests ride).  Byte-equal to the proof the oracle's
+    `compute_cells_and_kzg_proofs` emits at this index."""
+    with telemetry.span("das.cell_proof", cell=int(cell_index)):
+        telemetry.count("das.compute.column_proof_calls")
+        coeffs = poly_coefficients(blob)
+        q = _quotient_scalars(coeffs, _a_k(int(cell_index)))
+        pts = [cs.setup_g1_point(t) for t in range(M - L)]
+        return _curve.g1_to_bytes(_msm(pts, q, device))
+
+
+@functools.lru_cache(maxsize=8)
+def _cached_cells_and_column_proofs(blob: bytes, columns: tuple,
+                                    device: bool | None):
+    cells = compute_cells(blob)
+    proofs = {k: cell_proof_for_column(blob, k, device=device)
+              for k in columns}
+    return cells, proofs
+
+
+def cells_and_column_proofs(blob: bytes, columns,
+                            device: bool | None = None):
+    """(all 128 cells, {column: proof}) with a small per-process memo —
+    the two un-@slow merkle-proof tests share one real blob."""
+    return _cached_cells_and_column_proofs(
+        bytes(blob), tuple(int(c) for c in columns), device)
+
+
+def compute_cells_and_kzg_proofs(blob: bytes,
+                                 device: bool | None = None):
+    """All cells AND all 128 proofs via the k-independent D_u partials
+    (63 shared MSMs + one 63-point MSM per column — about 4x less
+    point work than 128 independent quotient MSMs, and every MSM a
+    device dispatch under the jax backend).  Bit-exact vs the spec
+    oracle; the jax-backend spec namespace routes here."""
+    with telemetry.span("das.compute_cells_and_proofs"):
+        telemetry.count("das.compute.full_calls")
+        cells = compute_cells(blob)
+        coeffs = poly_coefficients(blob)
+        d_points = []
+        for u in range(1, M // L):
+            pts = [cs.setup_g1_point(t) for t in range(M - u * L)]
+            d_points.append(_msm(pts, coeffs[u * L:], device))
+        proofs = []
+        for k in range(cs.CELLS_PER_EXT_BLOB):
+            a = _a_k(k)
+            pows, cur = [], 1
+            for _ in range(len(d_points)):
+                pows.append(cur)
+                cur = cur * a % P
+            proofs.append(_curve.g1_to_bytes(
+                _msm(d_points, pows, device)))
+        return cells, proofs

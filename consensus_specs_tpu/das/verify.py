@@ -1,0 +1,304 @@
+"""Batched PeerDAS cell-proof verification — the universal equation on
+the device path.
+
+The fulu oracle (`verify_cell_kzg_proof_batch_impl`) checks K cell
+statements with ONE pairing equation
+
+    e(LL, [s^n]) == e(RL, [1]),      n = FIELD_ELEMENTS_PER_CELL
+    LL = sum_k r^k W_k
+    RL = RLC - RLI + RLP
+       = sum_i w_i C_i - [sum_k r^k I_k(s)] + sum_k r^k h_k^n W_k
+
+(r the Fiat-Shamir challenge, W_k the proofs, C_i the deduplicated
+commitments with folded weights w_i, I_k the degree-<64 interpolant of
+cell k's evaluations over its coset h_k*G).  This module computes the
+SAME group elements from the same parsed statement
+(`ciphersuite.parse_cell_batch`) on two routes:
+
+host route (`verify_cell_proof_batch_host`)
+    pure-Python Pippenger MSMs + the oracle pairing — but with the
+    coset-IDFT interpolation (`ciphersuite.interpolate_coset_coeffs`,
+    O(K*64^2) instead of the oracle's O(K*64^3) Lagrange build), so it
+    doubles as the serve executor's degraded-mode oracle and the
+    affordable comparison baseline.  Bit-exact vs the spec oracle
+    (tests/test_das.py).
+
+device route (`verify_cell_proof_batch_async`)
+    the RLI coefficient fold runs in ONE `fr_batch.coset_interpolate
+    _sum` dispatch (evals stay in stored bit-reversed coset order — no
+    host re-sort), every point combination is a Pippenger MSM
+    (`g1_multi_exp_device`, `g1_multi_exp_sharded` when a mesh is
+    asked for), RLC/RLI/RLP fold into one MSM + RLP reusing LL's
+    compiled rung, and the final check is one shared-accumulator
+    multi-pairing.  Cell-batch shapes ride the `fr_batch.das_rung`
+    ladder (16 / 128 / 1024 — a single sampled cell, one full column
+    row, the 128x8 sampling matrix); every device fetch settles
+    through `serve.futures.DeviceFuture`, keeping the
+    `host-sync-outside-settle` analyzer rule clean.
+
+`evaluate_cells_at` rides the generalized coset barycentric kernel
+(`fr_batch.barycentric_eval(..., shift_int=h_k)`) — the
+device-resident coset evaluation of each cell's interpolant at an
+arbitrary point, the cross-check pinning the two interpolation
+representations against each other (and the sampling round's spot
+check).
+"""
+
+from __future__ import annotations
+
+from .. import telemetry
+from ..ops.bls import ciphersuite as _bls_cs
+from ..ops.bls import curve as _curve
+from ..serve.futures import DeviceFuture
+from ..telemetry import costmodel
+from . import ciphersuite as cs
+
+N_CELL = cs.FIELD_ELEMENTS_PER_CELL
+
+
+def das_rung(n: int) -> int:
+    """The cell-batch shape ladder (re-exported from `fr_batch`, where
+    the kernel lives)."""
+    from ..ops.fr_batch import das_rung as _rung
+
+    return _rung(n)
+
+
+def _neg_g2_gen():
+    return _curve.g2.neg(cs.setup_g2_point(0))
+
+
+def _rli_weight_rows(batch: cs.CellBatch) -> list[list[int]]:
+    """weights[k][j] = r^k * h_k^-j — the per-(cell, coefficient)
+    factors folding the IDFT outputs into RLI's scalar vector."""
+    rows = []
+    for rp, h in zip(batch.r_powers, batch.shifts):
+        h_inv = pow(h, cs.BLS_MODULUS - 2, cs.BLS_MODULUS)
+        row, cur = [], rp
+        for _ in range(N_CELL):
+            row.append(cur)
+            cur = cur * h_inv % cs.BLS_MODULUS
+        rows.append(row)
+    return rows
+
+
+def _rl_terms(batch: cs.CellBatch, rli_coeffs) -> tuple[list, list]:
+    """(points, scalars) of the RLC - RLI part of RL as one MSM: the
+    deduplicated commitments with their folded weights, plus the first
+    64 monomial setup points with the NEGATED summed interpolation
+    coefficients."""
+    points = list(batch.commitments) + [cs.setup_g1_point(j)
+                                        for j in range(N_CELL)]
+    scalars = batch.weights() + [(-int(c)) % cs.BLS_MODULUS
+                                 for c in rli_coeffs]
+    return points, scalars
+
+
+# --- host route --------------------------------------------------------------
+
+
+def _host_rli_coeffs(batch: cs.CellBatch) -> list[int]:
+    coeffs = [0] * N_CELL
+    for k in range(batch.n_cells):
+        rp = batch.r_powers[k]
+        ck = cs.interpolate_coset_coeffs(batch.cell_indices[k],
+                                         batch.evals[k])
+        for j in range(N_CELL):
+            coeffs[j] = (coeffs[j] + rp * ck[j]) % cs.BLS_MODULUS
+    return coeffs
+
+
+def verify_cell_proof_batch_host(commitments_bytes, cell_indices, cells,
+                                 proofs_bytes) -> bool:
+    """The pure-host verifier (also the serve executor's degraded-mode
+    oracle for the `das` request kind).  Same accept/reject verdict as
+    the device route and the fulu spec oracle."""
+    batch = cs.parse_cell_batch(commitments_bytes, cell_indices, cells,
+                                proofs_bytes)
+    if batch.n_cells == 0:
+        return True
+    with telemetry.span("das.verify_host", cells=batch.n_cells):
+        telemetry.count("das.verify.host_calls")
+        rli = _host_rli_coeffs(batch)
+        ll = _curve.g1.msm(batch.proofs, batch.r_powers)
+        pts, sc = _rl_terms(batch, rli)
+        rl = _curve.g1.add(
+            _curve.g1.msm(pts, sc),
+            _curve.g1.msm(batch.proofs, batch.weighted_r_powers()))
+        return _bls_cs._pairing_check(
+            [(ll, cs.setup_g2_point(N_CELL)), (rl, _neg_g2_gen())])
+
+
+# --- device route ------------------------------------------------------------
+
+
+def verify_cell_proof_batch_async(commitments_bytes, cell_indices, cells,
+                                  proofs_bytes, device: bool | None = None,
+                                  n_devices: int | None = None,
+                                  device_ids=None) -> DeviceFuture:
+    """Deferred batch verdict: parsing and the RLI coset-interpolation
+    dispatch happen eagerly, the MSM + pairing stages run at settle
+    time with every device fetch going through `DeviceFuture.result()`
+    (the sanctioned settle seam).  `device=None` follows the active BLS
+    backend; `device=False` answers on the host route immediately (the
+    tier-1 fallback when the device path is unavailable).  `n_devices`/
+    `device_ids` shard the big MSMs over the mesh
+    (`g1_multi_exp_sharded`)."""
+    if device is None:
+        from ..ops import bls
+
+        device = bls.backend_name() == "jax"
+    if not device:
+        try:
+            return DeviceFuture.settled(verify_cell_proof_batch_host(
+                commitments_bytes, cell_indices, cells, proofs_bytes))
+        except Exception as exc:
+            return DeviceFuture.failed(exc)
+
+    return _verify_device_async(commitments_bytes, cell_indices, cells,
+                                proofs_bytes, n_devices=n_devices,
+                                device_ids=device_ids)
+
+
+def _verify_device_async(commitments_bytes, cell_indices, cells,
+                         proofs_bytes, n_devices=None,
+                         device_ids=None) -> DeviceFuture:
+    from ..ops import bls_batch
+    from ..ops.fr_batch import coset_interpolate_sum_async
+
+    batch = cs.parse_cell_batch(commitments_bytes, cell_indices, cells,
+                                proofs_bytes)
+    if batch.n_cells == 0:
+        return DeviceFuture.settled(True)
+    rung = das_rung(batch.n_cells)
+    with telemetry.span("das.verify_device", cells=batch.n_cells,
+                        padded=rung):
+        telemetry.count("das.verify.device_calls")
+        telemetry.count("das.cells.live", batch.n_cells)
+        telemetry.count("das.cells.padded", rung)
+        # stage 1 dispatches NOW: the coset-interpolation fold (the
+        # only stage with field-element inputs) overlaps the caller's
+        # next host prep
+        rli_fut = coset_interpolate_sum_async(
+            batch.evals, cs.coset_idft_matrix(), _rli_weight_rows(batch))
+    costmodel.sample_watermark("das.verify_device")
+
+    sharded = n_devices is not None or device_ids is not None
+
+    def _msm_async(points, scalars, block=False):
+        if sharded:
+            return bls_batch.g1_multi_exp_sharded_async(
+                points, scalars, n_devices=n_devices,
+                device_ids=device_ids)
+        return bls_batch.g1_multi_exp_device_async(points, scalars,
+                                                   block=block)
+
+    def _finish(fut: DeviceFuture, timeout=None) -> None:
+        try:
+            rli = rli_fut.result()
+            # LL and RLP share the proof points AND the compiled MSM
+            # rung; RLC - RLI is one small MSM; RL composes on host
+            ll_fut = _msm_async(batch.proofs, batch.r_powers)
+            rlp_fut = _msm_async(batch.proofs, batch.weighted_r_powers())
+            pts, sc = _rl_terms(batch, rli)
+            rl_small_fut = _msm_async(pts, sc)
+            rl = _curve.g1.add(rl_small_fut.result(), rlp_fut.result())
+            ok_fut = bls_batch.pairing_check_device_async(
+                [(ll_fut.result(), cs.setup_g2_point(N_CELL)),
+                 (rl, _neg_g2_gen())])
+            fut.set_result(bool(ok_fut.result()))
+        except Exception as exc:
+            if fut.done():
+                raise
+            fut.set_exception(exc)
+
+    return DeviceFuture(waiter=_finish)
+
+
+def verify_cell_proof_batch(commitments_bytes, cell_indices, cells,
+                            proofs_bytes, device: bool | None = None,
+                            n_devices: int | None = None,
+                            device_ids=None) -> bool:
+    """Synchronous facade over `verify_cell_proof_batch_async`; the
+    fetches live in `serve.futures`."""
+    return verify_cell_proof_batch_async(
+        commitments_bytes, cell_indices, cells, proofs_bytes,
+        device=device, n_devices=n_devices,
+        device_ids=device_ids).result()
+
+
+def verify_and_isolate(commitments_bytes, cell_indices, cells,
+                       proofs_bytes,
+                       device: bool | None = None) -> tuple[bool, list]:
+    """(batch_verdict, per_statement_verdicts): one RLC batch check,
+    and — only when the batch fails — a per-statement recheck so each
+    bad cell is isolated instead of poisoning the whole sample (the
+    serving semantics; all-or-nothing is a block semantics)."""
+    ok = verify_cell_proof_batch(commitments_bytes, cell_indices, cells,
+                                 proofs_bytes, device=device)
+    if ok:
+        return True, [True] * len(cell_indices)
+    telemetry.count("das.verify.recheck_batches")
+    futs = [verify_cell_proof_batch_async(
+        [commitments_bytes[k]], [cell_indices[k]], [cells[k]],
+        [proofs_bytes[k]], device=device)
+        for k in range(len(cell_indices))]
+    return False, [f.result() for f in futs]
+
+
+# --- coset evaluation (the generalized barycentric surface) ------------------
+
+
+def evaluate_cells_at(cells, cell_indices, z_int,
+                      device: bool | None = None) -> list[int]:
+    """I_k(z) for each cell — the degree-<64 interpolant of the cell's
+    evaluations over its coset, evaluated at an arbitrary field point.
+
+    Device route: `fr_batch.barycentric_eval` over the coset domain IN
+    STORED ORDER with `shift_int=h_k` (the coset-generalized kernel —
+    all dispatches go out before the first settle, so a batch of cells
+    pipelines).  Host route: Horner on `interpolate_coset_coeffs`.
+    The two agreeing — and agreeing with the oracle's Lagrange
+    interpolant — is the coset-handling cross-check tests and the das
+    smoke assert."""
+    if device is None:
+        from ..ops import bls
+
+        device = bls.backend_name() == "jax"
+    rows = []
+    for cell in cells:
+        cell = bytes(cell)
+        assert len(cell) == cs.BYTES_PER_CELL
+        rows.append([int.from_bytes(
+            cell[i * cs.BYTES_PER_FIELD_ELEMENT:
+                 (i + 1) * cs.BYTES_PER_FIELD_ELEMENT],
+            cs.KZG_ENDIANNESS) for i in range(N_CELL)])
+    z = int(z_int) % cs.BLS_MODULUS
+    if device:
+        from ..ops.fr_batch import barycentric_eval_async
+
+        with telemetry.span("das.evaluate_cells", cells=len(rows)):
+            telemetry.count("das.evaluate_cells.device_calls")
+            # in-domain z short-circuits to the stored evaluation (the
+            # barycentric denominators vanish there), matching the
+            # oracle's `evaluate_polynomial_in_evaluation_form` guard
+            futs = [
+                DeviceFuture.settled(
+                    row[cs.coset_points(int(k)).index(z)])
+                if z in cs.coset_points(int(k))
+                else barycentric_eval_async(
+                    row, cs.coset_points(int(k)), z,
+                    shift_int=cs.coset_shift(int(k)))
+                for row, k in zip(rows, cell_indices)]
+        return [f.result() for f in futs]
+    out = []
+    for row, k in zip(rows, cell_indices):
+        if z in cs.coset_points(int(k)):
+            out.append(row[cs.coset_points(int(k)).index(z)])
+            continue
+        coeffs = cs.interpolate_coset_coeffs(int(k), row)
+        acc = 0
+        for c in reversed(coeffs):
+            acc = (acc * z + c) % cs.BLS_MODULUS
+        out.append(acc)
+    return out

@@ -128,6 +128,22 @@ def case_verify_cell_kzg_proof_batch():
     yield ("verify_cell_kzg_proof_batch_case_valid_multiple_blobs",
            runner(two_blobs))
 
+    # zero-blob closed form: infinity commitment, all-zero cells,
+    # infinity proofs — valid (reference's *_case_valid_zero_* family)
+    def zero_blob():
+        spec_ = kzg_7594_spec()
+        inf = b"\xc0" + b"\x00" * 47
+        zero_cell = b"\x00" * int(spec_.BYTES_PER_CELL)
+        return ([inf, inf], [0, 81], [zero_cell, zero_cell],
+                [inf, inf])
+
+    yield ("verify_cell_kzg_proof_batch_case_valid_zero_blob",
+           runner(zero_blob))
+    # the same statement repeated verbatim stays valid (duplicate
+    # (commitment, index, cell, proof) rows are legal)
+    yield ("verify_cell_kzg_proof_batch_case_valid_same_cell_repeated",
+           runner(subset(0, [11, 11])))
+
     # incorrect (well-formed but wrong) inputs
     yield ("verify_cell_kzg_proof_batch_case_incorrect_proof_add_one",
            runner(subset(0, [4, 5], mutate=lambda c, i, cl, p:
@@ -141,6 +157,20 @@ def case_verify_cell_kzg_proof_batch():
     yield ("verify_cell_kzg_proof_batch_case_cells_swapped",
            runner(subset(2, [1, 2], mutate=lambda c, i, cl, p:
                          (c, i, [cl[1], cl[0]], p))))
+    yield ("verify_cell_kzg_proof_batch_case_incorrect_cell_index",
+           runner(subset(1, [6], mutate=lambda c, i, cl, p:
+                         (c, [7], cl, p))))
+    yield ("verify_cell_kzg_proof_batch_case_incorrect_proof_point_at"
+           "_infinity",
+           runner(subset(0, [3], mutate=lambda c, i, cl, p:
+                         (c, i, cl, [b"\xc0" + b"\x00" * 47]))))
+    yield ("verify_cell_kzg_proof_batch_case_incorrect_commitment"
+           "_point_at_infinity",
+           runner(subset(0, [3], mutate=lambda c, i, cl, p:
+                         ([b"\xc0" + b"\x00" * 47], i, cl, p))))
+    yield ("verify_cell_kzg_proof_batch_case_proofs_swapped",
+           runner(subset(2, [8, 9], mutate=lambda c, i, cl, p:
+                         (c, i, cl, [p[1], p[0]]))))
 
     # malformed members
     for k, point in enumerate(invalid_g1_points()):
@@ -235,6 +265,10 @@ def case_recover_cells_and_kzg_proofs():
     yield ("recover_cells_and_kzg_proofs_case_invalid_length_mismatch",
            runner(available(0, list(range(0, n_cells, 2)),
                             mutate=lambda i, c: (i, c[:-1]))))
+    # a recoverable set strictly between half and all (the reference's
+    # more-than-half family): every other cell plus one extra
+    yield ("recover_cells_and_kzg_proofs_case_valid_more_than_half",
+           runner(available(1, list(range(0, n_cells, 2)) + [1])))
 
 
 CASE_FNS = [

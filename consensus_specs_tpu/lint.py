@@ -319,8 +319,9 @@ def lint_env_knobs(repo=None) -> list[str]:
     (`CST_MERKLE_*`) in the "Incremental merkleization" section,
     fault-plan knobs (`CST_FAULTS*`) in the "Resilience" section,
     checkpoint knobs (`CST_CHECKPOINT_*`) in the "Mesh resilience &
-    checkpointing" section, and mesh-sharding knobs (`CST_SHARD_*`) in
-    the "Mesh sharding" section — a subsystem's configuration surface
+    checkpointing" section, mesh-sharding knobs (`CST_SHARD_*`) in
+    the "Mesh sharding" section, and DAS knobs (`CST_DAS_*`) in the
+    "DAS / PeerDAS" section — a subsystem's configuration surface
     must be documented where the subsystem is explained, not only in
     the flat table.  `repo` overrides the tree root (tests)."""
     repo = Path(repo) if repo is not None else PKG_ROOT.parent
@@ -345,7 +346,9 @@ def lint_env_knobs(repo=None) -> list[str]:
                            section(re.escape(
                                "Mesh resilience & checkpointing"))),
                           ("CST_SHARD_", "Mesh sharding",
-                           section("Mesh sharding")))
+                           section("Mesh sharding")),
+                          ("CST_DAS_", "DAS / PeerDAS",
+                           section(re.escape("DAS / PeerDAS"))))
 
     used: dict[str, str] = {}
     for path in sorted(repo.rglob("*.py")):

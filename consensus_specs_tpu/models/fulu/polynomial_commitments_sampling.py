@@ -411,8 +411,22 @@ def compute_cells_and_kzg_proofs_polynomialcoeff(
 
 def compute_cells_and_kzg_proofs(blob: Blob):
     """All cell proofs for an extended blob (naive O(n^2); FK20 is the
-    performant path).  Public method."""
+    performant path).  Public method.
+
+    Device routing (the DAS subsystem, `consensus_specs_tpu/das/`):
+    under the jax backend with real BLS active, the residue-grouped
+    quotient route computes the identical cells and proofs — the
+    per-cell long division disappears and every MSM dispatches to the
+    Pippenger kernel (bit-exact, pinned by tests/test_das.py)."""
     assert len(blob) == BYTES_PER_BLOB
+
+    if bls.backend_name() == "jax" and bls.bls_active:
+        from consensus_specs_tpu.das import compute as _das_compute
+
+        cells, proofs = _das_compute.compute_cells_and_kzg_proofs(
+            bytes(blob))
+        return ([Cell(c) for c in cells],
+                [KZGProof(p) for p in proofs])
 
     polynomial = blob_to_polynomial(blob)
     polynomial_coeff = polynomial_eval_to_coeff(polynomial)
@@ -422,7 +436,21 @@ def compute_cells_and_kzg_proofs(blob: Blob):
 def verify_cell_kzg_proof_batch(commitments_bytes, cell_indices, cells,
                                 proofs_bytes) -> bool:
     """Verify (commitment, cell_index, cell, proof) tuples via the
-    universal verification equation.  Public method."""
+    universal verification equation.  Public method.
+
+    Device routing (the DAS subsystem): under the jax backend with
+    real BLS active, the whole batch verifies on the device path —
+    one `fr_batch` coset-interpolation dispatch for the RLI scalars,
+    Pippenger MSMs for every point combination, one shared-accumulator
+    multi-pairing — accept/reject identical to the oracle below
+    (malformed input raises on both routes)."""
+    if bls.backend_name() == "jax" and bls.bls_active:
+        from consensus_specs_tpu.das import verify as _das_verify
+
+        return _das_verify.verify_cell_proof_batch(
+            commitments_bytes, cell_indices, cells, proofs_bytes,
+            device=True)
+
     assert (len(commitments_bytes) == len(cells) == len(proofs_bytes)
             == len(cell_indices))
     for commitment_bytes in commitments_bytes:

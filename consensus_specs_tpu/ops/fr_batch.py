@@ -199,27 +199,51 @@ class PrimeFieldKernel:
 
 FR = PrimeFieldKernel(R_MODULUS)
 
+# batch-shape ladder for the DAS coset-interpolation kernel: rungs land
+# the sampling-matrix shapes exactly (a single sampled cell, one full
+# 128-column row, the 128x8 sampling matrix); larger batches fall back
+# to powers of two like `bls_batch._bucket`
+_DAS_STEPS = (16, 128, 1024)
+
+
+def das_rung(n: int) -> int:
+    """Padded cell-batch shape for n live statements (the compile-key
+    launderer the analyzer recognizes, like `_bucket`/`mesh_rung`)."""
+    b = 1 if n <= 1 else 1 << (n - 1).bit_length()
+    for step in _DAS_STEPS:
+        if b <= step:
+            return step
+    return b
+
 
 @functools.lru_cache(maxsize=4)
 def _barycentric_kernel(width: int):
-    """Jitted f(z) for one (poly, z) pair over a width-W domain."""
+    """Jitted f(z) for one (poly, z) pair over a width-W multiplicative
+    domain h*G (h enters as the Montgomery limbs of h^W and of
+    1/(W*h^W), both host-known): with domain points x_i,
+
+        f(z) = (z^W - h^W) / (W * h^W) * sum_i f_i * x_i / (z - x_i)
+
+    (vanishing polynomial X^W - h^W, Z'(x_i) = W * h^W / x_i).  The
+    classic roots-of-unity formula is the h = 1 instance, so the one
+    kernel serves both the blob-domain callers and the DAS coset
+    evaluations — the sum is order-agnostic, so callers pass the domain
+    exactly as stored (bit-reversed slices included, no re-sort)."""
     import jax
     jnp = _jnp()
 
-    inv_width_mont = FR.to_mont(pow(width, R_MODULUS - 2, R_MODULUS))
-
-    def run(poly, roots, z):
-        # poly/roots: (W, 33) Montgomery; z: (33,)
-        a = FR.mul(poly, roots)                     # f_i * w_i
-        b = FR.sub(jnp.broadcast_to(z, roots.shape), roots)  # z - w_i
+    def run(poly, roots, z, h_pow_w, inv_scale):
+        # poly/roots: (W, 33) Montgomery; z/h_pow_w/inv_scale: (33,)
+        a = FR.mul(poly, roots)                     # f_i * x_i
+        b = FR.sub(jnp.broadcast_to(z, roots.shape), roots)  # z - x_i
         d = FR.inv(b)                                # all lanes at once
         terms = FR.mul(a, d)
         total = FR.tree_sum(terms, width)            # value < W * 2p
 
         z_pow = FR.pow_uint(z, width)
-        factor = FR.sub(z_pow, jnp.asarray(FR.one_mont))
+        factor = FR.sub(z_pow, h_pow_w)
         total = FR.mul(total, factor)                # collapses magnitude
-        total = FR.mul(total, jnp.asarray(inv_width_mont))
+        total = FR.mul(total, inv_scale)
         return total
 
     return jax.jit(run)
@@ -230,37 +254,146 @@ def _roots_mont(roots_key):
     return FR.to_mont_batch(list(roots_key))
 
 
-def barycentric_eval_async(poly_ints, roots_brp_ints, z_int):
+def barycentric_eval_async(poly_ints, domain_ints, z_int,
+                           shift_int: int = 1):
     """Device evaluation of an evaluation-form polynomial at an
     out-of-domain z, deferred: returns a `serve.futures.DeviceFuture`
     settling to a canonical python int — the field element returns to
     the host (and leaves Montgomery form) only at `result()`, so a
     batch of blob evaluations pipelines instead of serializing on each
-    element."""
+    element.
+
+    `domain_ints` is the evaluation domain in THE SAME ORDER as
+    `poly_ints` — any order works (the barycentric sum commutes), so
+    coset slices stay in their stored bit-reversed order.  For a coset
+    domain h*G pass `shift_int=h`; the default 1 is the classic
+    roots-of-unity formula, bit-compatible with every existing caller."""
     from ..serve.futures import value_future
 
     width = len(poly_ints)
-    assert width == len(roots_brp_ints)
+    assert width == len(domain_ints)
+    h = int(shift_int) % R_MODULUS
+    assert h != 0
+    h_pow_w = pow(h, width, R_MODULUS)
+    inv_scale = pow(width * h_pow_w % R_MODULUS, R_MODULUS - 2,
+                    R_MODULUS)
     jnp = _jnp()
-    # cst: allow(recompile-unbucketed-dim): width is the KZG evaluation
-    # domain size — fixed per preset (4096 mainnet / 4 minimal), so the
-    # lru-cached kernel compiles once per process in practice
+    # cst: allow(recompile-unbucketed-dim): width is a KZG evaluation
+    # domain size — fixed per preset (4096 blob / 64 DAS cell coset /
+    # 4 minimal), so the lru-cached kernel compiles a handful of times
+    # per process, never per batch; the coset shift is a traced INPUT,
+    # not a compile key
     kfn = _barycentric_kernel(width)
     with telemetry.span("fr.barycentric_eval", width=width):
         telemetry.count("fr.barycentric_eval.calls")
         poly = jnp.asarray(FR.to_mont_batch([int(v) for v in poly_ints]))
         roots = jnp.asarray(_roots_mont(tuple(int(r)
-                                              for r in roots_brp_ints)))
+                                              for r in domain_ints)))
         z = jnp.asarray(FR.to_mont(int(z_int)))
-        out = kfn(poly, roots, z)
+        hw = jnp.asarray(FR.to_mont(h_pow_w))
+        scale = jnp.asarray(FR.to_mont(inv_scale))
+        out = kfn(poly, roots, z, hw, scale)
     # cost-capture seam (CST_COSTMODEL rounds), outside the span: the
     # AOT analysis pass must not contaminate the measured wall
-    costmodel.capture(f"barycentric@{width}", kfn, (poly, roots, z))
+    costmodel.capture(f"barycentric@{width}", kfn,
+                      (poly, roots, z, hw, scale))
     return value_future(out, convert=FR.from_mont)
 
 
-def barycentric_eval(poly_ints, roots_brp_ints, z_int) -> int:
+def barycentric_eval(poly_ints, domain_ints, z_int,
+                     shift_int: int = 1) -> int:
     """Synchronous facade over `barycentric_eval_async` (the host KZG
     library's call shape); the fetch lives in `serve.futures`."""
-    return barycentric_eval_async(poly_ints, roots_brp_ints,
-                                  z_int).result()
+    return barycentric_eval_async(poly_ints, domain_ints, z_int,
+                                  shift_int=shift_int).result()
+
+
+# --- DAS coset interpolation (the RLI term's field work) --------------------
+
+
+@functools.lru_cache(maxsize=4)
+def _coset_interpolate_kernel(batch: int, width: int):
+    """Jitted sum_k I_k coefficients for a cell batch: evals (B, W, 33)
+    in stored coset order, the rev-folded inverse-DFT matrix
+    (W, W, 33), and per-(cell, coefficient) weights (B, W, 33) carrying
+    r^k * h_k^-j.  One scan over the W input positions accumulates the
+    lazy matrix product (W * 2p stays far inside the signed budget),
+    one Montgomery multiply applies the weights, one log-depth tree sum
+    folds the batch — O(B*W^2) lane multiplies, zero host round trips."""
+    import jax
+    jnp = _jnp()
+
+    def run(evals, idft, weights):
+        ev_steps = jnp.moveaxis(evals, 1, 0)         # (W, B, 33)
+
+        def step(acc, x):
+            e_i, m_i = x                             # (B, 33), (W, 33)
+            return FR.add(acc, FR.mul(e_i[:, None, :], m_i[None])), None
+
+        acc0 = jnp.zeros((evals.shape[0], width, N_LIMBS),
+                         dtype=jnp.int32)
+        acc, _ = jax.lax.scan(step, acc0, (ev_steps, idft))
+        weighted = FR.mul(acc, weights)              # r^k * h_k^-j * c
+        return FR.tree_sum(weighted, batch)          # (W, 33)
+
+    return jax.jit(run)
+
+
+@functools.lru_cache(maxsize=2)
+def _idft_mont(matrix_key):
+    return FR.to_mont_batch(
+        [v for row in matrix_key for v in row]).reshape(
+            len(matrix_key), len(matrix_key), N_LIMBS)
+
+
+def _from_mont_rows(host):
+    return [FR.from_mont(row) for row in np.asarray(host)]
+
+
+def coset_interpolate_sum_async(evals_rows, idft_matrix, weight_rows):
+    """Device-resident interpolation-coefficient fold for a DAS cell
+    batch: settles to the `width` canonical field elements
+
+        S_j = sum_k weights[k][j] * (IDFT(evals[k]))_j
+
+    — with weights r^k * h_k^-j this IS the coefficient vector of
+    sum_k r^k I_k(X), the batched verification equation's RLI scalars
+    (`das.verify`).  `evals_rows` stay in stored (bit-reversed coset)
+    order; the permutation is folded into `idft_matrix`
+    (`das.ciphersuite.coset_idft_matrix`), so there is no host-side
+    re-sort.  Batch shapes ride the `das_rung` ladder; padded rows
+    carry zero weights and vanish from the fold."""
+    from ..serve.futures import value_future
+
+    n = len(evals_rows)
+    assert n == len(weight_rows) and n >= 1
+    width = len(idft_matrix)
+    b = das_rung(n)
+    jnp = _jnp()
+    # cst: allow(recompile-unbucketed-dim): width is the cell coset
+    # size — FIELD_ELEMENTS_PER_CELL, preset-fixed at 64 — so only the
+    # das_rung-laundered batch axis varies across calls
+    kfn = _coset_interpolate_kernel(b, width)
+    with telemetry.span("fr.coset_interpolate", cells=n, padded=b,
+                        width=width):
+        telemetry.count("fr.coset_interpolate.calls")
+        flat = [int(v) for row in evals_rows for v in row]
+        flat += [0] * ((b - n) * width)
+        evals = jnp.asarray(
+            FR.to_mont_batch(flat).reshape(b, width, N_LIMBS))
+        wflat = [int(v) for row in weight_rows for v in row]
+        wflat += [0] * ((b - n) * width)        # zero weight = dead lane
+        weights = jnp.asarray(
+            FR.to_mont_batch(wflat).reshape(b, width, N_LIMBS))
+        idft = jnp.asarray(_idft_mont(
+            tuple(tuple(int(v) for v in row) for row in idft_matrix)))
+        out = kfn(evals, idft, weights)
+    # cost-capture seam, outside the span (same contract as barycentric)
+    costmodel.capture(f"coset_interp@{b}", kfn, (evals, idft, weights))
+    return value_future(out, convert=_from_mont_rows)
+
+
+def coset_interpolate_sum(evals_rows, idft_matrix, weight_rows):
+    """Synchronous facade over `coset_interpolate_sum_async`."""
+    return coset_interpolate_sum_async(evals_rows, idft_matrix,
+                                       weight_rows).result()

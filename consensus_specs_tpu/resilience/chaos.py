@@ -124,6 +124,12 @@ def _check_results(tracked, expected) -> dict:
         elif kind == "proof":
             if not isinstance(value, list) or not value:
                 wrong += 1
+        elif kind == "das":
+            # every lane sample is a valid closed-form column: the
+            # only correct verdict is True (the oracle fallback's
+            # host route included)
+            if value is not True:
+                wrong += 1
     return {"wrong": wrong, "failed": failed, "checked": checked}
 
 
@@ -415,9 +421,12 @@ def run_chaos_load(cfg=None, plan=None) -> dict:
     plan = faults.load_plan(plan)
 
     pool = build_statement_pool(cfg.pool, cfg.committee)
+    from ..serve.loadgen import DAS_SAMPLES_PER_SLOT, _das_payloads
     payloads = {"pairing": _pairing_payload(pool[0]),
                 "fr": _fr_payload(), "sha256": _sha_payload(),
-                "proof": _proof_payload()}
+                "proof": _proof_payload(),
+                "das": (_das_payloads() if DAS_SAMPLES_PER_SLOT
+                        else [])}
     expected = _expectations(payloads)
     warm_s = _warm_kernels(cfg, pool, payloads)
 

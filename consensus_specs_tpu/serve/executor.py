@@ -33,6 +33,11 @@ Request kinds and their device paths:
                `parallel.incremental.MerkleForest`
                (`incremental.emit_proofs_async`) — the stateless-client
                proof-serving workload riding the same futures pipeline
+    das        one data-column sampling check (`das.sampling
+               .verify_sample_async`): host commitment-inclusion walk,
+               then ALL of the column's cell proofs as one batched RLC
+               pairing equation — the PeerDAS workload; each request is
+               itself a device batch, so requests dispatch one-to-one
 
 Failure semantics are LAYERED (PR 8, the resilience layer):
 
@@ -83,7 +88,7 @@ from ..resilience import faults
 from ..resilience.policies import DeadlineExceeded
 from .futures import DeviceFuture, FutureTimeout
 
-KINDS = ("verify", "pairing", "msm", "sha256", "fr", "proof")
+KINDS = ("verify", "pairing", "msm", "sha256", "fr", "proof", "das")
 
 # batched-kind dispatchers resolve lazily: importing the executor must
 # not pull jax/numpy-heavy ops modules until the first dispatch
@@ -208,10 +213,15 @@ def _oracle_compute(kind: str, payload):
             acc = pycurve.g1.add(acc, pycurve.g1.mul(p, int(s)
                                                      % pycurve.R))
         return acc
+    if kind == "das":
+        from ..das.sampling import verify_sample_host
+
+        return verify_sample_host(payload)
     raise KeyError(f"no oracle fallback for request kind {kind!r}")
 
 
-ORACLE_KINDS = frozenset({"verify", "pairing", "msm", "sha256", "fr"})
+ORACLE_KINDS = frozenset({"verify", "pairing", "msm", "sha256", "fr",
+                          "das"})
 
 
 class ServeExecutor:
@@ -313,6 +323,14 @@ class ServeExecutor:
         fail eagerly at dispatch and poison only their own handle."""
         return self._submit("proof", (forest, list(indices)))
 
+    def submit_das_sample(self, sample) -> DeviceFuture:
+        """One data-column sampling check (`das.sampling.DasSample`):
+        host inclusion walk + the column's cell proofs as one batched
+        RLC device check.  Settles to bool; a structurally broken or
+        inclusion-failing sample settles False without touching the
+        device."""
+        return self._submit("das", sample)
+
     # --- pipeline -----------------------------------------------------------
 
     def pump(self, settle_all: bool = False) -> None:
@@ -406,6 +424,11 @@ class ServeExecutor:
             elif kind == "fr":
                 from ..ops.fr_batch import barycentric_eval_async
                 fut = barycentric_eval_async(*reqs[0].payload)
+            elif kind == "das":
+                from ..das.sampling import verify_sample_async
+                # device=True: serve kinds always take the device path
+                # (the breaker's oracle fallback is the host route)
+                fut = verify_sample_async(reqs[0].payload, device=True)
             else:   # proof
                 from ..parallel.incremental import emit_proofs_async
                 fut = emit_proofs_async(*reqs[0].payload)

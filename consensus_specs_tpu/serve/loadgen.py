@@ -19,6 +19,10 @@ Arrival model (per mainnet slot, 12 s):
      2  batched SSZ single-proof emissions from a persistent
         `parallel.incremental.MerkleForest` (`submit_proof_request` —
         the stateless-client proof queries light clients issue)
+     2  data-column sampling checks (`submit_das_sample` — the PeerDAS
+        custody columns a node re-verifies per slot, each one batched
+        RLC cell-proof equation; CST_DAS_SAMPLES_PER_SLOT overrides,
+        0 disables the lane)
 
 `rate <= 0` switches to closed-loop mode: the generator keeps
 `max_batch * (depth + 1)` requests outstanding and the measured rate IS
@@ -53,9 +57,15 @@ SYNC_STATEMENTS_PER_SLOT = 1
 KZG_EVALS_PER_SLOT = 6
 SHA_ROOTS_PER_SLOT = 1
 PROOF_REQUESTS_PER_SLOT = 2             # stateless-client proof queries
+
+
+# an unparseable value fails loudly at import, like every other
+# CST_SERVE_* knob — a typo'd "disable" must not silently run the lane
+DAS_SAMPLES_PER_SLOT = max(
+    0, int(os.environ.get("CST_DAS_SAMPLES_PER_SLOT", 2)))
 STATEMENTS_PER_SLOT = (ATT_STATEMENTS_PER_SLOT + SYNC_STATEMENTS_PER_SLOT
                        + KZG_EVALS_PER_SLOT + SHA_ROOTS_PER_SLOT
-                       + PROOF_REQUESTS_PER_SLOT)
+                       + PROOF_REQUESTS_PER_SLOT + DAS_SAMPLES_PER_SLOT)
 STEADY_TOL = 0.2
 
 
@@ -161,6 +171,17 @@ def _sha_payload():
     return (np.arange(64, dtype=np.uint32).reshape(8, 8), 3)
 
 
+def _das_payloads(n_blobs: int = 2, columns=(0, 17)):
+    """A tiny closed-form sampling matrix cut into per-column
+    `DasSample`s (cycled by the das lane) — real pairing statements,
+    zero MSM setup cost (`das.ciphersuite.closed_form_matrix`)."""
+    from ..das.ciphersuite import closed_form_matrix
+    from ..das.sampling import sample_from_matrix
+
+    matrix = closed_form_matrix(n_blobs, columns=columns)
+    return [sample_from_matrix(*matrix, column) for column in columns]
+
+
 def _proof_payload(n_leaves: int = 256, batch: int = 16):
     """A persistent `MerkleForest` plus one index batch — the
     `submit_proof_request` payload shape (the forest is built once and
@@ -194,10 +215,13 @@ def make_submitter(ex, pool, payloads, track=None):
         + ["pairing"] * SYNC_STATEMENTS_PER_SLOT
         + ["fr"] * KZG_EVALS_PER_SLOT
         + ["sha256"] * SHA_ROOTS_PER_SLOT
-        + ["proof"] * PROOF_REQUESTS_PER_SLOT)
+        + ["proof"] * PROOF_REQUESTS_PER_SLOT
+        + ["das"] * DAS_SAMPLES_PER_SLOT)
     pool_iter = itertools.cycle(pool)
+    das_iter = itertools.cycle(payloads["das"]) if payloads.get("das") \
+        else None
     kinds_submitted = {k: 0 for k in ("verify", "pairing", "fr",
-                                      "sha256", "proof")}
+                                      "sha256", "proof", "das")}
 
     def submit_next():
         kind = next(schedule)
@@ -210,6 +234,8 @@ def make_submitter(ex, pool, payloads, track=None):
             fut = ex.submit_barycentric(*payloads["fr"])
         elif kind == "sha256":
             fut = ex.submit_sha256_root(*payloads["sha256"])
+        elif kind == "das":
+            fut = ex.submit_das_sample(next(das_iter))
         else:
             fut = ex.submit_proof_request(*payloads["proof"])
         if track is not None:
@@ -261,6 +287,10 @@ def _warm_kernels(cfg: LoadConfig, pool, payloads) -> float:
     barycentric_eval_async(*payloads["fr"]).result()
     merkleize_words_jax_async(*payloads["sha256"]).result()
     emit_proofs_async(*payloads["proof"]).result()
+    if payloads.get("das"):
+        from ..das.sampling import verify_sample_async
+
+        verify_sample_async(payloads["das"][0], device=True).result()
     return time.perf_counter() - t0
 
 
@@ -303,7 +333,8 @@ def run_load(cfg: LoadConfig | None = None, executor=None) -> dict:
     pool = build_statement_pool(cfg.pool, cfg.committee)
     payloads = {"pairing": _pairing_payload(pool[0]),
                 "fr": _fr_payload(), "sha256": _sha_payload(),
-                "proof": _proof_payload()}
+                "proof": _proof_payload(),
+                "das": (_das_payloads() if DAS_SAMPLES_PER_SLOT else [])}
     warm_s = _warm_kernels(cfg, pool, payloads)
     # a CST_FAULTS plan goes live only AFTER warmup: AOT precompile is
     # setup, not served traffic — the plan's fault budget must land on

@@ -461,6 +461,54 @@ def validate_scaling_block(obj) -> list[str]:
     return problems
 
 
+def validate_das_block(obj) -> list[str]:
+    """Schema check for the bench `"das"` sub-object (the PeerDAS
+    cell-proof sampling-matrix sweep `bench.py --worker das` emits);
+    returns problems (empty == valid).  Pinned by `bench_smoke.py
+    --das` and tests/test_das.py."""
+    if not isinstance(obj, dict):
+        return [f"das block is {type(obj).__name__}, not dict"]
+    problems: list[str] = []
+    matrix = obj.get("matrix")
+    if not isinstance(matrix, dict):
+        problems.append("'matrix' must be a dict")
+    else:
+        for key in ("columns", "blobs", "cells"):
+            v = matrix.get(key)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 1:
+                problems.append(f"matrix[{key!r}] must be a positive "
+                                f"int, got {v!r}")
+        if (isinstance(matrix.get("columns"), int)
+                and isinstance(matrix.get("blobs"), int)
+                and isinstance(matrix.get("cells"), int)
+                and matrix["cells"] !=
+                matrix["columns"] * matrix["blobs"]):
+            problems.append("matrix['cells'] must equal columns * blobs")
+    for key in ("verify_wall_s", "cells_per_s", "oracle_wall_s",
+                "speedup"):
+        v = obj.get(key)
+        if not isinstance(v, (int, float)) or isinstance(v, bool) \
+                or v <= 0:
+            problems.append(f"{key!r} must be a positive number, "
+                            f"got {v!r}")
+    for key in ("oracle_cells_measured", "rung"):
+        v = obj.get(key)
+        if not isinstance(v, int) or isinstance(v, bool) or v < 1:
+            problems.append(f"{key!r} must be a positive int, got {v!r}")
+    if obj.get("batch_verdict") is not True:
+        problems.append("'batch_verdict' must be True (the swept "
+                        "matrix is valid by construction)")
+    iso = obj.get("isolate")
+    if not isinstance(iso, dict) or not isinstance(
+            iso.get("isolated"), bool):
+        problems.append("'isolate' must carry a bool 'isolated' (the "
+                        "mixed-invalid recheck arc)")
+    if not isinstance(obj.get("eval_crosscheck"), bool):
+        problems.append("'eval_crosscheck' must be a bool (the coset "
+                        "barycentric agreement check)")
+    return problems
+
+
 def embed_bench_block(record: dict) -> dict:
     """The shared per-config bench protocol: attach the current
     `"telemetry"` block to a metric record and reset the per-config

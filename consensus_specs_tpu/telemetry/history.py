@@ -55,6 +55,12 @@ Record schema (version `SCHEMA`; one JSON object per line):
                                  # w/ restore-vs-rebuild speedup as
                                  # vs_baseline, journal depth, snapshot
                                  # bytes)
+     "das": dict,                # compacted PeerDAS sampling-matrix
+                                 # block (source "das"; metric
+                                 # "das::verify_wall@<cols>x<blobs>"
+                                 # per swept matrix + "das::speedup"
+                                 # vs the pure-Python oracle +
+                                 # "das::cells_per_s" throughput)
      "scaling": dict,            # compacted mesh-sharded flagship rung
                                  # (source "scaling"; metric
                                  # "scaling::flagship@<n>" per rung wall
@@ -85,7 +91,7 @@ SCHEMA = 1
 
 SOURCES = ("bench_round", "multichip_round", "baseline", "bench_emit",
            "pytest_snapshot", "costmodel", "serve", "resilience",
-           "mesh", "checkpoint", "scaling")
+           "mesh", "checkpoint", "scaling", "das")
 
 _ROUND_FILE_RE = re.compile(r"(?:BENCH|MULTICHIP)_r(\d+)\.json$")
 
@@ -411,6 +417,50 @@ def scaling_records(metric: str, sc, **context) -> list[dict]:
     return records
 
 
+def das_records(metric: str, das, **context) -> list[dict]:
+    """`das`-source history records mined from one metric line's
+    PeerDAS `"das"` sub-object (`bench.py --worker das` /
+    `bench_smoke.py --das`): the verification wall for the swept
+    sampling matrix (carrying the compact block, speedup as
+    `vs_baseline`), the `das::speedup` record the CPU-evaluated
+    `das-speedup` threshold row gates on, and the `das::cells_per_s`
+    throughput record the TPU-gated `das-throughput` row reads.
+    Malformed blocks yield zero records, never an exception."""
+    if not isinstance(das, dict):
+        return []
+    matrix = das.get("matrix")
+    wall = das.get("verify_wall_s")
+    if not isinstance(matrix, dict) \
+            or not isinstance(wall, (int, float)) \
+            or isinstance(wall, bool):
+        return []
+    cols, blobs = matrix.get("columns"), matrix.get("blobs")
+    if not isinstance(cols, int) or not isinstance(blobs, int) \
+            or isinstance(cols, bool) or isinstance(blobs, bool):
+        return []
+    compact = {k: das[k] for k in (
+        "matrix", "rung", "oracle_wall_s", "oracle_cells_measured",
+        "compile_first_s", "batch_verdict", "isolate",
+        "eval_crosscheck") if k in das}
+    speedup = das.get("speedup")
+    speedup = speedup if isinstance(speedup, (int, float)) \
+        and not isinstance(speedup, bool) else None
+    records = [make_record(
+        "das", f"das::verify_wall@{cols}x{blobs}", wall, unit="s",
+        vs_baseline=speedup, das=compact, via_metric=metric,
+        **context)]
+    if speedup is not None:
+        records.append(make_record(
+            "das", "das::speedup", speedup, unit="x",
+            via_metric=metric, **context))
+    cps = das.get("cells_per_s")
+    if isinstance(cps, (int, float)) and not isinstance(cps, bool):
+        records.append(make_record(
+            "das", "das::cells_per_s", cps, unit="cells/s",
+            via_metric=metric, **context))
+    return records
+
+
 def costmodel_records(metric: str, tel, **context) -> list[dict]:
     """Per-kernel `costmodel`-source history records mined from one
     metric line's telemetry sub-object (joined roofline records from
@@ -539,6 +589,9 @@ def parse_bench_round(path) -> tuple[list[dict], list[str]]:
             rc=rc, platform=obj.get("platform")))
         records.extend(scaling_records(
             name, obj.get("scaling"), round=rnd, file=path.name,
+            rc=rc, platform=obj.get("platform")))
+        records.extend(das_records(
+            name, obj.get("das"), round=rnd, file=path.name,
             rc=rc, platform=obj.get("platform")))
         for crec in costmodel_records(
                 name, obj.get("telemetry"), round=rnd, file=path.name,
@@ -842,6 +895,10 @@ def emission_records(metric_line: dict, ts: float | None = None
                 name, obj.get("scaling"), platform=platform,
                 ts=round(ts, 1) if ts is not None else None):
             records.append(srec)
+        for drec in das_records(
+                name, obj.get("das"), platform=platform,
+                ts=round(ts, 1) if ts is not None else None):
+            records.append(drec)
         for crec in costmodel_records(
                 name, obj.get("telemetry"), platform=platform,
                 ts=round(ts, 1) if ts is not None else None):

@@ -172,6 +172,21 @@ THRESHOLDS = (
      "title": "8M-validator flagship rung completes (no OOM)",
      "metric": r"scaling::flagship_8m_ok",
      "field": "value", "op": ">=", "target": 1.0, "tpu_only": True},
+    # DAS / PeerDAS (the batched cell-proof workload): the device
+    # route over a full 128-column sampling matrix must beat the
+    # pure-Python fulu oracle >= 2x — the oracle pays a Lagrange
+    # interpolation per cell, so the ratio is shape-bound and
+    # CPU-evaluable (the smoke measures it at 128x8).  Absolute
+    # throughput is a chip number: cells/s stays TPU-gated for the
+    # next round.
+    {"id": "das-speedup",
+     "title": "DAS cell-proof batch vs pure-Python oracle",
+     "metric": r"das::speedup",
+     "field": "value", "op": ">=", "target": 2.0, "tpu_only": False},
+    {"id": "das-throughput",
+     "title": "DAS sampling-matrix throughput (cells/s)",
+     "metric": r"das::cells_per_s",
+     "field": "value", "op": ">=", "target": 20000.0, "tpu_only": True},
     # checkpoint restore (PR 9): snapshot + journal replay must beat
     # the full O(N) re-merkleize >= 5x at <= 1% journal depth (the
     # speedup rides the restore record's vs_baseline).  Shape-, not
@@ -814,6 +829,58 @@ def render_scaling(records) -> list[str]:
     return lines
 
 
+def render_das(records) -> list[str]:
+    """The PeerDAS read side: per-matrix verification walls from the
+    latest `das::verify_wall@<cols>x<blobs>` records (the compact
+    block rides each), plus the latest speedup/throughput summary."""
+    lines = ["## DAS (PeerDAS cell-proof sampling)\n"]
+    recs = [r for r in records if r.get("source") == "das"]
+    if not recs:
+        lines.append("No das records — run the sampling-matrix sweep "
+                     "(`python bench.py --worker das` on the chip, or "
+                     "`make das-smoke` for the CPU contract check) to "
+                     "produce `das::*` records.\n")
+        return lines
+    rows: dict[tuple[int, int], dict] = {}
+    for r in sorted((r for r in recs
+                     if r["metric"].startswith("das::verify_wall@")
+                     and isinstance(r.get("das"), dict)),
+                    key=_order_key):
+        m = (r["das"].get("matrix") or {})
+        c, b = m.get("columns"), m.get("blobs")
+        if isinstance(c, int) and isinstance(b, int):
+            rows[(c, b)] = r
+    if rows:
+        lines.append("| matrix | cells | verify wall | vs oracle | "
+                     "rung | platform | where |")
+        lines.append("|---|---|---|---|---|---|---|")
+        for (c, b), r in sorted(rows.items()):
+            blk = r["das"]
+            cells = (blk.get("matrix") or {}).get("cells")
+            vs = r.get("vs_baseline")
+            lines.append(
+                f"| {c}x{b} | {cells} | {_fmt(r.get('value'), 4)} s "
+                f"| {'—' if vs is None else f'{_fmt(vs, 1)}x'} "
+                f"| {blk.get('rung', '—')} | {_platform_group(r)} "
+                f"| {_where(r)} |")
+        lines.append("")
+    sp = [r for r in recs if r["metric"] == "das::speedup"]
+    if sp:
+        latest = max(sp, key=_order_key)
+        lines.append(
+            f"Latest speedup over the pure-Python oracle: "
+            f"{_fmt(latest['value'], 1)}x ({_where(latest)}, platform "
+            f"{_platform_group(latest)}).\n")
+    cps = [r for r in recs if r["metric"] == "das::cells_per_s"]
+    if cps:
+        latest = max(cps, key=_order_key)
+        lines.append(
+            f"Latest throughput: {_si(latest['value'])} cells/s "
+            f"({_where(latest)}, platform "
+            f"{_platform_group(latest)}).\n")
+    return lines
+
+
 def render_msm(msm: dict) -> list[str]:
     lines = ["## `_MSM_DEVICE_MIN` break-even\n", msm["text"] + "\n"]
     if msm.get("sizes"):
@@ -881,6 +948,7 @@ def render_report(result: dict) -> str:
                                     result["max_regress_pct"]))
     lines.extend(render_resilience(result["records"]))
     lines.extend(render_scaling(result["records"]))
+    lines.extend(render_das(result["records"]))
     lines.extend(render_msm(result["msm"]))
     lines.extend(render_utilization(result["utilization"], result["msm"]))
     lines.extend(render_trend_tables(result["records"]))

@@ -1,0 +1,655 @@
+"""DAS subsystem tests — kernel-vs-oracle bit-exactness, the rung
+ladder, the async facade contract, and the serve `das` lane.
+
+The fulu spec oracle (`models/fulu/polynomial_commitments_sampling.py`)
+is the correctness reference throughout: the host route must match it
+statement-for-statement (challenge bytes, interpolation coefficients,
+accept/reject verdicts, raise-on-malformed), and the device route must
+match the host route.  Tests that compile the curve kernels (pairing /
+MSM) at large shapes are @slow like every other RLC-compiling test;
+the fr_batch coset kernels compile in well under a second on CPU and
+stay tier-1.
+"""
+
+import pytest
+
+from consensus_specs_tpu.das import ciphersuite as das_cs
+from consensus_specs_tpu.das import compute as das_compute
+from consensus_specs_tpu.das import sampling as das_sampling
+from consensus_specs_tpu.das import verify as das_verify
+from consensus_specs_tpu.models.builder import build_spec
+from consensus_specs_tpu.ops import bls
+
+
+@pytest.fixture(scope="module")
+def spec():
+    return build_spec("fulu", "minimal")
+
+
+@pytest.fixture()
+def real_bls():
+    prev = bls.bls_active
+    bls.bls_active = True
+    yield
+    bls.bls_active = prev
+
+
+@pytest.fixture(scope="module")
+def matrix():
+    """A small valid closed-form matrix: 2 rows x 4 columns spanning
+    both domain halves."""
+    return das_cs.closed_form_matrix(2, columns=[0, 3, 64, 127])
+
+
+def _tamper_cell(cells, k):
+    cells = list(cells)
+    cells[k] = cells[k][:-32] + int.to_bytes(7, 32, "big")
+    return cells
+
+
+# --- ciphersuite: tables, challenge, parsing --------------------------------
+
+
+def test_coset_tables_match_oracle(spec):
+    for k in (0, 1, 63, 64, 127):
+        assert das_cs.coset_shift(k) == int(
+            spec.coset_shift_for_cell(spec.CellIndex(k)))
+        assert list(das_cs.coset_points(k)) == [
+            int(v) for v in spec.coset_for_cell(spec.CellIndex(k))]
+
+
+def test_challenge_matches_oracle_bit_for_bit(spec, matrix):
+    com, idx, cells, proofs = matrix
+    batch = das_cs.parse_cell_batch(com, idx, cells, proofs)
+    want = spec.compute_verify_cell_kzg_proof_batch_challenge(
+        [spec.KZGCommitment(c) for c in batch.commitment_bytes],
+        batch.commitment_indices,
+        [spec.CellIndex(i) for i in batch.cell_indices],
+        [[spec.BLSFieldElement(e) for e in row] for row in batch.evals],
+        [spec.KZGProof(p) for p in batch.proof_bytes])
+    assert batch.r == int(want)
+    # r_powers are the oracle's compute_powers
+    assert batch.r_powers == [int(p) for p in spec.compute_powers(
+        want, len(batch.cell_indices))]
+
+
+def test_parse_rejects_malformed_like_oracle(spec, matrix, real_bls):
+    com, idx, cells, proofs = matrix
+
+    def mutations():
+        yield com[:-1], idx, cells, proofs              # length mismatch
+        yield com, [int(spec.CELLS_PER_EXT_BLOB)] + idx[1:], cells, \
+            proofs                                      # index range
+        yield com, idx, [cells[0][:-1]] + cells[1:], proofs  # cell size
+        yield com, idx, cells, [b"\x01" * 48] + proofs[1:]   # bad point
+        yield [b"\xaa" * 48] + com[1:], idx, cells, proofs   # bad point
+        # a cell carrying a non-canonical field element
+        big = (das_cs.BLS_MODULUS).to_bytes(32, "big")
+        yield com, idx, [cells[0][:-32] + big] + cells[1:], proofs
+
+    for m_com, m_idx, m_cells, m_proofs in mutations():
+        with pytest.raises(AssertionError):
+            das_cs.parse_cell_batch(m_com, m_idx, m_cells, m_proofs)
+        with pytest.raises(AssertionError):
+            spec.verify_cell_kzg_proof_batch(
+                m_com, m_idx, [spec.Cell(c) if len(c) == int(
+                    spec.BYTES_PER_CELL) else c for c in m_cells],
+                m_proofs)
+
+
+def test_interpolation_matches_oracle(spec, matrix):
+    _, idx, cells, _ = matrix
+    k = idx[1]
+    evals = [int(e) for e in spec.cell_to_coset_evals(
+        spec.Cell(cells[1]))]
+    want = [int(c) for c in spec.interpolate_polynomialcoeff(
+        spec.coset_for_cell(spec.CellIndex(k)),
+        [spec.BLSFieldElement(e) for e in evals])]
+    assert das_cs.interpolate_coset_coeffs(k, evals) == want
+
+
+# --- fr_batch coset kernels --------------------------------------------------
+
+
+def test_coset_interpolate_kernel_matches_host(matrix):
+    from consensus_specs_tpu.ops import fr_batch
+
+    com, idx, cells, proofs = matrix
+    batch = das_cs.parse_cell_batch(com, idx, cells, proofs)
+    weights = das_verify._rli_weight_rows(batch)
+    got = fr_batch.coset_interpolate_sum(
+        batch.evals, das_cs.coset_idft_matrix(), weights)
+    assert got == das_verify._host_rli_coeffs(batch)
+
+
+def test_coset_interpolate_rung_ladder_shapes(matrix):
+    from consensus_specs_tpu.ops import fr_batch
+
+    assert [fr_batch.das_rung(n) for n in (1, 2, 16, 17, 128, 129,
+                                           1024, 1025, 4096)] == \
+        [16, 16, 16, 128, 128, 1024, 1024, 2048, 4096]
+    # batches inside one rung share the compiled kernel: K=3 and the
+    # K=8 matrix fixture both pad to rung 16, so the lru-cached jit
+    # factory hands back the SAME callable (one compiled executable)
+    com, idx, cells, proofs = matrix
+    batch = das_cs.parse_cell_batch(com[:3], idx[:3], cells[:3],
+                                    proofs[:3])
+    before = fr_batch._coset_interpolate_kernel.cache_info().currsize
+    fr_batch.coset_interpolate_sum(
+        batch.evals, das_cs.coset_idft_matrix(),
+        das_verify._rli_weight_rows(batch))
+    full = das_cs.parse_cell_batch(com, idx, cells, proofs)
+    fr_batch.coset_interpolate_sum(
+        full.evals, das_cs.coset_idft_matrix(),
+        das_verify._rli_weight_rows(full))
+    after = fr_batch._coset_interpolate_kernel.cache_info().currsize
+    assert after <= max(before, 1)
+
+
+def test_barycentric_coset_shift_matches_host(matrix):
+    from consensus_specs_tpu.ops import fr_batch
+
+    _, idx, cells, _ = matrix
+    z = 0xFEEDFACE
+    got = das_verify.evaluate_cells_at(cells[:2], idx[:2], z,
+                                       device=True)
+    want = das_verify.evaluate_cells_at(cells[:2], idx[:2], z,
+                                        device=False)
+    assert got == want
+    # h=1 keeps the classic roots-of-unity formula bit-compatible
+    from consensus_specs_tpu.serve.executor import _oracle_barycentric
+
+    r = fr_batch.R_MODULUS
+    g = pow(7, (r - 1) // 8, r)
+    roots = [pow(g, i, r) for i in range(8)]
+    poly = [(5 * i + 3) % r for i in range(8)]
+    assert fr_batch.barycentric_eval(poly, roots, 0x5050) == \
+        _oracle_barycentric(poly, roots, 0x5050)
+
+
+def test_evaluate_cells_at_in_domain_short_circuits(matrix):
+    _, idx, cells, _ = matrix
+    k = idx[0]
+    point = das_cs.coset_points(k)[5]
+    evals = [int.from_bytes(cells[0][i * 32:(i + 1) * 32], "big")
+             for i in range(64)]
+    for device in (False, True):
+        assert das_verify.evaluate_cells_at(
+            [cells[0]], [k], point, device=device) == [evals[5]]
+
+
+# --- verification: host route vs the spec oracle ----------------------------
+
+
+def test_host_verify_matches_oracle_verdicts(spec, matrix, real_bls):
+    com, idx, cells, proofs = matrix
+    sub = slice(0, 2)
+    wrapped = [spec.Cell(c) for c in cells[sub]]
+    assert spec.verify_cell_kzg_proof_batch(
+        com[sub], idx[sub], wrapped, proofs[sub]) is True
+    assert das_verify.verify_cell_proof_batch_host(
+        com[sub], idx[sub], cells[sub], proofs[sub]) is True
+    # one tampered cell flips both verdicts
+    bad = _tamper_cell(cells, 1)
+    assert spec.verify_cell_kzg_proof_batch(
+        com[sub], idx[sub], [spec.Cell(c) for c in bad[sub]],
+        proofs[sub]) is False
+    assert das_verify.verify_cell_proof_batch_host(
+        com[sub], idx[sub], bad[sub], proofs[sub]) is False
+
+
+def test_host_verify_closed_form_matrix_and_tampering(matrix):
+    com, idx, cells, proofs = matrix
+    assert das_verify.verify_cell_proof_batch_host(com, idx, cells,
+                                                   proofs)
+    assert not das_verify.verify_cell_proof_batch_host(
+        com, idx, _tamper_cell(cells, 2), proofs)
+    bad_proofs = list(proofs)
+    bad_proofs[0] = proofs[4]
+    assert not das_verify.verify_cell_proof_batch_host(
+        com, idx, cells, bad_proofs)
+    # empty batch accepts (the oracle's trivial case)
+    assert das_verify.verify_cell_proof_batch_host([], [], [], [])
+
+
+def test_host_isolation_flags_exactly_the_bad_cell(matrix):
+    com, idx, cells, proofs = matrix
+    bad = _tamper_cell(cells, 2)
+    ok, per = das_verify.verify_and_isolate(com, idx, bad, proofs,
+                                            device=False)
+    assert ok is False
+    assert per == [True, True, False] + [True] * (len(idx) - 3)
+
+
+def test_duplicate_commitments_dedup_like_oracle(spec, real_bls):
+    # 2 rows from the SAME closed-form polynomial: the commitment list
+    # carries duplicates, dedup folds their weights
+    com, idx, cells, proofs = das_cs.closed_form_matrix(
+        1, columns=[0, 64])
+    com2 = com + com
+    idx2 = idx + idx
+    cells2 = cells + cells
+    proofs2 = proofs + proofs
+    batch = das_cs.parse_cell_batch(com2, idx2, cells2, proofs2)
+    assert len(batch.commitments) == 1
+    assert batch.commitment_indices == [0, 0, 0, 0]
+    assert das_verify.verify_cell_proof_batch_host(
+        com2, idx2, cells2, proofs2)
+    assert spec.verify_cell_kzg_proof_batch(
+        com2, idx2, [spec.Cell(c) for c in cells2], proofs2)
+
+
+# --- the async facade contract ----------------------------------------------
+
+
+def test_async_facade_settles_once_and_propagates_errors(matrix):
+    com, idx, cells, proofs = matrix
+    fut = das_verify.verify_cell_proof_batch_async(
+        com[:1], idx[:1], cells[:1], proofs[:1], device=False)
+    assert fut.done()            # host route settles eagerly
+    assert fut.result() is True
+    assert fut.result() is True  # idempotent
+    # malformed input fails the handle instead of raising at submit
+    bad = das_verify.verify_cell_proof_batch_async(
+        com[:1], idx[:1], [cells[0][:-1]], proofs[:1], device=False)
+    assert bad.exception() is not None
+    with pytest.raises(AssertionError):
+        bad.result()
+
+
+def test_coset_interpolate_async_is_deferred(matrix):
+    from consensus_specs_tpu.ops import fr_batch
+
+    com, idx, cells, proofs = matrix
+    batch = das_cs.parse_cell_batch(com, idx, cells, proofs)
+    fut = fr_batch.coset_interpolate_sum_async(
+        batch.evals, das_cs.coset_idft_matrix(),
+        das_verify._rli_weight_rows(batch))
+    assert not fut.done()        # device-backed: settles at result()
+    out = fut.result()
+    assert fut.done() and fut.result() is out
+
+
+# --- compute: producer parity ------------------------------------------------
+
+
+def test_compute_cells_matches_spec(spec):
+    blob = b"".join(
+        int.to_bytes(pow(11, i + 3, das_cs.BLS_MODULUS), 32, "big")
+        for i in range(4096))
+    got = das_compute.compute_cells(blob)
+    want = [bytes(c) for c in spec.compute_cells(spec.Blob(blob))]
+    assert got == want
+
+
+def test_column_proof_matches_oracle_multiproof(spec):
+    blob = b"".join(
+        int.to_bytes(pow(11, i + 3, das_cs.BLS_MODULUS), 32, "big")
+        for i in range(4096))
+    k = 65
+    got = das_compute.cell_proof_for_column(blob, k, device=False)
+    coeff = spec.polynomial_eval_to_coeff(
+        spec.blob_to_polynomial(spec.Blob(blob)))
+    want, ys = spec.compute_kzg_proof_multi_impl(
+        coeff, spec.coset_for_cell(spec.CellIndex(k)))
+    assert got == bytes(want)
+    # and the produced statement verifies through the das host route
+    commitment = bytes(spec.blob_to_kzg_commitment(spec.Blob(blob)))
+    cells = das_compute.compute_cells(blob)
+    assert das_verify.verify_cell_proof_batch_host(
+        [commitment], [k], [cells[k]], [got])
+
+
+# --- sampling ----------------------------------------------------------------
+
+
+def test_inclusion_proof_walk():
+    from hashlib import sha256
+
+    leaf = b"\x01" * 32
+    sib0 = b"\x02" * 32
+    sib1 = b"\x03" * 32
+    # index 2 (binary 10): leaf hashes LEFT at level 0, RIGHT at level 1
+    level1 = sha256(leaf + sib0).digest()
+    root = sha256(sib1 + level1).digest()
+    proof = das_sampling.InclusionProof(leaf=leaf, branch=[sib0, sib1],
+                                        index=2, root=root)
+    assert das_sampling.verify_inclusion(proof)
+    assert not das_sampling.verify_inclusion(
+        das_sampling.InclusionProof(leaf=sib0, branch=[sib0, sib1],
+                                    index=2, root=root))
+
+
+def test_verify_sample_structural_and_inclusion_rejects(matrix):
+    com, idx, cells, proofs = matrix
+    sample = das_sampling.sample_from_matrix(com, idx, cells, proofs, 3)
+    assert sample.column_index == 3 and len(sample.cells) == 2
+    assert das_sampling.verify_sample(sample, device=False)
+    # length mismatch rejects before any crypto
+    broken = das_sampling.DasSample(
+        column_index=3, commitments=sample.commitments,
+        cells=sample.cells, proofs=sample.proofs[:-1])
+    assert das_sampling.verify_sample(broken, device=False) is False
+    # column index out of range
+    oob = das_sampling.DasSample(
+        column_index=das_cs.CELLS_PER_EXT_BLOB,
+        commitments=sample.commitments, cells=sample.cells,
+        proofs=sample.proofs)
+    assert das_sampling.verify_sample(oob, device=False) is False
+    # failing inclusion proof rejects without touching the cells
+    bad_inc = das_sampling.DasSample(
+        column_index=3, commitments=sample.commitments,
+        cells=sample.cells, proofs=sample.proofs,
+        inclusion=das_sampling.InclusionProof(
+            leaf=b"\x00" * 32, branch=[b"\x01" * 32], index=0,
+            root=b"\x02" * 32))
+    assert das_sampling.verify_sample(bad_inc, device=False) is False
+
+
+def test_sample_from_sidecar_roundtrip(spec):
+    """The zero-blob closed-form sidecar (no MSMs) adapts into a
+    DasSample whose inclusion proof passes the host walk."""
+    from consensus_specs_tpu.testlib.context import (
+        default_activation_threshold)
+    from consensus_specs_tpu.testlib.helpers.block import (
+        build_empty_block_for_next_slot, sign_block)
+    from consensus_specs_tpu.testlib.helpers.genesis import (
+        create_genesis_state)
+
+    g1_inf = b"\xc0" + b"\x00" * 47
+    state = create_genesis_state(
+        spec, [int(spec.MAX_EFFECTIVE_BALANCE)] * 64,
+        default_activation_threshold(spec))
+    n_cells = int(spec.CELLS_PER_EXT_BLOB)
+    block = build_empty_block_for_next_slot(spec, state)
+    block.body.blob_kzg_commitments = [spec.KZGCommitment(g1_inf)]
+    signed = sign_block(spec, state, block)
+    sidecar = spec.get_data_column_sidecars_from_block(
+        signed, [([spec.Cell()] * n_cells,
+                  [spec.KZGProof(g1_inf)] * n_cells)])[0]
+    sample = das_sampling.sample_from_sidecar(spec, sidecar)
+    assert das_sampling.verify_inclusion(sample.inclusion)
+    assert das_sampling.verify_sample(sample, device=False)
+    # a tampered commitment list fails the inclusion walk
+    sample.inclusion.leaf = b"\xff" * 32
+    assert das_sampling.verify_sample(sample, device=False) is False
+
+
+# --- the serve `das` lane ----------------------------------------------------
+
+
+def test_serve_das_lane_host_routed(matrix, monkeypatch):
+    """submit_das_sample end to end with the dispatch routed to the
+    host verifier (the device arc is @slow below): valid and invalid
+    samples settle their own verdicts, kind ordering preserved."""
+    from consensus_specs_tpu.das import sampling as sampling_mod
+    from consensus_specs_tpu.serve.executor import ServeExecutor
+
+    orig_async = sampling_mod.verify_sample_async
+    monkeypatch.setattr(
+        sampling_mod, "verify_sample_async",
+        lambda sample, device=None: orig_async(sample, device=False))
+
+    com, idx, cells, proofs = matrix
+    good = das_sampling.sample_from_matrix(com, idx, cells, proofs, 0)
+    bad = das_sampling.sample_from_matrix(
+        com, idx, _tamper_cell(cells, 0), proofs, 0)
+    ex = ServeExecutor(max_batch=8, depth=1)
+    f_good = ex.submit_das_sample(good)
+    f_bad = ex.submit_das_sample(bad)
+    ex.drain()
+    assert f_good.result() is True
+    assert f_bad.result() is False
+    st = ex.stats()
+    assert st["settled"] == 2 and st["failed"] == 0
+
+
+def test_serve_das_breaker_falls_back_to_host_oracle(matrix,
+                                                     monkeypatch):
+    """A das dispatch failure walks the recovery ladder: the breaker
+    trips and the pure-host oracle answers (bit-identical verdicts)."""
+    from consensus_specs_tpu.das import sampling as sampling_mod
+    from consensus_specs_tpu.resilience.policies import BreakerRegistry
+    from consensus_specs_tpu.serve.executor import ServeExecutor
+
+    calls = {"n": 0}
+
+    def exploding(sample, device=None):
+        calls["n"] += 1
+        raise RuntimeError("device sick")
+
+    monkeypatch.setattr(sampling_mod, "verify_sample_async", exploding)
+    com, idx, cells, proofs = matrix
+    sample = das_sampling.sample_from_matrix(com, idx, cells, proofs, 0)
+    ex = ServeExecutor(max_batch=8, depth=1,
+                       breakers=BreakerRegistry(threshold=1))
+    f1 = ex.submit_das_sample(sample)
+    ex.drain()
+    # first dispatch failed and (threshold=1) tripped the breaker;
+    # the ladder answered on the host oracle — bit-identical verdict
+    assert f1.result() is True
+    assert ex.stats()["fallbacks"] >= 1
+    f2 = ex.submit_das_sample(sample)
+    ex.drain()
+    assert f2.result() is True
+    assert calls["n"] == 1       # breaker OPEN: no second device try
+
+
+def test_loadgen_schedule_carries_the_das_lane(monkeypatch):
+    from consensus_specs_tpu.serve import loadgen
+
+    class _StubEx:
+        def __init__(self):
+            self.kinds = []
+
+        def submit_verify_task(self, t):
+            self.kinds.append("verify")
+
+        def submit_pairing(self, p):
+            self.kinds.append("pairing")
+
+        def submit_barycentric(self, *a):
+            self.kinds.append("fr")
+
+        def submit_sha256_root(self, *a):
+            self.kinds.append("sha256")
+
+        def submit_proof_request(self, *a):
+            self.kinds.append("proof")
+
+        def submit_das_sample(self, s):
+            self.kinds.append("das")
+            self.sample = s
+
+    monkeypatch.setattr(loadgen, "DAS_SAMPLES_PER_SLOT", 2)
+    monkeypatch.setattr(loadgen, "STATEMENTS_PER_SLOT", 76)
+    ex = _StubEx()
+    samples = ["s0", "s1", "s2"]
+    submit, kinds = loadgen.make_submitter(
+        ex, ["task"], {"pairing": None, "fr": (1, 2, 3),
+                       "sha256": (None, 1), "proof": (None, [0]),
+                       "das": samples})
+    for _ in range(76):
+        submit()
+    assert kinds["das"] == 2
+    assert ex.kinds.count("das") == 2
+    assert ex.sample in samples
+
+
+# --- benchwatch wiring -------------------------------------------------------
+
+
+def _das_block(speedup=25.0, cells=1024, wall=2.5):
+    return {
+        "matrix": {"columns": 128, "blobs": cells // 128,
+                   "cells": cells},
+        "verify_wall_s": wall,
+        "cells_per_s": round(cells / wall, 1),
+        "oracle_wall_s": round(wall * speedup, 2),
+        "oracle_cells_measured": 16,
+        "speedup": speedup,
+        "rung": 1024,
+        "compile_first_s": 30.0,
+        "batch_verdict": True,
+        "isolate": {"bad_cells": 1, "isolated": True},
+        "eval_crosscheck": True,
+    }
+
+
+def test_das_block_schema_validates():
+    from consensus_specs_tpu.telemetry import validate_das_block
+
+    assert validate_das_block(_das_block()) == []
+    bad = _das_block()
+    bad["matrix"]["cells"] = 7
+    assert any("columns * blobs" in p for p in validate_das_block(bad))
+    assert validate_das_block("nope")
+    missing = _das_block()
+    del missing["speedup"]
+    assert any("speedup" in p for p in validate_das_block(missing))
+    noiso = _das_block()
+    noiso["isolate"] = {}
+    assert any("isolate" in p for p in validate_das_block(noiso))
+
+
+def test_das_history_records_and_thresholds(tmp_path):
+    from consensus_specs_tpu.telemetry import history, report
+
+    recs = history.das_records(
+        "das_cell_proof_batch_128x8_verify_wall", _das_block(),
+        platform="cpu", ts=1000.0)
+    by_metric = {r["metric"]: r for r in recs}
+    assert set(by_metric) == {"das::verify_wall@128x8", "das::speedup",
+                              "das::cells_per_s"}
+    for r in recs:
+        assert history.validate_record(r) == [], r
+        assert r["source"] == "das"
+    assert by_metric["das::verify_wall@128x8"]["vs_baseline"] == 25.0
+    assert by_metric["das::speedup"]["value"] == 25.0
+    # malformed blocks degrade to zero records, never raise
+    assert history.das_records("m", {"matrix": "x"}) == []
+    assert history.das_records("m", None) == []
+
+    hist = tmp_path / "h.jsonl"
+    history.append_records(hist, recs)
+    stored, skipped, _ = history.load_history(hist)
+    assert len(stored) == 3 and skipped == 0
+
+    rows = {t["id"]: t for t in report.evaluate_thresholds(stored)}
+    assert rows["das-speedup"]["status"] == "PASS"
+    # cpu-stamped throughput cannot satisfy the TPU-gated row
+    assert rows["das-throughput"]["status"] == "no data"
+    tpu = history.das_records("m", _das_block(wall=0.02),
+                              platform="tpu", ts=2000.0)
+    rows = {t["id"]: t
+            for t in report.evaluate_thresholds(stored + tpu)}
+    assert rows["das-throughput"]["status"] == "PASS"
+    # a sub-2x speedup FAILs the CPU-evaluated acceptance row
+    slow_recs = history.das_records("m", _das_block(speedup=1.5),
+                                    platform="cpu", ts=3000.0)
+    rows = {t["id"]: t
+            for t in report.evaluate_thresholds(stored + slow_recs)}
+    assert rows["das-speedup"]["status"] == "FAIL"
+
+
+def test_das_report_section_renders(tmp_path):
+    from consensus_specs_tpu.telemetry import history, report
+
+    recs = history.das_records(
+        "das_cell_proof_batch_128x8_verify_wall", _das_block(),
+        platform="cpu", ts=1000.0)
+    lines = "\n".join(report.render_das(recs))
+    assert "## DAS (PeerDAS cell-proof sampling)" in lines
+    assert "| 128x8 | 1024 |" in lines
+    assert "Latest speedup over the pure-Python oracle: 25x" in lines
+    empty = "\n".join(report.render_das([]))
+    assert "No das records" in empty
+
+
+# --- @slow: device-route end to end ------------------------------------------
+
+
+@pytest.mark.slow
+def test_device_verify_matches_host_and_oracle(spec, matrix, real_bls):
+    com, idx, cells, proofs = matrix
+    assert das_verify.verify_cell_proof_batch(
+        com, idx, cells, proofs, device=True) is True
+    bad = _tamper_cell(cells, 1)
+    assert das_verify.verify_cell_proof_batch(
+        com, idx, bad, proofs, device=True) is False
+    # direct oracle agreement on the same statements
+    assert spec.verify_cell_kzg_proof_batch(
+        com[:2], idx[:2], [spec.Cell(c) for c in cells[:2]],
+        proofs[:2]) is True
+    assert das_verify.verify_cell_proof_batch(
+        com[:2], idx[:2], cells[:2], proofs[:2], device=True) is True
+
+
+@pytest.mark.slow
+def test_device_verify_full_column_batch(real_bls):
+    """One full 128-column row x 2 blobs (256 cells, rung 1024...):
+    device verdict matches the host route on the identical batch, and
+    the mixed-invalid arc isolates exactly the bad cell."""
+    com, idx, cells, proofs = das_cs.closed_form_matrix(2)
+    assert len(idx) == 256
+    assert das_verify.verify_cell_proof_batch(
+        com, idx, cells, proofs, device=True) is True
+    assert das_verify.verify_cell_proof_batch_host(
+        com, idx, cells, proofs) is True
+    bad = _tamper_cell(cells, 200)
+    ok, per = das_verify.verify_and_isolate(com, idx, bad, proofs,
+                                            device=True)
+    assert ok is False
+    assert [i for i, v in enumerate(per) if not v] == [200]
+
+
+@pytest.mark.slow
+def test_device_full_compute_matches_column_route_and_oracle(spec):
+    """The D_u-partial full-proof route vs the independent per-column
+    quotient route (all 128 columns) and the oracle (2 columns)."""
+    blob = b"".join(
+        int.to_bytes(pow(13, i + 9, das_cs.BLS_MODULUS), 32, "big")
+        for i in range(4096))
+    cells, proofs = das_compute.compute_cells_and_kzg_proofs(
+        blob, device=False)
+    for k in range(0, 128, 17):
+        assert proofs[k] == das_compute.cell_proof_for_column(
+            blob, k, device=False), k
+    coeff = spec.polynomial_eval_to_coeff(
+        spec.blob_to_polynomial(spec.Blob(blob)))
+    for k in (0, 100):
+        want, _ = spec.compute_kzg_proof_multi_impl(
+            coeff, spec.coset_for_cell(spec.CellIndex(k)))
+        assert proofs[k] == bytes(want)
+    assert cells == das_compute.compute_cells(blob)
+
+
+@pytest.mark.slow
+def test_spec_namespace_routes_to_device_path(spec, real_bls):
+    """Under the jax backend the spec's own verify_cell_kzg_proof_batch
+    routes through the das device route with identical verdicts."""
+    com, idx, cells, proofs = das_cs.closed_form_matrix(
+        1, columns=[0, 9])
+    prev = bls.backend_name()
+    bls.use_backend("jax")
+    try:
+        assert spec.verify_cell_kzg_proof_batch(
+            com, idx, [spec.Cell(c) for c in cells], proofs) is True
+        assert spec.verify_cell_kzg_proof_batch(
+            com, idx, [spec.Cell(c) for c in _tamper_cell(cells, 0)],
+            proofs) is False
+    finally:
+        bls.use_backend(prev)
+
+
+@pytest.mark.slow
+def test_serve_das_lane_device_end_to_end(matrix):
+    from consensus_specs_tpu.serve.executor import ServeExecutor
+
+    com, idx, cells, proofs = matrix
+    good = das_sampling.sample_from_matrix(com, idx, cells, proofs, 64)
+    ex = ServeExecutor(max_batch=8, depth=1)
+    fut = ex.submit_das_sample(good)
+    ex.drain()
+    assert fut.result() is True
+    assert ex.stats()["failed"] == 0
