@@ -9,7 +9,7 @@ VECTOR_OUT ?= out/vectors
 
 .PHONY: test test-fast test-all test-bls lint vectors kzg_setups bench \
 	bench-smoke bench-report serve serve-smoke chaos-smoke \
-	chaos-mesh-smoke shard-smoke das-smoke multichip help
+	chaos-mesh-smoke shard-smoke das-smoke fc-smoke multichip help
 
 help:
 	@echo "targets: test (fast suite) | test-all (incl. slow crypto) |"
@@ -32,7 +32,10 @@ help:
 	@echo "  round-trip + report) | das-smoke (PeerDAS cell-proof sweep"
 	@echo "  at the 128x8 sampling matrix on CPU: das block schema,"
 	@echo "  >=2x speedup vs the pure-Python oracle, das::* round-trip"
-	@echo "  + report) | multichip (8-dev CPU dryrun)"
+	@echo "  + report) | fc-smoke (device LMD-GHOST sweep on a tiny CPU"
+	@echo "  tree: forkchoice block schema, >=2x speedup vs the phase0"
+	@echo "  spec oracle, bit-exact head parity, forkchoice::*"
+	@echo "  round-trip + report) | multichip (8-dev CPU dryrun)"
 
 test:
 	$(PYTHON) -m pytest tests/ -q -m "not slow"
@@ -135,6 +138,16 @@ shard-smoke:
 # the das::* history/report/threshold wiring (CI gates on this)
 das-smoke:
 	$(CPU_ENV) $(PYTHON) bench_smoke.py --das
+
+# no TPU required: the device LMD-GHOST sweep on a tiny CPU tree (64
+# blocks x 1024 validators).  Asserts the "forkchoice" block schema,
+# the >= 2x fc-speedup acceptance vs the phase0 spec oracle's
+# get_head (the oracle walks every active validator per child in pure
+# Python; measured on a validator subset and scaled linearly),
+# bit-exact device-vs-oracle head parity, and the forkchoice::*
+# history/report/threshold wiring (CI gates on this)
+fc-smoke:
+	$(CPU_ENV) $(PYTHON) bench_smoke.py --forkchoice
 
 multichip:
 	$(CPU_ENV) $(PYTHON) -c "import __graft_entry__ as g; g.dryrun_multichip(8); print('ok')"

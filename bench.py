@@ -765,6 +765,147 @@ def worker_das() -> None:
     print(json.dumps(out), flush=True)
 
 
+def worker_forkchoice() -> None:
+    """The fork-choice workload: device LMD-GHOST over proto-array
+    stores (CST_FC_MATRIX, default 256x16384 and 1024x262144 —
+    <blocks>x<validators> tree shapes).  Per shape the device route
+    (`forkchoice.store`: batched latest-message folds + the
+    pointer-jumping head kernel) is measured steady-state — apply wall
+    per attestation batch, head wall per poll, heads/s — and compared
+    against the phase0 spec oracle's `get_head`, which walks every
+    active validator per child in pure Python: the oracle wall is
+    measured on a CST_FC_ORACLE_VALIDATORS-validator store over the
+    SAME block tree (the per-poll cost is linear in the validator
+    count — the active-set loop dominates) and scaled linearly, the
+    same subset-scaling the DAS and flagship baselines use.  The
+    oracle store also pins bit-exact parity: the device head at the
+    measured subset size must equal the spec oracle's."""
+    from consensus_specs_tpu import telemetry
+
+    _worker_setup_jax()
+    from consensus_specs_tpu.forkchoice import (
+        FC_BATCH_STEPS,
+        FC_BLOCK_STEPS,
+        FC_VALIDATOR_STEPS,
+        fc_rung,
+    )
+
+    import jax
+
+    dev = jax.devices()[0]
+    raw = os.environ.get("CST_FC_MATRIX", "256x16384,1024x262144")
+    shapes = []
+    for part in raw.split(","):
+        if not part.strip():
+            continue
+        blocks, validators = part.lower().split("x")
+        shapes.append((int(blocks), int(validators)))
+    assert shapes and all(b >= 2 and v >= 8 for b, v in shapes), raw
+    oracle_v = max(8, int(os.environ.get("CST_FC_ORACLE_VALIDATORS",
+                                         2048)))
+    iters = 5
+    n_batches = 8
+
+    def build_store(n_blocks, n_validators, seed=29):
+        """The shared synthetic workload (`forkchoice.synthetic` —
+        same builder the serve loadgen's fc lane drives), with the
+        first `n_batches` of its attestation stream materialized."""
+        import itertools
+
+        from consensus_specs_tpu.forkchoice.synthetic import (
+            attestation_stream,
+            synthetic_store,
+        )
+
+        store, roots = synthetic_store(n_blocks, n_validators,
+                                       seed=seed)
+        batch = 1024 if n_validators >= 4096 else 64
+        batches = list(itertools.islice(
+            attestation_stream(roots, n_validators, batch, seed=seed),
+            n_batches))
+        return store, batches
+
+    out = {}
+    if telemetry.enabled():
+        telemetry.reset()
+    for n_blocks, n_validators in shapes:
+        store, batches = build_store(n_blocks, n_validators)
+        n_msgs = sum(len(b[0]) for b in batches)
+
+        t0 = time.perf_counter()
+        store.apply_attestations(*batches[0])
+        head = store.get_head()
+        compile_first = time.perf_counter() - t0
+        log(f"forkchoice {n_blocks}x{n_validators} compile+first: "
+            f"{compile_first:.1f}s")
+
+        apply_wall = head_wall = 0.0
+        polls = 0
+        for _ in range(iters):
+            for b in batches:
+                t0 = time.perf_counter()
+                store.apply_attestations(*b)
+                apply_wall += time.perf_counter() - t0
+                t0 = time.perf_counter()
+                head = store.get_head()
+                head_wall += time.perf_counter() - t0
+                polls += 1
+        apply_wall /= iters * n_batches
+        head_wall = max(head_wall / polls, 1e-9)
+        heads_per_s = 1.0 / head_wall
+
+        # the spec-oracle baseline + bit-exact parity, at the measured
+        # subset size over the SAME tree (per-poll oracle cost is
+        # linear in the validator count)
+        v_o = min(oracle_v, n_validators)
+        o_store, o_batches = build_store(n_blocks, v_o)
+        for b in o_batches:
+            o_store.apply_attestations(*b)
+        dev_head = o_store.get_head()
+        # untimed oracle warmup: the first get_head_host of the
+        # process pays the one-time spec-namespace build, which must
+        # not land in the scaled baseline (the device route's
+        # compile+first is likewise measured separately)
+        oracle_head = o_store.get_head_host()
+        t0 = time.perf_counter()
+        oracle_head = o_store.get_head_host()
+        oracle_sub = time.perf_counter() - t0
+        parity = dev_head == oracle_head
+        assert parity, (dev_head.hex(), oracle_head.hex())
+        oracle_wall = oracle_sub * n_validators / v_o
+        speedup = oracle_wall / head_wall
+        log(f"forkchoice {n_blocks}x{n_validators}: head "
+            f"{head_wall * 1e3:.2f}ms device vs {oracle_wall:.2f}s "
+            f"oracle ({speedup:.1f}x), apply {apply_wall * 1e3:.2f}ms")
+
+        block = {
+            "tree": {"blocks": n_blocks, "validators": n_validators,
+                     "messages": n_msgs},
+            "apply_wall_s": round(apply_wall, 6),
+            "head_wall_s": round(head_wall, 6),
+            "heads_per_s": round(heads_per_s, 1),
+            "oracle_head_wall_s": round(oracle_wall, 4),
+            "oracle_validators_measured": v_o,
+            "speedup": round(speedup, 1),
+            "rungs": {"blocks": fc_rung(n_blocks, FC_BLOCK_STEPS),
+                      "validators": fc_rung(n_validators,
+                                            FC_VALIDATOR_STEPS),
+                      "batch": fc_rung(len(batches[0][0]),
+                                       FC_BATCH_STEPS)},
+            "compile_first_s": round(compile_first, 2),
+            "parity": bool(parity),
+        }
+        rec = {"value": round(head_wall, 6), "unit": "s",
+               "vs_baseline": round(speedup, 1), "forkchoice": block}
+        if telemetry.enabled():
+            rec = telemetry.embed_bench_block(rec)
+        out[f"forkchoice_lmd_ghost_{n_blocks}x{n_validators}"
+            f"_head_wall"] = rec
+    out["platform"] = dev.platform
+    _stop_profile_trace()
+    print(json.dumps(out), flush=True)
+
+
 def worker_bls() -> None:
     """Configs #2/#3: attestation RLC batch + sync-aggregate pairing.
     With CST_TELEMETRY=1 each metric carries per-config compile/run,
@@ -1051,7 +1192,8 @@ def main():
     # budget and only when the flagship ran on the real chip; each
     # success re-prints a superset JSON line (drivers parsing the
     # first or the last line both see the flagship metric)
-    for mode in ("scaling", "merkle", "das", "bls", "kzg", "spec"):
+    for mode in ("scaling", "merkle", "das", "forkchoice", "bls", "kzg",
+                 "spec"):
         elapsed = time.time() - start
         if (result is None or platform is not None
                 or elapsed >= EXTRAS_DEADLINE):
@@ -1081,6 +1223,8 @@ if __name__ == "__main__":
             worker_merkle()
         elif sys.argv[2] == "das":
             worker_das()
+        elif sys.argv[2] == "forkchoice":
+            worker_forkchoice()
         elif sys.argv[2] == "bls":
             worker_bls()
         elif sys.argv[2] == "kzg":

@@ -52,6 +52,15 @@ per-cell Lagrange interpolation), the mixed-invalid isolation arc, the
 coset-barycentric cross-check, the `das::*` history round-trip, and
 the report's DAS section + threshold-row wiring.
 
+`bench_smoke.py --forkchoice` (the `make fc-smoke` lane) runs the
+device LMD-GHOST sweep on a tiny CPU tree (64 blocks x 1024
+validators): the `"forkchoice"` block schema
+(`validate_forkchoice_block`), the >= 2x fc-speedup acceptance
+criterion vs the phase0 spec oracle's `get_head` (shape-bound — the
+oracle walks every active validator per child in pure Python),
+bit-exact head parity, the `forkchoice::*` history round-trip, and
+the report's Fork choice section + threshold-row wiring.
+
 `bench_smoke.py --chaos-mesh` (the `make chaos-mesh-smoke` lane) runs
 the same round with CST_CHAOS_MESH=1 on the simulated 8-host-device
 CPU mesh (XLA_FLAGS=--xla_force_host_platform_device_count=8): a
@@ -847,6 +856,108 @@ def das_main():
     print("das smoke: PASS")
 
 
+def forkchoice_main():
+    """The fc-smoke lane (`make fc-smoke` / CI): the device LMD-GHOST
+    sweep on a tiny CPU tree, asserting the `"forkchoice"` block
+    schema, the >= 2x `fc-speedup` acceptance vs the phase0 spec
+    oracle (shape-bound: the oracle walks every active validator per
+    child in pure Python), bit-exact head parity, the `forkchoice::*`
+    history-record round-trip, and the report's Fork choice section —
+    `fc-speedup` must PASS on CPU, `fc-head-throughput` (a chip
+    number) must read 'no data'."""
+    from consensus_specs_tpu.telemetry import validate_forkchoice_block
+
+    hist_env = os.environ.get("CST_BENCHWATCH_HISTORY")
+    hist_file = Path(hist_env) if hist_env \
+        else HERE / "out" / "smoke_fc_history.jsonl"
+    hist_file.parent.mkdir(exist_ok=True)
+    if not hist_env and hist_file.exists():
+        hist_file.unlink()
+    fc_t0 = time.time()
+    out = _run(["bench.py", "--worker", "forkchoice"],
+               {"CST_FC_MATRIX": "64x1024",
+                "CST_FC_ORACLE_VALIDATORS": "256",
+                "CST_NO_COMPILE_CACHE": "1", "CST_TELEMETRY": "1"},
+               timeout=900)
+    last = out[-1]
+    rec = last.get("forkchoice_lmd_ghost_64x1024_head_wall")
+    assert isinstance(rec, dict) and rec.get("value", 0) > 0, last
+    block = rec.get("forkchoice")
+    problems = validate_forkchoice_block(block)
+    assert not problems, (problems, json.dumps(block)[:500])
+    assert block["tree"]["blocks"] == 64, block
+    assert block["tree"]["validators"] == 1024, block
+    assert block["rungs"]["blocks"] == 64, block
+    # the acceptance criteria: >= 2x over the spec oracle on this CPU,
+    # with the device head bit-identical to the oracle's
+    assert block["speedup"] >= 2.0, block
+    assert block["parity"] is True, block
+    assert rec["vs_baseline"] == block["speedup"], rec
+    _check_telemetry(rec, "forkchoice worker")
+    print("forkchoice worker JSON OK:", json.dumps(
+        {k: v for k, v in rec.items() if k != "telemetry"}))
+
+    # the forkchoice record kind round-trips through the store (the
+    # parent appends, like the driver does for extras workers)
+    prev_hist = os.environ.get("CST_BENCHWATCH_HISTORY")
+    os.environ["CST_BENCHWATCH_HISTORY"] = str(hist_file)
+    try:
+        benchwatch.append_emission(
+            dict(rec, metric="forkchoice_lmd_ghost_64x1024_head_wall",
+                 platform=last.get("platform", "cpu")),
+            ts=time.time())
+    finally:
+        if prev_hist is None:
+            os.environ.pop("CST_BENCHWATCH_HISTORY", None)
+        else:
+            os.environ["CST_BENCHWATCH_HISTORY"] = prev_hist
+    hist_records, skipped, warns = benchwatch.load_history(hist_file)
+    fresh = {r["metric"]: r for r in hist_records
+             if isinstance(r.get("ts"), (int, float))
+             and r["ts"] >= fc_t0 - 5}
+    for name in ("forkchoice_lmd_ghost_64x1024_head_wall",
+                 "forkchoice::head_wall@64x1024", "forkchoice::speedup",
+                 "forkchoice::heads_per_s"):
+        hrec = fresh.get(name)
+        assert hrec is not None, (name, sorted(fresh))
+        assert not benchwatch.validate_record(hrec), hrec
+        assert hrec["platform"] == "cpu", hrec
+        if name.startswith("forkchoice::"):
+            assert hrec["source"] == "forkchoice", hrec
+    wrec = fresh["forkchoice::head_wall@64x1024"]
+    assert wrec["forkchoice"]["tree"]["blocks"] == 64, wrec
+    assert wrec["vs_baseline"] >= 2.0, wrec
+    print(f"forkchoice history OK: {len(fresh)} records this run -> "
+          f"{hist_file}")
+
+    # the report renders the Fork choice section and the threshold
+    # rows wire up: fc-speedup PASSes from the CPU record,
+    # fc-head-throughput (a chip number) reads 'no data'
+    from consensus_specs_tpu.telemetry import report as bw_report
+
+    report_md = HERE / "out" / "smoke_fc_report.md"
+    rc = bw_report.main(["--repo", str(HERE), "--history",
+                         str(hist_file), "--out", str(report_md),
+                         "--no-update"])
+    assert rc == 0, f"benchwatch report exited {rc}"
+    text = report_md.read_text()
+    assert "## Fork choice (device LMD-GHOST)" in text, text[:2000]
+    assert "| 64x1024 |" in text, text
+    assert "Latest head speedup over the phase0 spec oracle:" in text
+    result = bw_report.build_report(
+        repo=HERE, history_path=hist_file, snapshots=[],
+        durations_path=None, top_n=5, strict=False,
+        max_regress_pct=0.0, update_history=False)
+    rows = {t["id"]: t for t in result["thresholds"]}
+    assert rows["fc-speedup"]["status"] == "PASS", rows["fc-speedup"]
+    assert rows["fc-head-throughput"]["status"] == "no data", \
+        rows["fc-head-throughput"]
+    print(f"forkchoice report OK: Fork choice section rendered, "
+          f"fc-speedup PASS, TPU-gated fc-head-throughput reads "
+          f"'no data' on CPU -> {report_md}")
+    print("forkchoice smoke: PASS")
+
+
 if __name__ == "__main__":
     if "--chaos-mesh" in sys.argv:
         chaos_main(mesh=True)
@@ -856,5 +967,7 @@ if __name__ == "__main__":
         shard_main()
     elif "--das" in sys.argv:
         das_main()
+    elif "--forkchoice" in sys.argv:
+        forkchoice_main()
     else:
         main()

@@ -104,7 +104,8 @@ ALL_ROLES = frozenset((ROLE_DEVICE, ROLE_KERNEL, ROLE_LIMB, ROLE_INSTR,
 # dispatches XLA programs (oracle siblings under ops/bls are scanned too;
 # they produce no findings because nothing in them touches jax)
 DEVICE_GLOBS = ("ops/bls_batch/*.py", "ops/bls/*.py", "parallel/*.py")
-DEVICE_FILES = ("ops/sha256_jax.py", "ops/fr_batch.py", "executor.py")
+DEVICE_FILES = ("ops/sha256_jax.py", "ops/fr_batch.py", "executor.py",
+                "forkchoice/kernels.py", "forkchoice/store.py")
 # exception-swallow discipline beyond the device files: the serving
 # subsystem (where a swallowed error reads as a healthy request) and
 # the resilience layer itself (which exists to keep failures typed).
@@ -136,19 +137,26 @@ KERNEL_FILES = LIMB_FILES + (
 # surface must stay observable like the kernels it wires up);
 # das/verify.py joined with the DAS workload (its batched cell-proof
 # entries chain fr_batch + bls_batch dispatches and must stay
-# span/cost-covered like the kernels they compose)
+# span/cost-covered like the kernels they compose);
+# forkchoice/store.py + kernels.py joined with the fork-choice
+# subsystem (the proto-array store's apply/head dispatches must stay
+# span/cost-covered like every other device path)
 INSTR_FILES = ("ops/bls_batch/__init__.py", "ops/bls/__init__.py",
                "ops/sha256_jax.py", "ops/fr_batch.py",
                "parallel/incremental.py", "parallel/partition.py",
                "resilience/mesh.py", "resilience/checkpoint.py",
-               "das/verify.py")
+               "das/verify.py", "forkchoice/store.py",
+               "forkchoice/kernels.py")
 
 # shape-laundering functions: a value that went through one of these is
 # a bucketed compile key, not a raw dimension.  `mesh_rung` is the
 # mesh-width form (parallel.partition): device-count reads are
 # mesh-shape compile keys, quantized to the power-of-two ladder;
-# `das_rung` is the DAS cell-batch form (ops.fr_batch)
-BUCKET_FUNCS = frozenset({"_bucket", "mesh_rung", "das_rung"})
+# `das_rung` is the DAS cell-batch form (ops.fr_batch); `fc_rung` is
+# the fork-choice form (forkchoice.kernels: block-count,
+# validator-count and attestation-batch ladders)
+BUCKET_FUNCS = frozenset({"_bucket", "mesh_rung", "das_rung",
+                          "fc_rung"})
 
 # device-pool probes whose results are mesh-shape compile keys: a jit
 # factory keyed by a raw device count recompiles per topology without

@@ -21,11 +21,12 @@ _matrix` — the bench/loadgen source).
 
 from __future__ import annotations
 
+import time
 from dataclasses import dataclass
 from hashlib import sha256
 
 from .. import telemetry
-from ..serve.futures import DeviceFuture
+from ..serve.futures import DeviceFuture, FutureTimeout
 from . import ciphersuite as cs
 from . import verify as _verify
 
@@ -105,6 +106,81 @@ def verify_sample_async(sample: DasSample,
 def verify_sample(sample: DasSample, device: bool | None = None) -> bool:
     """Synchronous facade over `verify_sample_async`."""
     return verify_sample_async(sample, device=device).result()
+
+
+def verify_sample_group_async(samples,
+                              device: bool | None = True) -> DeviceFuture:
+    """ALL the given samples' cell statements as ONE RLC device batch
+    (the serve executor's per-pump cross-sample fold): host prechecks
+    run per sample (structural/inclusion rejects settle False without
+    touching the device), the surviving samples' statements concatenate
+    into a single `verify_cell_proof_batch_async` dispatch, and a
+    failed batch verdict rechecks per SAMPLE so each request still gets
+    its own answer.  Settles to a list of bools aligned with
+    `samples`."""
+    samples = list(samples)
+    verdicts: list[bool | None] = [None] * len(samples)
+    live: list[int] = []
+    for i, sample in enumerate(samples):
+        early = _host_precheck(sample)
+        if early is not None:
+            verdicts[i] = early
+        else:
+            live.append(i)
+    if not live:
+        return DeviceFuture.settled([bool(v) for v in verdicts])
+    coms: list = []
+    idxs: list = []
+    cells: list = []
+    proofs: list = []
+    for i in live:
+        s = samples[i]
+        coms.extend(s.commitments)
+        cells.extend(s.cells)
+        proofs.extend(s.proofs)
+        idxs.extend([int(s.column_index)] * len(s.cells))
+    with telemetry.span("das.verify_sample_group", samples=len(samples),
+                        live=len(live), cells=len(cells)):
+        telemetry.count("das.sample.group_calls")
+        telemetry.count("das.sample.group_samples", len(live))
+        batch_fut = _verify.verify_cell_proof_batch_async(
+            coms, idxs, cells, proofs, device=device)
+
+    def _finish(fut: DeviceFuture, timeout=None) -> None:
+        # the bounded-wait contract: spend the caller's budget as a
+        # declining deadline across the internal settles, and let a
+        # FutureTimeout PROPAGATE unsettled (retrying stays legal and
+        # the serve executor re-queues the batch)
+        deadline = None if timeout is None \
+            else time.perf_counter() + float(timeout)
+
+        def remaining():
+            if deadline is None:
+                return None
+            return max(deadline - time.perf_counter(), 1e-3)
+
+        try:
+            if batch_fut.result(timeout=remaining()):
+                for i in live:
+                    verdicts[i] = True
+            else:
+                # one bad sample must not fail its pump-mates: recheck
+                # per sample (each its own small batch)
+                telemetry.count("das.sample.group_recheck")
+                futs = [(i, verify_sample_async(samples[i],
+                                                device=device))
+                        for i in live]
+                for i, f in futs:
+                    verdicts[i] = bool(f.result(timeout=remaining()))
+            fut.set_result([bool(v) for v in verdicts])
+        except FutureTimeout:
+            raise
+        except Exception as exc:
+            if fut.done():
+                raise
+            fut.set_exception(exc)
+
+    return DeviceFuture(waiter=_finish)
 
 
 def verify_sample_host(sample: DasSample) -> bool:

@@ -1,0 +1,226 @@
+"""Device kernels for the proto-array fork-choice store.
+
+LMD-GHOST is two segment-shaped reductions over flat arrays:
+
+apply (``_apply_kernel``)
+    a batch of latest-message candidates (validator, target epoch,
+    block index) folds into the per-validator latest-message table and
+    the per-block weight array in ONE dispatch: a scatter-max picks
+    each validator's in-batch winner (highest target epoch, earliest
+    arrival on ties — exactly the spec's sequential
+    ``update_latest_messages`` outcome), an accept mask applies the
+    strictly-greater epoch rule, and the weight deltas (-balance at the
+    old vote block, +balance at the new one) land as one scatter-add
+    segment-sum.  The strictly-greater rule makes the whole dispatch
+    IDEMPOTENT: re-applying a batch after a retry changes nothing,
+    which is what lets the serve executor's recovery ladder re-dispatch
+    a failed fc batch safely.
+
+head (``_head_kernel``)
+    subtree weights via fixed-depth pointer jumping on the parent
+    array: with R the parent relation (R[i,j]=1 iff parent[j]==i) and
+    w the per-block vote weights (+ proposer boost at the boosted
+    block), the subtree sum is sum_{m>=0} R^m w, and
+
+        sum_{m < 2^(k+1)} R^m  =  (sum_{m < 2^k} R^m) (I + R^(2^k))
+
+    so log2(rung) rounds of  ``s += scatter_add(s -> 2^k-th ancestor)``
+    with ancestor-pointer squaring settle every subtree sum at once.
+    Viability (the spec's ``filter_block_tree``) is the same doubling
+    with max: leaf-viability (voting-source epoch + finalized-descent
+    checks, evaluated per node on device) ORs up the tree, restricted
+    to LEAVES exactly like the reference's recursion.  Best-child
+    selection is a masked segment-argmax per parent refined over
+    (subtree weight, then the 8 big-endian u32 root limbs — the spec's
+    lexicographic tie-break), and the head is the fixpoint of
+    pointer-doubling on the best-child functional graph.
+
+Blocks, validators and attestation batches each ride their own
+``fc_rung`` ladder so sustained traffic reuses a handful of compiled
+shapes (the analyzer's sanctioned compile-key launderer, like
+``_bucket``/``mesh_rung``/``das_rung``).  Every array slot ladder
+carries ONE extra dummy slot (index == rung) that absorbs masked-out
+scatters; it is zeroed between jump rounds and never read.
+"""
+
+from __future__ import annotations
+
+import functools
+
+# batch-shape ladders: blocks (a client's protoarray holds hundreds to
+# a few thousand unfinalized blocks), validators (committee-scale tests
+# up to the mainnet million-validator regime), attestation batches
+# (per-pump aggregates)
+FC_BLOCK_STEPS = (64, 1024, 16384)
+FC_VALIDATOR_STEPS = (256, 4096, 65536, 1048576)
+FC_BATCH_STEPS = (64, 1024, 16384)
+
+
+def fc_rung(n: int, steps=FC_BLOCK_STEPS) -> int:
+    """Padded shape for n live rows on the given ladder (the compile-key
+    launderer the analyzer recognizes, like `_bucket`/`das_rung`)."""
+    b = 1 if n <= 1 else 1 << (n - 1).bit_length()
+    for step in steps:
+        if b <= step:
+            return step
+    return b
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+@functools.lru_cache(maxsize=8)
+def _apply_kernel(batch: int, v_pad: int, nb_pad: int):
+    """Jitted latest-message + weight-delta fold for one padded
+    attestation batch.
+
+    Inputs (device):
+      val_idx (B,) i32   attesting validator, padded rows -> v_pad
+      att_epoch (B,) i64 target epoch, padded rows -> -1
+      att_block (B,) i32 vote block index, padded rows -> nb_pad
+      lm_epoch (V+1,) i64 / lm_block (V+1,) i32  the latest-message
+                         table (-1 == no message); slot V is the dummy
+      balance (V+1,) i64 weight-eligible effective balance (zero for
+                         inactive/slashed/equivocating validators)
+      can_update (V+1,) bool  False for equivocators (their messages
+                         freeze, per the spec's update skip)
+      node_weight (NB+1,) i64  per-block vote weights; slot NB dummy
+
+    Returns the new (lm_epoch, lm_block, node_weight, accept_mask).
+    """
+    import jax
+    jnp = _jnp()
+
+    def run(val_idx, att_epoch, att_block, lm_epoch, lm_block,
+            balance, can_update, node_weight):
+        pos = jnp.arange(batch, dtype=jnp.int64)
+        # composite in-batch winner key: higher epoch wins, earlier
+        # arrival wins ties — the sequential-processing outcome of the
+        # spec's strictly-greater update rule
+        key = att_epoch * batch + (batch - 1 - pos)
+        best = jnp.full(v_pad + 1, -1, dtype=jnp.int64) \
+            .at[val_idx].max(key)
+        winner = best[val_idx] == key
+        accept = (winner
+                  & (att_epoch >= 0)
+                  & (att_epoch > lm_epoch[val_idx])
+                  & can_update[val_idx])
+        # at most ONE accepted row per validator (the winner), so the
+        # masked set-scatter has no live duplicates; losers write the
+        # dummy slot
+        tgt = jnp.where(accept, val_idx, v_pad)
+        new_lm_epoch = lm_epoch.at[tgt].set(att_epoch)
+        new_lm_block = lm_block.at[tgt].set(att_block)
+        # weight deltas as one segment-sum: -balance at the old vote
+        # block (when one exists), +balance at the new one
+        bal = balance[val_idx]
+        old_block = lm_block[val_idx]
+        sub_tgt = jnp.where(accept & (old_block >= 0), old_block, nb_pad)
+        add_tgt = jnp.where(accept, att_block, nb_pad)
+        new_weight = node_weight.at[sub_tgt].add(-bal).at[add_tgt].add(bal)
+        new_weight = new_weight.at[nb_pad].set(0)
+        return new_lm_epoch, new_lm_block, new_weight, accept
+
+    return jax.jit(run)
+
+
+@functools.lru_cache(maxsize=8)
+def _refresh_kernel(v_pad: int, nb_pad: int):
+    """Jitted full weight rebuild: node_weight[b] = sum of balances of
+    validators whose latest message sits at b — one segment-sum over
+    the validator table (the balance/equivocation-change path and the
+    degraded-mode device re-sync)."""
+    import jax
+    jnp = _jnp()
+
+    def run(lm_block, balance):
+        has = lm_block >= 0
+        tgt = jnp.where(has, lm_block, nb_pad)
+        val = jnp.where(has, balance, 0)
+        return jnp.zeros(nb_pad + 1, dtype=jnp.int64).at[tgt].add(val)
+
+    return jax.jit(run)
+
+
+@functools.lru_cache(maxsize=8)
+def _head_kernel(nb_pad: int):
+    """Jitted LMD-GHOST head selection over one padded block rung.
+
+    Inputs (device, all length NB+1 unless noted):
+      parent i32         parent index; anchor and padded rows -> NB
+      node_weight i64    per-block vote weights (the apply fold's)
+      boost_idx/boost_amt  proposer boost (idx NB + amt 0 when unset)
+      real bool          live-row mask
+      slots i64, block_epoch i64
+      je i64             block state's justified-checkpoint epoch
+      uje i64            unrealized (pulled-up) justification epoch
+      fin_ok bool        host-maintained finalized-descent flag
+      limbs (NB+1, 8) u32  big-endian root words (the tie-break key)
+      sj/sf/cur i64 scalars  store justified/finalized/current epochs
+      justified_idx i32  walk start
+
+    Returns the head's block index (i32 scalar).
+    """
+    import jax
+    jnp = _jnp()
+    rounds = max(int(nb_pad).bit_length() - 1, 1)
+
+    def run(parent, node_weight, boost_idx, boost_amt, real, slots,
+            block_epoch, je, uje, fin_ok, limbs, sj, sf, cur,
+            justified_idx):
+        del slots   # kept in the signature for costmodel symmetry
+        w = node_weight.at[boost_idx].add(boost_amt)
+        w = jnp.where(real, w, 0)
+
+        # subtree weight sums: s += scatter(s -> 2^k-th ancestor),
+        # ancestor pointers square each round; the dummy slot absorbs
+        # the past-the-root flow and is re-zeroed so it cannot overflow
+        s = w
+        ptr = parent
+        for _ in range(rounds):
+            s = s.at[ptr].add(s)
+            s = s.at[nb_pad].set(0)
+            ptr = ptr[ptr]
+
+        # leaf viability (filter_block_tree's leaf predicate), then the
+        # same doubling with max = subtree-OR over the LEAVES below
+        vs = jnp.where(block_epoch < cur, uje, je)
+        vs_ok = (sj == 0) | (vs == sj) | (vs + 2 >= cur)
+        f_ok = (sf == 0) | fin_ok
+        has_child = jnp.zeros(nb_pad + 1, dtype=jnp.int32) \
+            .at[parent].max(real.astype(jnp.int32))
+        leaf_pred = (vs_ok & f_ok & real
+                     & (has_child == 0)).astype(jnp.int32)
+        vsub = leaf_pred
+        ptr = parent
+        for _ in range(rounds):
+            vsub = vsub.at[ptr].max(vsub)
+            ptr = ptr[ptr]
+
+        # best child per parent: segment-argmax refined over subtree
+        # weight then the 8 big-endian root limbs (the lexicographic
+        # tie-break); after refinement at most one candidate per parent
+        # survives (roots are distinct)
+        cand = real & (vsub > 0) & (parent < nb_pad)
+        mx = jnp.full(nb_pad + 1, -1, dtype=jnp.int64) \
+            .at[jnp.where(cand, parent, nb_pad)].max(s)
+        cand = cand & (s == mx[parent])
+        for limb in range(8):
+            lv = limbs[:, limb].astype(jnp.int64)
+            ml = jnp.full(nb_pad + 1, -1, dtype=jnp.int64) \
+                .at[jnp.where(cand, parent, nb_pad)].max(lv)
+            cand = cand & (lv == ml[parent])
+
+        idx = jnp.arange(nb_pad + 1, dtype=jnp.int32)
+        best_child = idx.at[jnp.where(cand, parent, nb_pad)].set(idx)
+        # head = fixpoint of pointer-doubling on best_child (child
+        # indices strictly exceed their parent's, so the graph only
+        # walks down and 2^rounds jumps cover any chain in the rung)
+        bc = best_child
+        for _ in range(rounds):
+            bc = bc[bc]
+        return bc[justified_idx]
+
+    return jax.jit(run)

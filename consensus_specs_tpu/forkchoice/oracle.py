@@ -1,0 +1,159 @@
+"""The spec-oracle route: phase0 ``get_head`` over a synthesized Store.
+
+The proto-array store's host mirror carries exactly the facts the spec
+oracle's fork choice reads — blocks (slot, parent, root), the
+latest-message table, the justified-checkpoint validator set, the
+checkpoint/boost state.  ``spec_store_for`` lifts that mirror into a
+genuine ``spec.Store`` (the executable-spec dataclass from
+`models/phase0/fork_choice.py`) and ``spec_get_head`` runs THE SPEC'S
+``get_head`` on it — the heaviest-possible referee: every weight, every
+viability filter and every tie-break decision comes from the oracle
+code path, not a re-implementation.  This is the parity target of
+tests/test_forkchoice.py and the serve executor's degraded-mode
+fallback for the ``head`` request kind.
+
+Synthesis notes:
+
+- ``store.blocks`` is keyed by the proto store's root BYTES; the spec's
+  walk never re-hashes blocks, it follows ``parent_root`` through the
+  dict — so lightweight ``spec.BeaconBlock(slot, parent_root)`` rows
+  suffice.
+- ``block_states`` entries only serve ``get_voting_source`` (the
+  current-epoch branch reads ``current_justified_checkpoint``), so each
+  is a minimal shim carrying that one checkpoint; the justified
+  CHECKPOINT state is a real ``spec.BeaconState`` (``get_weight`` and
+  ``get_proposer_score`` read balances and the active set off it).
+- ``update_latest_messages`` (the spec's message fold) is exposed via
+  ``spec_apply_messages`` so the tests can pin the store's batched
+  fold against the oracle's sequential rule message-for-message.
+
+``spec_get_head`` is the seam the tier-1 conftest memoizes (keyed on
+``ProtoArrayStore.fingerprint()``): randomized parity suites re-evaluate
+identical stores across tests, and one oracle evaluation per distinct
+store state keeps the budget flat.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from types import SimpleNamespace
+
+
+def _build_spec(proto):
+    from ..models.builder import build_spec
+
+    spec = build_spec("phase0", proto.preset)
+    assert int(spec.SLOTS_PER_EPOCH) == proto.slots_per_epoch, \
+        (f"preset {proto.preset} has SLOTS_PER_EPOCH="
+         f"{int(spec.SLOTS_PER_EPOCH)}, the store was built with "
+         f"{proto.slots_per_epoch}")
+    assert int(spec.config.PROPOSER_SCORE_BOOST) \
+        == proto.proposer_boost_pct
+    assert int(spec.EFFECTIVE_BALANCE_INCREMENT) \
+        == proto.effective_balance_increment
+    return spec
+
+
+def _checkpoint_state(spec, proto):
+    """A real BeaconState at the justified boundary whose validator
+    registry reproduces the store's (balance, active, slashed) rows."""
+    validators = []
+    far = spec.FAR_FUTURE_EPOCH
+    for eb, act, sl in zip(proto._eb, proto._active, proto._slashed):
+        validators.append(spec.Validator(
+            effective_balance=int(eb),
+            slashed=bool(sl),
+            activation_eligibility_epoch=0,
+            activation_epoch=0 if act else far,
+            exit_epoch=far,
+            withdrawable_epoch=far,
+        ))
+    return spec.BeaconState(
+        slot=spec.compute_start_slot_at_epoch(
+            spec.Epoch(proto.justified_epoch)),
+        validators=validators,
+    )
+
+
+def spec_store_for(proto, spec=None):
+    """(spec, Store) — the executable-spec Store synthesized from the
+    proto store's host mirror (pending device-applied batches fold in
+    first, so the synthesis always sees the full message table)."""
+    proto._sync_pending()
+    if spec is None:
+        spec = _build_spec(proto)
+    seconds = int(spec.config.SECONDS_PER_SLOT)
+    justified = spec.Checkpoint(
+        epoch=spec.Epoch(proto.justified_epoch),
+        root=spec.Root(proto.justified_root))
+    finalized = spec.Checkpoint(
+        epoch=spec.Epoch(proto.finalized_epoch),
+        root=spec.Root(proto.finalized_root))
+    blocks = {}
+    block_states = {}
+    unrealized = {}
+    # the anchor's parent must point OUTSIDE the store (a zero parent
+    # would alias an all-zero anchor root and make the anchor its own
+    # child in filter_block_tree's children index)
+    outside = bytes(32)
+    while outside in proto.root_index:
+        outside = hashlib.sha256(outside + proto.roots[0]).digest()
+    for i, root in enumerate(proto.roots):
+        parent = proto.roots[proto.parent[i]] if proto.parent[i] >= 0 \
+            else outside
+        blocks[spec.Root(root)] = spec.BeaconBlock(
+            slot=spec.Slot(proto.slots[i]),
+            parent_root=spec.Root(parent))
+        block_states[spec.Root(root)] = SimpleNamespace(
+            current_justified_checkpoint=spec.Checkpoint(
+                epoch=spec.Epoch(proto.je[i])))
+        unrealized[spec.Root(root)] = spec.Checkpoint(
+            epoch=spec.Epoch(proto.uje[i]))
+    store = spec.Store(
+        time=spec.uint64(proto.current_epoch * proto.slots_per_epoch
+                         * seconds),
+        genesis_time=spec.uint64(0),
+        justified_checkpoint=justified,
+        finalized_checkpoint=finalized,
+        unrealized_justified_checkpoint=justified,
+        unrealized_finalized_checkpoint=finalized,
+        proposer_boost_root=spec.Root(proto.proposer_boost_root or
+                                      b"\x00" * 32),
+        equivocating_indices={
+            spec.ValidatorIndex(int(v))
+            for v in range(proto.n_validators) if proto._equiv[v]},
+        blocks=blocks,
+        block_states=block_states,
+        checkpoint_states={justified: _checkpoint_state(spec, proto)},
+        unrealized_justifications=unrealized,
+    )
+    store.latest_messages = {
+        spec.ValidatorIndex(int(v)): spec.LatestMessage(
+            epoch=spec.Epoch(int(proto._lm_epoch[v])),
+            root=spec.Root(proto.roots[int(proto._lm_block[v])]))
+        for v in range(proto.n_validators) if proto._lm_block[v] >= 0}
+    return spec, store
+
+
+def spec_get_head(proto) -> bytes:
+    """THE SPEC's ``get_head`` over the synthesized store (memoized by
+    the tier-1 conftest on the store fingerprint)."""
+    spec, store = spec_store_for(proto)
+    return bytes(spec.get_head(store))
+
+
+def spec_apply_messages(proto, validator_indices, target_epochs,
+                        block_roots):
+    """Run the spec oracle's ``update_latest_messages`` sequentially
+    over the message stream against a synthesized store; returns the
+    resulting {validator: (epoch, root)} table.  The parity pin for the
+    store's batched fold rule."""
+    spec, store = spec_store_for(proto)
+    for v, e, r in zip(validator_indices, target_epochs, block_roots):
+        att = SimpleNamespace(data=SimpleNamespace(
+            target=spec.Checkpoint(epoch=spec.Epoch(int(e))),
+            beacon_block_root=spec.Root(bytes(r))))
+        spec.update_latest_messages(store, [spec.ValidatorIndex(int(v))],
+                                    att)
+    return {int(v): (int(m.epoch), bytes(m.root))
+            for v, m in store.latest_messages.items()}

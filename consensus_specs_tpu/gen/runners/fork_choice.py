@@ -1,3 +1,8 @@
+"""fork_choice runner: reflects tests/*/fork_choice/ — including the
+`device_store` handler, whose head checks are the DEVICE proto-array
+store's decisions (`consensus_specs_tpu/forkchoice/`), each asserted
+bit-identical to the spec oracle's `get_head` before emission."""
+
 from ..from_tests import get_test_cases_for
 
 

@@ -320,8 +320,9 @@ def lint_env_knobs(repo=None) -> list[str]:
     fault-plan knobs (`CST_FAULTS*`) in the "Resilience" section,
     checkpoint knobs (`CST_CHECKPOINT_*`) in the "Mesh resilience &
     checkpointing" section, mesh-sharding knobs (`CST_SHARD_*`) in
-    the "Mesh sharding" section, and DAS knobs (`CST_DAS_*`) in the
-    "DAS / PeerDAS" section — a subsystem's configuration surface
+    the "Mesh sharding" section, DAS knobs (`CST_DAS_*`) in the
+    "DAS / PeerDAS" section, and fork-choice knobs (`CST_FC_*`) in
+    the "Fork choice" section — a subsystem's configuration surface
     must be documented where the subsystem is explained, not only in
     the flat table.  `repo` overrides the tree root (tests)."""
     repo = Path(repo) if repo is not None else PKG_ROOT.parent
@@ -348,7 +349,9 @@ def lint_env_knobs(repo=None) -> list[str]:
                           ("CST_SHARD_", "Mesh sharding",
                            section("Mesh sharding")),
                           ("CST_DAS_", "DAS / PeerDAS",
-                           section(re.escape("DAS / PeerDAS"))))
+                           section(re.escape("DAS / PeerDAS"))),
+                          ("CST_FC_", "Fork choice",
+                           section("Fork choice")))
 
     used: dict[str, str] = {}
     for path in sorted(repo.rglob("*.py")):

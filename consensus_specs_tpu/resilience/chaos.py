@@ -130,6 +130,16 @@ def _check_results(tracked, expected) -> dict:
             # host route included)
             if value is not True:
                 wrong += 1
+        elif kind == "fc_atts":
+            # an accepted-count outside [0, batch] is impossible on
+            # both routes (the store mutates under load, so the exact
+            # count is schedule-dependent, not a fixed expectation)
+            if not isinstance(value, int) or value < 0:
+                wrong += 1
+        elif kind == "head":
+            # both routes answer a 32-byte block root out of the store
+            if not (isinstance(value, bytes) and len(value) == 32):
+                wrong += 1
     return {"wrong": wrong, "failed": failed, "checked": checked}
 
 
@@ -421,12 +431,18 @@ def run_chaos_load(cfg=None, plan=None) -> dict:
     plan = faults.load_plan(plan)
 
     pool = build_statement_pool(cfg.pool, cfg.committee)
-    from ..serve.loadgen import DAS_SAMPLES_PER_SLOT, _das_payloads
+    from ..serve.loadgen import (
+        DAS_SAMPLES_PER_SLOT,
+        FC_ATTS_PER_SLOT,
+        _das_payloads,
+        _fc_payload,
+    )
     payloads = {"pairing": _pairing_payload(pool[0]),
                 "fr": _fr_payload(), "sha256": _sha_payload(),
                 "proof": _proof_payload(),
                 "das": (_das_payloads() if DAS_SAMPLES_PER_SLOT
-                        else [])}
+                        else []),
+                "fc": (_fc_payload() if FC_ATTS_PER_SLOT else None)}
     expected = _expectations(payloads)
     warm_s = _warm_kernels(cfg, pool, payloads)
 

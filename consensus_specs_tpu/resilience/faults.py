@@ -13,9 +13,12 @@ Sites (`SITES`) — the four seams the hooks live at:
                     `batch_verify_sharded_async` (key =
                     `rlc_sharded@<devices>x<per_shard>` — the
                     `device_loss` chaos target `resilience.mesh`
-                    recovers from), and `ops.sha256_jax` (key =
-                    `sha256_merkle@d<depth>`) — the jitted-kernel
-                    dispatch boundary
+                    recovers from), `ops.sha256_jax` (key =
+                    `sha256_merkle@d<depth>`), and the fork-choice
+                    store's kernels (keys `fc_weights@b<B>v<V>` /
+                    `fc_head@<NB>` — the serve `head` lane's
+                    breaker→spec-oracle chaos target) — the
+                    jitted-kernel dispatch boundary
     future_settle   `serve.futures.DeviceFuture` device-backed settle
                     (key = "device") — the device→host transfer
     serve_pump      `ServeExecutor._dispatch_one` (key = request kind:

@@ -33,11 +33,28 @@ Request kinds and their device paths:
                `parallel.incremental.MerkleForest`
                (`incremental.emit_proofs_async`) — the stateless-client
                proof-serving workload riding the same futures pipeline
-    das        one data-column sampling check (`das.sampling
-               .verify_sample_async`): host commitment-inclusion walk,
-               then ALL of the column's cell proofs as one batched RLC
-               pairing equation — the PeerDAS workload; each request is
-               itself a device batch, so requests dispatch one-to-one
+    das        data-column sampling checks, CROSS-SAMPLE BATCHED: every
+               sample queued at pump time folds into ONE RLC pairing
+               equation (`das.sampling.verify_sample_group_async` —
+               host inclusion walks per sample, then all the samples'
+               cell statements as a single device batch; a failed batch
+               verdict rechecks per sample, so each request keeps its
+               own answer)
+    fc_atts    fork-choice attestation batches (`forkchoice
+               .ProtoArrayStore.apply_attestations_async`): every batch
+               queued at pump time for the same store folds into ONE
+               latest-message/weight-delta dispatch; each request
+               settles to ITS OWN accepted count (the device accept
+               mask is split per request).  Idempotent under retry —
+               the strictly-greater epoch rule makes re-applying a
+               batch a no-op.
+    head       one LMD-GHOST head poll (`ProtoArrayStore
+               .get_head_async`); settles to the head's 32-byte root.
+               The breaker's degraded mode answers on the actual phase0
+               spec oracle (`get_head_host`), and degraded-mode
+               `fc_atts` applies land on the store's host mirror, from
+               which the device arrays rebuild when the breaker
+               re-closes.
 
 Failure semantics are LAYERED (PR 8, the resilience layer):
 
@@ -88,7 +105,8 @@ from ..resilience import faults
 from ..resilience.policies import DeadlineExceeded
 from .futures import DeviceFuture, FutureTimeout
 
-KINDS = ("verify", "pairing", "msm", "sha256", "fr", "proof", "das")
+KINDS = ("verify", "pairing", "msm", "sha256", "fr", "proof", "das",
+         "fc_atts", "head")
 
 # batched-kind dispatchers resolve lazily: importing the executor must
 # not pull jax/numpy-heavy ops modules until the first dispatch
@@ -217,11 +235,19 @@ def _oracle_compute(kind: str, payload):
         from ..das.sampling import verify_sample_host
 
         return verify_sample_host(payload)
+    if kind == "fc_atts":
+        # host-mirror fold (the exact kernel rule); the store rebuilds
+        # its device arrays from the mirror when the breaker re-closes
+        store, idx, epochs, roots = payload
+        return store.apply_attestations_host(idx, epochs, roots)
+    if kind == "head":
+        # the actual phase0 spec oracle's get_head over the mirror
+        return payload.get_head_host()
     raise KeyError(f"no oracle fallback for request kind {kind!r}")
 
 
 ORACLE_KINDS = frozenset({"verify", "pairing", "msm", "sha256", "fr",
-                          "das"})
+                          "das", "fc_atts", "head"})
 
 
 class ServeExecutor:
@@ -325,11 +351,34 @@ class ServeExecutor:
 
     def submit_das_sample(self, sample) -> DeviceFuture:
         """One data-column sampling check (`das.sampling.DasSample`):
-        host inclusion walk + the column's cell proofs as one batched
-        RLC device check.  Settles to bool; a structurally broken or
-        inclusion-failing sample settles False without touching the
-        device."""
+        host inclusion walk, then the cell proofs ride the pump's
+        cross-sample RLC batch (every das sample queued at pump time
+        folds into ONE device dispatch).  Settles to bool; a
+        structurally broken or inclusion-failing sample settles False
+        without touching the device."""
         return self._submit("das", sample)
+
+    def submit_attestation_batch(self, store, validator_indices,
+                                 target_epochs,
+                                 block_roots) -> DeviceFuture:
+        """One fork-choice attestation batch against a
+        `forkchoice.ProtoArrayStore` (validator index, target epoch,
+        vote-block root per message — the post-verification facts the
+        fork choice consumes; signature checking is the `verify`
+        lane's job).  Batches queued for the same store fold into ONE
+        device dispatch per pump; settles to this request's accepted
+        latest-message count."""
+        n = len(validator_indices)
+        assert n == len(target_epochs) == len(block_roots)
+        return self._submit("fc_atts", (store, list(validator_indices),
+                                        list(target_epochs),
+                                        list(block_roots)))
+
+    def submit_head_request(self, store) -> DeviceFuture:
+        """One LMD-GHOST head poll against a
+        `forkchoice.ProtoArrayStore`; settles to the head's 32-byte
+        root."""
+        return self._submit("head", store)
 
     # --- pipeline -----------------------------------------------------------
 
@@ -425,10 +474,28 @@ class ServeExecutor:
                 from ..ops.fr_batch import barycentric_eval_async
                 fut = barycentric_eval_async(*reqs[0].payload)
             elif kind == "das":
-                from ..das.sampling import verify_sample_async
-                # device=True: serve kinds always take the device path
-                # (the breaker's oracle fallback is the host route)
-                fut = verify_sample_async(reqs[0].payload, device=True)
+                from ..das.sampling import verify_sample_group_async
+                # cross-sample batching: every queued sample's cell
+                # statements fold into ONE RLC device batch (device
+                # route always — the breaker's oracle fallback is the
+                # host route)
+                fut = verify_sample_group_async(
+                    [r.payload for r in reqs])
+            elif kind == "fc_atts":
+                # cross-request batching: every queued batch for this
+                # store folds into ONE latest-message/weight dispatch;
+                # the settle splits the accept mask per request
+                store = reqs[0].payload[0]
+                idx: list = []
+                epochs: list = []
+                roots: list = []
+                for r in reqs:
+                    idx.extend(r.payload[1])
+                    epochs.extend(r.payload[2])
+                    roots.extend(r.payload[3])
+                fut = store.apply_attestations_async(idx, epochs, roots)
+            elif kind == "head":
+                fut = reqs[0].payload.get_head_async()
             else:   # proof
                 from ..parallel.incremental import emit_proofs_async
                 fut = emit_proofs_async(*reqs[0].payload)
@@ -457,9 +524,20 @@ class ServeExecutor:
             reqs = by_kind.get(kind)
             if not reqs:
                 continue
-            if kind == "verify":
+            if kind in ("verify", "das"):
+                # batched kinds: up to max_batch requests per device
+                # dispatch (das folds the samples' cell statements into
+                # one RLC batch)
                 for i in range(0, len(reqs), self.max_batch):
                     self._dispatch_one(kind, reqs[i:i + self.max_batch])
+            elif kind == "fc_atts":
+                # one merged dispatch per TARGET STORE, arrival order
+                # preserved within each group
+                groups: dict[int, list[_Request]] = {}
+                for req in reqs:
+                    groups.setdefault(id(req.payload[0]), []).append(req)
+                for group in groups.values():
+                    self._dispatch_one(kind, group)
             else:
                 for req in reqs:
                     self._dispatch_one(kind, [req])
@@ -566,6 +644,23 @@ class ServeExecutor:
                         telemetry.count("serve.batch_recheck")
                         results = [self._verify_single(r.payload)
                                    for r in batch.reqs]
+                elif batch.kind == "das":
+                    # the group future settles to per-sample verdicts
+                    results = list(out)
+                    assert len(results) == len(batch.reqs)
+                elif batch.kind == "fc_atts":
+                    # split the merged dispatch's accept mask back into
+                    # per-request accepted counts
+                    import numpy as np
+
+                    mask = np.asarray(out)
+                    results = []
+                    off = 0
+                    for req in batch.reqs:
+                        n = len(req.payload[1])
+                        results.append(int(np.count_nonzero(
+                            mask[off:off + n])))
+                        off += n
                 else:
                     results = [out] * len(batch.reqs)
             except FutureTimeout:

@@ -20,9 +20,14 @@ Arrival model (per mainnet slot, 12 s):
         `parallel.incremental.MerkleForest` (`submit_proof_request` —
         the stateless-client proof queries light clients issue)
      2  data-column sampling checks (`submit_das_sample` — the PeerDAS
-        custody columns a node re-verifies per slot, each one batched
-        RLC cell-proof equation; CST_DAS_SAMPLES_PER_SLOT overrides,
-        0 disables the lane)
+        custody columns a node re-verifies per slot; samples queued in
+        the same pump fold into ONE RLC cell-proof equation;
+        CST_DAS_SAMPLES_PER_SLOT overrides, 0 disables the lane)
+     2  fork-choice attestation batches + 1 LMD-GHOST head poll
+        (`submit_attestation_batch`/`submit_head_request` against a
+        synthetic proto-array store — the per-attestation bookkeeping
+        every client runs; CST_FC_ATTS_PER_SLOT overrides, 0 disables
+        the lane and its head poll)
 
 `rate <= 0` switches to closed-loop mode: the generator keeps
 `max_batch * (depth + 1)` requests outstanding and the measured rate IS
@@ -63,9 +68,17 @@ PROOF_REQUESTS_PER_SLOT = 2             # stateless-client proof queries
 # CST_SERVE_* knob — a typo'd "disable" must not silently run the lane
 DAS_SAMPLES_PER_SLOT = max(
     0, int(os.environ.get("CST_DAS_SAMPLES_PER_SLOT", 2)))
+# fork-choice lane: attestation batches feeding the proto-array store
+# per slot (each batch carries FC_BATCH_MESSAGES latest-message
+# updates) plus one LMD-GHOST head poll; 0 disables the lane
+FC_ATTS_PER_SLOT = max(
+    0, int(os.environ.get("CST_FC_ATTS_PER_SLOT", 2)))
+HEAD_POLLS_PER_SLOT = 1 if FC_ATTS_PER_SLOT else 0
+FC_BATCH_MESSAGES = 64
 STATEMENTS_PER_SLOT = (ATT_STATEMENTS_PER_SLOT + SYNC_STATEMENTS_PER_SLOT
                        + KZG_EVALS_PER_SLOT + SHA_ROOTS_PER_SLOT
-                       + PROOF_REQUESTS_PER_SLOT + DAS_SAMPLES_PER_SLOT)
+                       + PROOF_REQUESTS_PER_SLOT + DAS_SAMPLES_PER_SLOT
+                       + FC_ATTS_PER_SLOT + HEAD_POLLS_PER_SLOT)
 STEADY_TOL = 0.2
 
 
@@ -182,6 +195,19 @@ def _das_payloads(n_blobs: int = 2, columns=(0, 17)):
     return [sample_from_matrix(*matrix, column) for column in columns]
 
 
+def _fc_payload(n_blocks: int = 48, n_validators: int = 256,
+                batch: int = FC_BATCH_MESSAGES):
+    """A synthetic proto-array store plus an infinite attestation-batch
+    stream — the `submit_attestation_batch`/`submit_head_request`
+    lane's payload (`forkchoice.synthetic`, the same builder the bench
+    worker sweeps)."""
+    from ..forkchoice.synthetic import attestation_stream, synthetic_store
+
+    store, roots = synthetic_store(n_blocks, n_validators, seed=53)
+    return store, attestation_stream(roots, n_validators, batch,
+                                     seed=53)
+
+
 def _proof_payload(n_leaves: int = 256, batch: int = 16):
     """A persistent `MerkleForest` plus one index batch — the
     `submit_proof_request` payload shape (the forest is built once and
@@ -216,12 +242,17 @@ def make_submitter(ex, pool, payloads, track=None):
         + ["fr"] * KZG_EVALS_PER_SLOT
         + ["sha256"] * SHA_ROOTS_PER_SLOT
         + ["proof"] * PROOF_REQUESTS_PER_SLOT
-        + ["das"] * DAS_SAMPLES_PER_SLOT)
+        + ["das"] * DAS_SAMPLES_PER_SLOT
+        + ["fc_atts"] * FC_ATTS_PER_SLOT
+        + ["head"] * HEAD_POLLS_PER_SLOT)
     pool_iter = itertools.cycle(pool)
     das_iter = itertools.cycle(payloads["das"]) if payloads.get("das") \
         else None
+    fc_store, fc_batches = payloads["fc"] if payloads.get("fc") \
+        else (None, None)
     kinds_submitted = {k: 0 for k in ("verify", "pairing", "fr",
-                                      "sha256", "proof", "das")}
+                                      "sha256", "proof", "das",
+                                      "fc_atts", "head")}
 
     def submit_next():
         kind = next(schedule)
@@ -236,6 +267,11 @@ def make_submitter(ex, pool, payloads, track=None):
             fut = ex.submit_sha256_root(*payloads["sha256"])
         elif kind == "das":
             fut = ex.submit_das_sample(next(das_iter))
+        elif kind == "fc_atts":
+            fut = ex.submit_attestation_batch(fc_store,
+                                              *next(fc_batches))
+        elif kind == "head":
+            fut = ex.submit_head_request(fc_store)
         else:
             fut = ex.submit_proof_request(*payloads["proof"])
         if track is not None:
@@ -291,6 +327,10 @@ def _warm_kernels(cfg: LoadConfig, pool, payloads) -> float:
         from ..das.sampling import verify_sample_async
 
         verify_sample_async(payloads["das"][0], device=True).result()
+    if payloads.get("fc"):
+        fc_store, fc_batches = payloads["fc"]
+        fc_store.apply_attestations_async(*next(fc_batches)).result()
+        fc_store.get_head_async().result()
     return time.perf_counter() - t0
 
 
@@ -334,7 +374,8 @@ def run_load(cfg: LoadConfig | None = None, executor=None) -> dict:
     payloads = {"pairing": _pairing_payload(pool[0]),
                 "fr": _fr_payload(), "sha256": _sha_payload(),
                 "proof": _proof_payload(),
-                "das": (_das_payloads() if DAS_SAMPLES_PER_SLOT else [])}
+                "das": (_das_payloads() if DAS_SAMPLES_PER_SLOT else []),
+                "fc": (_fc_payload() if FC_ATTS_PER_SLOT else None)}
     warm_s = _warm_kernels(cfg, pool, payloads)
     # a CST_FAULTS plan goes live only AFTER warmup: AOT precompile is
     # setup, not served traffic — the plan's fault budget must land on

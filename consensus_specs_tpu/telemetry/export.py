@@ -509,6 +509,48 @@ def validate_das_block(obj) -> list[str]:
     return problems
 
 
+def validate_forkchoice_block(obj) -> list[str]:
+    """Schema check for the bench `"forkchoice"` sub-object (the
+    device LMD-GHOST sweep `bench.py --worker forkchoice` emits);
+    returns problems (empty == valid).  Pinned by `bench_smoke.py
+    --forkchoice` and tests/test_forkchoice.py."""
+    if not isinstance(obj, dict):
+        return [f"forkchoice block is {type(obj).__name__}, not dict"]
+    problems: list[str] = []
+    tree = obj.get("tree")
+    if not isinstance(tree, dict):
+        problems.append("'tree' must be a dict")
+    else:
+        for key in ("blocks", "validators", "messages"):
+            v = tree.get(key)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 1:
+                problems.append(f"tree[{key!r}] must be a positive "
+                                f"int, got {v!r}")
+    for key in ("apply_wall_s", "head_wall_s", "heads_per_s",
+                "oracle_head_wall_s", "speedup"):
+        v = obj.get(key)
+        if not isinstance(v, (int, float)) or isinstance(v, bool) \
+                or v <= 0:
+            problems.append(f"{key!r} must be a positive number, "
+                            f"got {v!r}")
+    if not isinstance(obj.get("oracle_validators_measured"), int) \
+            or isinstance(obj.get("oracle_validators_measured"), bool) \
+            or obj.get("oracle_validators_measured") < 1:
+        problems.append("'oracle_validators_measured' must be a "
+                        "positive int")
+    rungs = obj.get("rungs")
+    if not isinstance(rungs, dict) or not all(
+            isinstance(rungs.get(k), int) and not
+            isinstance(rungs.get(k), bool) and rungs.get(k) >= 1
+            for k in ("blocks", "validators", "batch")):
+        problems.append("'rungs' must carry positive int "
+                        "blocks/validators/batch ladder shapes")
+    if obj.get("parity") is not True:
+        problems.append("'parity' must be True (the device head must "
+                        "match the spec oracle's on the swept tree)")
+    return problems
+
+
 def embed_bench_block(record: dict) -> dict:
     """The shared per-config bench protocol: attach the current
     `"telemetry"` block to a metric record and reset the per-config

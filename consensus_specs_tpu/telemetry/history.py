@@ -61,6 +61,12 @@ Record schema (version `SCHEMA`; one JSON object per line):
                                  # per swept matrix + "das::speedup"
                                  # vs the pure-Python oracle +
                                  # "das::cells_per_s" throughput)
+     "forkchoice": dict,         # compacted device LMD-GHOST tree
+                                 # block (source "forkchoice"; metric
+                                 # "forkchoice::head_wall@<b>x<v>" per
+                                 # swept tree + "forkchoice::speedup"
+                                 # vs the phase0 spec oracle +
+                                 # "forkchoice::heads_per_s")
      "scaling": dict,            # compacted mesh-sharded flagship rung
                                  # (source "scaling"; metric
                                  # "scaling::flagship@<n>" per rung wall
@@ -91,7 +97,7 @@ SCHEMA = 1
 
 SOURCES = ("bench_round", "multichip_round", "baseline", "bench_emit",
            "pytest_snapshot", "costmodel", "serve", "resilience",
-           "mesh", "checkpoint", "scaling", "das")
+           "mesh", "checkpoint", "scaling", "das", "forkchoice")
 
 _ROUND_FILE_RE = re.compile(r"(?:BENCH|MULTICHIP)_r(\d+)\.json$")
 
@@ -461,6 +467,50 @@ def das_records(metric: str, das, **context) -> list[dict]:
     return records
 
 
+def forkchoice_records(metric: str, fc, **context) -> list[dict]:
+    """`forkchoice`-source history records mined from one metric
+    line's `"forkchoice"` sub-object (`bench.py --worker forkchoice` /
+    `bench_smoke.py --forkchoice`): the per-shape head wall (carrying
+    the compact block, speedup as `vs_baseline`), the
+    `forkchoice::speedup` record the CPU-evaluated `fc-speedup`
+    threshold row gates on, and the `forkchoice::heads_per_s` record
+    the TPU-gated `fc-head-throughput` row reads.  Malformed blocks
+    yield zero records, never an exception."""
+    if not isinstance(fc, dict):
+        return []
+    tree = fc.get("tree")
+    wall = fc.get("head_wall_s")
+    if not isinstance(tree, dict) \
+            or not isinstance(wall, (int, float)) \
+            or isinstance(wall, bool):
+        return []
+    blocks, validators = tree.get("blocks"), tree.get("validators")
+    if not isinstance(blocks, int) or not isinstance(validators, int) \
+            or isinstance(blocks, bool) or isinstance(validators, bool):
+        return []
+    compact = {k: fc[k] for k in (
+        "tree", "rungs", "apply_wall_s", "oracle_head_wall_s",
+        "oracle_validators_measured", "compile_first_s", "parity")
+        if k in fc}
+    speedup = fc.get("speedup")
+    speedup = speedup if isinstance(speedup, (int, float)) \
+        and not isinstance(speedup, bool) else None
+    records = [make_record(
+        "forkchoice", f"forkchoice::head_wall@{blocks}x{validators}",
+        wall, unit="s", vs_baseline=speedup, forkchoice=compact,
+        via_metric=metric, **context)]
+    if speedup is not None:
+        records.append(make_record(
+            "forkchoice", "forkchoice::speedup", speedup, unit="x",
+            via_metric=metric, **context))
+    hps = fc.get("heads_per_s")
+    if isinstance(hps, (int, float)) and not isinstance(hps, bool):
+        records.append(make_record(
+            "forkchoice", "forkchoice::heads_per_s", hps,
+            unit="heads/s", via_metric=metric, **context))
+    return records
+
+
 def costmodel_records(metric: str, tel, **context) -> list[dict]:
     """Per-kernel `costmodel`-source history records mined from one
     metric line's telemetry sub-object (joined roofline records from
@@ -592,6 +642,9 @@ def parse_bench_round(path) -> tuple[list[dict], list[str]]:
             rc=rc, platform=obj.get("platform")))
         records.extend(das_records(
             name, obj.get("das"), round=rnd, file=path.name,
+            rc=rc, platform=obj.get("platform")))
+        records.extend(forkchoice_records(
+            name, obj.get("forkchoice"), round=rnd, file=path.name,
             rc=rc, platform=obj.get("platform")))
         for crec in costmodel_records(
                 name, obj.get("telemetry"), round=rnd, file=path.name,
@@ -899,6 +952,10 @@ def emission_records(metric_line: dict, ts: float | None = None
                 name, obj.get("das"), platform=platform,
                 ts=round(ts, 1) if ts is not None else None):
             records.append(drec)
+        for frec in forkchoice_records(
+                name, obj.get("forkchoice"), platform=platform,
+                ts=round(ts, 1) if ts is not None else None):
+            records.append(frec)
         for crec in costmodel_records(
                 name, obj.get("telemetry"), platform=platform,
                 ts=round(ts, 1) if ts is not None else None):

@@ -187,6 +187,21 @@ THRESHOLDS = (
      "title": "DAS sampling-matrix throughput (cells/s)",
      "metric": r"das::cells_per_s",
      "field": "value", "op": ">=", "target": 20000.0, "tpu_only": True},
+    # fork choice (the device LMD-GHOST proto-array store): batched
+    # latest-message folding + pointer-jumping head selection must
+    # beat the phase0 spec oracle's get_head >= 2x — the oracle pays a
+    # python walk over every validator per child, so the ratio is
+    # shape-bound and CPU-evaluable (the fc smoke measures it at the
+    # tiny matrix).  Absolute head throughput is a chip number: the
+    # heads/s row stays TPU-gated for the next round.
+    {"id": "fc-speedup",
+     "title": "fork-choice head vs phase0 spec oracle",
+     "metric": r"forkchoice::speedup",
+     "field": "value", "op": ">=", "target": 2.0, "tpu_only": False},
+    {"id": "fc-head-throughput",
+     "title": "fork-choice head polls per second",
+     "metric": r"forkchoice::heads_per_s",
+     "field": "value", "op": ">=", "target": 100.0, "tpu_only": True},
     # checkpoint restore (PR 9): snapshot + journal replay must beat
     # the full O(N) re-merkleize >= 5x at <= 1% journal depth (the
     # speedup rides the restore record's vs_baseline).  Shape-, not
@@ -881,6 +896,61 @@ def render_das(records) -> list[str]:
     return lines
 
 
+def render_forkchoice(records) -> list[str]:
+    """The fork-choice read side: per-shape head walls from the latest
+    `forkchoice::head_wall@<blocks>x<validators>` records (the compact
+    block rides each), plus the latest speedup/throughput summary."""
+    lines = ["## Fork choice (device LMD-GHOST)\n"]
+    recs = [r for r in records if r.get("source") == "forkchoice"]
+    if not recs:
+        lines.append("No forkchoice records — run the tree sweep "
+                     "(`python bench.py --worker forkchoice` on the "
+                     "chip, or `make fc-smoke` for the CPU contract "
+                     "check) to produce `forkchoice::*` records.\n")
+        return lines
+    rows: dict[tuple[int, int], dict] = {}
+    for r in sorted((r for r in recs
+                     if r["metric"].startswith("forkchoice::head_wall@")
+                     and isinstance(r.get("forkchoice"), dict)),
+                    key=_order_key):
+        t = (r["forkchoice"].get("tree") or {})
+        b, v = t.get("blocks"), t.get("validators")
+        if isinstance(b, int) and isinstance(v, int):
+            rows[(b, v)] = r
+    if rows:
+        lines.append("| tree | head wall | apply wall | vs oracle | "
+                     "rungs | platform | where |")
+        lines.append("|---|---|---|---|---|---|---|")
+        for (b, v), r in sorted(rows.items()):
+            blk = r["forkchoice"]
+            vs = r.get("vs_baseline")
+            rungs = blk.get("rungs") or {}
+            rung_s = (f"{rungs.get('blocks', '—')}/"
+                      f"{rungs.get('validators', '—')}/"
+                      f"{rungs.get('batch', '—')}")
+            lines.append(
+                f"| {b}x{v} | {_fmt(r.get('value'), 5)} s "
+                f"| {_fmt(blk.get('apply_wall_s'), 5)} s "
+                f"| {'—' if vs is None else f'{_fmt(vs, 1)}x'} "
+                f"| {rung_s} | {_platform_group(r)} | {_where(r)} |")
+        lines.append("")
+    sp = [r for r in recs if r["metric"] == "forkchoice::speedup"]
+    if sp:
+        latest = max(sp, key=_order_key)
+        lines.append(
+            f"Latest head speedup over the phase0 spec oracle: "
+            f"{_fmt(latest['value'], 1)}x ({_where(latest)}, platform "
+            f"{_platform_group(latest)}).\n")
+    hps = [r for r in recs if r["metric"] == "forkchoice::heads_per_s"]
+    if hps:
+        latest = max(hps, key=_order_key)
+        lines.append(
+            f"Latest head throughput: {_si(latest['value'])} heads/s "
+            f"({_where(latest)}, platform "
+            f"{_platform_group(latest)}).\n")
+    return lines
+
+
 def render_msm(msm: dict) -> list[str]:
     lines = ["## `_MSM_DEVICE_MIN` break-even\n", msm["text"] + "\n"]
     if msm.get("sizes"):
@@ -949,6 +1019,7 @@ def render_report(result: dict) -> str:
     lines.extend(render_resilience(result["records"]))
     lines.extend(render_scaling(result["records"]))
     lines.extend(render_das(result["records"]))
+    lines.extend(render_forkchoice(result["records"]))
     lines.extend(render_msm(result["msm"]))
     lines.extend(render_utilization(result["utilization"], result["msm"]))
     lines.extend(render_trend_tables(result["records"]))

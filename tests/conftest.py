@@ -202,6 +202,30 @@ def _session_oracle_reuse():
             setattr(mod, name, orig)
 
 
+# The fork-choice spec-oracle route (`forkchoice.oracle.spec_get_head`)
+# synthesizes a full executable-spec Store and runs the oracle's
+# get_head — a pure function of the proto store's host state, which
+# `ProtoArrayStore.fingerprint()` digests canonically (blocks,
+# messages, balances, checkpoints, boost, config).  The parity suites
+# re-evaluate identical store states across tests (every device head
+# check re-asks the oracle), so the session scope memoizes the seam on
+# the fingerprint — bench paths measure the unwrapped oracle.
+
+
+@pytest.fixture(autouse=True, scope="session")
+def _session_forkchoice_oracle_reuse():
+    from consensus_specs_tpu.forkchoice import oracle as fc_oracle
+
+    wrapped = _memo(fc_oracle.spec_get_head,
+                    lambda proto: proto.fingerprint())
+    orig = fc_oracle.spec_get_head
+    fc_oracle.spec_get_head = wrapped
+    try:
+        yield
+    finally:
+        fc_oracle.spec_get_head = orig
+
+
 @pytest.fixture(autouse=True, scope="session")
 def _configure_backends(request):
     from consensus_specs_tpu.ops import bls

@@ -1,0 +1,355 @@
+"""Fork choice: device proto-array store vectors — every head check in
+these vectors is the DEVICE store's decision
+(`consensus_specs_tpu/forkchoice/`), asserted bit-identical to the
+spec oracle's `get_head` before it is written.  A consumer replaying
+the emitted steps replays device-made head selections the oracle
+co-signed: tie-breaks, proposer-boost (ex-ante) arcs, vote-driven
+re-orgs and equivocation discounts included.
+
+Each scenario drives the executable-spec Store through the standard
+on_tick/on_block/on_attestation helpers, then projects it into a
+`ProtoArrayStore` via `forkchoice.bridge` at every check point.  The
+suite doubles as the spec-store-driven parity pin (the synthetic-store
+randomized parity lives in tests/test_forkchoice.py).
+"""
+
+import pytest
+
+from consensus_specs_tpu.forkchoice.bridge import device_head
+from consensus_specs_tpu.testlib.context import (
+    spec_state_test,
+    with_phases,
+)
+from consensus_specs_tpu.testlib.helpers.attestations import (
+    get_valid_attestation,
+)
+from consensus_specs_tpu.testlib.helpers.attester_slashings import (
+    get_valid_attester_slashing_by_indices,
+)
+from consensus_specs_tpu.testlib.helpers.block import (
+    build_empty_block,
+    build_empty_block_for_next_slot,
+)
+from consensus_specs_tpu.testlib.helpers.fork_choice import (
+    add_attestation,
+    add_attester_slashing,
+    add_block,
+    apply_next_epoch_with_attestations,
+    get_anchor_root,
+    get_genesis_forkchoice_store_and_block,
+    on_tick_and_append_step,
+    tick_and_add_block,
+    tick_and_run_on_attestation,
+)
+from consensus_specs_tpu.testlib.helpers.fork_choice import (
+    encode_hex,
+)
+from consensus_specs_tpu.testlib.helpers.state import (
+    state_transition_and_sign_block,
+)
+
+
+def check_device_head(spec, store, test_steps, expected_root=None):
+    """The suite's ONE check primitive: the device store's head must
+    equal the spec oracle's (and `expected_root` when given); the step
+    written carries the device decision."""
+    head = device_head(spec, store)
+    assert head == bytes(spec.get_head(store))
+    if expected_root is not None:
+        assert head == bytes(expected_root)
+    test_steps.append({"checks": {"head": {
+        "slot": int(store.blocks[spec.Root(head)].slot),
+        "root": encode_hex(head),
+    }}})
+    return head
+
+
+def _start(spec, state):
+    store, anchor_block = get_genesis_forkchoice_store_and_block(spec,
+                                                                 state)
+    test_steps = []
+    current_time = (state.slot * spec.config.SECONDS_PER_SLOT
+                    + store.genesis_time)
+    on_tick_and_append_step(spec, store, current_time, test_steps)
+    return store, anchor_block, test_steps
+
+
+def _block_on(spec, parent_state, slot, graffiti=None):
+    post = parent_state.copy()
+    block = build_empty_block(spec, post, slot=slot)
+    if graffiti is not None:
+        block.body.graffiti = graffiti
+    signed = state_transition_and_sign_block(spec, post, block)
+    return signed, post
+
+
+@with_phases(["phase0"])
+@spec_state_test
+def test_device_genesis_head(spec, state):
+    """Anchor-only store: the device head is the anchor."""
+    store, anchor_block, test_steps = _start(spec, state)
+    yield "anchor_state", state
+    yield "anchor_block", anchor_block
+
+    anchor_root = get_anchor_root(spec, state)
+    check_device_head(spec, store, test_steps,
+                      expected_root=anchor_root)
+    yield "steps", test_steps
+
+
+@with_phases(["phase0"])
+@spec_state_test
+def test_device_chain_growth(spec, state):
+    """A vote-free chain: the device head follows the tip block by
+    block."""
+    store, anchor_block, test_steps = _start(spec, state)
+    yield "anchor_state", state
+    yield "anchor_block", anchor_block
+
+    for _ in range(3):
+        block = build_empty_block_for_next_slot(spec, state)
+        signed = state_transition_and_sign_block(spec, state, block)
+        yield from tick_and_add_block(spec, store, signed, test_steps)
+        check_device_head(spec, store, test_steps,
+                          expected_root=spec.hash_tree_root(block))
+    yield "steps", test_steps
+
+
+@with_phases(["phase0"])
+@spec_state_test
+def test_device_split_tie_breaker(spec, state):
+    """Two zero-weight siblings: the device tie-break (8 big-endian
+    root words) picks the lexicographically larger root, like the
+    oracle's bytes compare."""
+    store, anchor_block, test_steps = _start(spec, state)
+    yield "anchor_state", state
+    yield "anchor_block", anchor_block
+    genesis_state = state.copy()
+
+    signed_1, _ = _block_on(spec, genesis_state, state.slot + 1)
+    signed_2, _ = _block_on(spec, genesis_state, state.slot + 1,
+                            graffiti=b"\x42" * 32)
+
+    # tick past the slot so neither block carries the boost
+    time = (store.genesis_time
+            + (signed_2.message.slot + 1) * spec.config.SECONDS_PER_SLOT)
+    on_tick_and_append_step(spec, store, time, test_steps)
+    yield from add_block(spec, store, signed_1, test_steps)
+    yield from add_block(spec, store, signed_2, test_steps)
+
+    highest = max(spec.hash_tree_root(signed_1.message),
+                  spec.hash_tree_root(signed_2.message))
+    check_device_head(spec, store, test_steps, expected_root=highest)
+    yield "steps", test_steps
+
+
+@with_phases(["phase0"])
+@spec_state_test
+def test_device_vote_moves_head(spec, state):
+    """One attestation re-orgs the head onto a shorter but heavier
+    branch (the LMD weight fold beating chain length)."""
+    store, anchor_block, test_steps = _start(spec, state)
+    yield "anchor_state", state
+    yield "anchor_block", anchor_block
+    genesis_state = state.copy()
+
+    long_state = genesis_state.copy()
+    for _ in range(2):
+        long_block = build_empty_block_for_next_slot(spec, long_state)
+        signed_long = state_transition_and_sign_block(spec, long_state,
+                                                      long_block)
+        yield from tick_and_add_block(spec, store, signed_long,
+                                      test_steps)
+
+    short_state = genesis_state.copy()
+    short_block = build_empty_block_for_next_slot(spec, short_state)
+    short_block.body.graffiti = b"\x42" * 32
+    signed_short = state_transition_and_sign_block(spec, short_state,
+                                                   short_block)
+    yield from tick_and_add_block(spec, store, signed_short, test_steps)
+
+    attestation = get_valid_attestation(spec, short_state,
+                                        short_block.slot, signed=True)
+    yield from tick_and_run_on_attestation(spec, store, attestation,
+                                           test_steps)
+    check_device_head(spec, store, test_steps,
+                      expected_root=spec.hash_tree_root(short_block))
+    yield "steps", test_steps
+
+
+@with_phases(["phase0"])
+@spec_state_test
+def test_device_competing_votes(spec, state):
+    """Votes on both forks: the branch with more attesting committees
+    wins the subtree-weight comparison."""
+    store, anchor_block, test_steps = _start(spec, state)
+    yield "anchor_state", state
+    yield "anchor_block", anchor_block
+    genesis_state = state.copy()
+
+    state_1 = genesis_state.copy()
+    block_1 = build_empty_block_for_next_slot(spec, state_1)
+    block_1.body.graffiti = b"\x42" * 32
+    signed_1 = state_transition_and_sign_block(spec, state_1, block_1)
+
+    state_2 = genesis_state.copy()
+    block_2 = build_empty_block_for_next_slot(spec, state_2)
+    signed_2 = state_transition_and_sign_block(spec, state_2, block_2)
+
+    yield from tick_and_add_block(spec, store, signed_1, test_steps)
+    yield from add_block(spec, store, signed_2, test_steps)
+
+    # one committee votes fork 1, a half-committee votes fork 2
+    att_1 = get_valid_attestation(spec, state_1, block_1.slot,
+                                  signed=True)
+    att_2 = get_valid_attestation(
+        spec, state_2, block_2.slot, signed=True,
+        filter_participant_set=lambda comm:
+        set(list(comm)[:max(1, len(comm) // 2)]))
+    yield from tick_and_run_on_attestation(spec, store, att_1,
+                                           test_steps)
+    yield from tick_and_run_on_attestation(spec, store, att_2,
+                                           test_steps)
+    check_device_head(spec, store, test_steps,
+                      expected_root=spec.hash_tree_root(block_1))
+    yield "steps", test_steps
+
+
+@with_phases(["phase0"])
+@spec_state_test
+def test_device_ex_ante_boost(spec, state):
+    """Ex-ante re-org protection: one adversarial attestation for the
+    withheld sibling B cannot outweigh timely block C's proposer
+    boost — the device boost fold keeps C as head."""
+    store, anchor_block, test_steps = _start(spec, state)
+    yield "anchor_state", state
+    yield "anchor_block", anchor_block
+
+    signed_a, state_a = _block_on(spec, state, state.slot + 1)
+    yield from tick_and_add_block(spec, store, signed_a, test_steps)
+
+    signed_b, state_b = _block_on(spec, state_a, state_a.slot + 1)
+    signed_c, _ = _block_on(spec, state_a, state_a.slot + 2)
+
+    yield from tick_and_add_block(spec, store, signed_c, test_steps)
+    root_c = spec.hash_tree_root(signed_c.message)
+    assert store.proposer_boost_root == root_c
+    check_device_head(spec, store, test_steps, expected_root=root_c)
+
+    yield from add_block(spec, store, signed_b, test_steps)
+    attestation = get_valid_attestation(
+        spec, state_b, slot=signed_b.message.slot, signed=True,
+        filter_participant_set=lambda comm: set(list(comm)[:1]))
+    yield from add_attestation(spec, store, attestation, test_steps)
+    check_device_head(spec, store, test_steps, expected_root=root_c)
+    yield "steps", test_steps
+
+
+@with_phases(["phase0"])
+@spec_state_test
+def test_device_boost_expiry(spec, state):
+    """The proposer boost expires at the next slot tick: the boosted
+    block loses the head back to the attested sibling (the device
+    store re-decides without the boost term)."""
+    store, anchor_block, test_steps = _start(spec, state)
+    yield "anchor_state", state
+    yield "anchor_block", anchor_block
+
+    signed_a, state_a = _block_on(spec, state, state.slot + 1)
+    yield from tick_and_add_block(spec, store, signed_a, test_steps)
+
+    signed_b, state_b = _block_on(spec, state_a, state_a.slot + 1)
+    signed_c, _ = _block_on(spec, state_a, state_a.slot + 2)
+
+    yield from tick_and_add_block(spec, store, signed_c, test_steps)
+    root_c = spec.hash_tree_root(signed_c.message)
+    yield from add_block(spec, store, signed_b, test_steps)
+    root_b = spec.hash_tree_root(signed_b.message)
+    attestation = get_valid_attestation(
+        spec, state_b, slot=signed_b.message.slot, signed=True,
+        filter_participant_set=lambda comm: set(list(comm)[:1]))
+    yield from add_attestation(spec, store, attestation, test_steps)
+    check_device_head(spec, store, test_steps, expected_root=root_c)
+
+    # next-slot tick: the boost wears off, B's attestation decides
+    time = (store.genesis_time
+            + (signed_c.message.slot + 1) * spec.config.SECONDS_PER_SLOT)
+    on_tick_and_append_step(spec, store, time, test_steps)
+    assert store.proposer_boost_root == spec.Root()
+    check_device_head(spec, store, test_steps, expected_root=root_b)
+    yield "steps", test_steps
+
+
+@with_phases(["phase0"])
+@spec_state_test
+def test_device_equivocation_discount(spec, state):
+    """An attester slashing freezes the equivocator's latest message
+    out of the weight fold: the tie-break restores the rival head."""
+    store, anchor_block, test_steps = _start(spec, state)
+    yield "anchor_state", state
+    yield "anchor_block", anchor_block
+    genesis_state = state.copy()
+
+    state_1 = genesis_state.copy()
+    block_1 = build_empty_block_for_next_slot(spec, state_1)
+    block_1.body.graffiti = b"\x42" * 32
+    signed_1 = state_transition_and_sign_block(spec, state_1, block_1)
+
+    state_2 = genesis_state.copy()
+    block_2 = build_empty_block_for_next_slot(spec, state_2)
+    signed_2 = state_transition_and_sign_block(spec, state_2, block_2)
+
+    root_1 = spec.hash_tree_root(block_1)
+    root_2 = spec.hash_tree_root(block_2)
+    if root_2 > root_1:
+        signed_1, signed_2 = signed_2, signed_1
+        block_1, block_2 = block_2, block_1
+        state_1, state_2 = state_2, state_1
+        root_1, root_2 = root_2, root_1
+
+    attestation = get_valid_attestation(
+        spec, state_2, slot=block_2.slot, signed=True,
+        filter_participant_set=lambda comm: [min(comm)])
+    attester_index = min(spec.get_attesting_indices(state_2,
+                                                    attestation))
+    attester_slashing = get_valid_attester_slashing_by_indices(
+        spec, state_2, [attester_index], signed_1=True, signed_2=True)
+
+    yield from tick_and_add_block(spec, store, signed_1, test_steps)
+    yield from tick_and_add_block(spec, store, signed_2, test_steps)
+    yield from tick_and_run_on_attestation(spec, store, attestation,
+                                           test_steps)
+    check_device_head(spec, store, test_steps, expected_root=root_2)
+
+    yield from add_attester_slashing(spec, store, attester_slashing,
+                                     test_steps)
+    check_device_head(spec, store, test_steps, expected_root=root_1)
+    yield "steps", test_steps
+
+
+@pytest.mark.slow
+@with_phases(["phase0"])
+@spec_state_test
+def test_device_justified_tree_filter(spec, state):
+    """Multi-epoch arc: after justification advances, the device
+    viability filter (voting-source + finalized-descent checks ORed up
+    the tree) agrees with the oracle's filter_block_tree on every
+    check."""
+    store, anchor_block, test_steps = _start(spec, state)
+    yield "anchor_state", state
+    yield "anchor_block", anchor_block
+
+    for _ in range(3):
+        state, store, _ = yield from apply_next_epoch_with_attestations(
+            spec, state, store, True, False, test_steps=test_steps)
+    assert store.justified_checkpoint.epoch > 0
+    check_device_head(spec, store, test_steps)
+
+    # one more vote-free block on top: the head keeps tracking it
+    # through the justified-root walk
+    block = build_empty_block_for_next_slot(spec, state)
+    signed = state_transition_and_sign_block(spec, state, block)
+    yield from tick_and_add_block(spec, store, signed, test_steps)
+    check_device_head(spec, store, test_steps,
+                      expected_root=spec.hash_tree_root(block))
+    yield "steps", test_steps

@@ -381,14 +381,15 @@ def test_sample_from_sidecar_roundtrip(spec):
 def test_serve_das_lane_host_routed(matrix, monkeypatch):
     """submit_das_sample end to end with the dispatch routed to the
     host verifier (the device arc is @slow below): valid and invalid
-    samples settle their own verdicts, kind ordering preserved."""
+    samples settle their own verdicts through the per-pump group
+    batch, and the group's per-sample recheck isolates the bad one."""
     from consensus_specs_tpu.das import sampling as sampling_mod
     from consensus_specs_tpu.serve.executor import ServeExecutor
 
-    orig_async = sampling_mod.verify_sample_async
+    orig_group = sampling_mod.verify_sample_group_async
     monkeypatch.setattr(
-        sampling_mod, "verify_sample_async",
-        lambda sample, device=None: orig_async(sample, device=False))
+        sampling_mod, "verify_sample_group_async",
+        lambda samples, device=True: orig_group(samples, device=False))
 
     com, idx, cells, proofs = matrix
     good = das_sampling.sample_from_matrix(com, idx, cells, proofs, 0)
@@ -402,6 +403,37 @@ def test_serve_das_lane_host_routed(matrix, monkeypatch):
     assert f_bad.result() is False
     st = ex.stats()
     assert st["settled"] == 2 and st["failed"] == 0
+    # the two queued samples rode ONE group dispatch
+    assert st["batches"] == 1
+
+
+def test_serve_das_cross_sample_batching(matrix, monkeypatch):
+    """The per-pump fold: N queued das samples dispatch as ONE device
+    batch (the RLC equation over all their cell statements), and each
+    request still settles its own verdict."""
+    from consensus_specs_tpu.das import sampling as sampling_mod
+    from consensus_specs_tpu.serve.executor import ServeExecutor
+
+    calls = {"groups": 0, "samples": 0}
+    orig_group = sampling_mod.verify_sample_group_async
+
+    def counting_group(samples, device=True):
+        calls["groups"] += 1
+        calls["samples"] += len(samples)
+        return orig_group(samples, device=False)
+
+    monkeypatch.setattr(sampling_mod, "verify_sample_group_async",
+                        counting_group)
+    com, idx, cells, proofs = matrix
+    samples = [das_sampling.sample_from_matrix(com, idx, cells,
+                                               proofs, c)
+               for c in (0, 3, 64)]
+    ex = ServeExecutor(max_batch=8, depth=1)
+    futs = [ex.submit_das_sample(s) for s in samples]
+    ex.drain()
+    assert [f.result() for f in futs] == [True, True, True]
+    assert calls == {"groups": 1, "samples": 3}
+    assert ex.stats()["batches"] == 1
 
 
 def test_serve_das_breaker_falls_back_to_host_oracle(matrix,
@@ -414,11 +446,12 @@ def test_serve_das_breaker_falls_back_to_host_oracle(matrix,
 
     calls = {"n": 0}
 
-    def exploding(sample, device=None):
+    def exploding(samples, device=True):
         calls["n"] += 1
         raise RuntimeError("device sick")
 
-    monkeypatch.setattr(sampling_mod, "verify_sample_async", exploding)
+    monkeypatch.setattr(sampling_mod, "verify_sample_group_async",
+                        exploding)
     com, idx, cells, proofs = matrix
     sample = das_sampling.sample_from_matrix(com, idx, cells, proofs, 0)
     ex = ServeExecutor(max_batch=8, depth=1,

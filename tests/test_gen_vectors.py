@@ -233,3 +233,82 @@ def test_random_scenario_vector_replays(tmp_path):
             decompress((case / f"blocks_{i}.ssz_snappy").read_bytes()))
         spec.state_transition(state, block, validate_result=False)
     assert hash_tree_root(state) == hash_tree_root(post)
+
+
+def _device_store_cases():
+    from consensus_specs_tpu.gen.runners import fork_choice
+
+    return [tc for tc in fork_choice.get_test_cases()
+            if tc.preset_name == "minimal" and tc.fork_name == "phase0"
+            and tc.handler_name == "device_store"]
+
+
+def test_fork_choice_device_vector_slice(tmp_path):
+    """Fast-tier slice of the device-store fork-choice vectors: tree
+    layout, anchor parts, and the steps contract (every case ends with
+    a head check — the DEVICE store's decision, oracle-co-signed at
+    emission time)."""
+    cases = [tc for tc in _device_store_cases()
+             if tc.case_name in ("device_genesis_head",
+                                 "device_chain_growth",
+                                 "device_split_tie_breaker")]
+    assert len(cases) == 3, [tc.case_name for tc in cases]
+    rc = run_generator(cases, _args(tmp_path))
+    assert rc == 0
+    base = (tmp_path
+            / "minimal/phase0/fork_choice/device_store/pyspec_tests")
+    for name in ("device_genesis_head", "device_chain_growth",
+                 "device_split_tie_breaker"):
+        case = base / name
+        assert (case / "anchor_state.ssz_snappy").exists(), name
+        assert (case / "anchor_block.ssz_snappy").exists(), name
+        steps = yaml.safe_load((case / "steps.yaml").read_text())
+        heads = [s for s in steps
+                 if "checks" in s and "head" in s["checks"]]
+        assert heads, name
+        head = heads[-1]["checks"]["head"]
+        assert set(head) == {"slot", "root"}
+        assert head["root"].startswith("0x")
+
+    # consumer replay: the chain-growth case's final head must be the
+    # last emitted block
+    case = base / "device_chain_growth"
+    steps = yaml.safe_load((case / "steps.yaml").read_text())
+    blocks = [s["block"] for s in steps if "block" in s]
+    assert len(blocks) == 3
+    spec = build_spec("phase0", "minimal")
+    last = spec.SignedBeaconBlock.decode_bytes(decompress(
+        (case / f"{blocks[-1]}.ssz_snappy").read_bytes()))
+    final_head = [s for s in steps
+                  if "checks" in s and "head" in s["checks"]][-1]
+    assert final_head["checks"]["head"]["root"] \
+        == "0x" + hash_tree_root(last.message).hex()
+    assert final_head["checks"]["head"]["slot"] == int(last.message.slot)
+
+
+@pytest.mark.slow
+def test_fork_choice_device_vector_tree_full(tmp_path):
+    """The full device-store handler: >= 8 vectors generated, each
+    with anchor parts and at least one device head check (boost and
+    equivocation arcs included)."""
+    cases = _device_store_cases()
+    assert len(cases) >= 8, [tc.case_name for tc in cases]
+    rc = run_generator(cases, _args(tmp_path))
+    assert rc == 0
+    base = (tmp_path
+            / "minimal/phase0/fork_choice/device_store/pyspec_tests")
+    dirs = [d for d in base.iterdir() if d.is_dir()]
+    assert len(dirs) >= 8, sorted(d.name for d in dirs)
+    for d in dirs:
+        assert (d / "anchor_state.ssz_snappy").exists(), d.name
+        assert (d / "anchor_block.ssz_snappy").exists(), d.name
+        steps = yaml.safe_load((d / "steps.yaml").read_text())
+        assert any("checks" in s and "head" in s["checks"]
+                   for s in steps), d.name
+    # the boost-expiry arc must record the re-org: the emitted head
+    # checks carry BOTH the boosted head and the post-expiry head
+    steps = yaml.safe_load(
+        (base / "device_boost_expiry" / "steps.yaml").read_text())
+    heads = [s["checks"]["head"]["root"] for s in steps
+             if "checks" in s and "head" in s["checks"]]
+    assert len(set(heads)) >= 2, heads
