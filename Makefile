@@ -20,9 +20,12 @@ help:
 	@echo "  asserts the bench JSON contract) | bench-report (benchwatch"
 	@echo "  trend/threshold dashboard over the checked-in rounds +"
 	@echo "  out/bench_history.jsonl; exits nonzero on regression) |"
-	@echo "  serve (sustained-load verification service, real TPU) |"
-	@echo "  serve-smoke (short closed-loop CPU serve round, emits the"
-	@echo "  serve bench JSON + benchwatch history) | chaos-smoke (serve"
+	@echo "  serve (sustained-load verification service, real TPU;"
+	@echo "  CST_TRACE_REQUESTS=1 adds per-request tail-latency"
+	@echo "  attribution, CST_SERVE_STATUS_EVERY=N live status dumps) |"
+	@echo "  serve-smoke (short closed-loop CPU serve round with request"
+	@echo "  tracing, emits the serve bench JSON + benchwatch history +"
+	@echo "  worst-N exemplar traces) | chaos-smoke (serve"
 	@echo "  round under a canned fault plan: breaker/oracle-fallback"
 	@echo "  degraded mode, checkpoint kill/restore, flagship breaker,"
 	@echo "  recovery-to-steady, resilience records) | chaos-mesh-smoke"
@@ -93,11 +96,14 @@ serve:
 	$(PYTHON) bench_serve.py
 
 # no TPU required: short closed-loop serve round on tiny CPU shapes —
-# the measured rate is the host's capacity, the JSON contract and the
-# serve::* history records are what CI checks
+# the measured rate is the host's capacity, the JSON contract, the
+# serve::* history records, and (CST_TRACE_REQUESTS=1) the per-request
+# latency_attribution block + worst-N exemplar artifact are what CI
+# checks
 serve-smoke:
 	@$(CPU_ENV) CST_SERVE_DURATION_S=12 CST_SERVE_RATE=0 CST_SERVE_POOL=4 \
 		CST_SERVE_COMMITTEE=4 CST_SERVE_MAX_BATCH=8 CST_SERVE_WINDOWS=3 \
+		CST_TRACE_REQUESTS=1 \
 		$(PYTHON) bench_serve.py
 
 # no TPU required: the chaos round — bench_serve under CST_SERVE_CHAOS=1

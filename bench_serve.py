@@ -35,6 +35,17 @@ recovery-to-steady, with the `"resilience"` sub-object (schema
 `chaos-correctness` threshold rows.  A chaos round additionally exits
 nonzero on any wrong result or when the service never recovers.
 
+Request tracing: `CST_TRACE_REQUESTS=1` mints a per-request
+`RequestContext` at every submit (chaos rounds arm it automatically) —
+the serve block's p50/p99 switch to per-request submit→complete
+semantics (`latency_source: "reqtrace"`), a `latency_attribution`
+sub-object decomposes the per-kind tail into
+queue_wait/batch_form/device_wall/settle/detour, `latency::*` history
+records feed the report's "Tail latency" section, and the worst-N
+exemplar traces are written to `out/serve_exemplars.json` (the CI
+artifact).  `CST_SERVE_STATUS_EVERY=<s>` additionally dumps the
+executor's live `status()` JSON on stderr while the round runs.
+
 Knobs are the CST_SERVE_* family (README "Serving"); the CPU smoke runs
 closed-loop (`CST_SERVE_RATE=0`) so the measured rate is the host's
 capacity instead of an idle fixed-rate clock.  With CST_TELEMETRY=1 the
@@ -132,6 +143,26 @@ def main() -> int:
     }
     if res is not None:
         record["resilience"] = res
+    la = block.get("latency_attribution")
+    if la is not None:
+        # worst-N exemplar traces as a standalone artifact (CI uploads
+        # both): enough to reconstruct WHERE each tail request's wall
+        # went without re-running the round.  Chaos rounds write their
+        # own file so the CI job's later chaos-smoke step cannot
+        # clobber the serve-smoke step's exemplars
+        exemplars = Path(__file__).resolve().parent / "out" / \
+            ("chaos_exemplars.json" if chaos else "serve_exemplars.json")
+        exemplars.parent.mkdir(exist_ok=True)
+        exemplars.write_text(json.dumps(
+            {"metric": "serve_sustained_load",
+             "latency_source": block.get("latency_source"),
+             "p99_queue_frac": la.get("p99_queue_frac"),
+             "kinds": {k: v.get("p99_components_ms")
+                       for k, v in la.get("kinds", {}).items()},
+             "worst": la.get("worst", [])}, indent=1) + "\n")
+        log(f"serve bench: tail attribution — p99 queue frac "
+            f"{la.get('p99_queue_frac')}, worst exemplars -> "
+            f"{exemplars}")
     rc = 0
     if not block["steady"]:
         # the exit-code contract: an unconverged run must not pass for

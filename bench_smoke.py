@@ -21,12 +21,17 @@ with nonzero flops/bytes for the flagship kernel (the fused epoch step;
 the BLS round must cover the pairing/MSM/h2c/sha256 kernel surface),
 and the benchwatch store round-trips the new `costmodel` record kind.
 
-A third round runs bench_serve.py closed-loop on tiny shapes and
-asserts the serving contract: a steady-state `"serve"` sub-object
-(verifies/sec, p50/p99 batch latency, queue-depth histogram —
-`validate_serve_block`), the `serve::*` benchwatch history records,
-and the queue-depth / in-flight gauge counter tracks in the Chrome
-trace.
+A third round runs bench_serve.py closed-loop on tiny shapes with
+request tracing armed (CST_TRACE_REQUESTS=1) and asserts the serving
+contract: a steady-state `"serve"` sub-object (verifies/sec,
+per-request p50/p99, queue-depth histogram — `validate_serve_block`),
+the `latency_attribution` tail decomposition (every served kind
+present, exemplar components summing to end-to-end within 1ms), the
+`serve::*` + `latency::*` benchwatch history records, the queue-depth
+/ in-flight gauge counter tracks AND the per-request flow arrows
+(submit → batch → settle, one per kind) in the Chrome trace, the
+report's "Tail latency" section, and the worst-N exemplar artifact
+(`out/serve_exemplars.json`).
 
 `bench_smoke.py --chaos` (the `make chaos-smoke` / CI chaos-smoke
 lane) runs ONLY the chaos round: bench_serve.py under
@@ -355,12 +360,20 @@ def main():
     serve_trace = HERE / "out" / "smoke_serve_trace.json"
     if serve_trace.exists():
         serve_trace.unlink()
+    exemplar_file = HERE / "out" / "serve_exemplars.json"
+    if exemplar_file.exists():
+        exemplar_file.unlink()
     serve_t0 = time.time()
+    # CST_TRACE_REQUESTS=1: the round runs with request tracing armed —
+    # per-request percentile semantics, the latency_attribution block,
+    # flow events in the trace, latency::* records, and the exemplar
+    # artifact are all asserted below (the acceptance arc of the
+    # request-tracing PR)
     out = _run(["bench_serve.py"],
                {"CST_SERVE_DURATION_S": "12", "CST_SERVE_RATE": "0",
                 "CST_SERVE_POOL": "4", "CST_SERVE_COMMITTEE": "4",
                 "CST_SERVE_MAX_BATCH": "8", "CST_SERVE_WINDOWS": "3",
-                "CST_TELEMETRY": "1",
+                "CST_TELEMETRY": "1", "CST_TRACE_REQUESTS": "1",
                 "CST_TRACE_FILE": str(serve_trace),
                 "CST_BENCHWATCH_HISTORY": str(hist_file)},
                timeout=900)
@@ -381,6 +394,32 @@ def main():
     # futures pipeline (and settled — failed==0 covers it above)
     assert block["kinds"].get("proof", 0) >= 1, block["kinds"]
     _check_telemetry(sl, "serve bench")
+
+    # request-tracing contract: per-request percentile basis, a
+    # schema-valid latency_attribution with one entry per served kind,
+    # and components that sum to each exemplar's end-to-end within 1ms
+    from consensus_specs_tpu.telemetry import validate_latency_attribution
+    served_kinds = {k for k, n in block["kinds"].items() if n > 0}
+
+    assert block.get("latency_source") == "reqtrace", block.get(
+        "latency_source")
+    la = block.get("latency_attribution")
+    problems = validate_latency_attribution(la)
+    assert not problems, (problems, json.dumps(la)[:500])
+    assert served_kinds <= set(la["kinds"]), (served_kinds,
+                                              sorted(la["kinds"]))
+    assert la["answered"] == block["settled"], (la["answered"], block)
+    for ex_rec in la["worst"]:
+        total = sum(ex_rec["components_ms"].values())
+        assert abs(total - ex_rec["e2e_ms"]) <= 1.0, ex_rec
+    for kind, blk in la["kinds"].items():
+        assert sum(blk["outcomes"].values()) == blk["count"], (kind, blk)
+    print(f"latency attribution OK: {len(la['kinds'])} kind(s), p99 "
+          f"queue frac {la['p99_queue_frac']}, {len(la['worst'])} "
+          f"exemplar(s)")
+    # the worst-N exemplar artifact bench_serve writes for CI upload
+    exemplars = json.loads(exemplar_file.read_text())
+    assert exemplars["worst"] == la["worst"], exemplar_file
     print("bench_serve.py JSON OK:", json.dumps(
         {k: v for k, v in sl.items() if k not in ("telemetry", "serve")}),
         f"({block['verifies_per_s']} verifies/s, steady over "
@@ -389,6 +428,7 @@ def main():
     # serve history round-trip: the emission must land as the
     # bench_emit line PLUS serve-source serve::* records (throughput
     # carrying the compacted block, latency percentiles standalone)
+    # PLUS the latency-source attribution records the traced round mines
     hist_records, _, _ = benchwatch.load_history(hist_file)
     fresh = [r for r in hist_records
              if isinstance(r.get("ts"), (int, float))
@@ -405,7 +445,22 @@ def main():
     vrec = by_metric["serve::verifies_per_s"]
     assert vrec["serve"]["queue_depth"]["hist"], vrec
     assert isinstance(vrec["serve"]["steady"], bool), vrec
-    print(f"serve history OK: {len(fresh)} records this run")
+    assert vrec["serve"]["latency_source"] == "reqtrace", vrec
+    for kind in sorted(served_kinds):
+        rec = by_metric.get(f"latency::p99_ms@{kind}")
+        assert rec is not None, (kind, sorted(by_metric))
+        assert rec["source"] == "latency", rec
+        assert not benchwatch.validate_record(rec), rec
+        comp = rec["latency"]["p99_components_ms"]
+        assert set(comp) == {"queue_wait", "batch_form", "device_wall",
+                             "settle", "detour"}, comp
+    qrec = by_metric.get("latency::p99_queue_frac")
+    assert qrec is not None and qrec["source"] == "latency", \
+        sorted(by_metric)
+    assert qrec["latency"]["worst"], qrec
+    print(f"serve history OK: {len(fresh)} records this run "
+          f"(incl. {sum(1 for m in by_metric if m.startswith('latency::'))} "
+          f"latency:: records)")
 
     # the serve pipeline's gauges ride the Chrome trace as 'C' counter
     # tracks (queue depth + in-flight batches breathing against the
@@ -418,7 +473,44 @@ def main():
     span_names = {e["name"] for e in trace["traceEvents"]
                   if e.get("ph") == "X"}
     assert "serve.pump" in span_names, sorted(span_names)
-    print(f"serve trace OK: gauge counter tracks present -> {serve_trace}")
+    # request-tracing flow events: every served kind must have at least
+    # one submit→…→settle flow arrow ('s' and matching 'f' by id), and
+    # request/batch lifecycle spans ride the per-kind request tracks
+    flow_s = [e for e in trace["traceEvents"] if e.get("ph") == "s"]
+    flow_f = [e for e in trace["traceEvents"] if e.get("ph") == "f"]
+    s_names = {e["name"] for e in flow_s}
+    for kind in sorted(served_kinds):
+        assert f"req.{kind}" in s_names, (kind, sorted(s_names))
+    s_ids = {e["id"] for e in flow_s}
+    f_ids = {e["id"] for e in flow_f}
+    assert s_ids and s_ids == f_ids, (len(s_ids), len(f_ids))
+    assert any(n.startswith("req.") for n in span_names), span_names
+    assert any(n.startswith("batch.") for n in span_names), span_names
+    print(f"serve trace OK: gauge counter tracks + {len(flow_s)} "
+          f"request flow arrows -> {serve_trace}")
+
+    # the report renders the Tail latency section from the latency::*
+    # records; the serve-p99-queue-frac advisory row stays TPU-gated
+    # ('no data' on this CPU round)
+    from consensus_specs_tpu.telemetry import report as bw_report
+
+    serve_report = HERE / "out" / "smoke_serve_report.md"
+    rc = bw_report.main(["--repo", str(HERE), "--history",
+                         str(hist_file), "--out", str(serve_report),
+                         "--no-update"])
+    assert rc == 0, f"benchwatch report exited {rc}"
+    text = serve_report.read_text()
+    assert "## Tail latency (request tracing)" in text, text[:2000]
+    assert "`verify`" in text and "Worst exemplar traces:" in text
+    result = bw_report.build_report(
+        repo=HERE, history_path=hist_file, snapshots=[],
+        durations_path=None, top_n=5, strict=False,
+        max_regress_pct=0.0, update_history=False)
+    rows = {t["id"]: t for t in result["thresholds"]}
+    assert rows["serve-p99-queue-frac"]["status"] == "no data", \
+        rows["serve-p99-queue-frac"]
+    print(f"tail-latency report OK: section rendered, TPU-gated "
+          f"queue-frac row reads 'no data' on CPU -> {serve_report}")
 
     # telemetry-OFF contract: the default path (what a non-telemetry
     # TPU round runs) must emit the plain 2-metric lines — no
@@ -494,12 +586,17 @@ def chaos_main(mesh: bool = False):
     assert br["trips"] >= 1, br
     tos = [t["to"] for t in br["transitions"]]
     assert "open" in tos and "half_open" in tos and "closed" in tos, br
-    # every breaker that saw post-fault traffic re-closed; a rung the
-    # closed-loop batching never revisited after the fault window keeps
-    # its open breaker (no probe traffic) — that is not a failed
-    # recovery, which the recovery-latency/steady asserts below pin
+    # every breaker that saw post-fault traffic re-closed — usually via
+    # the half-open probe (half_open → closed), but a batch dispatched
+    # BEFORE the trip that settles successfully after it closes the
+    # breaker directly (open → closed): the pipeline keeps `depth`
+    # batches in flight, and their success is real device health.  A
+    # rung the closed-loop batching never revisited after the fault
+    # window keeps its open breaker (no probe traffic) — that is not a
+    # failed recovery, which the recovery-latency/steady asserts pin
     reclosed = [t["key"] for t in br["transitions"]
-                if t["from"] == "half_open" and t["to"] == "closed"]
+                if t["to"] == "closed"
+                and t["from"] in ("half_open", "open")]
     assert reclosed, br
     assert any(s == "closed" for s in br["states"].values()), br
     assert res["recovered"] and res["recovery_latency_s"] is not None, res
@@ -527,6 +624,28 @@ def chaos_main(mesh: bool = False):
     serve = sl["serve"]
     assert serve["steady"], serve["windows"]
     assert serve["failed"] == 0, serve
+    # request tracing is armed for every chaos round: per-request
+    # latency semantics plus the fault→victim correlation — the blast
+    # radius must be exactly the retried/fallback-answered/poisoned
+    # handles (a fault victim can never settle with a clean 'ok')
+    from consensus_specs_tpu.telemetry import validate_latency_attribution
+    assert serve.get("latency_source") == "reqtrace", serve.get(
+        "latency_source")
+    la = serve.get("latency_attribution")
+    assert not validate_latency_attribution(la), la
+    assert "verify" in la["kinds"], sorted(la["kinds"])
+    fv = res["fault_victims"]
+    assert fv["count"] >= 1, fv
+    assert fv["trace_ids"], fv
+    assert fv["clean_ok"] == 0, fv
+    assert sum(fv["outcomes"].values()) == fv["count"], fv
+    assert set(fv["outcomes"]) <= {"retry", "fallback", "poisoned",
+                                   "recheck", "timeout"}, fv
+    # the arc recovered every victim: zero poisoned handles (matches
+    # failed_requests == 0 above)
+    assert fv["outcomes"].get("poisoned", 0) == 0, fv
+    print("fault victims OK:", json.dumps(fv["outcomes"]),
+          f"({fv['count']} victim(s))")
     if mesh:
         mb = res["mesh"]
         assert "skipped" not in mb, mb
@@ -571,6 +690,13 @@ def chaos_main(mesh: bool = False):
     rrec = fresh["resilience::recovery_latency_s"]
     assert rrec["value"] > 0 and rrec["resilience"]["recovered"], rrec
     assert fresh["resilience::wrong_results"]["value"] == 0
+    # the fault-victim correlation rides the compact resilience block
+    assert rrec["resilience"]["fault_victims"]["count"] >= 1, rrec
+    # the chaos round's traced latency records land too
+    lrec = fresh.get("latency::p99_ms@verify")
+    assert lrec is not None and lrec["source"] == "latency", \
+        sorted(fresh)
+    assert not benchwatch.validate_record(lrec), lrec
     # the heal record carries the taken recovery path
     assert fresh["resilience::merkle_heal_s"]["heal_path"] == "checkpoint"
     # the checkpoint record kind round-trips: restore wall with the
@@ -620,6 +746,8 @@ def chaos_main(mesh: bool = False):
     assert "## Resilience (chaos rounds)" in text, text[:2000]
     assert "`resilience::recovery_latency_s`" in text
     assert "Latest chaos round:" in text
+    assert "Blast radius (request tracing):" in text
+    assert "## Tail latency (request tracing)" in text, text[:2000]
     result = bw_report.build_report(
         repo=HERE, history_path=hist_file, snapshots=[],
         durations_path=None, top_n=5, strict=False,

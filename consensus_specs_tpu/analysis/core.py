@@ -86,6 +86,11 @@ RULE_IDS = {
         "bare/over-broad except in a device or serve module that "
         "neither re-raises nor poisons/records the exception — device "
         "failures must stay typed and visible, not read as success",
+    "reqtrace-uncovered-submit":
+        "ServeExecutor submit_* entry point that never mints a "
+        "reqtrace.RequestContext — requests entering through it would "
+        "be invisible to tail-latency attribution (see README Request "
+        "tracing)",
 }
 
 # --- file roles (which rule families run where) ------------------------------
@@ -97,8 +102,10 @@ ROLE_INSTR = "instr"     # instrumentation coverage rules
 ROLE_EXC = "exc"         # exception-swallow discipline (serve +
                          # resilience modules; device files get it via
                          # ROLE_DEVICE)
+ROLE_SERVE = "serve"     # request-tracing coverage of serve submit_*
+                         # entry points (reqtrace-uncovered-submit)
 ALL_ROLES = frozenset((ROLE_DEVICE, ROLE_KERNEL, ROLE_LIMB, ROLE_INSTR,
-                       ROLE_EXC))
+                       ROLE_EXC, ROLE_SERVE))
 
 # the device path named by the north star: every module that builds or
 # dispatches XLA programs (oracle siblings under ops/bls are scanned too;
@@ -147,6 +154,13 @@ INSTR_FILES = ("ops/bls_batch/__init__.py", "ops/bls/__init__.py",
                "resilience/mesh.py", "resilience/checkpoint.py",
                "das/verify.py", "forkchoice/store.py",
                "forkchoice/kernels.py")
+
+# request-tracing coverage surface: every `submit_*` entry point of a
+# serve executor class must mint a reqtrace.RequestContext (directly or
+# via a same-module helper it calls — the same call-graph propagation
+# as instr-uncovered-entry), or requests entering through it would be
+# invisible to tail-latency attribution
+SERVE_FILES = ("serve/executor.py",)
 
 # shape-laundering functions: a value that went through one of these is
 # a bucketed compile key, not a raw dimension.  `mesh_rung` is the
@@ -690,6 +704,8 @@ def analyze_source(src: str, path: str = "<snippet>",
     if ROLE_INSTR in roles:
         findings += instrumentation.check(
             model, external_covered, external_device, external_cost)[0]
+    if ROLE_SERVE in roles:
+        findings += instrumentation.check_reqtrace(model)
     return _apply_suppressions(model, findings)
 
 
@@ -713,6 +729,10 @@ def _tree_files(root: Path) -> list[tuple[Path, frozenset]]:
     for pattern in EXC_GLOBS:
         for p in sorted(root.glob(pattern)):
             files.setdefault(p, set()).add(ROLE_EXC)
+    for rel in SERVE_FILES:
+        p = root / rel
+        if p.exists():
+            files.setdefault(p, set()).add(ROLE_SERVE)
     return [(p, frozenset(r)) for p, r in sorted(files.items())]
 
 

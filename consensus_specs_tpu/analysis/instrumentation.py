@@ -1,4 +1,5 @@
-"""Rule family 4 — instrumentation coverage of kernel entry points.
+"""Rule family 4 — instrumentation coverage of kernel entry points,
+plus the request-tracing coverage of serve submit entry points.
 
 PR 2's telemetry layer answers the ROADMAP's perf questions only while
 every kernel entry point reports into it; a new kernel that lands
@@ -13,6 +14,15 @@ instr-uncovered-entry
     jit-decorated local, or a covered bls_batch entry — must open a
     `telemetry.span(...)` / `telemetry.count(...)` either directly or
     via a same-surface function it calls.
+
+reqtrace-uncovered-submit
+    every public `submit_*` method of a public class in the serve
+    executor surface (`core.SERVE_FILES`) must mint a request-tracing
+    context — a `reqtrace.mint(...)` call, directly or via a
+    same-module function/method it calls (the same local call-graph
+    propagation as instr-uncovered-entry).  A submit entry point that
+    skips minting produces requests invisible to the tail-latency
+    attribution the serve-p99 production claim leans on.
 
 instr-uncovered-cost
     the same reach set must also pass through the COST-capture seam —
@@ -199,3 +209,91 @@ def check(model: ModuleModel, external_covered=frozenset(),
     cost_public = {qual.split(".")[-1] for qual, fn, public in funcs
                    if public and fn in cost_covered}
     return findings, covered_public, device_public, cost_public
+
+
+# --- request-tracing coverage (reqtrace-uncovered-submit) --------------------
+#
+# The serving counterpart of instr-uncovered-entry: a kernel must open a
+# span, a submit entry point must mint a RequestContext.  Minting is
+# recognized however the module spells the import — `reqtrace.mint(...)`
+# through a module alias, or a bare `mint(...)` imported from the
+# reqtrace module — and propagates over the same local call graph, so
+# the canonical `submit_x() -> self._submit() -> reqtrace.mint()` chain
+# covers every facade.
+
+_REQTRACE_MOD = "reqtrace"
+
+
+def _reqtrace_mint_names(model: ModuleModel) -> tuple[set[str], set[str]]:
+    """(bare names importing reqtrace.mint, module aliases of reqtrace)."""
+    names: set[str] = set()
+    aliases: set[str] = set()
+    for node in ast.walk(model.tree):
+        if isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if mod.split(".")[-1] == _REQTRACE_MOD:
+                names |= {a.asname or a.name for a in node.names
+                          if a.name == "mint"}
+            else:
+                aliases |= {a.asname or a.name for a in node.names
+                            if a.name == _REQTRACE_MOD}
+        elif isinstance(node, ast.Import):
+            aliases |= {a.asname or a.name.split(".")[0]
+                        for a in node.names
+                        if a.name.split(".")[-1] == _REQTRACE_MOD}
+    return names, aliases
+
+
+def check_reqtrace(model: ModuleModel) -> list:
+    """Findings for public `submit_*` methods (of public classes) that
+    never reach a `reqtrace.mint(...)` call through the local call
+    graph."""
+    funcs = _functions(model)
+    by_name: dict[str, list] = {}
+    for qual, node, _ in funcs:
+        by_name.setdefault(qual.split(".")[-1], []).append(node)
+    mint_names, mod_aliases = _reqtrace_mint_names(model)
+
+    mints: set = set()
+    calls: dict = {n: set() for _, n, _ in funcs}
+    for _, fn, _ in funcs:
+        for node in scope_nodes(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr == "mint" \
+                    and isinstance(f.value, ast.Name) \
+                    and f.value.id in mod_aliases:
+                mints.add(fn)
+                continue
+            if isinstance(f, ast.Name) and f.id in mint_names:
+                mints.add(fn)
+                continue
+            # local call-graph edges: bare calls and self.method() /
+            # cls.method() resolve by name, same as the kernel rule
+            name = f.id if isinstance(f, ast.Name) else (
+                f.attr if isinstance(f, ast.Attribute) else None)
+            if name:
+                for callee in by_name.get(name, []):
+                    calls[fn].add(callee)
+
+    covered = set(mints)
+    changed = True
+    while changed:
+        changed = False
+        for _, fn, _ in funcs:
+            if fn not in covered and calls[fn] & covered:
+                covered.add(fn)
+                changed = True
+
+    findings = []
+    for qual, fn, public in funcs:
+        if public and qual.split(".")[-1].startswith("submit_") \
+                and fn not in covered:
+            findings.append(Finding(
+                model.path, fn.lineno, "reqtrace-uncovered-submit",
+                f"serve entry point {qual}() never mints a "
+                f"reqtrace.RequestContext — requests submitted through "
+                f"it are invisible to tail-latency attribution (see "
+                f"README Request tracing)"))
+    return findings

@@ -23,6 +23,14 @@ statements are valid → True; sha256/fr expectations precomputed on the
 host oracle), so "zero wrong verification results" is a measured
 property of the whole round, not an assumption.
 
+Request tracing is armed for the whole round (telemetry.reqtrace,
+regardless of CST_TRACE_REQUESTS): the serve block carries per-request
+p50/p99 + the `latency_attribution` decomposition, and the
+`"resilience"` block's `fault_victims` correlates every injected fault
+with the trace ids it hit and their final outcomes — pinning the blast
+radius to exactly the retried/fallback-answered/poisoned handles (a
+fault victim can never settle with a clean `ok`).
+
 Deterministic closing segments (each oracle-checked, each feeding its
 own sub-block of the `"resilience"` object):
 
@@ -63,6 +71,7 @@ from __future__ import annotations
 import time
 
 from .. import telemetry
+from ..telemetry import reqtrace
 from . import faults, healing
 from .policies import BreakerRegistry, RetryPolicy
 
@@ -78,6 +87,26 @@ CHAOS_RETRY = dict(max_attempts=2, base_backoff_s=0.01, max_backoff_s=0.1)
 CHAOS_BREAKER = dict(threshold=2, cooldown_s=0.5)
 
 _TRACK_CAP = 200_000     # correctness-tracking memory bound
+_VICTIM_IDS_CAP = 64     # trace ids listed verbatim in the block
+
+
+def _fault_victims() -> dict:
+    """Blast-radius correlation (request tracing): the trace ids whose
+    dispatch/settle hit an injected fault, with their final outcomes.
+    The pin the chaos smoke asserts — a fault-hit request may recover
+    (retry) or degrade (fallback) or poison, but it can never settle
+    with a clean 'ok': the executor marks every member of a
+    FaultInjected batch, so blast radius == exactly these handles."""
+    victims = [r for r in reqtrace.records() if r.get("faulted")]
+    outcomes: dict[str, int] = {}
+    for r in victims:
+        outcomes[r["outcome"]] = outcomes.get(r["outcome"], 0) + 1
+    return {
+        "count": len(victims),
+        "trace_ids": [r["trace_id"] for r in victims[:_VICTIM_IDS_CAP]],
+        "outcomes": outcomes,
+        "clean_ok": outcomes.get("ok", 0),   # must stay 0
+    }
 
 
 def _expectations(payloads):
@@ -410,33 +439,45 @@ def run_chaos_load(cfg=None, plan=None) -> dict:
     (env defaults otherwise); chaos rounds are always closed-loop (an
     open-loop clock under faults measures the clock, not the service).
     `plan` overrides CST_FAULTS / the canned default."""
-    from ..serve.executor import ServeExecutor
-    from ..serve.loadgen import (
-        _fr_payload,
-        _pairing_payload,
-        _proof_payload,
-        _sha_payload,
-        _warm_kernels,
-        build_statement_pool,
-        config_from_env,
-        drive_closed_loop,
-        make_submitter,
-        percentile_ms,
-        steady_state,
-    )
+    from ..serve.loadgen import config_from_env
 
     cfg = cfg if cfg is not None else config_from_env()
     if plan is None:
         plan = faults.plan_from_env_source() or DEFAULT_CHAOS_SPEC
     plan = faults.load_plan(plan)
 
-    pool = build_statement_pool(cfg.pool, cfg.committee)
+    # request tracing is part of the chaos contract: the blast-radius
+    # correlation (which trace ids a fault hit, and how each settled)
+    # needs per-request contexts, so the round arms them regardless of
+    # CST_TRACE_REQUESTS and restores the prior state afterwards
+    was_tracing = reqtrace.enabled()
+    reqtrace.configure(enabled=True)
+    try:
+        return _run_chaos_load(cfg, plan)
+    finally:
+        reqtrace.configure(enabled=was_tracing)
+
+
+def _run_chaos_load(cfg, plan) -> dict:
+    from ..serve.executor import ServeExecutor
     from ..serve.loadgen import (
         DAS_SAMPLES_PER_SLOT,
         FC_ATTS_PER_SLOT,
         _das_payloads,
         _fc_payload,
+        _fr_payload,
+        _pairing_payload,
+        _proof_payload,
+        _sha_payload,
+        _warm_kernels,
+        build_statement_pool,
+        drive_closed_loop,
+        latency_block,
+        make_submitter,
+        steady_state,
     )
+
+    pool = build_statement_pool(cfg.pool, cfg.committee)
     payloads = {"pairing": _pairing_payload(pool[0]),
                 "fr": _fr_payload(), "sha256": _sha_payload(),
                 "proof": _proof_payload(),
@@ -445,6 +486,9 @@ def run_chaos_load(cfg=None, plan=None) -> dict:
                 "fc": (_fc_payload() if FC_ATTS_PER_SLOT else None)}
     expected = _expectations(payloads)
     warm_s = _warm_kernels(cfg, pool, payloads)
+    # scope the lifecycle records to THIS round's three phases (warmup
+    # settles are setup, not served traffic)
+    reqtrace.reset()
 
     breakers = BreakerRegistry(**CHAOS_BREAKER)
     ex = ServeExecutor(max_batch=cfg.max_batch, depth=cfg.depth,
@@ -508,6 +552,11 @@ def run_chaos_load(cfg=None, plan=None) -> dict:
                 break
     measured_s = time.perf_counter() - t0
     ex.drain()
+    # per-request latency basis + tail attribution + the fault→victim
+    # correlation, all from the round's lifecycle records (before the
+    # closing segments run — they own their own fault plans)
+    p50_ms, p99_ms, latency_attribution = latency_block(ex)
+    victims = _fault_victims()
 
     heal = _heal_segment()
     ckpt_block = _checkpoint_segment()
@@ -525,8 +574,9 @@ def run_chaos_load(cfg=None, plan=None) -> dict:
 
     block = {
         "verifies_per_s": round(steady_rate, 2),
-        "p50_ms": percentile_ms(ex.latencies_s, 0.50),
-        "p99_ms": percentile_ms(ex.latencies_s, 0.99),
+        "p50_ms": p50_ms,
+        "p99_ms": p99_ms,
+        "latency_source": "reqtrace",
         "steady": steady,
         "windows": [round(r, 2) for r in rates],
         "window_s": round(window_s, 3),
@@ -552,6 +602,7 @@ def run_chaos_load(cfg=None, plan=None) -> dict:
             "plan": plan.describe(),
             "faults_injected": len(injected),
             "injected_sites": by_site,
+            "fault_victims": victims,
             "wrong_results": check["wrong"],
             "failed_requests": check["failed"],
             "checked_results": check["checked"],
@@ -571,6 +622,8 @@ def run_chaos_load(cfg=None, plan=None) -> dict:
             "flagship": flagship,
         },
     }
+    if latency_attribution is not None:
+        block["latency_attribution"] = latency_attribution
     if mesh is not None:
         block["resilience"]["mesh"] = mesh
     return block

@@ -39,15 +39,20 @@ HALF_OPEN = "half_open"
 class DeadlineExceeded(RuntimeError):
     """A request aged past the executor's per-request deadline and was
     shed before dispatch.  Typed so callers can tell load shedding from
-    a device failure."""
+    a device failure.  On traced rounds (CST_TRACE_REQUESTS) the error
+    carries the shed request's `trace_id`, so a caller holding the
+    exception can find its lifecycle record in the reqtrace registry."""
 
-    def __init__(self, kind: str, age_s: float, deadline_s: float):
+    def __init__(self, kind: str, age_s: float, deadline_s: float,
+                 trace_id: int | None = None):
         super().__init__(
             f"{kind} request shed: queued {age_s:.3f}s, deadline "
-            f"{deadline_s:.3f}s")
+            f"{deadline_s:.3f}s"
+            + (f" (trace {trace_id})" if trace_id is not None else ""))
         self.kind = kind
         self.age_s = age_s
         self.deadline_s = deadline_s
+        self.trace_id = trace_id
 
 
 class RetryPolicy:

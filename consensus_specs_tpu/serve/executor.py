@@ -96,13 +96,16 @@ block never depends on CST_TELEMETRY.
 
 from __future__ import annotations
 
+import json
 import os
+import sys
 import time
 from collections import deque
 
 from .. import telemetry
 from ..resilience import faults
 from ..resilience.policies import DeadlineExceeded
+from ..telemetry import reqtrace
 from .futures import DeviceFuture, FutureTimeout
 
 KINDS = ("verify", "pairing", "msm", "sha256", "fr", "proof", "das",
@@ -118,12 +121,13 @@ def _ops_bls_batch():
 
 
 class _Request:
-    __slots__ = ("kind", "payload", "future", "t_enqueue")
+    __slots__ = ("kind", "payload", "future", "t_enqueue", "ctx")
 
-    def __init__(self, kind, payload, future):
+    def __init__(self, kind, payload, future, ctx=None):
         self.kind = kind
         self.payload = payload
         self.future = future
+        self.ctx = ctx          # reqtrace.RequestContext (None when off)
         self.t_enqueue = time.perf_counter()
 
 
@@ -292,13 +296,28 @@ class ServeExecutor:
         self._queue_hist: dict[str, int] = {}
         self._queue_max = 0
         self._inflight_max = 0
+        self._t_start = time.perf_counter()
+        # live ops snapshot: CST_SERVE_STATUS_EVERY seconds > 0 dumps
+        # status() as one JSON line on stderr from inside pump(), so a
+        # sustained round is observable while it runs (on-demand reads
+        # call status() directly)
+        try:
+            self._status_every = float(
+                os.environ.get("CST_SERVE_STATUS_EVERY", "0") or 0)
+        except ValueError:
+            self._status_every = 0.0
+        self._status_last = time.perf_counter()
 
     # --- submission ---------------------------------------------------------
 
     def _submit(self, kind: str, payload) -> DeviceFuture:
         assert kind in KINDS, kind
+        ctx = reqtrace.mint(kind)
         fut = DeviceFuture(waiter=self._settle_until)
-        self._queue.append(_Request(kind, payload, fut))
+        if ctx is not None:
+            fut.ctx = ctx       # the context rides the handle too
+            ctx.mark_enqueue()
+        self._queue.append(_Request(kind, payload, fut, ctx))
         self._submitted += 1
         telemetry.count("serve.submitted")
         self._note_queue_depth()
@@ -391,6 +410,7 @@ class ServeExecutor:
             self._shed_expired()
             self._dispatch_queued()
             self._settle_ready(settle_all)
+        self._maybe_dump_status()
 
     def drain(self) -> None:
         """Dispatch and settle everything; the queue and pipeline are
@@ -431,8 +451,14 @@ class ServeExecutor:
             if age <= self.deadline_s:
                 break
             req = self._queue.popleft()
+            trace_id = req.ctx.trace_id if req.ctx is not None else None
             req.future.set_exception(
-                DeadlineExceeded(req.kind, age, self.deadline_s))
+                DeadlineExceeded(req.kind, age, self.deadline_s,
+                                 trace_id=trace_id))
+            if req.ctx is not None:
+                # the whole shed lifetime is queue wait — there was no
+                # dispatch, no settle
+                req.ctx.complete("shed", final_component="queue_wait")
             self._shed += 1
             self._failed += 1
             telemetry.count("serve.shed")
@@ -445,6 +471,13 @@ class ServeExecutor:
                 and not self.breakers.get(key).allow():
             self._serve_fallback(kind, reqs)
             return
+        # request tracing: every member context closes its queue-wait
+        # (or retry-detour) interval and learns its batch id — the
+        # N-requests → 1-dispatch lineage the flow events render
+        ctxs = [r.ctx for r in reqs if r.ctx is not None]
+        batch_id = reqtrace.new_batch_id() if ctxs else None
+        for ctx in ctxs:
+            ctx.mark_dispatch(batch_id)
         try:
             # resilience seam: an injected fault here has exactly a real
             # host-prep failure's blast radius (THESE handles, no others)
@@ -505,6 +538,12 @@ class ServeExecutor:
             # ladder as a failed device batch
             self._batch_failed(kind, reqs, exc, attempt, key)
             return
+        for ctx in ctxs:
+            ctx.mark_inflight()
+        if batch_id is not None:
+            reqtrace.note_batch(batch_id, kind,
+                                [c.trace_id for c in ctxs], attempt,
+                                len(reqs))
         self._inflight.append(_Batch(kind, fut, reqs, attempt=attempt))
         self._dispatched_batches += 1
         telemetry.count(f"serve.dispatch.{kind}")
@@ -583,16 +622,26 @@ class ServeExecutor:
         its own handle."""
         with telemetry.span("serve.fallback", kind=kind,
                             requests=len(reqs)):
+            for req in reqs:
+                if req.ctx is not None:
+                    req.ctx.mark_fallback_begin()
             now_latencies = []
             for req in reqs:
                 try:
                     value = _oracle_compute(kind, req.payload)
                 except Exception as exc:
                     req.future.set_exception(exc)
+                    if req.ctx is not None:
+                        req.ctx.complete("poisoned",
+                                         final_component="detour")
                     self._failed += 1
                     telemetry.count("serve.failed")
                     continue
                 req.future.set_result(value)
+                if req.ctx is not None:
+                    # oracle compute time is a resilience detour
+                    req.ctx.complete("fallback",
+                                     final_component="detour")
                 now_latencies.append(req.t_enqueue)
                 self._settled += 1
             now = time.perf_counter()
@@ -607,6 +656,13 @@ class ServeExecutor:
         degrade to the oracle when the breaker is open — poisoning the
         handles only when no recovery path remains."""
         telemetry.count("serve.batch_failed")
+        # the failed attempt's wall is a detour; an injected fault marks
+        # its victims so the chaos harness can pin the blast radius to
+        # exactly these trace ids
+        faulted = isinstance(exc, faults.FaultInjected)
+        for req in reqs:
+            if req.ctx is not None:
+                req.ctx.mark_attempt_failed(faulted=faulted)
         breaker = self.breakers.get(key) if self.breakers is not None \
             else None
         if breaker is not None:
@@ -623,6 +679,8 @@ class ServeExecutor:
             return
         for req in reqs:
             req.future.set_exception(exc)
+            if req.ctx is not None:
+                req.ctx.complete("poisoned")
         self._failed += len(reqs)
         telemetry.count("serve.failed", len(reqs))
 
@@ -633,9 +691,12 @@ class ServeExecutor:
         with telemetry.span("serve.settle_batch", kind=batch.kind,
                             requests=len(batch.reqs)):
             key = _breaker_key(batch.kind, len(batch.reqs))
+            ctxs = [r.ctx for r in batch.reqs if r.ctx is not None]
             try:
                 out = batch.future.result() if timeout is None \
                     else batch.future.result(timeout=timeout)
+                for ctx in ctxs:
+                    ctx.mark_device_done()
                 if batch.kind == "verify" and len(batch.reqs) > 1:
                     if out:
                         results = [True] * len(batch.reqs)
@@ -644,6 +705,10 @@ class ServeExecutor:
                         telemetry.count("serve.batch_recheck")
                         results = [self._verify_single(r.payload)
                                    for r in batch.reqs]
+                        # the per-statement recheck wall is a detour,
+                        # and the outcome label upgrades to "recheck"
+                        for ctx in ctxs:
+                            ctx.note_recheck()
                 elif batch.kind == "das":
                     # the group future settles to per-sample verdicts
                     results = list(out)
@@ -664,6 +729,8 @@ class ServeExecutor:
                 else:
                     results = [out] * len(batch.reqs)
             except FutureTimeout:
+                for ctx in ctxs:
+                    ctx.note_timeout()      # provisional: still pending
                 self._inflight.appendleft(batch)
                 return False
             except Exception as exc:
@@ -678,12 +745,78 @@ class ServeExecutor:
             now = time.perf_counter()
             for req, value in zip(batch.reqs, results):
                 req.future.set_result(value)
+                if req.ctx is not None:
+                    # outcome auto-resolves: recheck > retry > ok
+                    req.ctx.complete()
                 self.latencies_s.append(now - req.t_enqueue)
             self._settled += len(batch.reqs)
             telemetry.count("serve.settled", len(batch.reqs))
             return True
 
     # --- accounting ---------------------------------------------------------
+
+    def status(self) -> dict:
+        """Live ops snapshot as one JSON-able dict: queue depths (total
+        + per kind + oldest age), in-flight batches/requests, the
+        lifecycle counters, breaker states, and — on traced rounds
+        (CST_TRACE_REQUESTS) — per-kind rolling p50/p99 with mean
+        component attribution.  Dumped periodically from `pump()` when
+        CST_SERVE_STATUS_EVERY > 0; callable on demand any time."""
+        now = time.perf_counter()
+        queue_by_kind: dict[str, int] = {}
+        for req in self._queue:
+            queue_by_kind[req.kind] = queue_by_kind.get(req.kind, 0) + 1
+        inflight_by_kind: dict[str, int] = {}
+        inflight_reqs = 0
+        for batch in self._inflight:
+            inflight_by_kind[batch.kind] = \
+                inflight_by_kind.get(batch.kind, 0) + 1
+            inflight_reqs += len(batch.reqs)
+        out = {
+            "ts": time.time(),
+            "uptime_s": round(now - self._t_start, 3),
+            "queue": {
+                "depth": len(self._queue),
+                "by_kind": queue_by_kind,
+                "oldest_age_s": (round(now - self._queue[0].t_enqueue, 4)
+                                 if self._queue else None),
+            },
+            "inflight": {
+                "batches": len(self._inflight),
+                "requests": inflight_reqs,
+                "by_kind": inflight_by_kind,
+            },
+            "counters": {
+                "submitted": self._submitted,
+                "settled": self._settled,
+                "failed": self._failed,
+                "rechecks": self._rechecks,
+                "batches": self._dispatched_batches,
+                "retries": self._retries,
+                "fallbacks": self._fallbacks,
+                "shed": self._shed,
+            },
+            "tracing": reqtrace.enabled(),
+        }
+        if self.breakers is not None:
+            out["breakers"] = self.breakers.states()
+        if reqtrace.enabled():
+            out["latency"] = reqtrace.rolling_summary()
+        return out
+
+    def _maybe_dump_status(self) -> None:
+        """The CST_SERVE_STATUS_EVERY hook: at most one status line per
+        interval, as `serve_status: {...}` on stderr (stdout stays the
+        benches' one-JSON-line-per-metric contract)."""
+        if self._status_every <= 0:
+            return
+        now = time.perf_counter()
+        if now - self._status_last < self._status_every:
+            return
+        self._status_last = now
+        telemetry.count("serve.status_dump")
+        print("serve_status: " + json.dumps(self.status()),
+              file=sys.stderr, flush=True)
 
     def stats(self) -> dict:
         """Plain-dict accounting for the bench `"serve"` block (does not

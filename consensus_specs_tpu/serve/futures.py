@@ -101,10 +101,14 @@ def _waiter_accepts_timeout(waiter) -> bool:
 
 class DeviceFuture:
     """Handle for a deferred device result.  See the module docstring
-    for the three construction flavors."""
+    for the three construction flavors.  `ctx` is the request-tracing
+    context (telemetry.reqtrace) the serve executor attaches to its
+    per-request handles — a bounded wait that runs out stamps it with
+    the provisional `timeout` outcome, so an abandoned handle stays
+    attributable even though nothing ever settles it."""
 
     __slots__ = ("_state", "_value", "_exc", "_device", "_convert",
-                 "_waiter", "_fetcher")
+                 "_waiter", "_fetcher", "ctx")
 
     def __init__(self, device=_UNSET, convert=None, waiter=None):
         self._state = PENDING
@@ -114,6 +118,7 @@ class DeviceFuture:
         self._convert = convert
         self._waiter = waiter
         self._fetcher = None
+        self.ctx = None
 
     # --- construction helpers -----------------------------------------------
 
@@ -225,6 +230,8 @@ class DeviceFuture:
                         # retry loops spinning on a dead future
                         if time.perf_counter() - t0 + 1e-3 \
                                 >= float(timeout):
+                            if self.ctx is not None:
+                                self.ctx.note_timeout()
                             raise FutureTimeout(
                                 f"future still pending after {timeout}s")
                         raise FutureError(

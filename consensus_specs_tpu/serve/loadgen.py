@@ -53,6 +53,7 @@ import os
 import time
 from dataclasses import dataclass
 
+from ..telemetry import reqtrace
 from .executor import ServeExecutor
 
 SLOT_SECONDS = 12.0
@@ -129,13 +130,40 @@ def steady_state(rates, tol: float = STEADY_TOL) -> bool:
 
 
 def percentile_ms(latencies_s, q: float) -> float | None:
-    """q-th percentile of a latency sample, in milliseconds (nearest-
-    rank on the sorted sample; None on empty input)."""
+    """q-th percentile of a latency sample, in milliseconds (None on
+    empty input).  Delegates to `reqtrace._percentile` — ONE
+    nearest-rank implementation, so the serve block's p50/p99 and the
+    attribution engine's per-kind percentiles can never diverge on the
+    same round's data."""
     if not latencies_s:
         return None
-    ordered = sorted(latencies_s)
-    idx = min(len(ordered) - 1, int(round(q * (len(ordered) - 1))))
-    return round(ordered[idx] * 1e3, 3)
+    return round(reqtrace._percentile(sorted(latencies_s), q) * 1e3, 3)
+
+
+WORST_EXEMPLARS = 5     # exemplar traces retained in latency_attribution
+
+
+def latency_block(ex) -> tuple[float | None, float | None, dict | None]:
+    """(p50_ms, p99_ms, latency_attribution) for one finished drive.
+
+    Traced rounds (CST_TRACE_REQUESTS) compute the percentiles from the
+    per-request lifecycle records — submit→complete, answered requests
+    only — and attach `reqtrace.attribution()` (per-kind p50/p90/p99
+    decomposed into queue_wait/batch_form/device_wall/settle/detour,
+    worst-N exemplars).  Untraced rounds return the executor's
+    enqueue→settle sample and no attribution.  ONE implementation so
+    `run_load` and the chaos harness cannot diverge on latency
+    semantics."""
+    if not reqtrace.enabled():
+        return (percentile_ms(ex.latencies_s, 0.50),
+                percentile_ms(ex.latencies_s, 0.99), None)
+    recs = reqtrace.records()
+    answered = [r["e2e_s"] for r in recs
+                if r.get("e2e_s") is not None
+                and r.get("outcome") in reqtrace.ANSWERED]
+    return (percentile_ms(answered, 0.50),
+            percentile_ms(answered, 0.99),
+            reqtrace.attribution(recs, worst_n=WORST_EXEMPLARS))
 
 
 # --- request payload pools ---------------------------------------------------
@@ -385,6 +413,11 @@ def run_load(cfg: LoadConfig | None = None, executor=None) -> dict:
 
     faults.install_from_env()
     ex = executor if executor is not None else _default_executor(cfg)
+    # request tracing (CST_TRACE_REQUESTS): scope the lifecycle-record
+    # registry to THIS measured load — warmup settles and any earlier
+    # run's records must not pollute the attribution
+    if reqtrace.enabled():
+        reqtrace.reset()
     # deterministic per-slot arrival mix (see module docstring)
     submit_next, kinds_submitted = make_submitter(ex, pool, payloads)
 
@@ -428,10 +461,20 @@ def run_load(cfg: LoadConfig | None = None, executor=None) -> dict:
     steady = steady_state(rates)
     steady_rate = (sum(rates[-3:]) / 3.0 if len(rates) >= 3
                    else (st["settled"] / measured_s if measured_s else 0.0))
-    return {
+    # latency basis (the serve-block schema's `latency_source` field):
+    # on traced rounds the percentiles are PER-REQUEST, submit→complete
+    # from the RequestContext timestamps — the batch-settle-granularity
+    # numbers understate the request tail by collapsing every member of
+    # a batch onto one settle stamp (and miss retry/fallback detours
+    # entirely).  Untraced rounds keep the executor's enqueue→settle
+    # sample so the metric never goes dark.
+    p50_ms, p99_ms, latency_attribution = latency_block(ex)
+    block = {
         "verifies_per_s": round(steady_rate, 2),
-        "p50_ms": percentile_ms(ex.latencies_s, 0.50),
-        "p99_ms": percentile_ms(ex.latencies_s, 0.99),
+        "p50_ms": p50_ms,
+        "p99_ms": p99_ms,
+        "latency_source": ("reqtrace" if latency_attribution is not None
+                          else "executor"),
         "steady": steady,
         "windows": [round(r, 2) for r in rates],
         "window_s": round(window_s, 3),
@@ -453,3 +496,6 @@ def run_load(cfg: LoadConfig | None = None, executor=None) -> dict:
         "queue_depth": st["queue_depth"],
         "inflight_max": st["inflight_max"],
     }
+    if latency_attribution is not None:
+        block["latency_attribution"] = latency_attribution
+    return block

@@ -55,7 +55,7 @@ Zero dependencies (stdlib only); never imports jax, numpy, or any spec
 module — safe to import from anywhere, including before backend pinning.
 """
 
-from . import costmodel
+from . import costmodel, reqtrace
 from .core import (
     add_event,
     configure,
@@ -80,6 +80,7 @@ from .export import (
     validate_costmodel_block,
     validate_das_block,
     validate_forkchoice_block,
+    validate_latency_attribution,
     validate_mesh_block,
     validate_resilience_block,
     validate_scaling_block,
@@ -90,11 +91,13 @@ from .export import (
 
 __all__ = [
     "add_event", "configure", "costmodel", "count", "counter_value",
-    "enabled", "first_call", "gauge", "observe", "reset", "set_meta",
+    "enabled", "first_call", "gauge", "observe", "reqtrace", "reset",
+    "set_meta",
     "snapshot", "span", "span_seconds", "bench_block", "chrome_trace",
     "embed_bench_block", "validate_bench_block",
     "validate_checkpoint_block", "validate_costmodel_block",
     "validate_das_block", "validate_forkchoice_block",
+    "validate_latency_attribution",
     "validate_mesh_block",
     "validate_resilience_block", "validate_scaling_block",
     "validate_serve_block",

@@ -135,6 +135,11 @@ def reset(full: bool = False) -> None:
         # keep them, full test-isolation resets wipe them too
         from . import costmodel
         costmodel._reset_state()
+        # request-trace lifecycle records follow the same rule: they
+        # survive per-config resets (the Chrome-trace export is
+        # whole-process), full resets wipe them and their id counters
+        from . import reqtrace
+        reqtrace._reset_state()
 
 
 # --- recording primitives ---------------------------------------------------
@@ -409,10 +414,12 @@ def snapshot() -> dict:
             "events": len(_events),
             "events_dropped": _events_dropped,
         }
-    # outside _lock: the cost-model registry has its own lock, and its
-    # snapshot must not nest under ours (lock-order discipline)
-    from . import costmodel
+    # outside _lock: the cost-model and request-trace registries have
+    # their own locks, and their snapshots must not nest under ours
+    # (lock-order discipline)
+    from . import costmodel, reqtrace
     snap["costmodel"] = costmodel.raw_snapshot()
+    snap["reqtrace"] = reqtrace.raw_snapshot()
     return snap
 
 

@@ -86,6 +86,12 @@ def chrome_trace() -> dict:
             "pid": pid, "tid": 0, "ts": round(g["ts"], 3),
             "args": {"value": g["value"]},
         })
+    # request tracing (CST_TRACE_REQUESTS): per-request lifecycle 'X'
+    # spans + 's'/'t'/'f' flow arrows (submit → batch → settle) + batch
+    # spans, on per-kind request tracks next to the span timeline
+    from . import reqtrace
+
+    out.extend(reqtrace.chrome_events(pid, core._T0))
     trace = {"traceEvents": out, "displayTimeUnit": "ms"}
     if dropped or wm_dropped or g_dropped:
         trace["otherData"] = {
@@ -295,6 +301,97 @@ def validate_serve_block(obj) -> list[str]:
     if obj.get("mode") not in ("open", "closed"):
         problems.append(f"'mode' must be 'open' or 'closed', "
                         f"got {obj.get('mode')!r}")
+    # request-tracing surface (PR 15): `latency_source` names the
+    # percentile basis — "reqtrace" = per-request submit→complete
+    # lifecycle records (queue wait + detours included), "executor" =
+    # the legacy enqueue→batch-settle sample.  Optional for
+    # backward-compat with pre-tracing blocks; a traced block must also
+    # carry a schema-valid `latency_attribution` sub-object.
+    src = obj.get("latency_source")
+    if src is not None and src not in ("reqtrace", "executor"):
+        problems.append(f"'latency_source' must be 'reqtrace' or "
+                        f"'executor', got {src!r}")
+    la = obj.get("latency_attribution")
+    if src == "reqtrace" and la is None:
+        problems.append("'latency_source' is 'reqtrace' but "
+                        "'latency_attribution' is missing")
+    if la is not None:
+        problems.extend(validate_latency_attribution(la))
+    return problems
+
+
+_LATENCY_COMPONENTS = ("queue_wait", "batch_form", "device_wall",
+                       "settle", "detour")
+_LATENCY_OUTCOMES = ("ok", "recheck", "retry", "fallback", "shed",
+                     "poisoned", "timeout")
+
+
+def validate_latency_attribution(obj) -> list[str]:
+    """Schema check for the serve block's `latency_attribution`
+    sub-object (`telemetry.reqtrace.attribution`); returns problems
+    (empty == valid).  Pinned by `bench_smoke.py`'s traced serve round
+    and tests/test_reqtrace.py."""
+    if not isinstance(obj, dict):
+        return [f"latency_attribution is {type(obj).__name__}, not dict"]
+    problems: list[str] = []
+    kinds = obj.get("kinds")
+    if not isinstance(kinds, dict):
+        problems.append("latency_attribution['kinds'] must be a dict")
+        kinds = {}
+    for kind, blk in kinds.items():
+        if not isinstance(blk, dict):
+            problems.append(f"latency kind {kind!r} must be a dict")
+            continue
+        n = blk.get("count")
+        if not isinstance(n, int) or isinstance(n, bool) or n < 1:
+            problems.append(f"latency kind {kind!r}: 'count' must be a "
+                            f"positive int, got {n!r}")
+        for key in ("p50_ms", "p90_ms", "p99_ms"):
+            v = blk.get(key)
+            if not isinstance(v, (int, float)) or isinstance(v, bool) \
+                    or v < 0:
+                problems.append(f"latency kind {kind!r}: {key!r} must "
+                                f"be a non-negative number, got {v!r}")
+        p50, p99 = blk.get("p50_ms"), blk.get("p99_ms")
+        if isinstance(p50, (int, float)) and isinstance(p99, (int, float)) \
+                and p99 < p50:
+            problems.append(f"latency kind {kind!r}: p99_ms ({p99}) "
+                            f"below p50_ms ({p50})")
+        for key in ("mean_components_ms", "p99_components_ms"):
+            comp = blk.get(key)
+            if not isinstance(comp, dict) or not all(
+                    c in comp and isinstance(comp[c], (int, float))
+                    and not isinstance(comp[c], bool) and comp[c] >= 0
+                    for c in _LATENCY_COMPONENTS):
+                problems.append(
+                    f"latency kind {kind!r}: {key!r} must map every "
+                    f"component {_LATENCY_COMPONENTS} to a non-negative "
+                    f"number")
+        oc = blk.get("outcomes")
+        if not isinstance(oc, dict) or not all(
+                k in _LATENCY_OUTCOMES and isinstance(v, int)
+                for k, v in oc.items()):
+            problems.append(f"latency kind {kind!r}: 'outcomes' must "
+                            f"map outcomes in {_LATENCY_OUTCOMES} to "
+                            f"int counts")
+    frac = obj.get("p99_queue_frac")
+    if frac is not None and (not isinstance(frac, (int, float))
+                             or isinstance(frac, bool)
+                             or not 0.0 <= frac <= 1.0):
+        problems.append(f"'p99_queue_frac' must be in [0, 1] or null, "
+                        f"got {frac!r}")
+    worst = obj.get("worst")
+    if not isinstance(worst, list):
+        problems.append("'worst' must be a list of exemplar traces")
+    else:
+        for i, ex in enumerate(worst):
+            if not isinstance(ex, dict) \
+                    or not isinstance(ex.get("trace_id"), int) \
+                    or not isinstance(ex.get("e2e_ms"), (int, float)) \
+                    or not isinstance(ex.get("components_ms"), dict):
+                problems.append(f"worst[{i}] must carry trace_id / "
+                                f"e2e_ms / components_ms")
+                break
     return problems
 
 
@@ -355,6 +452,20 @@ def validate_resilience_block(obj) -> list[str]:
     if plan is not None and (not isinstance(plan, dict)
                              or not isinstance(plan.get("faults"), list)):
         problems.append("'plan' must be a fault-plan summary dict")
+    fv = obj.get("fault_victims")
+    if fv is not None:
+        # blast-radius correlation (request tracing): which trace ids a
+        # fault hit and how each settled.  `clean_ok` counts victims
+        # that settled with a clean 'ok' — always zero by construction
+        # (a fault-hit batch recovers as retry/fallback or poisons)
+        if not isinstance(fv, dict) \
+                or not isinstance(fv.get("count"), int) \
+                or not isinstance(fv.get("trace_ids"), list) \
+                or not isinstance(fv.get("outcomes"), dict):
+            problems.append("'fault_victims' must carry int 'count', a "
+                            "'trace_ids' list and an 'outcomes' dict")
+        elif not all(isinstance(t, int) for t in fv["trace_ids"]):
+            problems.append("fault_victims['trace_ids'] must be ints")
     problems.extend(validate_checkpoint_block(obj.get("checkpoint")))
     problems.extend(validate_mesh_block(obj.get("mesh")))
     fl = obj.get("flagship")

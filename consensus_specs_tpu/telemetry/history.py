@@ -38,6 +38,14 @@ Record schema (version `SCHEMA`; one JSON object per line):
                                  # "serve::<metric>" — verifies/sec,
                                  # p50/p99, queue-depth histogram,
                                  # steady flag, window rates)
+     "latency": dict,            # compacted tail-latency attribution
+                                 # (source "latency"; per kind
+                                 # "latency::p99_ms@<kind>" carrying the
+                                 # component decomposition, plus
+                                 # "latency::p99_queue_frac" — the
+                                 # serve-p99-queue-frac advisory row's
+                                 # surface, carrying the worst-N
+                                 # exemplar traces)
      "resilience": dict,         # compacted chaos-round block (source
                                  # "resilience" only; metric
                                  # "resilience::<metric>" — recovery
@@ -97,7 +105,8 @@ SCHEMA = 1
 
 SOURCES = ("bench_round", "multichip_round", "baseline", "bench_emit",
            "pytest_snapshot", "costmodel", "serve", "resilience",
-           "mesh", "checkpoint", "scaling", "das", "forkchoice")
+           "mesh", "checkpoint", "scaling", "das", "forkchoice",
+           "latency")
 
 _ROUND_FILE_RE = re.compile(r"(?:BENCH|MULTICHIP)_r(\d+)\.json$")
 
@@ -205,6 +214,8 @@ def serve_records(metric: str, serve, **context) -> list[dict]:
         "failed", "rechecks", "batches", "queue_depth", "inflight_max",
         "retries", "fallbacks", "shed")
         if k in serve}
+    if isinstance(serve.get("latency_source"), str):
+        compact["latency_source"] = serve["latency_source"]
     records = [make_record(
         "serve", "serve::verifies_per_s", serve["verifies_per_s"],
         unit="verifies/s", serve=compact, via_metric=metric, **context)]
@@ -214,6 +225,45 @@ def serve_records(metric: str, serve, **context) -> list[dict]:
             records.append(make_record(
                 "serve", f"serve::{key}", v, unit=unit,
                 via_metric=metric, **context))
+    records.extend(latency_records(
+        metric, serve.get("latency_attribution"), **context))
+    return records
+
+
+def latency_records(metric: str, la, **context) -> list[dict]:
+    """`latency`-source history records mined from a serve block's
+    `latency_attribution` sub-object (`telemetry.reqtrace.attribution`,
+    traced rounds only): one `latency::p99_ms@<kind>` record per
+    request kind carrying the compacted per-kind block (p50/p90/p99,
+    component decomposition, outcome counts), and one
+    `latency::p99_queue_frac` record — the `serve-p99-queue-frac`
+    advisory threshold row's surface — carrying the worst-N exemplar
+    traces.  Malformed blocks yield zero records, never an
+    exception."""
+    if not isinstance(la, dict) or not isinstance(la.get("kinds"), dict):
+        return []
+    records: list[dict] = []
+    for kind, blk in sorted(la["kinds"].items()):
+        if not isinstance(blk, dict):
+            continue
+        p99 = blk.get("p99_ms")
+        if not isinstance(p99, (int, float)) or isinstance(p99, bool):
+            continue
+        compact = {k: blk[k] for k in (
+            "count", "p50_ms", "p90_ms", "p99_ms", "mean_components_ms",
+            "p99_components_ms", "p99_queue_frac", "outcomes")
+            if k in blk}
+        records.append(make_record(
+            "latency", f"latency::p99_ms@{kind}", p99, unit="ms",
+            latency=compact, via_metric=metric, **context))
+    frac = la.get("p99_queue_frac")
+    if isinstance(frac, (int, float)) and not isinstance(frac, bool):
+        records.append(make_record(
+            "latency", "latency::p99_queue_frac", frac, unit="frac",
+            latency={"worst": la.get("worst") or [],
+                     "requests": la.get("requests"),
+                     "answered": la.get("answered")},
+            via_metric=metric, **context))
     return records
 
 
@@ -230,7 +280,8 @@ def resilience_records(metric: str, res, **context) -> list[dict]:
     if not isinstance(res, dict) or not isinstance(res.get("chaos"), bool):
         return []
     compact = {k: res[k] for k in (
-        "chaos", "faults_injected", "injected_sites", "wrong_results",
+        "chaos", "faults_injected", "injected_sites", "fault_victims",
+        "wrong_results",
         "failed_requests", "checked_results", "recovered", "retries",
         "fallbacks", "shed") if k in res}
     br = res.get("breaker")

@@ -107,6 +107,18 @@ THRESHOLDS = (
      "title": "serve p99 batch latency (ms)",
      "metric": r"serve::p99_ms",
      "field": "value", "op": "<", "target": 500.0, "tpu_only": True},
+    # tail-latency attribution (request tracing, CST_TRACE_REQUESTS):
+    # the advisory decomposition row behind serve-p99 — if more than
+    # half of the p99 tail's wall is QUEUE WAIT, the service is
+    # under-batched/under-pumped (an arrival/scheduling problem), not
+    # device-bound, and kernel work won't move the p99.  TPU-gated like
+    # the serve rows: the CPU smoke's closed-loop drive intentionally
+    # saturates the queue, so its queue fraction is a property of the
+    # drive, not the service.
+    {"id": "serve-p99-queue-frac",
+     "title": "serve p99 tail: queue-wait fraction (advisory)",
+     "metric": r"latency::p99_queue_frac",
+     "field": "value", "op": "<", "target": 0.5, "tpu_only": True},
     # incremental merkleization (ROADMAP stateless-client item): the
     # persisted-layer dirty-path re-hash must beat a full re-merkleize
     # by >= 5x at 1% dirty — measurable on the CPU smoke (the ratio is
@@ -752,6 +764,15 @@ def render_resilience(records) -> list[str]:
             f"{compact.get('breaker_trips', 0)}, final states: "
             f"{compact.get('breaker_states') or {}}; "
             f"{'recovered' if recovered else 'DID NOT RECOVER'}.\n")
+        fv = compact.get("fault_victims")
+        if isinstance(fv, dict):
+            lines.append(
+                f"Blast radius (request tracing): {fv.get('count', 0)} "
+                f"victim request(s) — outcomes "
+                f"{fv.get('outcomes') or {}}; "
+                f"{fv.get('clean_ok', 0)} settled clean "
+                f"(must be 0 — a fault-hit handle recovers as "
+                f"retry/fallback or poisons, never silently).\n")
     mrec = latest_by_metric.get("mesh::recovery_latency_s")
     mesh = mrec.get("mesh") if mrec else None
     if isinstance(mesh, dict):
@@ -777,6 +798,81 @@ def render_resilience(records) -> list[str]:
             f"{_fmt(cp.get('rebuild_s'), 4)} s "
             f"({_fmt(sp, 1)}x), parity "
             f"{'OK' if cp.get('parity') else 'FAILED'}.\n")
+    return lines
+
+
+def render_tail_latency(records) -> list[str]:
+    """The request-tracing read side: latest per-kind
+    `latency::p99_ms@<kind>` records (the compact attribution block
+    rides each — p50/p90/p99 + the p99 tail's component decomposition),
+    the overall p99 queue-wait fraction, and the worst-N exemplar
+    traces riding the `latency::p99_queue_frac` record."""
+    lines = ["## Tail latency (request tracing)\n"]
+    recs = [r for r in records if r.get("source") == "latency"]
+    if not recs:
+        lines.append("No latency records — run a serve round with "
+                     "`CST_TRACE_REQUESTS=1` (`make serve` / "
+                     "`make serve-smoke`) to mint per-request contexts "
+                     "and produce `latency::*` attribution records.\n")
+        return lines
+    by_kind: dict[str, dict] = {}
+    for r in sorted((r for r in recs
+                     if r["metric"].startswith("latency::p99_ms@")
+                     and isinstance(r.get("latency"), dict)),
+                    key=_order_key):
+        by_kind[r["metric"][len("latency::p99_ms@"):]] = r
+    if by_kind:
+        lines.append("Per-kind percentiles are per-REQUEST "
+                     "(submit→complete, queue wait and resilience "
+                     "detours included); the component columns "
+                     "decompose the p99 tail's wall.\n")
+        lines.append("| kind | n | p50 | p90 | p99 | queue | batch | "
+                     "device | settle | detour | platform | where |")
+        lines.append("|---|---|---|---|---|---|---|---|---|---|---|---|")
+        for kind, r in sorted(by_kind.items()):
+            blk = r["latency"]
+            comp = blk.get("p99_components_ms") or {}
+            lines.append(
+                f"| `{kind}` | {blk.get('count', '—')} "
+                f"| {_fmt(blk.get('p50_ms'), 2)} "
+                f"| {_fmt(blk.get('p90_ms'), 2)} "
+                f"| {_fmt(r.get('value'), 2)} ms "
+                f"| {_fmt(comp.get('queue_wait'), 2)} "
+                f"| {_fmt(comp.get('batch_form'), 2)} "
+                f"| {_fmt(comp.get('device_wall'), 2)} "
+                f"| {_fmt(comp.get('settle'), 2)} "
+                f"| {_fmt(comp.get('detour'), 2)} "
+                f"| {_platform_group(r)} | {_where(r)} |")
+        lines.append("")
+    frac_recs = [r for r in recs
+                 if r["metric"] == "latency::p99_queue_frac"]
+    if frac_recs:
+        latest = max(frac_recs, key=_order_key)
+        frac = latest.get("value")
+        lines.append(
+            f"Overall p99 tail queue-wait fraction: "
+            f"{'—' if frac is None else f'{float(frac) * 100:.0f}%'} "
+            f"({_where(latest)}, platform {_platform_group(latest)}) — "
+            f"above 50% the tail is an arrival/scheduling problem, not "
+            f"a device one (the `serve-p99-queue-frac` advisory row).\n")
+        worst = (latest.get("latency") or {}).get("worst") or []
+        if worst:
+            lines.append("Worst exemplar traces:\n")
+            lines.append("| trace | kind | outcome | attempts | e2e | "
+                         "queue | device | detour |")
+            lines.append("|---|---|---|---|---|---|---|---|")
+            for ex in worst:
+                comp = ex.get("components_ms") or {}
+                lines.append(
+                    f"| {ex.get('trace_id', '—')} "
+                    f"| `{ex.get('kind', '—')}` "
+                    f"| {ex.get('outcome', '—')} "
+                    f"| {ex.get('attempts', '—')} "
+                    f"| {_fmt(ex.get('e2e_ms'), 2)} ms "
+                    f"| {_fmt(comp.get('queue_wait'), 2)} "
+                    f"| {_fmt(comp.get('device_wall'), 2)} "
+                    f"| {_fmt(comp.get('detour'), 2)} |")
+            lines.append("")
     return lines
 
 
@@ -1016,6 +1112,7 @@ def render_report(result: dict) -> str:
     lines.extend(render_thresholds(result["thresholds"], result["strict"]))
     lines.extend(render_regressions(result["regressions"],
                                     result["max_regress_pct"]))
+    lines.extend(render_tail_latency(result["records"]))
     lines.extend(render_resilience(result["records"]))
     lines.extend(render_scaling(result["records"]))
     lines.extend(render_das(result["records"]))

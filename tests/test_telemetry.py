@@ -181,7 +181,7 @@ def test_snapshot_schema_stable():
     snap = telemetry.snapshot()
     assert set(snap) == {"enabled", "meta", "counters", "histograms",
                          "spans", "gauges", "events", "events_dropped",
-                         "costmodel"}
+                         "costmodel", "reqtrace"}
     assert snap["enabled"] is True
     assert set(snap["histograms"]["h"]) == {"count", "total", "min", "max"}
     assert set(snap["gauges"]["g"]) == {"last", "min", "max", "count"}
@@ -189,6 +189,8 @@ def test_snapshot_schema_stable():
                                        "max_s"}
     assert set(snap["costmodel"]) == {"kernels", "watermarks",
                                       "wm_events", "wm_events_dropped"}
+    assert set(snap["reqtrace"]) >= {"enabled", "completed", "batches",
+                                     "by_kind", "by_outcome"}
     json.dumps(snap)   # JSON-able end to end
 
 
