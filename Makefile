@@ -34,8 +34,9 @@ help:
 	@echo "  simulated 8-device mesh, asserts the scaling::* record"
 	@echo "  round-trip + report) | das-smoke (PeerDAS cell-proof sweep"
 	@echo "  at the 128x8 sampling matrix on CPU: das block schema,"
-	@echo "  >=2x speedup vs the pure-Python oracle, das::* round-trip"
-	@echo "  + report) | fc-smoke (device LMD-GHOST sweep on a tiny CPU"
+	@echo "  >=2x speedup vs the pure-Python oracle, FK20 producer +"
+	@echo "  recover round, das::* round-trip + report) | fc-smoke"
+	@echo "  (device LMD-GHOST sweep on a tiny CPU"
 	@echo "  tree: forkchoice block schema, >=2x speedup vs the phase0"
 	@echo "  spec oracle, bit-exact head parity, forkchoice::*"
 	@echo "  round-trip + report) | multichip (8-dev CPU dryrun)"
@@ -141,7 +142,11 @@ shard-smoke:
 # (oracle measured on a cell subset and scaled — its per-cell Lagrange
 # interpolation makes a full-matrix oracle run hours), the
 # mixed-invalid isolation arc, the coset-barycentric cross-check, and
-# the das::* history/report/threshold wiring (CI gates on this)
+# the das::* history/report/threshold wiring (CI gates on this).
+# The same run covers the FK20 producer + damaged-matrix recover
+# round: byte-parity vs the closed form, >= 4x das-producer-speedup
+# vs the D_u MSM route, >= 2x das-recover-speedup vs the pure-Python
+# recover oracle (both CPU-evaluable)
 das-smoke:
 	$(CPU_ENV) $(PYTHON) bench_smoke.py --das
 

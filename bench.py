@@ -758,6 +758,135 @@ def worker_das() -> None:
             if telemetry.enabled():
                 rec = telemetry.embed_bench_block(rec)
             out[f"das_cell_proof_batch_{cols}x{blobs}_verify_wall"] = rec
+
+        # --- FK20 producer + erasure recovery (the super-node path) --
+        # The producer measures the FK20 pipeline steady-state against
+        # the D_u partial route it replaced; the D_u wall is
+        # subset-scaled (CST_DAS_DU_MSMS of its 63 wide MSMs measured,
+        # the rest scaled by their pad rung — a full D_u run is ~40
+        # device-minutes).  Recovery measures the device decode +
+        # FK20 re-prove against the pure-Python oracle with the
+        # oracle's 128 per-coset proofs subset-scaled the same way
+        # (CST_DAS_RECOVER_ORACLE_COSETS measured).  Parity rides a
+        # degree-65 closed-form blob: its recovered cells and proofs
+        # are known without any oracle run.
+        from consensus_specs_tpu.das import compute as das_compute
+        from consensus_specs_tpu.das import recover as das_recover
+        from consensus_specs_tpu.models.builder import build_spec as _bs
+        from consensus_specs_tpu.ops.bls_batch import _bucket
+
+        produce_iters = max(1, int(os.environ.get(
+            "CST_DAS_PRODUCE_ITERS", 2)))
+        du_msms = max(1, int(os.environ.get("CST_DAS_DU_MSMS", 2)))
+        oracle_cosets = max(1, int(os.environ.get(
+            "CST_DAS_RECOVER_ORACLE_COSETS", 1)))
+        n_ext = das_cs.CELLS_PER_EXT_BLOB
+        m_blob = das_cs.FIELD_ELEMENTS_PER_BLOB
+        p_mod = das_cs.BLS_MODULUS
+
+        c2, c1, c0 = 90001, 80001, 70001
+        roots = das_cs.roots_of_unity(m_blob)
+        evals = [(c2 * pow(roots[das_cs.reverse_bits(i, m_blob)], 65,
+                           p_mod)
+                  + c1 * pow(roots[das_cs.reverse_bits(i, m_blob)], 64,
+                             p_mod) + c0) % p_mod
+                 for i in range(m_blob)]
+        blob = das_cs._encode_evals(evals)
+        _, per_cell = das_cs.closed_form_row(c2, c1, c0, range(n_ext))
+        true_cells = [per_cell[k][0] for k in range(n_ext)]
+        true_proofs = [per_cell[k][1] for k in range(n_ext)]
+
+        t0 = time.perf_counter()
+        fk_cells, fk_proofs = das_compute.compute_cells_and_kzg_proofs(
+            blob, device=True, route="fk20")
+        produce_first = time.perf_counter() - t0
+        parity = (fk_cells == true_cells and fk_proofs == true_proofs)
+        log(f"fk20 compile+setup+first: {produce_first:.1f}s "
+            f"(closed-form parity: {parity})")
+        t0 = time.perf_counter()
+        for _ in range(produce_iters):
+            das_compute.compute_cells_and_kzg_proofs(
+                blob, device=True, route="fk20")
+        produce_wall = (time.perf_counter() - t0) / produce_iters
+
+        # D_u baseline, subset-scaled by pad rung: sizes M - 64u for
+        # u = 1..63 (the wide partials) plus 128 rung-64 column MSMs
+        coeffs = das_compute.poly_coefficients(blob, device=True)
+        wide_pts = [das_cs.setup_g1_point(t) for t in range(m_blob - 64)]
+        das_compute._msm(wide_pts, coeffs[64:], True)      # warm
+        t0 = time.perf_counter()
+        for _ in range(du_msms):
+            das_compute._msm(wide_pts, coeffs[64:], True)
+        t_wide = (time.perf_counter() - t0) / du_msms
+        das_compute._msm(wide_pts[:63], coeffs[:63], True)  # warm rung 64
+        t0 = time.perf_counter()
+        das_compute._msm(wide_pts[:63], coeffs[:63], True)
+        t_narrow = time.perf_counter() - t0
+        sizes = [m_blob - 64 * u for u in range(1, m_blob // 64)]
+        rung_scale = sum(_bucket(s) for s in sizes) / _bucket(sizes[0])
+        du_wall = t_wide * rung_scale + n_ext * t_narrow
+        producer_speedup = du_wall / produce_wall
+        log(f"fk20 produce: {produce_wall:.1f}s vs D_u {du_wall:.1f}s "
+            f"({producer_speedup:.1f}x; wide MSM {t_wide:.1f}s x "
+            f"{rung_scale:.1f} rung-scaled, measured {du_msms})")
+
+        # recovery: exactly half the cells survive (worst recoverable)
+        keep = [k for k in range(n_ext) if k % 2 == 0]
+        kept_cells = [true_cells[k] for k in keep]
+        t0 = time.perf_counter()
+        rc_cells, rc_proofs = das_recover.recover_cells_and_kzg_proofs(
+            keep, kept_cells, device=True)
+        recover_first = time.perf_counter() - t0
+        roundtrip = (rc_cells == true_cells and rc_proofs == true_proofs)
+        t0 = time.perf_counter()
+        das_recover.recover_cells_and_kzg_proofs(keep, kept_cells,
+                                                 device=True)
+        recover_wall = time.perf_counter() - t0
+        log(f"device recover first: {recover_first:.1f}s, steady: "
+            f"{recover_wall:.1f}s (closed-form roundtrip: {roundtrip})")
+
+        # oracle baseline: full pure-Python decode, subset-scaled
+        # per-coset re-prove
+        fulu = _bs("fulu", "mainnet")
+        o_evals = [fulu.cell_to_coset_evals(c) for c in kept_cells]
+        t0 = time.perf_counter()
+        o_coeffs = fulu.recover_polynomialcoeff(keep, o_evals)
+        decode_oracle = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for k in range(oracle_cosets):
+            fulu.compute_kzg_proof_multi_impl(
+                o_coeffs, fulu.coset_for_cell(fulu.CellIndex(k)))
+        prove_oracle = (time.perf_counter() - t0) / oracle_cosets
+        recover_oracle_wall = decode_oracle + n_ext * prove_oracle
+        recover_speedup = recover_oracle_wall / recover_wall
+        log(f"oracle recover: decode {decode_oracle:.1f}s + 128 x "
+            f"{prove_oracle:.1f}s/coset = {recover_oracle_wall:.1f}s "
+            f"({recover_speedup:.1f}x, measured {oracle_cosets} cosets)")
+
+        producer_block = {
+            "produce_wall_s": round(produce_wall, 3),
+            "produce_first_s": round(produce_first, 2),
+            "proofs_per_s": round(n_ext / produce_wall, 2),
+            "du_wall_s": round(du_wall, 2),
+            "du_msms_measured": du_msms,
+            "producer_speedup": round(producer_speedup, 1),
+            "parity": parity,
+            "recover": {
+                "cells_in": len(keep),
+                "missing": n_ext - len(keep),
+                "wall_s": round(recover_wall, 3),
+                "oracle_wall_s": round(recover_oracle_wall, 2),
+                "oracle_cosets_measured": oracle_cosets,
+                "speedup": round(recover_speedup, 1),
+                "roundtrip": roundtrip,
+            },
+        }
+        rec = {"value": round(produce_wall, 4), "unit": "s",
+               "vs_baseline": round(producer_speedup, 1),
+               "das_producer": producer_block}
+        if telemetry.enabled():
+            rec = telemetry.embed_bench_block(rec)
+        out["das_fk20_produce_wall"] = rec
     finally:
         bls.bls_active = prev_active
     out["platform"] = dev.platform

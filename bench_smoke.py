@@ -888,8 +888,14 @@ def das_main():
     `das-speedup` must PASS on CPU (the >= 2x acceptance criterion is
     shape-bound: the oracle pays a per-cell Lagrange interpolation the
     device route never does), `das-throughput` must read 'no data'
-    (a chip number)."""
-    from consensus_specs_tpu.telemetry import validate_das_block
+    (a chip number).  The same worker run also covers the FK20
+    producer + damaged-matrix recover round: the `"das_producer"`
+    block schema, byte-parity vs the closed form, the >= 4x
+    `das-producer-speedup` floor vs the D_u MSM route and the >= 2x
+    `das-recover-speedup` floor vs the pure-Python recover oracle —
+    both shape-bound, so they PASS on CPU too."""
+    from consensus_specs_tpu.telemetry import (validate_das_block,
+                                               validate_das_producer_block)
 
     hist_env = os.environ.get("CST_BENCHWATCH_HISTORY")
     hist_file = Path(hist_env) if hist_env \
@@ -900,8 +906,10 @@ def das_main():
     das_t0 = time.time()
     out = _run(["bench.py", "--worker", "das"],
                {"CST_DAS_MATRIX": "128x8", "CST_DAS_ORACLE_CELLS": "8",
+                "CST_DAS_PRODUCE_ITERS": "1", "CST_DAS_DU_MSMS": "1",
+                "CST_DAS_RECOVER_ORACLE_COSETS": "1",
                 "CST_NO_COMPILE_CACHE": "1", "CST_TELEMETRY": "1"},
-               timeout=1800)
+               timeout=3600)
     last = out[-1]
     rec = last.get("das_cell_proof_batch_128x8_verify_wall")
     assert isinstance(rec, dict) and rec.get("value", 0) > 0, last
@@ -923,6 +931,23 @@ def das_main():
     print("das worker JSON OK:", json.dumps(
         {k: v for k, v in rec.items() if k != "telemetry"}))
 
+    # the FK20 producer + damaged-matrix recover round: block schema,
+    # byte-parity/roundtrip, and the two CPU-evaluable speedup floors
+    prec = last.get("das_fk20_produce_wall")
+    assert isinstance(prec, dict) and prec.get("value", 0) > 0, last
+    pblock = prec.get("das_producer")
+    problems = validate_das_producer_block(pblock)
+    assert not problems, (problems, json.dumps(pblock)[:500])
+    assert pblock["parity"] is True, pblock
+    # the acceptance criteria: >= 4x vs the D_u MSM route for the
+    # producer, >= 2x vs the pure-Python oracle for recovery
+    assert pblock["producer_speedup"] >= 4.0, pblock
+    assert prec["vs_baseline"] == pblock["producer_speedup"], prec
+    assert pblock["recover"]["roundtrip"] is True, pblock
+    assert pblock["recover"]["speedup"] >= 2.0, pblock
+    print("das producer JSON OK:", json.dumps(
+        {k: v for k, v in prec.items() if k != "telemetry"}))
+
     # the das record kind round-trips through the store (the parent
     # appends, like the driver does for extras workers)
     prev_hist = os.environ.get("CST_BENCHWATCH_HISTORY")
@@ -930,6 +955,10 @@ def das_main():
     try:
         benchwatch.append_emission(
             dict(rec, metric="das_cell_proof_batch_128x8_verify_wall",
+                 platform=last.get("platform", "cpu")),
+            ts=time.time())
+        benchwatch.append_emission(
+            dict(prec, metric="das_fk20_produce_wall",
                  platform=last.get("platform", "cpu")),
             ts=time.time())
     finally:
@@ -943,7 +972,10 @@ def das_main():
              and r["ts"] >= das_t0 - 5}
     for name in ("das_cell_proof_batch_128x8_verify_wall",
                  "das::verify_wall@128x8", "das::speedup",
-                 "das::cells_per_s"):
+                 "das::cells_per_s",
+                 "das_fk20_produce_wall", "das::produce_wall",
+                 "das::producer_speedup", "das::proofs_per_s",
+                 "das::recover_wall", "das::recover_speedup"):
         hrec = fresh.get(name)
         assert hrec is not None, (name, sorted(fresh))
         assert not benchwatch.validate_record(hrec), hrec
@@ -953,6 +985,12 @@ def das_main():
     wrec = fresh["das::verify_wall@128x8"]
     assert wrec["das"]["matrix"]["cells"] == 1024, wrec
     assert wrec["vs_baseline"] >= 2.0, wrec
+    pwrec = fresh["das::produce_wall"]
+    assert pwrec["das_producer"]["parity"] is True, pwrec
+    assert pwrec["vs_baseline"] >= 4.0, pwrec
+    rwrec = fresh["das::recover_wall"]
+    assert rwrec["das_recover"]["roundtrip"] is True, rwrec
+    assert rwrec["vs_baseline"] >= 2.0, rwrec
     print(f"das history OK: {len(fresh)} records this run -> "
           f"{hist_file}")
 
@@ -970,15 +1008,23 @@ def das_main():
     assert "## DAS (PeerDAS cell-proof sampling)" in text, text[:2000]
     assert "| 128x8 | 1024 |" in text, text
     assert "Latest speedup over the pure-Python oracle:" in text
+    assert "FK20 producer:" in text, text
+    assert "Erasure recovery:" in text, text
+    assert "Latest producer throughput:" in text, text
     result = bw_report.build_report(
         repo=HERE, history_path=hist_file, snapshots=[],
         durations_path=None, top_n=5, strict=False,
         max_regress_pct=0.0, update_history=False)
     rows = {t["id"]: t for t in result["thresholds"]}
     assert rows["das-speedup"]["status"] == "PASS", rows["das-speedup"]
+    assert rows["das-producer-speedup"]["status"] == "PASS", \
+        rows["das-producer-speedup"]
+    assert rows["das-recover-speedup"]["status"] == "PASS", \
+        rows["das-recover-speedup"]
     assert rows["das-throughput"]["status"] == "no data", \
         rows["das-throughput"]
-    print(f"das report OK: DAS section rendered, das-speedup PASS, "
+    print(f"das report OK: DAS section rendered, das-speedup + "
+          f"das-producer-speedup + das-recover-speedup PASS, "
           f"TPU-gated das-throughput reads 'no data' on CPU -> "
           f"{report_md}")
     print("das smoke: PASS")

@@ -112,7 +112,8 @@ ALL_ROLES = frozenset((ROLE_DEVICE, ROLE_KERNEL, ROLE_LIMB, ROLE_INSTR,
 # they produce no findings because nothing in them touches jax)
 DEVICE_GLOBS = ("ops/bls_batch/*.py", "ops/bls/*.py", "parallel/*.py")
 DEVICE_FILES = ("ops/sha256_jax.py", "ops/fr_batch.py", "executor.py",
-                "forkchoice/kernels.py", "forkchoice/store.py")
+                "forkchoice/kernels.py", "forkchoice/store.py",
+                "das/recover.py")
 # exception-swallow discipline beyond the device files: the serving
 # subsystem (where a swallowed error reads as a healthy request) and
 # the resilience layer itself (which exists to keep failures typed).
@@ -147,13 +148,18 @@ KERNEL_FILES = LIMB_FILES + (
 # span/cost-covered like the kernels they compose);
 # forkchoice/store.py + kernels.py joined with the fork-choice
 # subsystem (the proto-array store's apply/head dispatches must stay
-# span/cost-covered like every other device path)
+# span/cost-covered like every other device path);
+# das/recover.py + ops/bls_batch/g1fft_jax.py joined with the FK20
+# producer / erasure-recovery path (the G1-FFT and circulant-MSM
+# entries plus the recover decode chain dispatch fr_batch + bls_batch
+# kernels and must stay span/cost-covered)
 INSTR_FILES = ("ops/bls_batch/__init__.py", "ops/bls/__init__.py",
+               "ops/bls_batch/g1fft_jax.py",
                "ops/sha256_jax.py", "ops/fr_batch.py",
                "parallel/incremental.py", "parallel/partition.py",
                "resilience/mesh.py", "resilience/checkpoint.py",
-               "das/verify.py", "forkchoice/store.py",
-               "forkchoice/kernels.py")
+               "das/verify.py", "das/recover.py",
+               "forkchoice/store.py", "forkchoice/kernels.py")
 
 # request-tracing coverage surface: every `submit_*` entry point of a
 # serve executor class must mint a reqtrace.RequestContext (directly or
@@ -168,9 +174,10 @@ SERVE_FILES = ("serve/executor.py",)
 # mesh-shape compile keys, quantized to the power-of-two ladder;
 # `das_rung` is the DAS cell-batch form (ops.fr_batch); `fc_rung` is
 # the fork-choice form (forkchoice.kernels: block-count,
-# validator-count and attestation-batch ladders)
+# validator-count and attestation-batch ladders); `g1fft_rung` is the
+# G1-FFT point-vector form (ops.bls_batch.g1fft_jax)
 BUCKET_FUNCS = frozenset({"_bucket", "mesh_rung", "das_rung",
-                          "fc_rung"})
+                          "fc_rung", "g1fft_rung"})
 
 # device-pool probes whose results are mesh-shape compile keys: a jit
 # factory keyed by a raw device count recompiles per topology without

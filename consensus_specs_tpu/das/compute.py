@@ -32,6 +32,7 @@ equal the oracle's byte-for-byte (pinned by tests/test_das.py).
 from __future__ import annotations
 
 import functools
+import os
 
 from .. import telemetry
 from ..ops.bls import curve as _curve
@@ -40,6 +41,8 @@ from . import ciphersuite as cs
 M = cs.FIELD_ELEMENTS_PER_BLOB
 L = cs.FIELD_ELEMENTS_PER_CELL
 P = cs.BLS_MODULUS
+K = M // L                      # residue classes / points per FK20 vector
+N_EXT = cs.CELLS_PER_EXT_BLOB   # FK20 circulant order (128)
 
 
 # --- field FFTs (host ints, the oracle's recursive shape) -------------------
@@ -78,22 +81,53 @@ def blob_to_poly_ints(blob: bytes) -> list[int]:
     return out
 
 
-def poly_coefficients(blob: bytes) -> list[int]:
+def _device_default() -> bool:
+    from ..ops import bls
+
+    return bls.backend_name() == "jax"
+
+
+def poly_coefficients(blob: bytes,
+                      device: bool | None = None) -> list[int]:
     """Coefficient form of the blob polynomial
-    (`polynomial_eval_to_coeff`: un-brp, inverse FFT)."""
+    (`polynomial_eval_to_coeff`: un-brp, inverse FFT).  Under the jax
+    backend the inverse FFT is one `fr_batch.fr_fft` dispatch —
+    value-identical to the host recursion (exact mod-p arithmetic)."""
     evals = blob_to_poly_ints(blob)
     brp = [evals[cs.reverse_bits(i, M)] for i in range(M)]
-    return _ifft(brp, list(cs.roots_of_unity(M)))
+    roots = list(cs.roots_of_unity(M))
+    if device is None:
+        device = _device_default()
+    if device:
+        from ..ops.fr_batch import fr_fft
+
+        return fr_fft([brp], roots, inverse=True)[0]
+    return _ifft(brp, roots)
 
 
-def compute_cells(blob: bytes) -> list[bytes]:
+def _extended_evals(coeffs, device: bool | None = None) -> list[int]:
+    """The extension: the blob polynomial evaluated over the whole
+    size-8192 domain (natural order)."""
+    if device is None:
+        device = _device_default()
+    padded = list(coeffs) + [0] * M
+    roots = list(cs.roots_of_unity(2 * M))
+    if device:
+        from ..ops.fr_batch import fr_fft
+
+        return fr_fft([padded], roots)[0]
+    return _fft(padded, roots)
+
+
+def compute_cells(blob: bytes,
+                  device: bool | None = None) -> list[bytes]:
     """All 128 cells of the extended blob via one size-8192 FFT —
-    bit-exact vs the spec's `compute_cells`."""
+    bit-exact vs the spec's `compute_cells`; one device dispatch per
+    transform under the jax backend."""
     with telemetry.span("das.compute_cells"):
         telemetry.count("das.compute.cells_calls")
-        coeffs = poly_coefficients(blob)
-        ext = _fft(coeffs + [0] * M,
-                   list(cs.roots_of_unity(2 * M)))
+        coeffs = poly_coefficients(blob, device=device)
+        ext = _extended_evals(coeffs, device=device)
         ext_brp = [ext[cs.reverse_bits(i, 2 * M)] for i in range(2 * M)]
         return [cs._encode_evals(ext_brp[k * L:(k + 1) * L])
                 for k in range(cs.CELLS_PER_EXT_BLOB)]
@@ -172,28 +206,134 @@ def cells_and_column_proofs(blob: bytes, columns,
         bytes(blob), tuple(int(c) for c in columns), device)
 
 
+# --- FK20: all proofs from O(log) FFTs + one MSM ----------------------------
+#
+# Every cell coset satisfies x^64 = a_k = w_128^rev7(k) (w_128 the
+# order-128 root), so the 128 proofs are the order-128 G1 FFT of the
+# D_u partials:
+#
+#     proofs (cell order) = brp( FFT_128([D_1 .. D_63, inf x 65]) )
+#
+# and the D_u themselves factor through per-residue circular
+# convolutions against the trusted setup: with b^c_m = f[c + 64m] and
+# x^c_v = [s^(c + 64v)],
+#
+#     D_u = [ IFFT_128( sum_c FFT_fr(B^c) * X_fft^c ) ]_(128-u) mod 128
+#
+# where B^c is the circulant embedding (B_0 = b_0, B_(128-m) = b_m) and
+# X_fft^c the order-128 G1 FFT of [x^c_0 .. x^c_63, inf x 64] — the
+# bit-reversed Toeplitz/circulant extended-setup tables, computed as
+# ONE batched 64-lane G1 FFT at first use and pinned device-resident
+# (`_fk20_setup_tables`).  Per blob: one batched field FFT, one
+# grouped Pippenger MSM (`fk20_hext_device`), one G1 IFFT + gather +
+# G1 FFT — ~30x less point work than the D_u route's 63 wide MSMs +
+# 128 narrow ones, byte-equal proofs (pinned by tests/test_das.py and
+# the kzg_7594 vectors).
+
+
+@functools.lru_cache(maxsize=1)
+def _fk20_setup_tables():
+    """Device-pinned X_fft tables (one per residue class), built by one
+    batched G1-FFT dispatch the first time a proof is produced and kept
+    on device for the life of the process."""
+    import numpy as np
+
+    from ..ops.bls_batch import g1fft_jax as gf
+
+    with telemetry.span("das.fk20_setup"):
+        telemetry.count("das.fk20.setup_builds")
+        xs, ys, zs = [], [], []
+        for c in range(L):
+            pts = [cs.setup_g1_point(c + L * v) for v in range(K)]
+            x, y, z = gf.points_to_limbs(pts, pad_to=N_EXT)
+            xs.append(x)
+            ys.append(y)
+            zs.append(z)
+        return gf.g1_fft_device(np.stack(xs), np.stack(ys),
+                                np.stack(zs))
+
+
+def _fk20_proofs_device(coeffs) -> list[bytes]:
+    """All 128 compressed proofs for a coefficient-form blob polynomial
+    via the FK20 pipeline above."""
+    import numpy as np
+
+    from ..ops.bls_batch import g1fft_jax as gf
+    from ..ops.fr_batch import fr_fft
+
+    with telemetry.span("das.fk20_proofs"):
+        telemetry.count("das.compute.fk20_calls")
+        rows = []
+        for c in range(L):
+            row = [0] * N_EXT
+            row[0] = int(coeffs[c])
+            for m in range(1, K):
+                row[N_EXT - m] = int(coeffs[c + L * m])
+            rows.append(row)
+        sfft = fr_fft(rows, list(cs.roots_of_unity(N_EXT)))
+        hext = gf.fk20_hext_device(*_fk20_setup_tables(), sfft)
+        cg = gf.g1_fft_device(*(c[None] for c in hext), inverse=True)
+        # gather E_d = C_(127-d) for d < 63, infinity beyond (Z = 0
+        # masks the lane; the stale x/y limbs are dead under the
+        # branchless is_inf selects)
+        import jax.numpy as jnp
+
+        idx = np.array([(N_EXT - 1 - d) % N_EXT for d in range(N_EXT)])
+        keep = np.arange(N_EXT) < (K - 1)
+        ex, ey, ez = (jnp.asarray(c)[:, idx] for c in cg)
+        ez = jnp.where(jnp.asarray(keep)[None, :, None], ez, 0)
+        out = gf.g1_fft_device(ex, ey, ez)
+        pts = gf.limbs_to_oracle_list(out)
+        return [_curve.g1_to_bytes(pts[cs.reverse_bits(k, N_EXT)])
+                for k in range(N_EXT)]
+
+
+def _du_proofs(coeffs, device: bool | None) -> list[bytes]:
+    """The D_u route (63 shared MSMs + one 63-point MSM per column) —
+    kept as the FK20 benchmark baseline and the host-route producer."""
+    d_points = []
+    for u in range(1, K):
+        pts = [cs.setup_g1_point(t) for t in range(M - u * L)]
+        d_points.append(_msm(pts, coeffs[u * L:], device))
+    proofs = []
+    for k in range(N_EXT):
+        a = _a_k(k)
+        pows, cur = [], 1
+        for _ in range(len(d_points)):
+            pows.append(cur)
+            cur = cur * a % P
+        proofs.append(_curve.g1_to_bytes(_msm(d_points, pows, device)))
+    return proofs
+
+
+def _producer_route(device: bool) -> str:
+    """FK20 on the device path unless CST_DAS_PRODUCER=du pins the D_u
+    baseline (the bench worker measures both); the host path keeps the
+    D_u shape (no device kernels to amortize)."""
+    if not device:
+        return "du"
+    route = os.environ.get("CST_DAS_PRODUCER", "fk20")
+    return "du" if route == "du" else "fk20"
+
+
 def compute_cells_and_kzg_proofs(blob: bytes,
-                                 device: bool | None = None):
-    """All cells AND all 128 proofs via the k-independent D_u partials
-    (63 shared MSMs + one 63-point MSM per column — about 4x less
-    point work than 128 independent quotient MSMs, and every MSM a
-    device dispatch under the jax backend).  Bit-exact vs the spec
-    oracle; the jax-backend spec namespace routes here."""
-    with telemetry.span("das.compute_cells_and_proofs"):
+                                 device: bool | None = None,
+                                 route: str | None = None):
+    """All cells AND all 128 proofs — the FK20 pipeline under the jax
+    backend (O(log) FFTs + one MSM), the D_u partial route otherwise
+    (or when `route='du'` / CST_DAS_PRODUCER=du pins the baseline).
+    Byte-exact vs the spec oracle on every route; the jax-backend spec
+    namespace routes here."""
+    if device is None:
+        device = _device_default()
+    if route is None:
+        route = _producer_route(device)
+    with telemetry.span("das.compute_cells_and_proofs", route=route):
         telemetry.count("das.compute.full_calls")
-        cells = compute_cells(blob)
-        coeffs = poly_coefficients(blob)
-        d_points = []
-        for u in range(1, M // L):
-            pts = [cs.setup_g1_point(t) for t in range(M - u * L)]
-            d_points.append(_msm(pts, coeffs[u * L:], device))
-        proofs = []
-        for k in range(cs.CELLS_PER_EXT_BLOB):
-            a = _a_k(k)
-            pows, cur = [], 1
-            for _ in range(len(d_points)):
-                pows.append(cur)
-                cur = cur * a % P
-            proofs.append(_curve.g1_to_bytes(
-                _msm(d_points, pows, device)))
+        cells = compute_cells(blob, device=device)
+        coeffs = poly_coefficients(blob, device=device)
+        if route == "fk20":
+            proofs = _fk20_proofs_device(coeffs)
+        else:
+            proofs = _du_proofs(coeffs, device)
         return cells, proofs

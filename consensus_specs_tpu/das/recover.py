@@ -1,0 +1,263 @@
+"""Erasure recovery for PeerDAS extended blobs — the device decode path.
+
+The fulu oracle (`recover_cells_and_kzg_proofs`) reconstructs a blob
+polynomial from any >= 50% of its 128 cells by the classic
+Reed-Solomon-via-FFT dance, then re-proves every cell with the naive
+O(n^2) producer.  Both halves are pure recursive Python — minutes per
+blob — which is why the super-node scenario (ingest damaged columns,
+reconstruct, re-prove, re-serve) had no measurable path until now.
+
+This module computes the SAME bytes on two routes:
+
+host route (`recover_cells_and_kzg_proofs_host`)
+    the spec oracle verbatim (its own `recover_polynomialcoeff` +
+    per-coset quotient producer) — the breaker's degraded route and the
+    bench baseline.  Bit-exact by construction.
+
+device route (`recover_cells_and_kzg_proofs_async`)
+    coset-structured decode: the vanishing polynomial over the missing
+    cosets is built HOST-side from the short order-128 product (at most
+    64 monomial multiplies — the stride-64 embedding into the order-8192
+    domain is free), and every heavy step is an `fr_batch.fr_fft`
+    dispatch on the extended domain —
+
+        Z(x)   = FFT(zero_poly)                      [forward]
+        (E*Z)  = IFFT(Z(x) * E(x))                   [inverse]
+        coset  = FFT(shift^i * ..) for E*Z and Z     [forward, batch=2]
+        P(x)   = IFFT(coset quotient) / shift^i      [inverse]
+
+    two extended-domain FFT round-trips, with the coset quotient done by
+    one host Montgomery batch-inversion (the coset is disjoint from the
+    domain, so Z never vanishes there).  The recovered coefficients then
+    re-prove through the FK20 producer (`compute._fk20_proofs_device`)
+    and re-evaluate through the same device FFT that serves
+    `compute_cells`.  Byte-identical output to the oracle on every
+    surviving-set shape (pinned by tests/test_das.py and the kzg_7594
+    recover vectors).
+
+Facades: `*_async` settles through `serve.futures.DeviceFuture` (the
+zero-poly FFT dispatches eagerly; everything else runs at settle time),
+`recover_cells_and_kzg_proofs` is the sync wrapper, and
+`CST_DAS_RECOVER_ROUTE=host` pins the oracle (the serve executor's
+degraded mode uses the host entry point directly).
+"""
+
+from __future__ import annotations
+
+import os
+
+from .. import telemetry
+from ..serve.futures import DeviceFuture
+from ..telemetry import costmodel
+from . import ciphersuite as cs
+from . import compute as dc
+
+P = cs.BLS_MODULUS
+M = cs.FIELD_ELEMENTS_PER_BLOB
+M_EXT = cs.FIELD_ELEMENTS_PER_EXT_BLOB
+L = cs.FIELD_ELEMENTS_PER_CELL
+N_EXT = cs.CELLS_PER_EXT_BLOB
+_SHIFT = cs.PRIMITIVE_ROOT_OF_UNITY
+
+
+def _assert_recoverable(cell_indices, cells) -> None:
+    """The spec oracle's argument contract, mirrored bit-for-bit so both
+    routes reject exactly the same inputs (AssertionError, like the
+    oracle)."""
+    assert len(cell_indices) == len(cells)
+    assert N_EXT // 2 <= len(cell_indices) <= N_EXT
+    assert len(cell_indices) == len(set(cell_indices))
+    for cell_index in cell_indices:
+        assert cell_index < N_EXT
+    for cell in cells:
+        assert len(cell) == cs.BYTES_PER_CELL
+
+
+def _cell_rows(cells) -> list[list[int]]:
+    return [[int.from_bytes(
+        bytes(cell)[i * cs.BYTES_PER_FIELD_ELEMENT:
+                    (i + 1) * cs.BYTES_PER_FIELD_ELEMENT],
+        cs.KZG_ENDIANNESS) for i in range(L)] for cell in cells]
+
+
+def _short_vanishing(missing_cell_indices) -> list[int]:
+    """Coefficients of prod (X - w_128^rev7(k)) over the missing cells —
+    the order-128 vanishing polynomial the oracle stride-embeds into the
+    extended domain (at most 64 monomial multiplies, host arithmetic)."""
+    roots = cs.roots_of_unity(N_EXT)
+    poly = [1]
+    for k in missing_cell_indices:
+        r = roots[cs.reverse_bits(int(k), N_EXT)]
+        nxt = [0] * (len(poly) + 1)
+        for i, c in enumerate(poly):
+            nxt[i] = (nxt[i] - c * r) % P
+            nxt[i + 1] = (nxt[i + 1] + c) % P
+        poly = nxt
+    return poly
+
+
+def construct_vanishing_poly(missing_cell_indices) -> list[int]:
+    """The extended-domain vanishing polynomial: the short order-128
+    product stride-64 embedded into 8192 coefficients (the oracle's
+    `construct_vanishing_polynomial`, ints instead of field wrappers)."""
+    short = _short_vanishing(missing_cell_indices)
+    out = [0] * M_EXT
+    for i, c in enumerate(short):
+        out[i * L] = c
+    return out
+
+
+def _batch_inverse(vals: list[int]) -> list[int]:
+    """Montgomery's trick: n inversions for one modpow + 3n mulmods."""
+    pref = [1] * (len(vals) + 1)
+    for i, v in enumerate(vals):
+        pref[i + 1] = pref[i] * v % P
+    inv = pow(pref[-1], P - 2, P)
+    out = [0] * len(vals)
+    for i in range(len(vals) - 1, -1, -1):
+        out[i] = pref[i] * inv % P
+        inv = inv * vals[i] % P
+    return out
+
+
+def _shift_scale(vals, factor: int) -> list[int]:
+    out, cur = [], 1
+    for v in vals:
+        out.append(v * cur % P)
+        cur = cur * factor % P
+    return out
+
+
+def recover_coefficients_device(cell_indices, rows,
+                                zero_fut=None) -> list[int]:
+    """The decode half: surviving cells (field-element rows in stored
+    coset order) -> the 4096 blob polynomial coefficients, every FFT a
+    device dispatch.  `zero_fut` lets the async facade pre-dispatch the
+    zero-poly evaluation."""
+    from ..ops.fr_batch import fr_fft, fr_fft_async
+
+    roots = list(cs.roots_of_unity(M_EXT))
+    have = {int(k) for k in cell_indices}
+    missing = [k for k in range(N_EXT) if k not in have]
+    zero_poly = construct_vanishing_poly(missing)
+    with telemetry.span("das.recover_decode", cells=len(rows),
+                        missing=len(missing)):
+        telemetry.count("das.recover.decode_calls")
+        telemetry.count("das.recover.missing_cells", len(missing))
+        if zero_fut is None:
+            zero_fut = fr_fft_async([zero_poly], roots)
+        ext_rbo = [0] * M_EXT
+        for k, row in zip(cell_indices, rows):
+            ext_rbo[int(k) * L:(int(k) + 1) * L] = [int(v) % P
+                                                    for v in row]
+        ext = [ext_rbo[cs.reverse_bits(i, M_EXT)] for i in range(M_EXT)]
+        zero_eval = zero_fut.result()[0]
+        prod = [a * b % P for a, b in zip(zero_eval, ext)]
+        ez_coeffs = fr_fft([prod], roots, inverse=True)[0]
+        coset = fr_fft([_shift_scale(ez_coeffs, _SHIFT),
+                        _shift_scale(zero_poly, _SHIFT)], roots)
+        quotient = [a * zi % P for a, zi
+                    in zip(coset[0], _batch_inverse(coset[1]))]
+        shifted = fr_fft([quotient], roots, inverse=True)[0]
+        coeffs = _shift_scale(shifted, pow(_SHIFT, P - 2, P))[:M]
+    costmodel.sample_watermark("das.recover_decode")
+    return coeffs
+
+
+# --- host route (the oracle, the breaker's degraded mode) --------------------
+
+
+def recover_cells_and_kzg_proofs_host(cell_indices, cells):
+    """The pure-Python spec oracle end to end (decode + naive per-coset
+    re-prove).  Slow — this is the degraded route and the bench
+    baseline, not the serving path."""
+    from ..models.builder import build_spec
+
+    fulu = build_spec("fulu", "mainnet")
+    _assert_recoverable(cell_indices, cells)
+    with telemetry.span("das.recover_host", cells=len(cells)):
+        telemetry.count("das.recover.host_calls")
+        cosets_evals = [fulu.cell_to_coset_evals(bytes(cell))
+                        for cell in cells]
+        coeffs = fulu.recover_polynomialcoeff(
+            [int(k) for k in cell_indices], cosets_evals)
+        out_cells, out_proofs = \
+            fulu.compute_cells_and_kzg_proofs_polynomialcoeff(coeffs)
+        return ([bytes(c) for c in out_cells],
+                [bytes(p) for p in out_proofs])
+
+
+# --- device route ------------------------------------------------------------
+
+
+def _recover_route(device: bool | None) -> bool:
+    """True -> device decode + FK20 re-prove.  `CST_DAS_RECOVER_ROUTE=
+    host` pins the oracle (the bench baseline switch); otherwise follow
+    the active BLS backend like every other das entry point."""
+    if os.environ.get("CST_DAS_RECOVER_ROUTE", "") == "host":
+        return False
+    if device is not None:
+        return bool(device)
+    from ..ops import bls
+
+    return bls.backend_name() == "jax"
+
+
+def recover_cells_and_kzg_proofs_async(cell_indices, cells,
+                                       device: bool | None = None
+                                       ) -> DeviceFuture:
+    """Deferred (cells, proofs) recovery.  Argument validation and the
+    zero-poly FFT dispatch happen eagerly; decode, re-evaluation, and
+    the FK20 re-prove run at settle time with every device fetch going
+    through `DeviceFuture.result()` (the sanctioned settle seam).
+    `device=False` (or CST_DAS_RECOVER_ROUTE=host) answers on the spec
+    oracle immediately."""
+    if not _recover_route(device):
+        try:
+            return DeviceFuture.settled(recover_cells_and_kzg_proofs_host(
+                cell_indices, cells))
+        except Exception as exc:
+            return DeviceFuture.failed(exc)
+
+    from ..ops.fr_batch import fr_fft_async
+
+    _assert_recoverable(cell_indices, cells)
+    rows = _cell_rows(cells)
+    indices = [int(k) for k in cell_indices]
+    have = set(indices)
+    missing = [k for k in range(N_EXT) if k not in have]
+    with telemetry.span("das.recover_device", cells=len(cells),
+                        missing=len(missing)):
+        telemetry.count("das.recover.device_calls")
+        # stage 1 dispatches NOW: the zero-poly evaluation depends only
+        # on WHICH cells are missing, so it overlaps the caller's next
+        # host prep (and the row parse above)
+        zero_fut = fr_fft_async([construct_vanishing_poly(missing)],
+                                list(cs.roots_of_unity(M_EXT)))
+    costmodel.sample_watermark("das.recover_device")
+
+    def _finish(fut: DeviceFuture, timeout=None) -> None:
+        try:
+            coeffs = recover_coefficients_device(indices, rows,
+                                                 zero_fut=zero_fut)
+            ext = dc._extended_evals(coeffs, device=True)
+            ext_brp = [ext[cs.reverse_bits(i, M_EXT)]
+                       for i in range(M_EXT)]
+            out_cells = [cs._encode_evals(ext_brp[k * L:(k + 1) * L])
+                         for k in range(N_EXT)]
+            out_proofs = dc._fk20_proofs_device(coeffs)
+            fut.set_result((out_cells, out_proofs))
+        except Exception as exc:
+            if fut.done():
+                raise
+            fut.set_exception(exc)
+
+    return DeviceFuture(waiter=_finish)
+
+
+def recover_cells_and_kzg_proofs(cell_indices, cells,
+                                 device: bool | None = None):
+    """Synchronous facade over `recover_cells_and_kzg_proofs_async`; the
+    fetches live in `serve.futures`."""
+    return recover_cells_and_kzg_proofs_async(cell_indices, cells,
+                                              device=device).result()

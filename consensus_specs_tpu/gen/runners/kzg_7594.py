@@ -270,6 +270,59 @@ def case_recover_cells_and_kzg_proofs():
     yield ("recover_cells_and_kzg_proofs_case_valid_more_than_half",
            runner(available(1, list(range(0, n_cells, 2)) + [1])))
 
+    # --- device-route vectors (coset erasure decode + FK20 re-prove).
+    # Rendered through the jax route with the host oracle run on the
+    # same inputs and byte-parity asserted BEFORE the vector is
+    # written, so a published vector can never encode a device-only
+    # answer.  A degree-65 closed-form blob keeps the pure-Python
+    # oracle tractable (its MSM skips the ~4030 zero scalars).
+    def device_runner(get_inputs):
+        def _run():
+            from ...das import recover as das_recover
+            cell_indices, cells = get_inputs()
+            dev = _try(das_recover.recover_cells_and_kzg_proofs,
+                       cell_indices, cells, True)
+            host = _try(das_recover.recover_cells_and_kzg_proofs_host,
+                        cell_indices, cells)
+            assert (dev is None) == (host is None), \
+                (dev is None, host is None)
+            if dev is not None:
+                assert [bytes(c) for c in dev[0]] == \
+                    [bytes(c) for c in host[0]], "device/oracle cells"
+                assert [bytes(p) for p in dev[1]] == \
+                    [bytes(p) for p in host[1]], "device/oracle proofs"
+            return _data_part(
+                {"cell_indices": [int(i) for i in cell_indices],
+                 "cells": encode_hex_list(cells)},
+                ((encode_hex_list(dev[0]), encode_hex_list(dev[1]))
+                 if dev is not None else None))
+        return _run
+
+    def closed_form_available(indices, mutate=None):
+        def _get():
+            from ...das import ciphersuite as dcs
+            _, by_col = dcs.closed_form_row(
+                90007, 80007, 70007, list(range(n_cells)))
+            inputs = (list(indices), [by_col[i][0] for i in indices])
+            if mutate is not None:
+                inputs = mutate(*inputs)
+            return inputs
+        return _get
+
+    yield ("recover_cells_and_kzg_proofs_case_valid_device_half"
+           "_missing",
+           device_runner(closed_form_available(
+               list(range(0, n_cells, 2)))))
+    yield ("recover_cells_and_kzg_proofs_case_invalid_device_one_more"
+           "_than_half_missing",
+           device_runner(closed_form_available(
+               list(range(n_cells // 2 - 1)))))
+    yield ("recover_cells_and_kzg_proofs_case_invalid_device"
+           "_duplicate_cell_index",
+           device_runner(closed_form_available(
+               list(range(0, n_cells, 2)),
+               mutate=lambda i, c: ([i[0], i[0]] + i[2:], c))))
+
 
 CASE_FNS = [
     ("compute_cells", case_compute_cells),

@@ -561,7 +561,22 @@ def recover_polynomialcoeff(cell_indices, cosets_evals) -> PolynomialCoeff:
 
 def recover_cells_and_kzg_proofs(cell_indices, cells):
     """Given >= 50% of a blob's cells, recover all cells and proofs.
-    Public method."""
+    Public method.
+
+    Device routing (the DAS subsystem): under the jax backend with real
+    BLS active, `das/recover.py` runs the coset-structured decode as
+    device field-FFT dispatches and re-proves through the FK20 producer
+    — byte-identical cells and proofs, same AssertionError contract on
+    malformed input (pinned by tests/test_das.py and the kzg_7594
+    recover vectors)."""
+    if bls.backend_name() == "jax" and bls.bls_active:
+        from consensus_specs_tpu.das import recover as _das_recover
+
+        out_cells, out_proofs = _das_recover.recover_cells_and_kzg_proofs(
+            [int(k) for k in cell_indices], [bytes(c) for c in cells])
+        return ([Cell(c) for c in out_cells],
+                [KZGProof(p) for p in out_proofs])
+
     # Same number of cells and indices
     assert len(cell_indices) == len(cells)
     # Enough cells to reconstruct

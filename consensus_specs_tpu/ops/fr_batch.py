@@ -397,3 +397,121 @@ def coset_interpolate_sum(evals_rows, idft_matrix, weight_rows):
     """Synchronous facade over `coset_interpolate_sum_async`."""
     return coset_interpolate_sum_async(evals_rows, idft_matrix,
                                        weight_rows).result()
+
+
+# --- radix-2 field FFT (the DAS coefficient/evaluation transform) -----------
+#
+# The host recursive `_fft` in `das/compute.py` is the oracle shape:
+# natural-order input, natural-order output, twiddles taken from the
+# caller's root list.  The device kernel is the same arithmetic as ONE
+# dispatch — bit-reverse the input on host (free: an index permutation
+# before the Montgomery conversion), then log2(n) butterfly stages of
+# lazy adds around one CIOS multiply per v-lane.  Magnitudes grow by
+# ~2p per stage (u rides adds only), far inside the signed 2**388
+# budget even at n = 8192 (13 stages); the final scale multiply
+# (inv_n for the inverse, 1 for the forward) collapses everything back
+# under 2p, so outputs feed elementwise follow-ups directly.
+
+
+@functools.lru_cache(maxsize=8)
+def _fr_fft_kernel(n: int, batch: int):
+    """Jitted batched radix-2 DIT FFT over an order-n multiplicative
+    domain: x (B, n, 33) Montgomery in BIT-REVERSED order, per-stage
+    twiddle tables ((1,33), (2,33), ..., (n/2,33)), one scale limb
+    (33,).  Natural-order output, value-identical to the recursive
+    host `_fft` (exact mod-p arithmetic: any correct FFT bracketing
+    computes the same field elements)."""
+    import jax
+    jnp = _jnp()
+
+    def run(x, tws, scale):
+        for tw in tws:
+            h = tw.shape[0]
+            blocks = x.reshape(batch, n // (2 * h), 2, h, N_LIMBS)
+            u = blocks[:, :, 0]
+            v = blocks[:, :, 1]
+            t = FR.mul(v, tw[None, None])
+            x = jnp.stack([FR.add(u, t), FR.sub(u, t)],
+                          axis=2).reshape(batch, n, N_LIMBS)
+        return FR.mul(x, scale[None, None])
+
+    return jax.jit(run)
+
+
+@functools.lru_cache(maxsize=8)
+def _fft_twiddles_mont(roots_key: tuple):
+    """Per-stage Montgomery twiddle tables for a root tuple: stage with
+    half-width h multiplies lane i by roots[i * n/(2h)]."""
+    n = len(roots_key)
+    tws, h = [], 1
+    while h < n:
+        stride = n // (2 * h)
+        tws.append(FR.to_mont_batch(
+            [roots_key[i * stride] for i in range(h)]))
+        h *= 2
+    return tuple(tws)
+
+
+@functools.lru_cache(maxsize=8)
+def _bitrev_perm(n: int) -> tuple:
+    bits = n.bit_length() - 1
+    return tuple(int(f"{i:0{bits}b}"[::-1], 2) if bits else 0
+                 for i in range(n))
+
+
+def _from_mont_matrix(host):
+    arr = np.asarray(host)
+    return [[FR.from_mont(row) for row in block] for block in arr]
+
+
+def fr_fft_async(rows, roots, inverse: bool = False):
+    """Device FFT of a batch of field-element rows over the domain the
+    caller supplies (the same contract as the host `_fft`/`_ifft` in
+    `das/compute.py`: natural-order values in, natural-order out,
+    `inverse=True` runs the reversed-root transform and scales by
+    1/n).  Settles to a list of rows of canonical ints.
+
+    One dispatch replaces the O(n log n) host recursion — the FK20
+    producer calls this at n=128 (64 circulant columns in one batch),
+    n=4096 (coefficient extraction) and n=8192 (cell evaluation /
+    erasure-decode round trips)."""
+    from ..serve.futures import value_future
+
+    n = len(roots)
+    assert n and n & (n - 1) == 0
+    batch = len(rows)
+    # cst: allow(recompile-traced-branch): rows is the HOST input list
+    # (the device array is built further down) — this is argument
+    # validation, not a branch on a traced value
+    assert batch >= 1 and all(len(r) == n for r in rows)
+    roots_key = tuple(int(r) % R_MODULUS for r in roots)
+    if inverse:
+        roots_key = (roots_key[0],) + roots_key[:0:-1]
+        scale_int = pow(n, R_MODULUS - 2, R_MODULUS)
+    else:
+        scale_int = 1
+    jnp = _jnp()
+    # cst: allow(recompile-unbucketed-dim): n is a KZG domain order —
+    # preset-fixed (128 / 4096 / 8192 on mainnet) — and batch is the
+    # FK20 residue count (64) or a single blob, so the lru-cached
+    # kernel compiles a handful of shapes per process, never per call
+    kfn = _fr_fft_kernel(n, batch)
+    perm = _bitrev_perm(n)
+    with telemetry.span("fr.fft", n=n, batch=batch,
+                        inverse=bool(inverse)):
+        telemetry.count("fr.fft.calls")
+        flat = [int(row[j]) for row in rows for j in perm]
+        x = jnp.asarray(FR.to_mont_batch(flat).reshape(batch, n,
+                                                       N_LIMBS))
+        tws = tuple(jnp.asarray(t)
+                    for t in _fft_twiddles_mont(roots_key))
+        scale = jnp.asarray(FR.to_mont(scale_int))
+        out = kfn(x, tws, scale)
+    # cost-capture seam, outside the span (same contract as barycentric)
+    costmodel.capture(f"fr_fft@{n}x{batch}", kfn, (x, tws, scale))
+    return value_future(out, convert=_from_mont_matrix)
+
+
+def fr_fft(rows, roots, inverse: bool = False):
+    """Synchronous facade over `fr_fft_async`."""
+    return fr_fft_async(rows, roots, inverse=inverse).result()

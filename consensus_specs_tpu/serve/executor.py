@@ -109,7 +109,7 @@ from ..telemetry import reqtrace
 from .futures import DeviceFuture, FutureTimeout
 
 KINDS = ("verify", "pairing", "msm", "sha256", "fr", "proof", "das",
-         "fc_atts", "head")
+         "recover", "fc_atts", "head")
 
 # batched-kind dispatchers resolve lazily: importing the executor must
 # not pull jax/numpy-heavy ops modules until the first dispatch
@@ -239,6 +239,10 @@ def _oracle_compute(kind: str, payload):
         from ..das.sampling import verify_sample_host
 
         return verify_sample_host(payload)
+    if kind == "recover":
+        from ..das.recover import recover_cells_and_kzg_proofs_host
+
+        return recover_cells_and_kzg_proofs_host(*payload)
     if kind == "fc_atts":
         # host-mirror fold (the exact kernel rule); the store rebuilds
         # its device arrays from the mirror when the breaker re-closes
@@ -251,7 +255,7 @@ def _oracle_compute(kind: str, payload):
 
 
 ORACLE_KINDS = frozenset({"verify", "pairing", "msm", "sha256", "fr",
-                          "das", "fc_atts", "head"})
+                          "das", "recover", "fc_atts", "head"})
 
 
 class ServeExecutor:
@@ -376,6 +380,16 @@ class ServeExecutor:
         structurally broken or inclusion-failing sample settles False
         without touching the device."""
         return self._submit("das", sample)
+
+    def submit_recover_request(self, cell_indices, cells) -> DeviceFuture:
+        """One damaged-blob reconstruction (the super-node lane): >= 64
+        surviving cells in, ALL 128 cells + FK20 proofs out — the
+        device coset decode + re-prove (`das.recover`).  Settles to
+        (cells, proofs); malformed input (too few cells, duplicates,
+        bad sizes) fails at dispatch and poisons only its own handle.
+        The breaker's degraded route is the pure-Python spec oracle."""
+        return self._submit("recover", (list(cell_indices),
+                                        [bytes(c) for c in cells]))
 
     def submit_attestation_batch(self, store, validator_indices,
                                  target_epochs,
@@ -514,6 +528,14 @@ class ServeExecutor:
                 # host route)
                 fut = verify_sample_group_async(
                     [r.payload for r in reqs])
+            elif kind == "recover":
+                from ..das.recover import \
+                    recover_cells_and_kzg_proofs_async
+                # one reconstruction per dispatch (the payload is a
+                # whole damaged blob); the zero-poly FFT goes out now,
+                # decode + FK20 re-prove run at settle
+                fut = recover_cells_and_kzg_proofs_async(
+                    *reqs[0].payload, device=True)
             elif kind == "fc_atts":
                 # cross-request batching: every queued batch for this
                 # store folds into ONE latest-message/weight dispatch;

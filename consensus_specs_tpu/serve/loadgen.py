@@ -28,6 +28,11 @@ Arrival model (per mainnet slot, 12 s):
         synthetic proto-array store — the per-attestation bookkeeping
         every client runs; CST_FC_ATTS_PER_SLOT overrides, 0 disables
         the lane and its head poll)
+     0  damaged-blob reconstructions (`submit_recover_request` — the
+        super-node path: erasure-decode a >= 50%-surviving cell set and
+        FK20 re-prove it on device; the heaviest single request, so
+        OPT-IN via CST_DAS_RECOVER_PER_SLOT, with CST_DAS_RECOVER_COLS
+        surviving cells per ingest)
 
 `rate <= 0` switches to closed-loop mode: the generator keeps
 `max_batch * (depth + 1)` requests outstanding and the measured rate IS
@@ -76,10 +81,22 @@ FC_ATTS_PER_SLOT = max(
     0, int(os.environ.get("CST_FC_ATTS_PER_SLOT", 2)))
 HEAD_POLLS_PER_SLOT = 1 if FC_ATTS_PER_SLOT else 0
 FC_BATCH_MESSAGES = 64
+# super-node lane: damaged-blob reconstructions per slot (ingest a
+# >= 50%-surviving cell set, reconstruct + FK20 re-prove on device,
+# re-serve; the breaker degrades to the pure-Python oracle).  A full
+# reconstruction is the heaviest single request the executor carries,
+# so the lane is OPT-IN (default 0); CST_DAS_RECOVER_COLS sets how many
+# cells survive each damaged ingest (default 64 — exactly half, the
+# worst recoverable case)
+RECOVER_PER_SLOT = max(
+    0, int(os.environ.get("CST_DAS_RECOVER_PER_SLOT", 0)))
+RECOVER_COLS = min(128, max(
+    64, int(os.environ.get("CST_DAS_RECOVER_COLS", 64))))
 STATEMENTS_PER_SLOT = (ATT_STATEMENTS_PER_SLOT + SYNC_STATEMENTS_PER_SLOT
                        + KZG_EVALS_PER_SLOT + SHA_ROOTS_PER_SLOT
                        + PROOF_REQUESTS_PER_SLOT + DAS_SAMPLES_PER_SLOT
-                       + FC_ATTS_PER_SLOT + HEAD_POLLS_PER_SLOT)
+                       + FC_ATTS_PER_SLOT + HEAD_POLLS_PER_SLOT
+                       + RECOVER_PER_SLOT)
 STEADY_TOL = 0.2
 
 
@@ -236,6 +253,35 @@ def _fc_payload(n_blocks: int = 48, n_validators: int = 256,
                                      seed=53)
 
 
+def _recover_payloads(n_patterns: int = 3, survive: int = RECOVER_COLS,
+                      seed: int = 4100):
+    """Damaged-blob ingests for the super-node lane: one low-degree
+    (closed-form) blob's full cell set, cut down to `survive` cells
+    under `n_patterns` distinct damage patterns (cycled by the lane).
+    The blob is degree-65 so building the ground-truth cells costs two
+    host FFTs, not an MSM."""
+    import random
+
+    from ..das import ciphersuite as dcs
+    from ..das import compute as dc
+
+    roots = dcs.roots_of_unity(dcs.FIELD_ELEMENTS_PER_BLOB)
+    evals = []
+    for i in range(dcs.FIELD_ELEMENTS_PER_BLOB):
+        x = roots[dcs.reverse_bits(i, dcs.FIELD_ELEMENTS_PER_BLOB)]
+        evals.append((seed * pow(x, 65, dcs.BLS_MODULUS)
+                      + (seed + 1) * pow(x, 64, dcs.BLS_MODULUS)
+                      + seed + 2) % dcs.BLS_MODULUS)
+    cells = dc.compute_cells(dcs._encode_evals(evals), device=False)
+    rng = random.Random(seed)
+    out = []
+    for _ in range(n_patterns):
+        keep = sorted(rng.sample(range(dcs.CELLS_PER_EXT_BLOB),
+                                 survive))
+        out.append((keep, [cells[k] for k in keep]))
+    return out
+
+
 def _proof_payload(n_leaves: int = 256, batch: int = 16):
     """A persistent `MerkleForest` plus one index batch — the
     `submit_proof_request` payload shape (the forest is built once and
@@ -272,15 +318,18 @@ def make_submitter(ex, pool, payloads, track=None):
         + ["proof"] * PROOF_REQUESTS_PER_SLOT
         + ["das"] * DAS_SAMPLES_PER_SLOT
         + ["fc_atts"] * FC_ATTS_PER_SLOT
-        + ["head"] * HEAD_POLLS_PER_SLOT)
+        + ["head"] * HEAD_POLLS_PER_SLOT
+        + ["recover"] * RECOVER_PER_SLOT)
     pool_iter = itertools.cycle(pool)
     das_iter = itertools.cycle(payloads["das"]) if payloads.get("das") \
         else None
+    recover_iter = itertools.cycle(payloads["recover"]) \
+        if payloads.get("recover") else None
     fc_store, fc_batches = payloads["fc"] if payloads.get("fc") \
         else (None, None)
     kinds_submitted = {k: 0 for k in ("verify", "pairing", "fr",
                                       "sha256", "proof", "das",
-                                      "fc_atts", "head")}
+                                      "recover", "fc_atts", "head")}
 
     def submit_next():
         kind = next(schedule)
@@ -295,6 +344,8 @@ def make_submitter(ex, pool, payloads, track=None):
             fut = ex.submit_sha256_root(*payloads["sha256"])
         elif kind == "das":
             fut = ex.submit_das_sample(next(das_iter))
+        elif kind == "recover":
+            fut = ex.submit_recover_request(*next(recover_iter))
         elif kind == "fc_atts":
             fut = ex.submit_attestation_batch(fc_store,
                                               *next(fc_batches))
@@ -355,6 +406,11 @@ def _warm_kernels(cfg: LoadConfig, pool, payloads) -> float:
         from ..das.sampling import verify_sample_async
 
         verify_sample_async(payloads["das"][0], device=True).result()
+    if payloads.get("recover"):
+        from ..das.recover import recover_cells_and_kzg_proofs_async
+
+        recover_cells_and_kzg_proofs_async(
+            *payloads["recover"][0], device=True).result()
     if payloads.get("fc"):
         fc_store, fc_batches = payloads["fc"]
         fc_store.apply_attestations_async(*next(fc_batches)).result()
@@ -403,6 +459,8 @@ def run_load(cfg: LoadConfig | None = None, executor=None) -> dict:
                 "fr": _fr_payload(), "sha256": _sha_payload(),
                 "proof": _proof_payload(),
                 "das": (_das_payloads() if DAS_SAMPLES_PER_SLOT else []),
+                "recover": (_recover_payloads() if RECOVER_PER_SLOT
+                            else []),
                 "fc": (_fc_payload() if FC_ATTS_PER_SLOT else None)}
     warm_s = _warm_kernels(cfg, pool, payloads)
     # a CST_FAULTS plan goes live only AFTER warmup: AOT precompile is

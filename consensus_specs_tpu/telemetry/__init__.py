@@ -79,6 +79,7 @@ from .export import (
     validate_checkpoint_block,
     validate_costmodel_block,
     validate_das_block,
+    validate_das_producer_block,
     validate_forkchoice_block,
     validate_latency_attribution,
     validate_mesh_block,
@@ -96,7 +97,8 @@ __all__ = [
     "snapshot", "span", "span_seconds", "bench_block", "chrome_trace",
     "embed_bench_block", "validate_bench_block",
     "validate_checkpoint_block", "validate_costmodel_block",
-    "validate_das_block", "validate_forkchoice_block",
+    "validate_das_block", "validate_das_producer_block",
+    "validate_forkchoice_block",
     "validate_latency_attribution",
     "validate_mesh_block",
     "validate_resilience_block", "validate_scaling_block",

@@ -620,6 +620,53 @@ def validate_das_block(obj) -> list[str]:
     return problems
 
 
+def validate_das_producer_block(obj) -> list[str]:
+    """Schema check for the bench `"das_producer"` sub-object (the FK20
+    producer + erasure-recovery sweep `bench.py --worker das` emits);
+    returns problems (empty == valid).  Pinned by `bench_smoke.py
+    --das` and tests/test_das.py."""
+    if not isinstance(obj, dict):
+        return [f"das_producer block is {type(obj).__name__}, not dict"]
+    problems: list[str] = []
+    for key in ("produce_wall_s", "produce_first_s", "proofs_per_s",
+                "du_wall_s", "producer_speedup"):
+        v = obj.get(key)
+        if not isinstance(v, (int, float)) or isinstance(v, bool) \
+                or v <= 0:
+            problems.append(f"{key!r} must be a positive number, "
+                            f"got {v!r}")
+    v = obj.get("du_msms_measured")
+    if not isinstance(v, int) or isinstance(v, bool) or v < 1:
+        problems.append(f"'du_msms_measured' must be a positive int, "
+                        f"got {v!r}")
+    if obj.get("parity") is not True:
+        problems.append("'parity' must be True (FK20 proofs byte-equal "
+                        "the closed-form ground truth)")
+    rec = obj.get("recover")
+    if not isinstance(rec, dict):
+        problems.append("'recover' must be a dict")
+        return problems
+    for key in ("wall_s", "oracle_wall_s", "speedup"):
+        v = rec.get(key)
+        if not isinstance(v, (int, float)) or isinstance(v, bool) \
+                or v <= 0:
+            problems.append(f"recover[{key!r}] must be a positive "
+                            f"number, got {v!r}")
+    for key in ("cells_in", "missing", "oracle_cosets_measured"):
+        v = rec.get(key)
+        if not isinstance(v, int) or isinstance(v, bool) or v < 1:
+            problems.append(f"recover[{key!r}] must be a positive int, "
+                            f"got {v!r}")
+    if isinstance(rec.get("cells_in"), int) and rec["cells_in"] < 64:
+        problems.append("recover['cells_in'] must be >= 64 (half the "
+                        "extended blob — below that nothing is "
+                        "recoverable)")
+    if rec.get("roundtrip") is not True:
+        problems.append("'recover.roundtrip' must be True (recovered "
+                        "cells and proofs byte-equal the originals)")
+    return problems
+
+
 def validate_forkchoice_block(obj) -> list[str]:
     """Schema check for the bench `"forkchoice"` sub-object (the
     device LMD-GHOST sweep `bench.py --worker forkchoice` emits);

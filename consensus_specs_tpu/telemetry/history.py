@@ -518,6 +518,62 @@ def das_records(metric: str, das, **context) -> list[dict]:
     return records
 
 
+def das_producer_records(metric: str, prod, **context) -> list[dict]:
+    """`das`-source history records mined from one metric line's
+    `"das_producer"` sub-object (the FK20 producer + erasure-recovery
+    sweep `bench.py --worker das` emits): `das::produce_wall` (carrying
+    the compact block, producer speedup as `vs_baseline`),
+    `das::proofs_per_s`, and the `das::producer_speedup` record the
+    CPU-evaluated `das-producer-speedup` threshold row gates on; when
+    the recovery sub-object is present, `das::recover_wall` plus the
+    `das::recover_speedup` record behind `das-recover-speedup`.
+    Malformed blocks yield zero records, never an exception."""
+    if not isinstance(prod, dict):
+        return []
+
+    def _num(v):
+        return v if isinstance(v, (int, float)) \
+            and not isinstance(v, bool) else None
+
+    wall = _num(prod.get("produce_wall_s"))
+    if wall is None:
+        return []
+    speedup = _num(prod.get("producer_speedup"))
+    compact = {k: prod[k] for k in (
+        "produce_first_s", "du_wall_s", "du_msms_measured",
+        "parity") if k in prod}
+    records = [make_record(
+        "das", "das::produce_wall", wall, unit="s",
+        vs_baseline=speedup, das_producer=compact, via_metric=metric,
+        **context)]
+    if speedup is not None:
+        records.append(make_record(
+            "das", "das::producer_speedup", speedup, unit="x",
+            via_metric=metric, **context))
+    pps = _num(prod.get("proofs_per_s"))
+    if pps is not None:
+        records.append(make_record(
+            "das", "das::proofs_per_s", pps, unit="proofs/s",
+            via_metric=metric, **context))
+    rec = prod.get("recover")
+    if isinstance(rec, dict):
+        rwall = _num(rec.get("wall_s"))
+        rspeed = _num(rec.get("speedup"))
+        if rwall is not None:
+            records.append(make_record(
+                "das", "das::recover_wall", rwall, unit="s",
+                vs_baseline=rspeed,
+                das_recover={k: rec[k] for k in (
+                    "cells_in", "missing", "oracle_wall_s",
+                    "oracle_cosets_measured", "roundtrip") if k in rec},
+                via_metric=metric, **context))
+        if rspeed is not None:
+            records.append(make_record(
+                "das", "das::recover_speedup", rspeed, unit="x",
+                via_metric=metric, **context))
+    return records
+
+
 def forkchoice_records(metric: str, fc, **context) -> list[dict]:
     """`forkchoice`-source history records mined from one metric
     line's `"forkchoice"` sub-object (`bench.py --worker forkchoice` /
@@ -693,6 +749,9 @@ def parse_bench_round(path) -> tuple[list[dict], list[str]]:
             rc=rc, platform=obj.get("platform")))
         records.extend(das_records(
             name, obj.get("das"), round=rnd, file=path.name,
+            rc=rc, platform=obj.get("platform")))
+        records.extend(das_producer_records(
+            name, obj.get("das_producer"), round=rnd, file=path.name,
             rc=rc, platform=obj.get("platform")))
         records.extend(forkchoice_records(
             name, obj.get("forkchoice"), round=rnd, file=path.name,
@@ -1001,6 +1060,10 @@ def emission_records(metric_line: dict, ts: float | None = None
             records.append(srec)
         for drec in das_records(
                 name, obj.get("das"), platform=platform,
+                ts=round(ts, 1) if ts is not None else None):
+            records.append(drec)
+        for drec in das_producer_records(
+                name, obj.get("das_producer"), platform=platform,
                 ts=round(ts, 1) if ts is not None else None):
             records.append(drec)
         for frec in forkchoice_records(

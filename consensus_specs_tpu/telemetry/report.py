@@ -199,6 +199,20 @@ THRESHOLDS = (
      "title": "DAS sampling-matrix throughput (cells/s)",
      "metric": r"das::cells_per_s",
      "field": "value", "op": ">=", "target": 20000.0, "tpu_only": True},
+    # the producer side (PR 16): FK20 must beat the D_u partial route
+    # >= 4x on full-matrix proof production, and the device erasure
+    # decode + re-prove must beat the pure-Python oracle >= 2x.  Both
+    # ratios are shape-bound (the D_u route pays ~64 large MSMs the
+    # FK20 FFTs collapse; the oracle re-proves 128 cosets in python),
+    # so both rows are CPU-evaluable.
+    {"id": "das-producer-speedup",
+     "title": "FK20 proof producer vs the D_u MSM route",
+     "metric": r"das::producer_speedup",
+     "field": "value", "op": ">=", "target": 4.0, "tpu_only": False},
+    {"id": "das-recover-speedup",
+     "title": "device erasure recovery vs pure-Python oracle",
+     "metric": r"das::recover_speedup",
+     "field": "value", "op": ">=", "target": 2.0, "tpu_only": False},
     # fork choice (the device LMD-GHOST proto-array store): batched
     # latest-message folding + pointer-jumping head selection must
     # beat the phase0 spec oracle's get_head >= 2x — the oracle pays a
@@ -988,6 +1002,42 @@ def render_das(records) -> list[str]:
         lines.append(
             f"Latest throughput: {_si(latest['value'])} cells/s "
             f"({_where(latest)}, platform "
+            f"{_platform_group(latest)}).\n")
+    # the producer side: FK20 full-matrix proof production + erasure
+    # recovery (the super-node path)
+    pw = [r for r in recs if r["metric"] == "das::produce_wall"]
+    if pw:
+        latest = max(pw, key=_order_key)
+        blk = latest.get("das_producer") or {}
+        vs = latest.get("vs_baseline")
+        lines.append(
+            f"FK20 producer: {_fmt(latest.get('value'), 2)} s per blob "
+            f"(all 128 proofs"
+            + (f", {_fmt(vs, 1)}x vs the D_u MSM route" if vs is not None
+               else "")
+            + (", byte-parity OK" if blk.get("parity") else "")
+            + f") — {_where(latest)}, platform "
+            f"{_platform_group(latest)}.\n")
+    rw = [r for r in recs if r["metric"] == "das::recover_wall"]
+    if rw:
+        latest = max(rw, key=_order_key)
+        blk = latest.get("das_recover") or {}
+        vs = latest.get("vs_baseline")
+        lines.append(
+            f"Erasure recovery: {_fmt(latest.get('value'), 2)} s "
+            f"({blk.get('cells_in', '—')} surviving cells -> full "
+            f"reconstruction + re-prove"
+            + (f", {_fmt(vs, 1)}x vs the pure-Python oracle"
+               if vs is not None else "")
+            + (", roundtrip OK" if blk.get("roundtrip") else "")
+            + f") — {_where(latest)}, platform "
+            f"{_platform_group(latest)}.\n")
+    pps = [r for r in recs if r["metric"] == "das::proofs_per_s"]
+    if pps:
+        latest = max(pps, key=_order_key)
+        lines.append(
+            f"Latest producer throughput: {_si(latest['value'])} "
+            f"proofs/s ({_where(latest)}, platform "
             f"{_platform_group(latest)}).\n")
     return lines
 

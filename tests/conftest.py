@@ -127,8 +127,12 @@ def _verify_cell_batch_key(commitments_bytes, cell_indices, cells,
 
 
 def _recover_cells_key(cell_indices, cells):
+    # keyed on the BLS mode like the verify seam: the jax backend routes
+    # recovery through das/recover.py, so a device-route result must
+    # never alias an oracle-route memo entry (and vice versa)
     return (tuple(int(i) for i in cell_indices),
-            tuple(bytes(c) for c in cells))
+            tuple(bytes(c) for c in cells),
+            _bls_mode())
 
 
 _KZG_MEMO_FNS = (

@@ -509,6 +509,235 @@ def test_loadgen_schedule_carries_the_das_lane(monkeypatch):
     assert ex.sample in samples
 
 
+# --- G1-FFT kernel + FK20 producer: host-side contracts ----------------------
+# (the kernels themselves compile curve arithmetic, so every test that
+# actually dispatches one is @slow at the bottom of this file)
+
+
+def test_g1fft_domain_matches_ciphersuite():
+    from consensus_specs_tpu.ops.bls_batch import g1fft_jax as gf
+
+    for n in (8, 128):
+        assert gf.fft_domain(n) == das_cs.roots_of_unity(n)
+
+
+def test_g1fft_rung_ladder():
+    from consensus_specs_tpu.ops.bls_batch import g1fft_jax as gf
+
+    assert [gf.g1fft_rung(n) for n in (1, 3, 8, 9, 64, 128, 129,
+                                       300)] == \
+        [8, 8, 8, 128, 128, 128, 256, 512]
+
+
+def test_g1fft_stage_plan_is_log2_rounds_of_disjoint_pairs():
+    from consensus_specs_tpu.ops.bls_batch import g1fft_jax as gf
+
+    for n in (8, 128):
+        u, v, digs = gf._stage_plan(n, False)
+        # one shape-uniform row per butterfly round: log2(n) rounds,
+        # each pairing every position exactly once
+        assert u.shape == v.shape == (n.bit_length() - 1, n // 2)
+        assert digs.shape[:2] == (n.bit_length() - 1, n // 2)
+        for r in range(u.shape[0]):
+            touched = sorted(u[r].tolist() + v[r].tolist())
+            assert touched == list(range(n)), (n, r)
+
+
+def test_g1fft_limbs_roundtrip_and_infinity_padding():
+    from consensus_specs_tpu.ops.bls import curve as pycurve
+    from consensus_specs_tpu.ops.bls_batch import g1fft_jax as gf
+
+    pts = [pycurve.g1.mul(pycurve.G1_GEN, s) for s in (1, 2, 3)]
+    x, y, z = gf.points_to_limbs(pts, pad_to=8)
+    assert x.shape == (8, gf._fq.N_LIMBS)
+    back = gf.limbs_to_oracle_list((x, y, z))
+    for a, b in zip(back[:3], pts):
+        assert pycurve.g1.eq_points(a, b)
+    # padded lanes are the canonical infinity encoding (Z == 0)
+    for p in back[3:]:
+        assert pycurve.g1.to_affine(p) is None
+
+
+def test_fk20_producer_route_knob(monkeypatch):
+    # the host route never takes FK20; the device default does; the
+    # CST_DAS_PRODUCER=du pin forces the D_u baseline on device too
+    monkeypatch.delenv("CST_DAS_PRODUCER", raising=False)
+    assert das_compute._producer_route(False) == "du"
+    assert das_compute._producer_route(True) == "fk20"
+    monkeypatch.setenv("CST_DAS_PRODUCER", "du")
+    assert das_compute._producer_route(True) == "du"
+
+
+# --- erasure recovery (das/recover) ------------------------------------------
+
+
+def test_recover_vanishing_poly_and_batch_inverse():
+    from consensus_specs_tpu.das import recover as das_recover
+
+    P = das_recover.P
+    missing = [1, 7, 127]
+    short = das_recover._short_vanishing(missing)
+    assert len(short) == len(missing) + 1 and short[-1] == 1
+    roots128 = das_cs.roots_of_unity(128)
+    for k in range(128):
+        val = sum(c * pow(roots128[das_cs.reverse_bits(k, 128)], i, P)
+                  for i, c in enumerate(short)) % P
+        assert (val == 0) == (k in missing), k
+    # the stride-64 embedding: Z_ext(x) = Z_short(x^64)
+    ext = das_recover.construct_vanishing_poly(missing)
+    assert len(ext) == das_recover.M_EXT
+    assert [ext[i * 64] for i in range(len(short))] == short
+    assert all(v == 0 for i, v in enumerate(ext) if i % 64)
+    vals = [3, 5, 0xDEADBEEF, P - 2]
+    assert das_recover._batch_inverse(vals) == \
+        [pow(v, P - 2, P) for v in vals]
+
+
+def test_recover_rejects_malformed_like_oracle():
+    """Both routes enforce the spec oracle's argument contract: the
+    device facade asserts EAGERLY (before any dispatch), the host
+    oracle raises the same AssertionError."""
+    from consensus_specs_tpu.das import recover as das_recover
+
+    cell = b"\x00" * das_cs.BYTES_PER_CELL
+    bad_inputs = [
+        (list(range(63)), [cell] * 63),          # below half
+        ([0, 0] + list(range(2, 64)), [cell] * 64),   # duplicate index
+        ([128] + list(range(1, 64)), [cell] * 64),    # out of range
+        (list(range(64)), [cell] * 63),          # length mismatch
+        (list(range(64)), [cell] * 63 + [cell[:-1]]),  # short cell
+    ]
+    for idx, cls in bad_inputs:
+        with pytest.raises(AssertionError):
+            das_recover.recover_cells_and_kzg_proofs_async(
+                idx, cls, device=True)
+        with pytest.raises(AssertionError):
+            das_recover.recover_cells_and_kzg_proofs_host(idx, cls)
+
+
+def test_recover_route_knob(monkeypatch):
+    from consensus_specs_tpu.das import recover as das_recover
+
+    monkeypatch.delenv("CST_DAS_RECOVER_ROUTE", raising=False)
+    assert das_recover._recover_route(True) is True
+    assert das_recover._recover_route(False) is False
+    monkeypatch.setenv("CST_DAS_RECOVER_ROUTE", "host")
+    assert das_recover._recover_route(True) is False
+
+
+def test_serve_recover_lane_round_trips(monkeypatch):
+    """submit_recover_request end to end with the device facade
+    stubbed: the payload normalizes to (int indices, bytes cells) and
+    the settled (cells, proofs) pair rides back on the request's own
+    future."""
+    from consensus_specs_tpu.das import recover as das_recover
+    from consensus_specs_tpu.serve.executor import ServeExecutor
+    from consensus_specs_tpu.serve.futures import DeviceFuture
+
+    seen = {}
+
+    def stub(cell_indices, cells, device=None):
+        seen["args"] = (cell_indices, cells, device)
+        return DeviceFuture.settled((["cells"], ["proofs"]))
+
+    monkeypatch.setattr(das_recover,
+                        "recover_cells_and_kzg_proofs_async", stub)
+    cell = b"\x07" * das_cs.BYTES_PER_CELL
+    ex = ServeExecutor(max_batch=8, depth=1)
+    fut = ex.submit_recover_request(range(64), [bytearray(cell)] * 64)
+    ex.drain()
+    assert fut.result() == (["cells"], ["proofs"])
+    idx, cls, device = seen["args"]
+    assert idx == list(range(64)) and device is True
+    assert cls == [cell] * 64 and all(type(c) is bytes for c in cls)
+    assert ex.stats()["failed"] == 0
+
+
+def test_serve_recover_breaker_falls_back_to_host_oracle(monkeypatch):
+    """A recover dispatch failure walks the same recovery ladder as
+    every other kind: the breaker trips and the pure-host spec oracle
+    answers."""
+    from consensus_specs_tpu.das import recover as das_recover
+    from consensus_specs_tpu.resilience.policies import BreakerRegistry
+    from consensus_specs_tpu.serve.executor import ServeExecutor
+
+    calls = {"device": 0, "host": 0}
+
+    def exploding(cell_indices, cells, device=None):
+        calls["device"] += 1
+        raise RuntimeError("device sick")
+
+    def host_stub(cell_indices, cells):
+        calls["host"] += 1
+        return (["oracle-cells"], ["oracle-proofs"])
+
+    monkeypatch.setattr(das_recover,
+                        "recover_cells_and_kzg_proofs_async", exploding)
+    monkeypatch.setattr(das_recover,
+                        "recover_cells_and_kzg_proofs_host", host_stub)
+    cell = b"\x01" * das_cs.BYTES_PER_CELL
+    ex = ServeExecutor(max_batch=8, depth=1,
+                       breakers=BreakerRegistry(threshold=1))
+    f1 = ex.submit_recover_request(list(range(64)), [cell] * 64)
+    ex.drain()
+    assert f1.result() == (["oracle-cells"], ["oracle-proofs"])
+    assert ex.stats()["fallbacks"] >= 1
+    f2 = ex.submit_recover_request(list(range(64)), [cell] * 64)
+    ex.drain()
+    assert f2.result() == (["oracle-cells"], ["oracle-proofs"])
+    assert calls["device"] == 1      # breaker OPEN: no second try
+    assert calls["host"] == 2
+
+
+def test_loadgen_schedule_carries_the_recover_lane(monkeypatch):
+    from consensus_specs_tpu.serve import loadgen
+
+    class _StubEx:
+        def __init__(self):
+            self.kinds = []
+
+        def submit_verify_task(self, t):
+            self.kinds.append("verify")
+
+        def submit_pairing(self, p):
+            self.kinds.append("pairing")
+
+        def submit_barycentric(self, *a):
+            self.kinds.append("fr")
+
+        def submit_sha256_root(self, *a):
+            self.kinds.append("sha256")
+
+        def submit_proof_request(self, *a):
+            self.kinds.append("proof")
+
+        def submit_das_sample(self, s):
+            self.kinds.append("das")
+
+        def submit_recover_request(self, idx, cells):
+            self.kinds.append("recover")
+            self.recover_args = (idx, cells)
+
+    monkeypatch.setattr(loadgen, "RECOVER_PER_SLOT", 2)
+    monkeypatch.setattr(loadgen, "DAS_SAMPLES_PER_SLOT", 1)
+    # the recover entries sit at the END of the slot schedule: park the
+    # fork-choice lanes so one full cycle reaches them with a stub
+    monkeypatch.setattr(loadgen, "FC_ATTS_PER_SLOT", 0)
+    monkeypatch.setattr(loadgen, "HEAD_POLLS_PER_SLOT", 0)
+    monkeypatch.setattr(loadgen, "STATEMENTS_PER_SLOT", 77)
+    ex = _StubEx()
+    payloads = [([0, 1], ["c0"]), ([2, 3], ["c1"])]
+    submit, kinds = loadgen.make_submitter(
+        ex, ["task"], {"pairing": None, "fr": (1, 2, 3),
+                       "sha256": (None, 1), "proof": (None, [0]),
+                       "das": ["s0"], "recover": payloads})
+    for _ in range(77):
+        submit()
+    assert kinds["recover"] == 2
+    assert ex.kinds.count("recover") == 2
+    assert ex.recover_args in payloads
+
+
 # --- benchwatch wiring -------------------------------------------------------
 
 
@@ -599,6 +828,109 @@ def test_das_report_section_renders(tmp_path):
     assert "No das records" in empty
 
 
+def _das_producer_block(producer_speedup=30.0, recover_speedup=12.0):
+    return {
+        "produce_wall_s": 37.0,
+        "produce_first_s": 325.0,
+        "proofs_per_s": 3.5,
+        "du_wall_s": 37.0 * producer_speedup,
+        "du_msms_measured": 2,
+        "producer_speedup": producer_speedup,
+        "parity": True,
+        "recover": {
+            "cells_in": 64,
+            "missing": 64,
+            "wall_s": 34.0,
+            "oracle_wall_s": 34.0 * recover_speedup,
+            "oracle_cosets_measured": 1,
+            "speedup": recover_speedup,
+            "roundtrip": True,
+        },
+    }
+
+
+def test_das_producer_block_schema_validates():
+    from consensus_specs_tpu.telemetry import validate_das_producer_block
+
+    assert validate_das_producer_block(_das_producer_block()) == []
+    assert validate_das_producer_block("nope")
+    bad = _das_producer_block()
+    bad["parity"] = False
+    assert any("parity" in p
+               for p in validate_das_producer_block(bad))
+    bad = _das_producer_block()
+    bad["recover"]["roundtrip"] = False
+    assert any("roundtrip" in p
+               for p in validate_das_producer_block(bad))
+    bad = _das_producer_block()
+    bad["recover"]["cells_in"] = 63
+    assert any("cells_in" in p
+               for p in validate_das_producer_block(bad))
+    missing = _das_producer_block()
+    del missing["producer_speedup"]
+    assert any("producer_speedup" in p
+               for p in validate_das_producer_block(missing))
+
+
+def test_das_producer_history_records_and_thresholds(tmp_path):
+    from consensus_specs_tpu.telemetry import history, report
+
+    recs = history.das_producer_records(
+        "das_fk20_produce_wall", _das_producer_block(),
+        platform="cpu", ts=1000.0)
+    by_metric = {r["metric"]: r for r in recs}
+    assert set(by_metric) == {
+        "das::produce_wall", "das::producer_speedup",
+        "das::proofs_per_s", "das::recover_wall",
+        "das::recover_speedup"}
+    for r in recs:
+        assert history.validate_record(r) == [], r
+        assert r["source"] == "das"
+    assert by_metric["das::produce_wall"]["vs_baseline"] == 30.0
+    assert by_metric["das::produce_wall"]["das_producer"]["parity"] \
+        is True
+    assert by_metric["das::recover_wall"]["vs_baseline"] == 12.0
+    assert by_metric["das::recover_wall"]["das_recover"][
+        "cells_in"] == 64
+    # malformed blocks degrade to zero records, never raise
+    assert history.das_producer_records("m", {"recover": 1}) == []
+    assert history.das_producer_records("m", None) == []
+
+    hist = tmp_path / "h.jsonl"
+    history.append_records(hist, recs)
+    stored, skipped, _ = history.load_history(hist)
+    assert len(stored) == 5 and skipped == 0
+    rows = {t["id"]: t for t in report.evaluate_thresholds(stored)}
+    assert rows["das-producer-speedup"]["status"] == "PASS"
+    assert rows["das-recover-speedup"]["status"] == "PASS"
+    # sub-floor speedups FAIL the CPU-evaluated rows
+    slow_recs = history.das_producer_records(
+        "m", _das_producer_block(producer_speedup=3.0,
+                                 recover_speedup=1.5),
+        platform="cpu", ts=2000.0)
+    rows = {t["id"]: t
+            for t in report.evaluate_thresholds(stored + slow_recs)}
+    assert rows["das-producer-speedup"]["status"] == "FAIL"
+    assert rows["das-recover-speedup"]["status"] == "FAIL"
+
+
+def test_das_producer_report_section_renders():
+    from consensus_specs_tpu.telemetry import history, report
+
+    recs = history.das_producer_records(
+        "das_fk20_produce_wall", _das_producer_block(),
+        platform="cpu", ts=1000.0)
+    lines = "\n".join(report.render_das(recs))
+    assert "FK20 producer: 37 s per blob" in lines
+    assert "30x vs the D_u MSM route" in lines
+    assert "byte-parity OK" in lines
+    assert "Erasure recovery: 34 s" in lines
+    assert "64 surviving cells" in lines
+    assert "12x vs the pure-Python oracle" in lines
+    assert "roundtrip OK" in lines
+    assert "Latest producer throughput:" in lines
+
+
 # --- @slow: device-route end to end ------------------------------------------
 
 
@@ -686,3 +1018,119 @@ def test_serve_das_lane_device_end_to_end(matrix):
     ex.drain()
     assert fut.result() is True
     assert ex.stats()["failed"] == 0
+
+
+# --- @slow: G1-FFT kernel + FK20 + recovery ----------------------------------
+
+
+def _closed_form_blob_and_truth(c2=90001, c1=80001, c0=70001):
+    """(blob bytes, true cells, true proofs) for the degree-65 closed
+    form f = c2*X^65 + c1*X^64 + c0 — the one blob family whose full
+    proof set is known WITHOUT running any producer (see
+    `closed_form_row`), and low-degree enough that the pure-Python
+    oracle stays tractable (its MSM skips the ~4030 zero scalars)."""
+    m = das_cs.FIELD_ELEMENTS_PER_BLOB
+    p = das_cs.BLS_MODULUS
+    roots = das_cs.roots_of_unity(m)
+    evals = [(c2 * pow(roots[das_cs.reverse_bits(i, m)], 65, p)
+              + c1 * pow(roots[das_cs.reverse_bits(i, m)], 64, p)
+              + c0) % p for i in range(m)]
+    blob = das_cs._encode_evals(evals)
+    _, per_cell = das_cs.closed_form_row(c2, c1, c0, range(128))
+    return (blob, [per_cell[k][0] for k in range(128)],
+            [per_cell[k][1] for k in range(128)])
+
+
+@pytest.mark.slow
+def test_g1fft_matches_naive_and_shares_rung_compiles():
+    """The batched G1 FFT against per-point naive evaluation on the
+    bottom rung, the ifft(fft(x)) == x round-trip, rung-ladder compile
+    sharing (3 live points and 5 live points ride the SAME n=8
+    executable), and the butterfly-round telemetry (log2(rung) per
+    dispatch)."""
+    from consensus_specs_tpu import telemetry
+    from consensus_specs_tpu.ops.bls import curve as pycurve
+    from consensus_specs_tpu.ops.bls_batch import g1fft_jax as gf
+
+    p = das_cs.BLS_MODULUS
+    dom = gf.fft_domain(8)
+    pts = [pycurve.g1.mul(pycurve.G1_GEN, s) for s in (5, 9, 11)]
+    padded = pts + [pycurve.g1.infinity()] * 5
+
+    was_enabled = telemetry.enabled()
+    telemetry.configure(enabled=True)
+    try:
+        telemetry.reset()
+        before = gf._g1_fft_kernel.cache_info()
+        out = gf.g1_fft(pts)
+        assert telemetry.counter_value("g1fft.butterfly_rounds") == 3
+        for i in range(8):
+            want = None
+            for j, pt in enumerate(padded):
+                t = pycurve.g1.mul(pt, dom[(i * j) % 8])
+                want = t if want is None else pycurve.g1.add(want, t)
+            assert pycurve.g1.eq_points(out[i], want), i
+        # the inverse transform recovers the padded input exactly
+        back = gf.g1_fft(out, inverse=True)
+        for a, b in zip(back, padded):
+            assert pycurve.g1.eq_points(a, b)
+        # a 5-point vector pads to the same rung: no new compile
+        mid = gf._g1_fft_kernel.cache_info()
+        out5 = gf.g1_fft(pts + [pycurve.g1.mul(pycurve.G1_GEN, 13),
+                                pycurve.g1.infinity()])
+        after = gf._g1_fft_kernel.cache_info()
+        assert after.misses == mid.misses
+        assert after.hits > mid.hits
+        assert mid.misses > before.misses  # the first call DID compile
+        assert len(out5) == 8
+    finally:
+        telemetry.configure(enabled=was_enabled)
+    # domain pinned to the spec derivation (w = 7^((r-1)/n))
+    assert pow(dom[1], 8, p) == 1 and pow(dom[1], 4, p) != 1
+
+
+@pytest.mark.slow
+def test_fk20_proofs_match_du_route_and_oracle():
+    """The FK20 device producer vs the D_u-partial host route vs the
+    spec oracle's multiproof, all on one closed-form blob whose true
+    proof set is known in closed form."""
+    blob, true_cells, true_proofs = _closed_form_blob_and_truth()
+    fk_cells, fk_proofs = das_compute.compute_cells_and_kzg_proofs(
+        blob, device=True, route="fk20")
+    assert fk_cells == true_cells
+    assert fk_proofs == true_proofs
+    # the D_u route (host MSMs — the oracle msm skips the ~4030 zero
+    # scalars, so the low-degree blob keeps this tractable)
+    du_cells, du_proofs = das_compute.compute_cells_and_kzg_proofs(
+        blob, device=False)
+    assert du_cells == fk_cells
+    assert du_proofs == fk_proofs
+    # the spec oracle's own multiproof on a sample of cosets
+    fulu = build_spec("fulu", "mainnet")
+    coeff = fulu.polynomial_eval_to_coeff(
+        fulu.blob_to_polynomial(fulu.Blob(blob)))
+    for k in (0, 65, 127):
+        want, _ = fulu.compute_kzg_proof_multi_impl(
+            coeff, fulu.coset_for_cell(fulu.CellIndex(k)))
+        assert fk_proofs[k] == bytes(want), k
+
+
+@pytest.mark.slow
+def test_recover_device_matches_truth_and_host_oracle():
+    """Erasure recovery end to end on an exactly-half survival set:
+    the device decode + FK20 re-prove byte-equals both the closed-form
+    ground truth and the pure-Python spec oracle run on the SAME
+    surviving cells."""
+    from consensus_specs_tpu.das import recover as das_recover
+
+    _, true_cells, true_proofs = _closed_form_blob_and_truth()
+    keep = list(range(0, 128, 2))
+    kept = [true_cells[k] for k in keep]
+    dev_cells, dev_proofs = das_recover.recover_cells_and_kzg_proofs(
+        keep, kept, device=True)
+    assert dev_cells == true_cells
+    assert dev_proofs == true_proofs
+    o_cells, o_proofs = das_recover.recover_cells_and_kzg_proofs_host(
+        keep, kept)
+    assert o_cells == dev_cells
+    assert o_proofs == dev_proofs
