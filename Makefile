@@ -100,11 +100,16 @@ serve:
 # the measured rate is the host's capacity, the JSON contract, the
 # serve::* history records, and (CST_TRACE_REQUESTS=1) the per-request
 # latency_attribution block + worst-N exemplar artifact are what CI
-# checks
+# checks.  CST_METRICS_PORT + CST_SLO_RULES arm the live exposition
+# endpoint (self-scraped mid-round into out/metrics_scrape.txt) and
+# the SLO watchdog (evidence -> out/slo_breaches.json, slo::* records
+# for the slo-clean-round report row); the generous thresholds mean a
+# healthy round ends clean — breaches here are real findings
 serve-smoke:
 	@$(CPU_ENV) CST_SERVE_DURATION_S=12 CST_SERVE_RATE=0 CST_SERVE_POOL=4 \
 		CST_SERVE_COMMITTEE=4 CST_SERVE_MAX_BATCH=8 CST_SERVE_WINDOWS=3 \
-		CST_TRACE_REQUESTS=1 \
+		CST_TRACE_REQUESTS=1 CST_METRICS_PORT=9464 \
+		CST_SLO_RULES='serve.p99_ms<100000:name=p99-sane; serve.queue_depth<100000:name=queue-sane' \
 		$(PYTHON) bench_serve.py
 
 # no TPU required: the chaos round — bench_serve under CST_SERVE_CHAOS=1

@@ -46,6 +46,16 @@ exemplar traces are written to `out/serve_exemplars.json` (the CI
 artifact).  `CST_SERVE_STATUS_EVERY=<s>` additionally dumps the
 executor's live `status()` JSON on stderr while the round runs.
 
+Monitoring: `CST_METRICS_PORT=<port>` serves live Prometheus text
+exposition while the round runs (the loadgen self-scrapes it mid-round
+into `out/metrics_scrape.txt`), and `CST_SLO_RULES=...` arms the live
+SLO watchdog — the serve block gains the `"slo"` evidence sub-object
+(schema `validate_slo_block`, mined into `slo::*` records for the
+`slo-clean-round` threshold row) and the breach evidence is written to
+`out/slo_breaches.json` (`out/chaos_slo_breaches.json` on chaos
+rounds, where the deterministic breach→clear arc is asserted and gated
+by `chaos-slo-arc`).  See README "Monitoring".
+
 Knobs are the CST_SERVE_* family (README "Serving"); the CPU smoke runs
 closed-loop (`CST_SERVE_RATE=0`) so the measured rate is the host's
 capacity instead of an idle fixed-rate clock.  With CST_TELEMETRY=1 the
@@ -163,6 +173,19 @@ def main() -> int:
         log(f"serve bench: tail attribution — p99 queue frac "
             f"{la.get('p99_queue_frac')}, worst exemplars -> "
             f"{exemplars}")
+    slo = block.get("slo")
+    if slo is not None:
+        # the watchdog's breach evidence as a standalone artifact (CI
+        # uploads it next to the exemplars): the per-rule summary plus
+        # the bounded breach→clear event log with exemplar payloads
+        slo_out = Path(__file__).resolve().parent / "out" / \
+            ("chaos_slo_breaches.json" if chaos else "slo_breaches.json")
+        slo_out.parent.mkdir(exist_ok=True)
+        slo_out.write_text(json.dumps(
+            {"metric": "serve_sustained_load", "slo": slo}, indent=1)
+            + "\n")
+        log(f"serve bench: SLO watchdog — {slo['breaches']} breach(es) "
+            f"over {slo['ticks']} tick(s), evidence -> {slo_out}")
     rc = 0
     if not block["steady"]:
         # the exit-code contract: an unconverged run must not pass for

@@ -81,6 +81,7 @@ from __future__ import annotations
 
 import json
 import os
+import socket
 import subprocess
 import sys
 import time
@@ -363,18 +364,36 @@ def main():
     exemplar_file = HERE / "out" / "serve_exemplars.json"
     if exemplar_file.exists():
         exemplar_file.unlink()
+    scrape_file = HERE / "out" / "metrics_scrape.txt"
+    if scrape_file.exists():
+        scrape_file.unlink()
+    slo_file = HERE / "out" / "slo_breaches.json"
+    if slo_file.exists():
+        slo_file.unlink()
+    # an ephemeral port for the live exposition endpoint (bind/release:
+    # CI runners share the host, a fixed port would collide)
+    with socket.socket() as sock:
+        sock.bind(("127.0.0.1", 0))
+        metrics_port = sock.getsockname()[1]
     serve_t0 = time.time()
     # CST_TRACE_REQUESTS=1: the round runs with request tracing armed —
     # per-request percentile semantics, the latency_attribution block,
     # flow events in the trace, latency::* records, and the exemplar
     # artifact are all asserted below (the acceptance arc of the
-    # request-tracing PR)
+    # request-tracing PR).  CST_METRICS_PORT + CST_SLO_RULES arm the
+    # live-monitoring arc: the loadgen self-scrapes the exposition
+    # endpoint mid-round (validated line-by-line below) and the SLO
+    # watchdog runs sane-bound rules the round must end CLEAN on
     out = _run(["bench_serve.py"],
                {"CST_SERVE_DURATION_S": "12", "CST_SERVE_RATE": "0",
                 "CST_SERVE_POOL": "4", "CST_SERVE_COMMITTEE": "4",
                 "CST_SERVE_MAX_BATCH": "8", "CST_SERVE_WINDOWS": "3",
                 "CST_TELEMETRY": "1", "CST_TRACE_REQUESTS": "1",
                 "CST_TRACE_FILE": str(serve_trace),
+                "CST_METRICS_PORT": str(metrics_port),
+                "CST_SLO_RULES": ("serve.p99_ms<100000:name=p99-sane; "
+                                  "serve.queue_depth<100000"
+                                  ":name=queue-sane"),
                 "CST_BENCHWATCH_HISTORY": str(hist_file)},
                timeout=900)
     serve_lines = [o for o in out if o.get("metric") == "serve_sustained_load"]
@@ -420,6 +439,45 @@ def main():
     # the worst-N exemplar artifact bench_serve writes for CI upload
     exemplars = json.loads(exemplar_file.read_text())
     assert exemplars["worst"] == la["worst"], exemplar_file
+
+    # live-monitoring arc, scrape side: the loadgen self-scraped the
+    # CST_METRICS_PORT endpoint mid-round and wrote the exposition text
+    # verbatim — re-parse it LINE BY LINE with the strict parser and
+    # assert every served kind appears as a labeled lifetime series
+    from consensus_specs_tpu.telemetry import metrics_export
+    assert scrape_file.exists(), \
+        "loadgen never wrote the mid-round scrape artifact"
+    scrape = metrics_export.parse_exposition(scrape_file.read_text())
+    scraped_kinds = {lb["kind"] for lb, _ in
+                     scrape.get("cst_serve_requests_total", [])}
+    assert served_kinds <= scraped_kinds, (sorted(served_kinds),
+                                           sorted(scraped_kinds))
+    assert scrape.get("cst_serve_live_queue_depth"), sorted(scrape)
+    # the watchdog publishes its own rule-labeled families
+    slo_rules_scraped = {lb.get("rule") for lb, _ in
+                         scrape.get("cst_slo_breaching", [])}
+    assert slo_rules_scraped == {"p99-sane", "queue-sane"}, \
+        slo_rules_scraped
+    assert scrape.get("cst_slo_ticks_total", [({}, 0.0)])[0][1] > 0, \
+        scrape.get("cst_slo_ticks_total")
+    print(f"metrics scrape OK: {len(scrape)} families, kinds "
+          f"{sorted(scraped_kinds)} -> {scrape_file}")
+
+    # live-monitoring arc, watchdog side: a healthy round ends CLEAN —
+    # zero breaches over a positive tick count, schema-valid, and the
+    # breach-evidence artifact rides along for CI upload
+    from consensus_specs_tpu.telemetry import validate_slo_block
+    slo = block.get("slo")
+    assert slo is not None, "CST_SLO_RULES armed but no slo block"
+    assert not validate_slo_block(slo), validate_slo_block(slo)
+    assert slo["ticks"] > 0, slo
+    assert slo["breaches"] == 0 and slo["clean"], slo
+    assert {r["name"] for r in slo["rules"]} == {"p99-sane",
+                                                 "queue-sane"}, slo
+    assert json.loads(slo_file.read_text())["slo"]["clean"], slo_file
+    print(f"slo watchdog OK: clean round, {slo['ticks']} tick(s), "
+          f"evidence -> {slo_file}")
+
     print("bench_serve.py JSON OK:", json.dumps(
         {k: v for k, v in sl.items() if k not in ("telemetry", "serve")}),
         f"({block['verifies_per_s']} verifies/s, steady over "
@@ -458,6 +516,15 @@ def main():
     assert qrec is not None and qrec["source"] == "latency", \
         sorted(by_metric)
     assert qrec["latency"]["worst"], qrec
+    # the slo record kinds land too: zero breaches carrying the compact
+    # block, and the clean-round 0/1 the threshold row gates
+    brec = by_metric.get("slo::breaches")
+    assert brec is not None and brec["source"] == "slo", sorted(by_metric)
+    assert not benchwatch.validate_record(brec), brec
+    assert brec["value"] == 0 and brec["slo"]["ticks"] > 0, brec
+    crec = by_metric.get("slo::clean_round")
+    assert crec is not None and crec["value"] == 1.0, crec
+    assert not benchwatch.validate_record(crec), crec
     print(f"serve history OK: {len(fresh)} records this run "
           f"(incl. {sum(1 for m in by_metric if m.startswith('latency::'))} "
           f"latency:: records)")
@@ -509,8 +576,14 @@ def main():
     rows = {t["id"]: t for t in result["thresholds"]}
     assert rows["serve-p99-queue-frac"]["status"] == "no data", \
         rows["serve-p99-queue-frac"]
+    # the watchdog section renders and the clean-round row gates green
+    # on this zero-breach round
+    assert "## SLO (live watchdog)" in text, text[:2000]
+    assert rows["slo-clean-round"]["status"] == "PASS", \
+        rows["slo-clean-round"]
     print(f"tail-latency report OK: section rendered, TPU-gated "
-          f"queue-frac row reads 'no data' on CPU -> {serve_report}")
+          f"queue-frac row reads 'no data' on CPU, slo-clean-round "
+          f"PASS -> {serve_report}")
 
     # telemetry-OFF contract: the default path (what a non-telemetry
     # TPU round runs) must emit the plain 2-metric lines — no
@@ -545,6 +618,9 @@ def chaos_main(mesh: bool = False):
     hist_file.parent.mkdir(exist_ok=True)
     if not hist_env and hist_file.exists():
         hist_file.unlink()
+    chaos_slo_file = HERE / "out" / "chaos_slo_breaches.json"
+    if chaos_slo_file.exists():
+        chaos_slo_file.unlink()
     chaos_t0 = time.time()
     # the canned plan: deterministic dispatch failures into the RLC
     # verify kernel (the acceptance shape — resilience.chaos's default,
@@ -646,6 +722,28 @@ def chaos_main(mesh: bool = False):
     assert fv["outcomes"].get("poisoned", 0) == 0, fv
     print("fault victims OK:", json.dumps(fv["outcomes"]),
           f"({fv['count']} victim(s))")
+    # the SLO watchdog's deterministic chaos arc: the injected-fault
+    # counter rule breached while the plan was live and the breach
+    # CLEARED after recovery — the transition proven in both directions
+    from consensus_specs_tpu.telemetry import validate_slo_block
+    slo = serve.get("slo")
+    assert slo is not None, "chaos round must arm the SLO watchdog"
+    assert not validate_slo_block(slo), validate_slo_block(slo)
+    assert slo["ticks"] > 0, slo
+    assert slo["breaches"] >= 1 and not slo["clean"], slo
+    assert any(r["name"] == "chaos-fault-injections"
+               for r in slo["rules"]), slo["rules"]
+    arc = res["slo_arc"]
+    assert arc["rule"] == "chaos-fault-injections", arc
+    assert arc["breached_in_fault_window"], arc
+    assert arc["cleared_after_recovery"], arc
+    # the breach evidence artifact landed (the CI upload)
+    assert chaos_slo_file.exists(), chaos_slo_file
+    slo_art = json.loads(chaos_slo_file.read_text())["slo"]
+    assert slo_art["breaches"] >= 1, slo_art
+    print(f"slo chaos arc OK: {slo['breaches']} breach(es) over "
+          f"{slo['ticks']} tick(s), breach->clear both ways, "
+          f"evidence -> {chaos_slo_file}")
     if mesh:
         mb = res["mesh"]
         assert "skipped" not in mb, mb
@@ -716,6 +814,15 @@ def chaos_main(mesh: bool = False):
     frec = fresh.get("resilience::flagship_degraded_steps")
     assert frec is not None and frec["value"] >= 2, frec
     assert frec["flagship"]["wrong_results"] == 0, frec
+    # the SLO arc record the chaos-slo-arc row gates on, plus the
+    # per-rule breach count; a breaching round must NOT mint the
+    # clean-round record (that gate is for quiet rounds only)
+    arec = fresh.get("resilience::slo_arc_ok")
+    assert arec is not None and arec["value"] == 1.0, arec
+    assert not benchwatch.validate_record(arec), arec
+    srec = fresh.get("slo::breaches@chaos-fault-injections")
+    assert srec is not None and srec["value"] >= 1, sorted(fresh)
+    assert "slo::clean_round" not in fresh, fresh["slo::clean_round"]
     if mesh:
         for name in ("mesh::recovery_latency_s", "mesh::recovered",
                      "mesh::lost_statements",
@@ -760,6 +867,8 @@ def chaos_main(mesh: bool = False):
         rows["chaos-correctness"]
     assert rows["checkpoint-restore"]["status"] == "PASS", \
         rows["checkpoint-restore"]
+    assert rows["chaos-slo-arc"]["status"] == "PASS", rows["chaos-slo-arc"]
+    assert "## SLO (live watchdog)" in text, text[:2000]
     assert "Latest checkpoint restore:" in text
     if mesh:
         for row_id in ("mesh-recovered", "mesh-recovery",

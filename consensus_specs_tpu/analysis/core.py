@@ -91,6 +91,12 @@ RULE_IDS = {
         "reqtrace.RequestContext — requests entering through it would "
         "be invisible to tail-latency attribution (see README Request "
         "tracing)",
+    "metric-name-invalid":
+        "telemetry.count/observe/gauge/span name outside the dotted-"
+        "name convention, or two distinct names that collide into the "
+        "same exposition family after Prometheus sanitization — the "
+        "metrics endpoint would silently rewrite or merge their series "
+        "(see README Monitoring)",
 }
 
 # --- file roles (which rule families run where) ------------------------------
@@ -104,8 +110,12 @@ ROLE_EXC = "exc"         # exception-swallow discipline (serve +
                          # ROLE_DEVICE)
 ROLE_SERVE = "serve"     # request-tracing coverage of serve submit_*
                          # entry points (reqtrace-uncovered-submit)
+ROLE_METRIC = "metric"   # metric-name discipline at every telemetry
+                         # call site (metric-name-invalid) — runs over
+                         # the whole package, since counters/spans are
+                         # minted everywhere the device path runs
 ALL_ROLES = frozenset((ROLE_DEVICE, ROLE_KERNEL, ROLE_LIMB, ROLE_INSTR,
-                       ROLE_EXC, ROLE_SERVE))
+                       ROLE_EXC, ROLE_SERVE, ROLE_METRIC))
 
 # the device path named by the north star: every module that builds or
 # dispatches XLA programs (oracle siblings under ops/bls are scanned too;
@@ -160,6 +170,11 @@ INSTR_FILES = ("ops/bls_batch/__init__.py", "ops/bls/__init__.py",
                "resilience/mesh.py", "resilience/checkpoint.py",
                "das/verify.py", "das/recover.py",
                "forkchoice/store.py", "forkchoice/kernels.py")
+
+# metric-name discipline runs over EVERY package module: instrument
+# calls are minted from ops, serve, resilience, telemetry itself — a
+# bad name or a sanitization collision can land anywhere
+METRIC_GLOBS = ("*.py", "*/*.py", "*/*/*.py")
 
 # request-tracing coverage surface: every `submit_*` entry point of a
 # serve executor class must mint a reqtrace.RequestContext (directly or
@@ -697,7 +712,8 @@ def analyze_source(src: str, path: str = "<snippet>",
     suppression-resolved report; `external_covered`/`external_device`/
     `external_cost` feed the instrumentation rules' cross-module
     resolution."""
-    from . import dtype, excswallow, hostsync, instrumentation, recompile
+    from . import (dtype, excswallow, hostsync, instrumentation,
+                   metricnames, recompile)
 
     model = ModuleModel(src, path, roles)
     findings: list[Finding] = []
@@ -713,6 +729,8 @@ def analyze_source(src: str, path: str = "<snippet>",
             model, external_covered, external_device, external_cost)[0]
     if ROLE_SERVE in roles:
         findings += instrumentation.check_reqtrace(model)
+    if ROLE_METRIC in roles:
+        findings += metricnames.check(model)
     return _apply_suppressions(model, findings)
 
 
@@ -740,6 +758,9 @@ def _tree_files(root: Path) -> list[tuple[Path, frozenset]]:
         p = root / rel
         if p.exists():
             files.setdefault(p, set()).add(ROLE_SERVE)
+    for pattern in METRIC_GLOBS:
+        for p in sorted(root.glob(pattern)):
+            files.setdefault(p, set()).add(ROLE_METRIC)
     return [(p, frozenset(r)) for p, r in sorted(files.items())]
 
 

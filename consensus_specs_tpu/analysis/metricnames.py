@@ -1,0 +1,167 @@
+"""Rule family 7 — metric-name discipline (metric-name-invalid).
+
+The metrics exposition endpoint (`telemetry/metrics_export.py`) renders
+every registry name into the Prometheus exposition format by
+sanitizing it (`sanitize_name`: anything outside ``[a-zA-Z0-9_:]``
+becomes ``_``).  Sanitization never *fails* — it silently rewrites —
+so two hazards stay invisible until a scrape looks wrong:
+
+- a name outside the repo's dotted-name convention
+  (``seg.seg2.seg3``, segments of ``[a-zA-Z0-9_]``, leading segment
+  not starting with a digit) leaks a surprising exposition stem
+  (``cst_foo__bar_total`` from ``foo-.bar``);
+- two *different* registry names can sanitize to the SAME exposition
+  family (``serve.queue_depth`` vs ``serve.queue.depth`` both become
+  ``cst_serve_queue_depth``) and their series silently merge.
+
+This rule makes both a lint invariant at every telemetry call site:
+the literal first argument of ``telemetry.count / observe / gauge /
+span / add_event`` (or ``core.*`` inside the telemetry package) must
+match the dotted-name convention, and no two distinct literal names in
+a module may collide after sanitization within the same instrument
+family (counters, histograms, gauges, spans).
+
+Names built with f-strings (``f"kernel.{kernel}.calls"``) are checked
+on their LITERAL fragments only — the runtime segments are the point
+of the f-string — and are exempt from the collision check (their final
+spelling is not known statically).  Exposition *label* names come from
+keyword arguments (`add_event(name, dur, kind=...)`) and reqtrace
+context fields, which are Python identifiers and therefore always
+inside the Prometheus label charset; they need no rule.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from .core import Finding, ModuleModel
+
+# instrument API name -> exposition family (collisions only matter
+# within a family: counters get a `_total` stem, spans `_seconds_*`,
+# histogram summaries their own suffixes, gauges the bare stem)
+_API = {
+    "count": "counter",
+    "observe": "histogram",
+    "gauge": "gauge",
+    "span": "span",
+    "add_event": "span",
+}
+
+# the repo's dotted-name convention: dot-separated segments of the
+# metric charset, first character a letter or underscore
+_NAME_RE = re.compile(r"[a-zA-Z_][a-zA-Z0-9_]*(\.[a-zA-Z0-9_]+)*\Z")
+# literal fragments of an f-string name: any run of in-charset
+# characters (the runtime segments supply the rest)
+_FRAG_RE = re.compile(r"[a-zA-Z0-9_.]*\Z")
+
+_SANITIZE_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _sanitize(name: str) -> str:
+    """Mirror of `metrics_export.sanitize_name` — duplicated here so
+    the analyzer stays importable without the telemetry package (and
+    pure-stdlib, like every other rule)."""
+    out = _SANITIZE_RE.sub("_", name)
+    if out and out[0].isdigit():
+        out = "_" + out
+    return out
+
+
+def _telemetry_aliases(model: ModuleModel) -> tuple[set[str], dict[str, str]]:
+    """(module aliases whose attributes are the instrument API,
+    bare-imported instrument names -> API name)."""
+    aliases: set[str] = set()
+    bare: dict[str, str] = {}
+    for node in ast.walk(model.tree):
+        if isinstance(node, ast.ImportFrom):
+            mod = (node.module or "").split(".")[-1]
+            for a in node.names:
+                if a.name == "telemetry":
+                    aliases.add(a.asname or a.name)
+                elif mod == "telemetry" and a.name == "core":
+                    aliases.add(a.asname or a.name)
+                elif node.module is None and node.level and a.name == "core":
+                    # `from . import core` — the telemetry package's own
+                    # modules; other packages' `core` has no instrument
+                    # API, so a false alias can only match a call like
+                    # core.count(...) that does not exist there
+                    aliases.add(a.asname or a.name)
+                elif mod in ("telemetry", "core") and a.name in _API:
+                    bare[a.asname or a.name] = a.name
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name.split(".")[-1] == "telemetry":
+                    aliases.add(a.asname or a.name.split(".")[0])
+    return aliases, bare
+
+
+def _instrument_calls(model: ModuleModel, aliases: set[str],
+                      bare: dict[str, str]):
+    """Yield (call_node, api_name) for every instrument call site."""
+    for node in ast.walk(model.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if (isinstance(f, ast.Attribute) and f.attr in _API
+                and isinstance(f.value, ast.Name)
+                and f.value.id in aliases):
+            yield node, f.attr
+        elif isinstance(f, ast.Name) and f.id in bare:
+            yield node, bare[f.id]
+
+
+def check(model: ModuleModel) -> list:
+    findings: list[Finding] = []
+    # (family, sanitized stem) -> (first literal spelling, lineno)
+    seen: dict[tuple[str, str], tuple[str, int]] = {}
+    aliases, bare = _telemetry_aliases(model)
+
+    for call, api in _instrument_calls(model, aliases, bare):
+        if not call.args:
+            continue
+        arg = call.args[0]
+        family = _API[api]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            name = arg.value
+            if not _NAME_RE.match(name):
+                findings.append(Finding(
+                    model.path, arg.lineno, "metric-name-invalid",
+                    f"telemetry.{api}() name {name!r} is outside the "
+                    f"dotted-name convention "
+                    f"([a-zA-Z_][a-zA-Z0-9_]*(.seg)*) — sanitization "
+                    f"would silently rewrite its exposition stem (see "
+                    f"README Monitoring)"))
+                continue
+            key = (family, _sanitize(name))
+            prev = seen.get(key)
+            if prev is None:
+                seen[key] = (name, arg.lineno)
+            elif prev[0] != name:
+                findings.append(Finding(
+                    model.path, arg.lineno, "metric-name-invalid",
+                    f"telemetry.{api}() name {name!r} collides with "
+                    f"{prev[0]!r} (line {prev[1]}) after exposition "
+                    f"sanitization — both render as the "
+                    f"'cst_{_sanitize(name)}' {family} family and "
+                    f"their series would silently merge"))
+        elif isinstance(arg, ast.JoinedStr):
+            for i, part in enumerate(arg.values):
+                if not (isinstance(part, ast.Constant)
+                        and isinstance(part.value, str)):
+                    continue
+                frag = part.value
+                ok = bool(_FRAG_RE.match(frag))
+                if ok and i == 0 and frag and (frag[0].isdigit()
+                                               or frag[0] == "."):
+                    ok = False
+                if not ok:
+                    findings.append(Finding(
+                        model.path, arg.lineno, "metric-name-invalid",
+                        f"telemetry.{api}() f-string name has literal "
+                        f"fragment {frag!r} outside the dotted-name "
+                        f"charset [a-zA-Z0-9_.] — sanitization would "
+                        f"silently rewrite its exposition stem (see "
+                        f"README Monitoring)"))
+                    break
+    return findings

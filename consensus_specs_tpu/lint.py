@@ -317,6 +317,8 @@ def lint_env_knobs(repo=None) -> list[str]:
     "Benchwatch" section, serving knobs (`CST_SERVE_*`) in the
     "Serving" section, incremental-merkleization knobs
     (`CST_MERKLE_*`) in the "Incremental merkleization" section,
+    monitoring knobs (`CST_METRICS_*`, `CST_SLO_*`,
+    `CST_PROFILE_ON_BREACH`) in the "Monitoring" section,
     fault-plan knobs (`CST_FAULTS*`) in the "Resilience" section,
     checkpoint knobs (`CST_CHECKPOINT_*`) in the "Mesh resilience &
     checkpointing" section, mesh-sharding knobs (`CST_SHARD_*`) in
@@ -340,6 +342,12 @@ def lint_env_knobs(repo=None) -> list[str]:
                           ("CST_SERVE_", "Serving", section("Serving")),
                           ("CST_MERKLE_", "Incremental merkleization",
                            section("Incremental merkleization")),
+                          ("CST_METRICS_", "Monitoring",
+                           section("Monitoring")),
+                          ("CST_SLO_", "Monitoring",
+                           section("Monitoring")),
+                          ("CST_PROFILE_ON_BREACH", "Monitoring",
+                           section("Monitoring")),
                           ("CST_FAULTS", "Resilience",
                            section("Resilience")),
                           ("CST_CHECKPOINT_",

@@ -31,6 +31,14 @@ with the trace ids it hit and their final outcomes — pinning the blast
 radius to exactly the retried/fallback-answered/poisoned handles (a
 fault victim can never settle with a clean `ok`).
 
+The SLO watchdog (`telemetry.monitor`) is armed for the whole round on
+a deterministic per-window tick: the canned `CHAOS_SLO_RULE` watches
+the fired-fault count (plus any `CST_SLO_RULES` the operator set), and
+the round ASSERTS the arc both ways — the rule breaches inside the
+fault window and clears after recovery — so every chaos run regression-
+tests the watchdog itself.  The evidence rides the block as the `"slo"`
+sub-object plus `resilience["slo_arc"]`.
+
 Deterministic closing segments (each oracle-checked, each feeding its
 own sub-block of the `"resilience"` object):
 
@@ -71,7 +79,7 @@ from __future__ import annotations
 import time
 
 from .. import telemetry
-from ..telemetry import reqtrace
+from ..telemetry import metrics_export, monitor, reqtrace
 from . import faults, healing
 from .policies import BreakerRegistry, RetryPolicy
 
@@ -80,6 +88,15 @@ from .policies import BreakerRegistry, RetryPolicy
 # breaker through retry, exercise the oracle-fallback degraded mode,
 # fail at least one half-open probe, and then let the device recover
 DEFAULT_CHAOS_SPEC = "seed=1234;dispatch:raise:key=rlc_*:count=4"
+
+# the chaos round's canned SLO rule: the fired-fault count is the one
+# signal that is 1:1 with the plan being live (whatever traffic shape
+# the round measured), so the watchdog arc — breach INSIDE the fault
+# window, clear after recovery — is deterministic.  The round asserts
+# both directions, which regression-tests the watchdog itself.
+CHAOS_SLO_RULE = {"metric": "counter.faults.injected", "op": "<=",
+                  "threshold": 0.0, "for": 1, "clear": 2,
+                  "name": "chaos-fault-injections"}
 
 # chaos-round policy shape: trip fast, probe fast — the smoke must see
 # the full open→half-open→closed arc inside a handful of windows
@@ -434,6 +451,43 @@ def _mesh_segment() -> dict:
     return block
 
 
+def _chaos_slo_rules(window_s: float) -> dict:
+    """The chaos watchdog's rule set: the canned injection-rate rule
+    (rate window spanning a few load windows, so the breach clears
+    within the recovery phase) plus any `CST_SLO_RULES` the operator
+    armed — those are evaluated on the same deterministic ticks.  A
+    malformed env set is skipped with the counted warning
+    (`install_from_env`'s contract), never killing the round; an env
+    rule whose name collides with an already-merged one is dropped."""
+    import os
+    import sys
+
+    rule = dict(CHAOS_SLO_RULE)
+    rule["window_s"] = max(3.0 * window_s, 0.5)
+    rules = {"rules": [rule]}
+    source = os.environ.get("CST_SLO_RULES")
+    if source:
+        try:
+            extra = monitor.load_rules(source)
+        except ValueError as exc:       # json.JSONDecodeError included
+            telemetry.count("slo.rules_invalid")
+            print(f"slo: ignoring invalid CST_SLO_RULES: {exc}",
+                  file=sys.stderr)
+            return rules
+        seen = {r.get("name") or monitor._default_name(r["metric"],
+                                                       r.get("kind"))
+                for r in rules["rules"]}
+        for r in extra["rules"]:
+            name = r.get("name") or monitor._default_name(r["metric"],
+                                                          r.get("kind"))
+            if name not in seen:
+                seen.add(name)
+                rules["rules"].append(r)
+        if "tick_s" in extra:
+            rules["tick_s"] = extra["tick_s"]
+    return rules
+
+
 def run_chaos_load(cfg=None, plan=None) -> dict:
     """See the module docstring.  `cfg` is a `serve.loadgen.LoadConfig`
     (env defaults otherwise); chaos rounds are always closed-loop (an
@@ -510,6 +564,27 @@ def _run_chaos_load(cfg, plan) -> dict:
     rates: list[float] = []
     settled_prev = 0
 
+    # the SLO watchdog is part of the chaos contract: ticked once per
+    # load window (the daemon's wall-clock cadence would race the phase
+    # boundaries), it must breach while the plan is live and clear
+    # during recovery — asserted below, on the same clock the ticks use.
+    # The exposition endpoint is armed too, so a chaos pod round is
+    # scrapeable mid-fault.
+    metrics_export.start_from_env()
+    metrics_export.set_status_provider(ex.status)
+
+    def injected_total(name: str) -> float:
+        # the chaos rule's signal: fired faults so far (site-agnostic —
+        # a CST_FAULTS override may target any seam)
+        if name == "faults.injected":
+            return float(len(faults.injections()))
+        return telemetry.counter_value(name)
+
+    wd = monitor.install(_chaos_slo_rules(window_s), autostart=False,
+                         status_provider=ex.status,
+                         counter_provider=injected_total,
+                         profile_dir=monitor.profile_dir_from_env())
+
     def run_window():
         nonlocal settled_prev
         win_t0 = time.perf_counter()
@@ -519,6 +594,7 @@ def _run_chaos_load(cfg, plan) -> dict:
         rates.append((settled_now - settled_prev)
                      / (time.perf_counter() - win_t0))
         settled_prev = settled_now
+        wd.tick()
 
     t0 = time.perf_counter()
     with telemetry.span("resilience.chaos_round"):
@@ -532,6 +608,7 @@ def _run_chaos_load(cfg, plan) -> dict:
         baseline_windows = len(rates)
 
         # phase 2: the fault plan is live
+        t_fault0 = time.monotonic()
         faults.install(plan)
         try:
             for _ in range(cfg.windows):
@@ -539,6 +616,7 @@ def _run_chaos_load(cfg, plan) -> dict:
         finally:
             injected = faults.injections()
             faults.clear()
+        t_fault1 = time.monotonic()
         chaos_rates = rates[baseline_windows:]
         degraded_rate = (min(chaos_rates) if chaos_rates else None)
 
@@ -552,6 +630,33 @@ def _run_chaos_load(cfg, plan) -> dict:
                 break
     measured_s = time.perf_counter() - t0
     ex.drain()
+    # let the clear hysteresis drain: the recovery loop may have hit
+    # its steady-state break before `clear` consecutive healthy ticks
+    # ran (the plan is gone, so every extra tick is healthy)
+    for _ in range(2 * CHAOS_SLO_RULE["clear"] + 2):
+        if not wd.breaching():
+            break
+        wd.tick()
+    metrics_export.set_status_provider(None)
+    slo_block = monitor.clear()
+
+    # the watchdog arc, asserted both ways (it is only required when
+    # faults actually fired — a CST_FAULTS plan keyed off this round's
+    # traffic never breaches, correctly)
+    arc_name = CHAOS_SLO_RULE["name"]
+    breached_in_window = any(
+        e["phase"] == "breach" and t_fault0 <= e["ts"] <= t_fault1
+        for e in slo_block["events"] if e["rule"] == arc_name)
+    arc_cleared = arc_name not in slo_block["breaching_now"]
+    if injected:
+        assert breached_in_window, (
+            f"{len(injected)} fault(s) fired but the "
+            f"{arc_name!r} SLO rule never breached inside the fault "
+            f"window — the watchdog missed a live incident")
+        assert arc_cleared, (
+            f"the {arc_name!r} SLO rule is still breaching after "
+            f"recovery — the clear hysteresis never released")
+
     # per-request latency basis + tail attribution + the fault→victim
     # correlation, all from the round's lifecycle records (before the
     # closing segments run — they own their own fault plans)
@@ -620,8 +725,14 @@ def _run_chaos_load(cfg, plan) -> dict:
             "heal": heal,
             "checkpoint": ckpt_block,
             "flagship": flagship,
+            "slo_arc": {
+                "rule": arc_name,
+                "breached_in_fault_window": breached_in_window,
+                "cleared_after_recovery": arc_cleared,
+            },
         },
     }
+    block["slo"] = slo_block
     if latency_attribution is not None:
         block["latency_attribution"] = latency_attribution
     if mesh is not None:

@@ -58,7 +58,8 @@ import os
 import time
 from dataclasses import dataclass
 
-from ..telemetry import reqtrace
+from .. import telemetry
+from ..telemetry import metrics_export, monitor, reqtrace
 from .executor import ServeExecutor
 
 SLOT_SECONDS = 12.0
@@ -299,6 +300,46 @@ def _proof_payload(n_leaves: int = 256, batch: int = 16):
                                                 replace=False)])
 
 
+# self-scrape artifact (written whenever the CST_METRICS_PORT endpoint
+# is live during a measured load): the exposition text exactly as an
+# external Prometheus would have seen it, validated line-by-line by
+# bench_smoke's serve round.  The round scrapes mid-load and again
+# after the drain; the kept snapshot is the latest one, so the
+# artifact carries every served kind as a labeled series
+SCRAPE_ARTIFACT = "out/metrics_scrape.txt"
+
+
+def scrape_live_endpoint() -> str | None:
+    """One GET against the process's own exposition endpoint — the
+    mid-round scrape.  Returns the exposition text, or None when the
+    endpoint is down (never raises: a failed scrape must not fail the
+    measured load)."""
+    port = metrics_export.serving_port()
+    if port is None:
+        return None
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10) as resp:
+            return resp.read().decode("utf-8")
+    except Exception as exc:
+        # recorded, not raised: a failed scrape must not fail the round
+        telemetry.count("serve.scrape_failed")
+        telemetry.add_event("serve.scrape_failed", 0.0,
+                            error=type(exc).__name__)
+        return None
+
+
+def write_scrape_artifact(text: str, path: str = SCRAPE_ARTIFACT) -> str:
+    dirname = os.path.dirname(path)
+    if dirname:
+        os.makedirs(dirname, exist_ok=True)
+    with open(path, "w") as f:
+        f.write(text)
+    return path
+
+
 # --- the load loop -----------------------------------------------------------
 
 
@@ -476,6 +517,11 @@ def run_load(cfg: LoadConfig | None = None, executor=None) -> dict:
     # run's records must not pollute the attribution
     if reqtrace.enabled():
         reqtrace.reset()
+    # live monitoring arms with the measured load (same placement rule
+    # as the fault plan: warmup is setup, not served traffic) — the
+    # CST_METRICS_PORT endpoint starts scraping this executor's status
+    # and the CST_SLO_RULES watchdog begins its tick
+    watchdog = monitor.install_from_env(status_provider=ex.status)
     # deterministic per-slot arrival mix (see module docstring)
     submit_next, kinds_submitted = make_submitter(ex, pool, payloads)
 
@@ -488,6 +534,7 @@ def run_load(cfg: LoadConfig | None = None, executor=None) -> dict:
     t0 = time.perf_counter()
     settled_prev = 0
     arrived = 0
+    scrape_text = None
     for wi in range(3 * cfg.windows):       # extend (≤3x) until steady
         # Anchor each window at its actual start and divide by the wall
         # it really spanned: a single pump that overruns the nominal
@@ -510,10 +557,28 @@ def run_load(cfg: LoadConfig | None = None, executor=None) -> dict:
         settled_now = ex.stats()["settled"]
         rates.append((settled_now - settled_prev) / win_elapsed)
         settled_prev = settled_now
+        # the mid-round scrape: once, after traffic has flowed for half
+        # the configured windows — the exposition snapshot an external
+        # scraper would see while the service is under load
+        if scrape_text is None and wi + 1 >= max(1, cfg.windows // 2):
+            scrape_text = scrape_live_endpoint()
         if wi + 1 >= cfg.windows and steady_state(rates):
             break
     measured_s = time.perf_counter() - t0
     ex.drain()
+    # a final live scrape supersedes the mid-round one when it lands:
+    # the endpoint and status provider are still wired, and with the
+    # queue drained every served kind has completed — so the artifact
+    # always carries the full per-kind `cst_serve_requests_total`
+    # series set (bench_smoke asserts exactly that; a slow-to-warm
+    # kind can be absent from the mid-round snapshot)
+    scrape_text = scrape_live_endpoint() or scrape_text
+    if scrape_text is not None:
+        write_scrape_artifact(scrape_text)
+    # finalize the watchdog BEFORE tearing down the status provider so
+    # its last tick still sees the live executor
+    slo_block = monitor.clear() if watchdog is not None else None
+    metrics_export.set_status_provider(None)
 
     st = ex.stats()
     steady = steady_state(rates)
@@ -556,4 +621,6 @@ def run_load(cfg: LoadConfig | None = None, executor=None) -> dict:
     }
     if latency_attribution is not None:
         block["latency_attribution"] = latency_attribution
+    if slo_block is not None:
+        block["slo"] = slo_block
     return block

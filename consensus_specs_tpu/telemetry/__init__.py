@@ -51,11 +51,21 @@ ingests bench/telemetry rounds into the schema-versioned
 consensus_specs_tpu.telemetry.report` renders the trend/threshold/
 attribution dashboard and gates on regressions.
 
+Live monitoring (`metrics_export` + `monitor` submodules): a zero-dep
+Prometheus text-exposition endpoint (`CST_METRICS_PORT`) publishing the
+registry/reqtrace/costmodel/serve-status surfaces per scrape, and the
+declarative SLO watchdog (`CST_SLO_RULES` rules, rolling windows,
+breach→clear hysteresis, typed `SloBreach` events with worst-N reqtrace
+exemplars and an optional `CST_PROFILE_ON_BREACH` profiler grab).  The
+watchdog's round summary rides the serve block (`"slo"` sub-object,
+`validate_slo_block`), is mined into `slo::*` history records, and
+renders as the report's "SLO" section.
+
 Zero dependencies (stdlib only); never imports jax, numpy, or any spec
 module — safe to import from anywhere, including before backend pinning.
 """
 
-from . import costmodel, reqtrace
+from . import costmodel, metrics_export, monitor, reqtrace
 from .core import (
     add_event,
     configure,
@@ -86,13 +96,15 @@ from .export import (
     validate_resilience_block,
     validate_scaling_block,
     validate_serve_block,
+    validate_slo_block,
     write_chrome_trace,
     write_jsonl,
 )
 
 __all__ = [
     "add_event", "configure", "costmodel", "count", "counter_value",
-    "enabled", "first_call", "gauge", "observe", "reqtrace", "reset",
+    "enabled", "first_call", "gauge", "metrics_export", "monitor",
+    "observe", "reqtrace", "reset",
     "set_meta",
     "snapshot", "span", "span_seconds", "bench_block", "chrome_trace",
     "embed_bench_block", "validate_bench_block",
@@ -102,6 +114,6 @@ __all__ = [
     "validate_latency_attribution",
     "validate_mesh_block",
     "validate_resilience_block", "validate_scaling_block",
-    "validate_serve_block",
+    "validate_serve_block", "validate_slo_block",
     "write_chrome_trace", "write_jsonl",
 ]

@@ -140,6 +140,12 @@ def reset(full: bool = False) -> None:
         # whole-process), full resets wipe them and their id counters
         from . import reqtrace
         reqtrace._reset_state()
+        # the live-monitoring layer is process-level too: a full reset
+        # stops the SLO watchdog and the exposition endpoint so one
+        # test's daemon threads never observe the next test's registry
+        from . import metrics_export, monitor
+        monitor._reset_state()
+        metrics_export._reset_state()
 
 
 # --- recording primitives ---------------------------------------------------

@@ -379,6 +379,14 @@ def sample_watermark(tag: str = "") -> dict[str, int]:
 # --- snapshot / join ---------------------------------------------------------
 
 
+def watermark_bytes() -> dict[str, int]:
+    """`{device: last_bytes}` — the cheap point read behind the SLO
+    watchdog's memory-slope signal and the exposition endpoint's
+    per-device gauges (no kernel-record copy, unlike `raw_snapshot`)."""
+    with _lock:
+        return {dev: wm["last_bytes"] for dev, wm in _watermarks.items()}
+
+
 def raw_snapshot() -> dict:
     """The captured state as-is (no derived metrics): what
     `telemetry.snapshot()["costmodel"]` carries.  Schema:

@@ -317,6 +317,77 @@ def validate_serve_block(obj) -> list[str]:
                         "'latency_attribution' is missing")
     if la is not None:
         problems.extend(validate_latency_attribution(la))
+    # live-monitoring surface (SLO watchdog): optional — present on
+    # rounds armed with CST_SLO_RULES
+    slo = obj.get("slo")
+    if slo is not None:
+        problems.extend(validate_slo_block(slo))
+    return problems
+
+
+_SLO_PHASES = ("breach", "clear")
+
+
+def validate_slo_block(obj) -> list[str]:
+    """Schema check for the serve block's `"slo"` sub-object
+    (`telemetry.monitor.Watchdog.slo_block`); returns problems (empty
+    == valid).  Pinned by `bench_smoke.py`'s serve/chaos rounds and
+    tests/test_monitor.py."""
+    if not isinstance(obj, dict):
+        return [f"slo block is {type(obj).__name__}, not dict"]
+    problems: list[str] = []
+    for key in ("ticks", "breaches", "events_dropped"):
+        v = obj.get(key)
+        if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+            problems.append(f"slo[{key!r}] must be a non-negative int, "
+                            f"got {v!r}")
+    if not isinstance(obj.get("clean"), bool):
+        problems.append("slo['clean'] must be a bool")
+    elif isinstance(obj.get("breaches"), int) \
+            and obj["clean"] != (obj["breaches"] == 0):
+        problems.append("slo['clean'] must equal (breaches == 0)")
+    bn = obj.get("breaching_now")
+    if not isinstance(bn, list) or not all(isinstance(n, str)
+                                           for n in bn):
+        problems.append("slo['breaching_now'] must be a list of rule "
+                        "names")
+    rules = obj.get("rules")
+    if not isinstance(rules, list) or not rules:
+        problems.append("slo['rules'] must be a non-empty list")
+        rules = []
+    for i, r in enumerate(rules):
+        if not isinstance(r, dict) or not isinstance(r.get("name"), str):
+            problems.append(f"slo rules[{i}] must be a dict with a "
+                            f"str 'name'")
+            continue
+        for key in ("ticks", "breaches", "clears"):
+            v = r.get(key)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                problems.append(f"slo rules[{i}][{key!r}] must be a "
+                                f"non-negative int, got {v!r}")
+        if not isinstance(r.get("breaching"), bool):
+            problems.append(f"slo rules[{i}]['breaching'] must be a "
+                            f"bool")
+        thr = r.get("threshold")
+        if not isinstance(thr, (int, float)) or isinstance(thr, bool):
+            problems.append(f"slo rules[{i}]['threshold'] must be a "
+                            f"number, got {thr!r}")
+    events = obj.get("events")
+    if not isinstance(events, list):
+        problems.append("slo['events'] must be a list")
+        events = []
+    for i, e in enumerate(events):
+        if not isinstance(e, dict) or e.get("phase") not in _SLO_PHASES \
+                or not isinstance(e.get("rule"), str) \
+                or not isinstance(e.get("ts"), (int, float)):
+            problems.append(f"slo events[{i}] must carry phase in "
+                            f"{_SLO_PHASES}, a str 'rule' and a "
+                            f"numeric 'ts'")
+            break
+    profiles = obj.get("profiles")
+    if not isinstance(profiles, list) or not all(
+            isinstance(p, str) for p in profiles):
+        problems.append("slo['profiles'] must be a list of paths")
     return problems
 
 

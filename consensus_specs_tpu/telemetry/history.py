@@ -46,6 +46,14 @@ Record schema (version `SCHEMA`; one JSON object per line):
                                  # serve-p99-queue-frac advisory row's
                                  # surface, carrying the worst-N
                                  # exemplar traces)
+     "slo": dict,                # compacted SLO-watchdog round summary
+                                 # (source "slo"; metric
+                                 # "slo::breaches[@<rule>]" /
+                                 # "slo::worst_margin@<rule>" /
+                                 # "slo::clean_round" — the watchdog's
+                                 # breach counts, per-rule worst
+                                 # margins, and the non-chaos
+                                 # clean-round 0/1 gate)
      "resilience": dict,         # compacted chaos-round block (source
                                  # "resilience" only; metric
                                  # "resilience::<metric>" — recovery
@@ -106,7 +114,7 @@ SCHEMA = 1
 SOURCES = ("bench_round", "multichip_round", "baseline", "bench_emit",
            "pytest_snapshot", "costmodel", "serve", "resilience",
            "mesh", "checkpoint", "scaling", "das", "forkchoice",
-           "latency")
+           "latency", "slo")
 
 _ROUND_FILE_RE = re.compile(r"(?:BENCH|MULTICHIP)_r(\d+)\.json$")
 
@@ -197,14 +205,18 @@ def _compact_telemetry(tel) -> dict | None:
     return out or None
 
 
-def serve_records(metric: str, serve, **context) -> list[dict]:
+def serve_records(metric: str, serve, chaos: bool = False,
+                  **context) -> list[dict]:
     """`serve`-source history records mined from one metric line's
     sustained-load `"serve"` sub-object (`serve.loadgen.run_load`'s
     block): one scalar record each for the steady-state throughput and
     the latency percentiles — the threshold-gate surface — with the
     compacted block (steady flag, window rates, queue-depth histogram,
-    mode/shape knobs) riding on the throughput record.  Malformed
-    blocks yield zero records, never an exception."""
+    mode/shape knobs) riding on the throughput record.  `chaos` marks a
+    chaos round (bench_serve hoists the `"resilience"` sub-object to
+    the metric line's top level, so the caller must pass the flag) —
+    it gates `slo::clean_round` off.  Malformed blocks yield zero
+    records, never an exception."""
     vps = serve.get("verifies_per_s") if isinstance(serve, dict) else None
     if not isinstance(vps, (int, float)) or isinstance(vps, bool):
         return []
@@ -227,6 +239,62 @@ def serve_records(metric: str, serve, **context) -> list[dict]:
                 via_metric=metric, **context))
     records.extend(latency_records(
         metric, serve.get("latency_attribution"), **context))
+    records.extend(slo_records(
+        metric, serve.get("slo"),
+        chaos=chaos or isinstance(serve.get("resilience"), dict),
+        **context))
+    return records
+
+
+def slo_records(metric: str, slo, chaos: bool = False,
+                **context) -> list[dict]:
+    """`slo`-source history records mined from a serve block's `"slo"`
+    sub-object (`telemetry.monitor.Watchdog.slo_block`, armed rounds
+    only): one `slo::breaches` total carrying the compact block, per
+    rule a `slo::breaches@<rule>` count plus `slo::worst_margin@<rule>`
+    when the rule ever failed a tick, and — on NON-chaos rounds only —
+    the `slo::clean_round` 0/1 record the `slo-clean-round` threshold
+    row gates on (a chaos round breaches BY DESIGN; its arc is asserted
+    in the round itself and mined as `resilience::slo_arc_ok`).
+    Malformed blocks yield zero records, never an exception."""
+    if not isinstance(slo, dict):
+        return []
+    breaches = slo.get("breaches")
+    ticks = slo.get("ticks")
+    if not isinstance(breaches, int) or isinstance(breaches, bool) \
+            or not isinstance(ticks, int) or isinstance(ticks, bool):
+        return []
+    compact = {k: slo[k] for k in (
+        "ticks", "breaches", "clean", "breaching_now", "events_dropped")
+        if k in slo}
+    compact["rules"] = [
+        {k: r[k] for k in ("name", "metric", "breaches", "clears",
+                           "breaching", "worst_margin", "last_value")
+         if k in r}
+        for r in slo.get("rules", []) if isinstance(r, dict)]
+    if slo.get("profiles"):
+        compact["profiles"] = slo["profiles"]
+    records = [make_record(
+        "slo", "slo::breaches", breaches, unit="count", slo=compact,
+        via_metric=metric, **context)]
+    for r in slo.get("rules", []):
+        if not isinstance(r, dict) or not isinstance(r.get("name"), str) \
+                or not r.get("name"):
+            continue
+        rb = r.get("breaches")
+        if isinstance(rb, int) and not isinstance(rb, bool):
+            records.append(make_record(
+                "slo", f"slo::breaches@{r['name']}", rb, unit="count",
+                via_metric=metric, **context))
+        wm = r.get("worst_margin")
+        if isinstance(wm, (int, float)) and not isinstance(wm, bool):
+            records.append(make_record(
+                "slo", f"slo::worst_margin@{r['name']}", wm,
+                unit="margin", via_metric=metric, **context))
+    if not chaos and isinstance(slo.get("clean"), bool):
+        records.append(make_record(
+            "slo", "slo::clean_round", 1.0 if slo["clean"] else 0.0,
+            unit="bool", via_metric=metric, **context))
     return records
 
 
@@ -338,6 +406,20 @@ def resilience_records(metric: str, res, **context) -> list[dict]:
                                          "checked_settles", "recovered")
                       if k in fl},
             **context))
+    # the chaos round's watchdog arc as a 0/1 gate record: breached
+    # inside the fault window AND cleared after recovery (the inverse
+    # of slo::clean_round — a chaos round that stayed clean means the
+    # watchdog missed a live incident)
+    arc = res.get("slo_arc")
+    if isinstance(arc, dict) \
+            and isinstance(arc.get("breached_in_fault_window"), bool) \
+            and isinstance(arc.get("cleared_after_recovery"), bool):
+        ok = (arc["breached_in_fault_window"]
+              and arc["cleared_after_recovery"])
+        records.append(make_record(
+            "resilience", "resilience::slo_arc_ok",
+            1.0 if ok else 0.0, unit="bool", slo_arc=arc,
+            via_metric=metric, **context))
     records.extend(mesh_records(metric, res.get("mesh"), **context))
     records.extend(checkpoint_records(metric, res.get("checkpoint"),
                                       **context))
@@ -739,7 +821,9 @@ def parse_bench_round(path) -> tuple[list[dict], list[str]]:
             rec["baseline_us_per_validator"] = fingerprint
         records.append(rec)
         records.extend(serve_records(
-            name, obj.get("serve"), round=rnd, file=path.name,
+            name, obj.get("serve"),
+            chaos=isinstance(obj.get("resilience"), dict),
+            round=rnd, file=path.name,
             rc=rc, platform=obj.get("platform")))
         records.extend(resilience_records(
             name, obj.get("resilience"), round=rnd, file=path.name,
@@ -1047,7 +1131,9 @@ def emission_records(metric_line: dict, ts: float | None = None
             error=obj.get("error"),
             ts=round(ts, 1) if ts is not None else None))
         for srec in serve_records(
-                name, obj.get("serve"), platform=platform,
+                name, obj.get("serve"),
+                chaos=isinstance(obj.get("resilience"), dict),
+                platform=platform,
                 ts=round(ts, 1) if ts is not None else None):
             records.append(srec)
         for rrec in resilience_records(

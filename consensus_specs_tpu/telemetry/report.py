@@ -20,7 +20,9 @@ one markdown dashboard:
   rebuild from the `checkpoint::*` records; and the mesh-sharded
   flagship gates — >= 70% per-chip throughput retention at the full
   mesh and the 8M-validator rung completing, from the `scaling::*`
-  records) evaluated against the latest data;
+  records; and the SLO watchdog gates — a zero-breach non-chaos serve
+  round (`slo::clean_round`) and the chaos breach→clear arc
+  (`resilience::slo_arc_ok`)) evaluated against the latest data;
 - a generic round-over-round regression rule (no TPU metric may
   regress more than CST_BENCHWATCH_MAX_REGRESS_PCT percent);
 - the `_MSM_DEVICE_MIN` break-even recommendation from the
@@ -145,6 +147,20 @@ THRESHOLDS = (
      "title": "chaos round: wrong verification results",
      "metric": r"resilience::wrong_results",
      "field": "value", "op": "<", "target": 1.0, "tpu_only": False},
+    # the live SLO watchdog (CST_SLO_RULES): a healthy serve round must
+    # end with ZERO breaches (the slo::clean_round 0/1 record is only
+    # mined from NON-chaos rounds — a chaos round breaches by design),
+    # and a chaos round must walk the full arc: breach inside the fault
+    # window, clear after recovery (resilience::slo_arc_ok).  Both are
+    # shape-, not platform-, bound.
+    {"id": "slo-clean-round",
+     "title": "SLO watchdog: clean serve round (zero breaches)",
+     "metric": r"slo::clean_round",
+     "field": "value", "op": ">=", "target": 1.0, "tpu_only": False},
+    {"id": "chaos-slo-arc",
+     "title": "SLO watchdog: chaos breach→clear arc completed",
+     "metric": r"resilience::slo_arc_ok",
+     "field": "value", "op": ">=", "target": 1.0, "tpu_only": False},
     # mesh resilience (PR 9): a device_loss against the sharded verify
     # path must re-bucket onto the survivors within a bounded wall and
     # lose ZERO statements — CI-testable on the 8-host-device simulated
@@ -815,6 +831,78 @@ def render_resilience(records) -> list[str]:
     return lines
 
 
+def render_slo(records) -> list[str]:
+    """The live-watchdog read side: latest `slo::*` records (one row
+    per metric), the latest round's per-rule summary from the compact
+    block riding the `slo::breaches` record, and the latest chaos
+    round's breach→clear arc verdict."""
+    lines = ["## SLO (live watchdog)\n"]
+    recs = [r for r in records if r.get("source") == "slo"]
+    arcs = [r for r in records
+            if r.get("metric") == "resilience::slo_arc_ok"]
+    if not recs and not arcs:
+        lines.append("No SLO records — arm the watchdog on a serve "
+                     "round (`CST_SLO_RULES=... CST_METRICS_PORT=9464 "
+                     "make serve` / `make serve-smoke`) to evaluate "
+                     "rules against the live fleet and produce "
+                     "`slo::*` records.\n")
+        return lines
+    if recs:
+        lines.append("| metric | latest | where |")
+        lines.append("|---|---|---|")
+        latest_by_metric = {}
+        for metric, series in sorted(_by_metric(recs).items()):
+            latest = series[-1]
+            latest_by_metric[metric] = latest
+            val = "—" if latest.get("value") is None else \
+                f"{_fmt(latest['value'])} {latest.get('unit', '')}".rstrip()
+            lines.append(f"| `{metric}` | {val} | {_where(latest)} |")
+        lines.append("")
+        rec = latest_by_metric.get("slo::breaches")
+        compact = rec.get("slo") if rec else None
+        if isinstance(compact, dict):
+            now = ", ".join(compact.get("breaching_now") or []) or "none"
+            lines.append(
+                f"Latest armed round: {compact.get('ticks', '?')} "
+                f"tick(s), {compact.get('breaches', '?')} breach(es), "
+                f"currently breaching: {now}"
+                + (f", {compact['events_dropped']} event(s) dropped at "
+                   f"the cap" if compact.get("events_dropped") else "")
+                + (f"; profiler grabs: "
+                   f"{len(compact['profiles'])}"
+                   if compact.get("profiles") else "")
+                + ".\n")
+            rules = [r for r in compact.get("rules", [])
+                     if isinstance(r, dict)]
+            if rules:
+                lines.append("| rule | metric | breaches | clears | "
+                             "breaching | worst margin | last value |")
+                lines.append("|---|---|---|---|---|---|---|")
+                for r in rules:
+                    lines.append(
+                        f"| `{r.get('name', '—')}` "
+                        f"| `{r.get('metric', '—')}` "
+                        f"| {r.get('breaches', '—')} "
+                        f"| {r.get('clears', '—')} "
+                        f"| {'yes' if r.get('breaching') else 'no'} "
+                        f"| {_fmt(r.get('worst_margin'), 3)} "
+                        f"| {_fmt(r.get('last_value'), 3)} |")
+                lines.append("")
+    if arcs:
+        latest = max(arcs, key=_order_key)
+        arc = latest.get("slo_arc") or {}
+        lines.append(
+            ("Latest chaos arc: breached inside the fault window and "
+             "cleared after recovery — the watchdog saw the incident "
+             "both ways"
+             if latest.get("value") else
+             f"Latest chaos arc: INCOMPLETE — breached in window: "
+             f"{arc.get('breached_in_fault_window')}, cleared after "
+             f"recovery: {arc.get('cleared_after_recovery')}")
+            + f" (rule `{arc.get('rule', '?')}`, {_where(latest)}).\n")
+    return lines
+
+
 def render_tail_latency(records) -> list[str]:
     """The request-tracing read side: latest per-kind
     `latency::p99_ms@<kind>` records (the compact attribution block
@@ -1163,6 +1251,7 @@ def render_report(result: dict) -> str:
     lines.extend(render_regressions(result["regressions"],
                                     result["max_regress_pct"]))
     lines.extend(render_tail_latency(result["records"]))
+    lines.extend(render_slo(result["records"]))
     lines.extend(render_resilience(result["records"]))
     lines.extend(render_scaling(result["records"]))
     lines.extend(render_das(result["records"]))

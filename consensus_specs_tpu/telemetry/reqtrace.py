@@ -60,6 +60,7 @@ before backend pinning (same discipline as the rest of `telemetry/`).
 
 from __future__ import annotations
 
+import collections
 import itertools
 import os
 import threading
@@ -74,6 +75,13 @@ OUTCOMES = ("ok", "recheck", "retry", "fallback", "shed", "poisoned",
 # a sustained round; drops are counted, never silent
 _MAX_RECORDS = 100_000
 _MAX_BATCHES = 50_000
+
+# the live-summary ring: `rolling_summary()` (the ServeExecutor.status()
+# dump and the SLO watchdog tick) reads ONLY this fixed-size window of
+# the freshest completions, so its cost is O(window) however large the
+# full registry grows — and it keeps rolling after the registry cap
+# stops admitting records
+_WINDOW_CAP = 4096
 
 _lock = threading.Lock()
 
@@ -95,6 +103,14 @@ _records: list = []
 _records_dropped = 0
 _batches: list[dict] = []
 _batches_dropped = 0
+# the rolling live window + monotone completion totals (never reset by
+# the registry cap; the watchdog's throughput signal is a delta of
+# these).  deque.append with maxlen is atomic under the GIL, so the
+# per-completion path stays lock-free like `_publish`.
+_window: collections.deque = collections.deque(maxlen=_WINDOW_CAP)
+_completed_total = 0
+_completed_by_kind: dict[str, int] = {}
+_completed_by_outcome: dict[str, int] = {}
 
 
 def enabled() -> bool:
@@ -115,12 +131,16 @@ def reset() -> None:
     """Clear completed records and batch spans (id counters keep
     monotone so records from before/after a reset can never collide).
     How the loadgen scopes a measured run's records to itself."""
-    global _records_dropped, _batches_dropped
+    global _records_dropped, _batches_dropped, _completed_total
     with _lock:
         _records.clear()
         _batches.clear()
         _records_dropped = 0
         _batches_dropped = 0
+        _window.clear()
+        _completed_total = 0
+        _completed_by_kind.clear()
+        _completed_by_outcome.clear()
 
 
 def _reset_state() -> None:
@@ -137,11 +157,20 @@ def _publish(ctx: "RequestContext") -> None:
     # lock-free: append is atomic, and the cap check racing a
     # concurrent append can overshoot by at most a few records — the
     # bound is a memory guard, not an exact count
-    global _records_dropped
+    global _records_dropped, _completed_total
     if len(_records) < _MAX_RECORDS:
         _records.append(ctx)
     else:
         _records_dropped += 1
+    # the live window and the monotone totals admit EVERY completion
+    # (capped registry or not) — the rolling summary and the watchdog's
+    # throughput delta must track the service, not the memory guard
+    _window.append(ctx)
+    _completed_total += 1
+    _completed_by_kind[ctx.kind] = _completed_by_kind.get(ctx.kind, 0) + 1
+    if ctx.outcome is not None:
+        _completed_by_outcome[ctx.outcome] = \
+            _completed_by_outcome.get(ctx.outcome, 0) + 1
 
 
 class RequestContext:
@@ -434,12 +463,27 @@ def attribution(trace_records: list[dict] | None = None,
     }
 
 
+def completed_totals() -> tuple[int, dict, dict]:
+    """(total, by_kind, by_outcome) completion counts: monotone past
+    the registry cap (every completion counts, admitted or dropped),
+    zeroed by `reset()` so a measured run owns its counts.  The
+    exposition endpoint's lifetime series and the watchdog's
+    throughput-delta baseline."""
+    with _lock:
+        return (_completed_total, dict(_completed_by_kind),
+                dict(_completed_by_outcome))
+
+
 def rolling_summary(window: int = 2048) -> dict:
     """Per-kind rolling p50/p99 + mean components over the freshest
     `window` completed records — the live `ServeExecutor.status()`
-    surface (cheap: one registry copy of the tail)."""
+    surface and the SLO watchdog's latency signal.  Reads the fixed
+    `_WINDOW_CAP` ring, never the full registry: O(window) per call
+    under sustained load (bound pinned by tests/test_monitor.py)."""
     with _lock:
-        tail_ctxs = _records[-window:]
+        tail_ctxs = list(_window)
+    if window < len(tail_ctxs):
+        tail_ctxs = tail_ctxs[-window:]
     tail = [c.record() for c in tail_ctxs]
     by_kind: dict[str, list[dict]] = {}
     for r in tail:

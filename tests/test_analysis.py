@@ -657,6 +657,108 @@ def test_wrong_rule_id_does_not_suppress():
     assert rules_at(report) == [("host-sync-item", 2)]
 
 
+# --- family 7: metric-name discipline ----------------------------------------
+
+
+def test_metric_name_bad_charset_fires():
+    report = run("""\
+        from .. import telemetry
+
+        def g():
+            telemetry.count("serve-submitted!")
+        """)
+    assert rules_at(report) == [("metric-name-invalid", 4)]
+
+
+def test_metric_name_sanitization_collision_fires():
+    report = run("""\
+        from .. import telemetry
+
+        def g():
+            telemetry.count("serve.queue_depth")
+            telemetry.count("serve.queue.depth")
+        """)
+    assert rules_at(report) == [("metric-name-invalid", 5)]
+
+
+def test_metric_name_same_name_twice_is_clean():
+    report = run("""\
+        from .. import telemetry
+
+        def g():
+            telemetry.count("serve.submitted")
+            telemetry.count("serve.submitted")
+        """)
+    assert rules_at(report) == []
+
+
+def test_metric_name_collision_across_families_is_clean():
+    # a counter renders `cst_X_total`, a gauge the bare `cst_X` stem —
+    # the same registry name in different instrument families does not
+    # merge series
+    report = run("""\
+        from .. import telemetry
+
+        def g():
+            telemetry.count("serve.depth")
+            telemetry.gauge("serve.depth", 1)
+        """)
+    assert rules_at(report) == []
+
+
+def test_metric_name_fstring_literal_fragment_fires():
+    report = run("""\
+        from .. import telemetry
+
+        def g(kind):
+            telemetry.count(f"serve dispatch.{kind}")
+        """)
+    assert rules_at(report) == [("metric-name-invalid", 4)]
+
+
+def test_metric_name_fstring_with_clean_fragments_is_clean():
+    report = run("""\
+        from .. import telemetry
+
+        def g(kernel, which):
+            telemetry.count(f"kernel.{kernel}.calls")
+            telemetry.observe(f"kernel.{kernel}.{which}", 2)
+        """)
+    assert rules_at(report) == []
+
+
+def test_metric_name_core_alias_inside_telemetry_pkg_fires():
+    # the telemetry package's own modules spell it `core.count(...)`
+    report = run("""\
+        from . import core
+
+        def g():
+            core.count("1leading.digit")
+        """)
+    assert rules_at(report) == [("metric-name-invalid", 4)]
+
+
+def test_metric_name_suppression_round_trips():
+    report = run("""\
+        from .. import telemetry
+
+        def g():
+            telemetry.count("x-y")  # cst: allow(metric-name-invalid): fixture
+        """)
+    assert rules_at(report) == []
+    assert [f.rule for f, _ in report.suppressed] == ["metric-name-invalid"]
+
+
+def test_metric_name_nonliteral_names_are_ignored():
+    report = run("""\
+        from .. import telemetry
+
+        def g(name):
+            telemetry.count(name)
+        """)
+    assert rules_at(report) == []
+
+
 # --- registry / whole-tree / CLI ---------------------------------------------
 
 
