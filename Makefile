@@ -9,7 +9,8 @@ VECTOR_OUT ?= out/vectors
 
 .PHONY: test test-fast test-all test-bls lint vectors kzg_setups bench \
 	bench-smoke bench-report serve serve-smoke chaos-smoke \
-	chaos-mesh-smoke shard-smoke das-smoke fc-smoke multichip help
+	chaos-mesh-smoke shard-smoke das-smoke fc-smoke multichip \
+	incident help
 
 help:
 	@echo "targets: test (fast suite) | test-all (incl. slow crypto) |"
@@ -39,7 +40,8 @@ help:
 	@echo "  (device LMD-GHOST sweep on a tiny CPU"
 	@echo "  tree: forkchoice block schema, >=2x speedup vs the phase0"
 	@echo "  spec oracle, bit-exact head parity, forkchoice::*"
-	@echo "  round-trip + report) | multichip (8-dev CPU dryrun)"
+	@echo "  round-trip + report) | incident (on-demand flight-recorder"
+	@echo "  bundle -> out/incidents/) | multichip (8-dev CPU dryrun)"
 
 test:
 	$(PYTHON) -m pytest tests/ -q -m "not slow"
@@ -108,7 +110,7 @@ serve:
 serve-smoke:
 	@$(CPU_ENV) CST_SERVE_DURATION_S=12 CST_SERVE_RATE=0 CST_SERVE_POOL=4 \
 		CST_SERVE_COMMITTEE=4 CST_SERVE_MAX_BATCH=8 CST_SERVE_WINDOWS=3 \
-		CST_TRACE_REQUESTS=1 CST_METRICS_PORT=9464 \
+		CST_TRACE_REQUESTS=1 CST_METRICS_PORT=9464 CST_OCCUPANCY=1 \
 		CST_SLO_RULES='serve.p99_ms<100000:name=p99-sane; serve.queue_depth<100000:name=queue-sane' \
 		$(PYTHON) bench_serve.py
 
@@ -120,6 +122,15 @@ serve-smoke:
 # Resilience section + chaos-recovery threshold row (CI gates on this)
 chaos-smoke:
 	$(CPU_ENV) $(PYTHON) bench_smoke.py --chaos
+
+# on-demand incident dump from whatever process state is reachable:
+# writes a self-contained bundle (manifest + event ring + fault plan +
+# exemplars + metrics + state) under out/incidents/ and validates its
+# own manifest.  The automatic triggers are CST_FLIGHTREC_ON_BREACH=1
+# (one bundle per breached SLO rule) and CST_FLIGHTREC_POISON_N (poison
+# storms) — see README "Flight recorder"
+incident:
+	$(CPU_ENV) $(PYTHON) -m consensus_specs_tpu.telemetry.flightrec
 
 # no TPU required: the simulated-mesh chaos round — CPU_ENV forces 8
 # host devices, CST_CHAOS_MESH arms the shard-loss segment: one

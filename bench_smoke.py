@@ -394,6 +394,7 @@ def main():
                 "CST_SLO_RULES": ("serve.p99_ms<100000:name=p99-sane; "
                                   "serve.queue_depth<100000"
                                   ":name=queue-sane"),
+                "CST_OCCUPANCY": "1",
                 "CST_BENCHWATCH_HISTORY": str(hist_file)},
                timeout=900)
     serve_lines = [o for o in out if o.get("metric") == "serve_sustained_load"]
@@ -436,6 +437,27 @@ def main():
     print(f"latency attribution OK: {len(la['kinds'])} kind(s), p99 "
           f"queue frac {la['p99_queue_frac']}, {len(la['worst'])} "
           f"exemplar(s)")
+
+    # device-occupancy contract (CST_OCCUPANCY=1): the serve block
+    # carries a schema-valid occupancy sub-object whose busy wall plus
+    # the four bubble causes partition the measured wall EXACTLY (the
+    # same contiguity discipline as the reqtrace components), and at
+    # depth>=2 the prep-overlap score is computable
+    from consensus_specs_tpu.telemetry import validate_occupancy_block
+    occ = block.get("occupancy")
+    assert occ is not None, "CST_OCCUPANCY=1 but no occupancy block"
+    problems = validate_occupancy_block(occ)
+    assert not problems, (problems, json.dumps(occ)[:500])
+    assert occ["busy_s"] > 0, occ
+    occ_total = occ["busy_s"] + sum(occ["bubbles_s"].values())
+    assert abs(occ_total - occ["wall_s"]) <= 1e-6 * occ["wall_s"], \
+        (occ_total, occ["wall_s"], occ["bubbles_s"])
+    if (occ.get("depth") or 0) >= 2:
+        assert occ["overlap"]["score"] is not None, occ["overlap"]
+    print(f"occupancy OK: busy_frac {occ['busy_frac']}, bubbles "
+          + json.dumps({k: round(v, 3)
+                        for k, v in occ["bubbles_s"].items()})
+          + f", overlap score {occ['overlap']['score']}")
     # the worst-N exemplar artifact bench_serve writes for CI upload
     exemplars = json.loads(exemplar_file.read_text())
     assert exemplars["worst"] == la["worst"], exemplar_file
@@ -460,6 +482,13 @@ def main():
         slo_rules_scraped
     assert scrape.get("cst_slo_ticks_total", [({}, 0.0)])[0][1] > 0, \
         scrape.get("cst_slo_ticks_total")
+    # the occupancy families publish live: the rolling busy fraction
+    # and the cause-labeled bubble accumulators
+    assert scrape.get("cst_serve_device_busy_frac"), sorted(scrape)
+    bubble_causes = {lb["cause"] for lb, _ in
+                     scrape.get("cst_serve_bubble_seconds_total", [])}
+    assert bubble_causes == {"host_prep", "queue_starved",
+                             "settle_serialized", "drain"}, bubble_causes
     print(f"metrics scrape OK: {len(scrape)} families, kinds "
           f"{sorted(scraped_kinds)} -> {scrape_file}")
 
@@ -525,6 +554,16 @@ def main():
     crec = by_metric.get("slo::clean_round")
     assert crec is not None and crec["value"] == 1.0, crec
     assert not benchwatch.validate_record(crec), crec
+    # the pipeline-source occupancy records land: busy_frac carrying
+    # the compact block, one bubble record per cause
+    orec = by_metric.get("pipeline::busy_frac")
+    assert orec is not None and orec["source"] == "pipeline", \
+        sorted(by_metric)
+    assert not benchwatch.validate_record(orec), orec
+    assert orec["value"] == occ["busy_frac"], (orec, occ["busy_frac"])
+    for cause in ("host_prep", "queue_starved", "settle_serialized",
+                  "drain"):
+        assert f"pipeline::bubble@{cause}" in by_metric, sorted(by_metric)
     print(f"serve history OK: {len(fresh)} records this run "
           f"(incl. {sum(1 for m in by_metric if m.startswith('latency::'))} "
           f"latency:: records)")
@@ -537,6 +576,8 @@ def main():
                      if e.get("ph") == "C"}
     assert "serve.queue_depth" in counter_names, sorted(counter_names)
     assert "serve.inflight_batches" in counter_names, sorted(counter_names)
+    assert any(n.startswith("pipeline.device_busy.")
+               for n in counter_names), sorted(counter_names)
     span_names = {e["name"] for e in trace["traceEvents"]
                   if e.get("ph") == "X"}
     assert "serve.pump" in span_names, sorted(span_names)
@@ -581,6 +622,11 @@ def main():
     assert "## SLO (live watchdog)" in text, text[:2000]
     assert rows["slo-clean-round"]["status"] == "PASS", \
         rows["slo-clean-round"]
+    # the occupancy section renders from the pipeline:: records; the
+    # serve-occupancy floor stays TPU-gated on this CPU round
+    assert "## Pipeline occupancy" in text, text[:2000]
+    assert rows["serve-occupancy"]["status"] == "no data", \
+        rows["serve-occupancy"]
     print(f"tail-latency report OK: section rendered, TPU-gated "
           f"queue-frac row reads 'no data' on CPU, slo-clean-round "
           f"PASS -> {serve_report}")
@@ -621,6 +667,10 @@ def chaos_main(mesh: bool = False):
     chaos_slo_file = HERE / "out" / "chaos_slo_breaches.json"
     if chaos_slo_file.exists():
         chaos_slo_file.unlink()
+    incidents_dir = HERE / "out" / "smoke_incidents"
+    if incidents_dir.exists():
+        import shutil
+        shutil.rmtree(incidents_dir)
     chaos_t0 = time.time()
     # the canned plan: deterministic dispatch failures into the RLC
     # verify kernel (the acceptance shape — resilience.chaos's default,
@@ -634,6 +684,8 @@ def chaos_main(mesh: bool = False):
            "CST_SERVE_POOL": "4", "CST_SERVE_COMMITTEE": "4",
            "CST_SERVE_MAX_BATCH": "8", "CST_SERVE_WINDOWS": "3",
            "CST_TELEMETRY": "1",
+           "CST_FLIGHTREC_ON_BREACH": "1",
+           "CST_FLIGHTREC_DIR": str(incidents_dir),
            "CST_BENCHWATCH_HISTORY": str(hist_file)}
     if mesh:
         env["CST_CHAOS_MESH"] = "1"
@@ -744,6 +796,45 @@ def chaos_main(mesh: bool = False):
     print(f"slo chaos arc OK: {slo['breaches']} breach(es) over "
           f"{slo['ticks']} tick(s), breach->clear both ways, "
           f"evidence -> {chaos_slo_file}")
+
+    # incident flight-recorder arc (CST_FLIGHTREC_ON_BREACH=1): each
+    # breached rule froze exactly ONE self-contained bundle — the fault
+    # plan, the breach events, the breaker arc, and the exemplars must
+    # all be readable from the bundle directory alone (plain json, no
+    # live process) so a post-mortem needs nothing but the CI artifact
+    from consensus_specs_tpu.telemetry import flightrec
+    breached_rules = {r["name"] for r in slo["rules"]
+                      if r["breaches"] >= 1}
+    incidents = slo["incidents"]
+    assert len(incidents) == len(breached_rules) >= 1, \
+        (incidents, breached_rules)
+    dumped_rules = set()
+    for inc in incidents:
+        bundle = Path(inc)
+        if not bundle.is_absolute():
+            bundle = HERE / bundle
+        assert bundle.is_dir(), bundle
+        manifest = json.loads((bundle / "manifest.json").read_text())
+        problems = flightrec.validate_manifest(manifest)
+        assert not problems, (problems, bundle)
+        assert manifest["rule"] in breached_rules, manifest
+        dumped_rules.add(manifest["rule"])
+        fp = manifest["fault_plan"]
+        assert fp is not None and fp["seed"] == 1234 and fp["faults"], fp
+        events = [json.loads(ln) for ln in
+                  (bundle / "events.jsonl").read_text().splitlines()]
+        kinds = {e["kind"] for e in events}
+        assert "slo_breach" in kinds, sorted(kinds)
+        assert "fault_injected" in kinds, sorted(kinds)
+        # the breaker arc up to the freeze: the chaos trip is in the ring
+        trips = [e for e in events if e["kind"] == "breaker_transition"]
+        assert any(e["to"] == "open" for e in trips), sorted(kinds)
+        exemplars = json.loads((bundle / "exemplars.json").read_text())
+        assert "worst" in exemplars, bundle
+        json.loads((bundle / "state.json").read_text())
+    assert dumped_rules == breached_rules, (dumped_rules, breached_rules)
+    print(f"incident bundles OK: {len(incidents)} bundle(s) for "
+          f"breached rule(s) {sorted(breached_rules)} -> {incidents_dir}")
     if mesh:
         mb = res["mesh"]
         assert "skipped" not in mb, mb

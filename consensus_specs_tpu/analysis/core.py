@@ -91,6 +91,12 @@ RULE_IDS = {
         "reqtrace.RequestContext — requests entering through it would "
         "be invisible to tail-latency attribution (see README Request "
         "tracing)",
+    "instr-uncovered-dispatch-ledger":
+        "dispatch/settle seam function (`_dispatch*` or "
+        "`_settle_from_device` on the occupancy surface) that never "
+        "reaches an occupancy-ledger call — device work flowing "
+        "through it would be invisible to the busy/bubble attribution "
+        "(see README Pipeline occupancy)",
     "metric-name-invalid":
         "telemetry.count/observe/gauge/span name outside the dotted-"
         "name convention, or two distinct names that collide into the "
@@ -114,8 +120,10 @@ ROLE_METRIC = "metric"   # metric-name discipline at every telemetry
                          # call site (metric-name-invalid) — runs over
                          # the whole package, since counters/spans are
                          # minted everywhere the device path runs
+ROLE_LEDGER = "ledger"   # occupancy-ledger coverage of the dispatch /
+                         # settle seams (instr-uncovered-dispatch-ledger)
 ALL_ROLES = frozenset((ROLE_DEVICE, ROLE_KERNEL, ROLE_LIMB, ROLE_INSTR,
-                       ROLE_EXC, ROLE_SERVE, ROLE_METRIC))
+                       ROLE_EXC, ROLE_SERVE, ROLE_METRIC, ROLE_LEDGER))
 
 # the device path named by the north star: every module that builds or
 # dispatches XLA programs (oracle siblings under ops/bls are scanned too;
@@ -162,14 +170,20 @@ KERNEL_FILES = LIMB_FILES + (
 # das/recover.py + ops/bls_batch/g1fft_jax.py joined with the FK20
 # producer / erasure-recovery path (the G1-FFT and circulant-MSM
 # entries plus the recover decode chain dispatch fr_batch + bls_batch
-# kernels and must stay span/cost-covered)
+# kernels and must stay span/cost-covered);
+# telemetry/occupancy.py + flightrec.py joined with the occupancy /
+# flight-recorder subsystems (stdlib-only modules — they never dispatch,
+# so the entry rules stay silent, but joining the surface keeps their
+# sources under the same instrumentation sweep and the metric-name
+# tree pass as every other observability layer)
 INSTR_FILES = ("ops/bls_batch/__init__.py", "ops/bls/__init__.py",
                "ops/bls_batch/g1fft_jax.py",
                "ops/sha256_jax.py", "ops/fr_batch.py",
                "parallel/incremental.py", "parallel/partition.py",
                "resilience/mesh.py", "resilience/checkpoint.py",
                "das/verify.py", "das/recover.py",
-               "forkchoice/store.py", "forkchoice/kernels.py")
+               "forkchoice/store.py", "forkchoice/kernels.py",
+               "telemetry/occupancy.py", "telemetry/flightrec.py")
 
 # metric-name discipline runs over EVERY package module: instrument
 # calls are minted from ops, serve, resilience, telemetry itself — a
@@ -182,6 +196,15 @@ METRIC_GLOBS = ("*.py", "*/*.py", "*/*/*.py")
 # as instr-uncovered-entry), or requests entering through it would be
 # invisible to tail-latency attribution
 SERVE_FILES = ("serve/executor.py",)
+
+# occupancy-ledger coverage surface: every dispatch/settle seam
+# function (`_dispatch*`, `_settle_from_device`) in these modules must
+# reach an occupancy-ledger call (begin_batch / note_kernel_* /
+# note_settled) directly or via the local call graph — a future
+# dispatch seam that skips the ledger would silently punch a hole in
+# the busy/bubble attribution (instr-uncovered-dispatch-ledger)
+OCCUPANCY_FILES = ("ops/bls_batch/__init__.py", "serve/executor.py",
+                   "serve/futures.py")
 
 # shape-laundering functions: a value that went through one of these is
 # a bucketed compile key, not a raw dimension.  `mesh_rung` is the
@@ -729,6 +752,8 @@ def analyze_source(src: str, path: str = "<snippet>",
             model, external_covered, external_device, external_cost)[0]
     if ROLE_SERVE in roles:
         findings += instrumentation.check_reqtrace(model)
+    if ROLE_LEDGER in roles:
+        findings += instrumentation.check_occupancy(model)
     if ROLE_METRIC in roles:
         findings += metricnames.check(model)
     return _apply_suppressions(model, findings)
@@ -758,6 +783,10 @@ def _tree_files(root: Path) -> list[tuple[Path, frozenset]]:
         p = root / rel
         if p.exists():
             files.setdefault(p, set()).add(ROLE_SERVE)
+    for rel in OCCUPANCY_FILES:
+        p = root / rel
+        if p.exists():
+            files.setdefault(p, set()).add(ROLE_LEDGER)
     for pattern in METRIC_GLOBS:
         for p in sorted(root.glob(pattern)):
             files.setdefault(p, set()).add(ROLE_METRIC)

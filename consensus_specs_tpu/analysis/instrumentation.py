@@ -32,6 +32,18 @@ instr-uncovered-cost
     utilization layer (`telemetry/costmodel.py`).  Intentional gaps are
     allow-annotated with a reason, like every other rule.
 
+instr-uncovered-dispatch-ledger
+    every dispatch/settle seam function (`_dispatch*` or
+    `_settle_from_device`) in the occupancy surface
+    (`core.OCCUPANCY_FILES`) must reach an occupancy-LEDGER call —
+    `occupancy.begin_batch`, `note_kernel_busy`,
+    `note_kernel_dispatched` or `note_settled`, directly or via the
+    local call graph.  A bare `occupancy.enabled()` gate does not
+    count, mirroring the cost-capture rule: the seam must actually
+    stamp the ledger, not just consult it.  A future dispatch seam
+    that skips the ledger would punch a silent hole in the busy /
+    bubble attribution (README Pipeline occupancy).
+
 Coverage propagates along the local call graph (a facade function that
 delegates to `bls_batch.batch_verify` is covered by the span — and the
 capture seam — inside `batch_verify`), which is why the tree runner
@@ -296,4 +308,96 @@ def check_reqtrace(model: ModuleModel) -> list:
                 f"reqtrace.RequestContext — requests submitted through "
                 f"it are invisible to tail-latency attribution (see "
                 f"README Request tracing)"))
+    return findings
+
+
+# --- occupancy-ledger coverage (instr-uncovered-dispatch-ledger) -------------
+#
+# The pipeline counterpart of the rules above: a kernel must open a
+# span, a submit entry must mint a context, and a dispatch/settle seam
+# must stamp the occupancy ledger.  Only the LEDGER entry points count
+# as coverage — `occupancy.enabled()` is a gate, not a stamp, exactly
+# like `costmodel.enabled()` under instr-uncovered-cost.
+
+_OCCUPANCY_MOD = "occupancy"
+_LEDGER_FUNCS = frozenset({"begin_batch", "note_kernel_busy",
+                           "note_kernel_dispatched", "note_settled"})
+
+
+def _occupancy_ledger_names(model: ModuleModel) -> tuple[set[str], set[str]]:
+    """(bare names importing occupancy ledger entries, module aliases
+    of the occupancy module)."""
+    names: set[str] = set()
+    aliases: set[str] = set()
+    for node in ast.walk(model.tree):
+        if isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if mod.split(".")[-1] == _OCCUPANCY_MOD:
+                names |= {a.asname or a.name for a in node.names
+                          if a.name in _LEDGER_FUNCS}
+            else:
+                aliases |= {a.asname or a.name for a in node.names
+                            if a.name == _OCCUPANCY_MOD}
+        elif isinstance(node, ast.Import):
+            aliases |= {a.asname or a.name.split(".")[0]
+                        for a in node.names
+                        if a.name.split(".")[-1] == _OCCUPANCY_MOD}
+    return names, aliases
+
+
+def check_occupancy(model: ModuleModel) -> list:
+    """Findings for dispatch/settle seam functions (`_dispatch*` or
+    `_settle_from_device`, module-level or method) that never reach an
+    occupancy-ledger call through the local call graph."""
+    funcs = _functions(model)
+    by_name: dict[str, list] = {}
+    for qual, node, _ in funcs:
+        by_name.setdefault(qual.split(".")[-1], []).append(node)
+    ledger_names, mod_aliases = _occupancy_ledger_names(model)
+
+    stamps: set = set()
+    calls: dict = {n: set() for _, n, _ in funcs}
+    for _, fn, _ in funcs:
+        for node in scope_nodes(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr in _LEDGER_FUNCS \
+                    and isinstance(f.value, ast.Name) \
+                    and f.value.id in mod_aliases:
+                stamps.add(fn)
+                continue
+            if isinstance(f, ast.Name) and f.id in ledger_names:
+                stamps.add(fn)
+                continue
+            # local call-graph edges, same resolution as the rules above
+            name = f.id if isinstance(f, ast.Name) else (
+                f.attr if isinstance(f, ast.Attribute) else None)
+            if name:
+                for callee in by_name.get(name, []):
+                    calls[fn].add(callee)
+
+    covered = set(stamps)
+    changed = True
+    while changed:
+        changed = False
+        for _, fn, _ in funcs:
+            if fn not in covered and calls[fn] & covered:
+                covered.add(fn)
+                changed = True
+
+    def _is_seam(qual: str) -> bool:
+        leaf = qual.split(".")[-1]
+        return leaf.startswith("_dispatch") or leaf == "_settle_from_device"
+
+    findings = []
+    for qual, fn, _ in funcs:
+        if _is_seam(qual) and fn not in covered:
+            findings.append(Finding(
+                model.path, fn.lineno, "instr-uncovered-dispatch-ledger",
+                f"dispatch seam {qual}() never stamps the occupancy "
+                f"ledger (begin_batch / note_kernel_* / note_settled) — "
+                f"device work flowing through it is invisible to the "
+                f"busy/bubble attribution (see README Pipeline "
+                f"occupancy)"))
     return findings

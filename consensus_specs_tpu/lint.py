@@ -319,6 +319,9 @@ def lint_env_knobs(repo=None) -> list[str]:
     (`CST_MERKLE_*`) in the "Incremental merkleization" section,
     monitoring knobs (`CST_METRICS_*`, `CST_SLO_*`,
     `CST_PROFILE_ON_BREACH`) in the "Monitoring" section,
+    occupancy knobs (`CST_OCCUPANCY`) in the "Pipeline occupancy"
+    section, flight-recorder knobs (`CST_FLIGHTREC*`) in the
+    "Flight recorder" section,
     fault-plan knobs (`CST_FAULTS*`) in the "Resilience" section,
     checkpoint knobs (`CST_CHECKPOINT_*`) in the "Mesh resilience &
     checkpointing" section, mesh-sharding knobs (`CST_SHARD_*`) in
@@ -348,6 +351,10 @@ def lint_env_knobs(repo=None) -> list[str]:
                            section("Monitoring")),
                           ("CST_PROFILE_ON_BREACH", "Monitoring",
                            section("Monitoring")),
+                          ("CST_OCCUPANCY", "Pipeline occupancy",
+                           section("Pipeline occupancy")),
+                          ("CST_FLIGHTREC", "Flight recorder",
+                           section("Flight recorder")),
                           ("CST_FAULTS", "Resilience",
                            section("Resilience")),
                           ("CST_CHECKPOINT_",

@@ -74,7 +74,7 @@ import numpy as np
 from ... import telemetry
 from ...resilience import faults
 from ...serve.futures import DeviceFuture, bool_future, value_future
-from ...telemetry import costmodel
+from ...telemetry import costmodel, occupancy
 from ..bls import curve as _pycurve
 from ..bls.hash_to_curve import DST_G2, hash_to_g2
 from . import curve_jax as cj
@@ -143,7 +143,17 @@ def _dispatch(kernel: str, fn, args, block: bool = True):
     if faults.active():
         faults.maybe_inject("dispatch", kernel)
     if not telemetry.enabled():
-        out = fn(*args)
+        # the occupancy ledger has its own gate (CST_OCCUPANCY) — a
+        # serve round can measure device busy without paying for the
+        # full telemetry registry.  Without a sync we can't tell
+        # enqueue from execute, so the span opens at enqueue and the
+        # next future settle on this device closes it.
+        if occupancy.enabled():
+            t0 = time.perf_counter()
+            out = fn(*args)
+            occupancy.note_kernel_dispatched(kernel, t0=t0)
+        else:
+            out = fn(*args)
         return faults.corrupt("dispatch", kernel, out) \
             if faults.active() else out
     import jax
@@ -157,6 +167,13 @@ def _dispatch(kernel: str, fn, args, block: bool = True):
         out = fn(*args)
         which = "dispatch_s"
     dt = time.perf_counter() - t0
+    if block or first:
+        # blocking dispatch: the measured wall IS device busy
+        occupancy.note_kernel_busy(kernel, t0, t0 + dt)
+    else:
+        # pipelined dispatch: busy opens at enqueue, the next future
+        # settle on this device closes it (in-order stream)
+        occupancy.note_kernel_dispatched(kernel, t0=t0)
     telemetry.observe(f"kernel.{which}", dt)
     telemetry.observe(f"kernel.{kernel}.{which}", dt)
     telemetry.count(f"kernel.{kernel}.calls")

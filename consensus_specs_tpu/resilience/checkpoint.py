@@ -59,6 +59,7 @@ import zipfile
 from pathlib import Path
 
 from .. import telemetry
+from ..telemetry import flightrec
 
 FORMAT = 1
 
@@ -187,6 +188,9 @@ class CheckpointManager:
             self._updates_since_snapshot = 0
             self.snapshot_bytes = self.layers_path.stat().st_size
             telemetry.count("checkpoint.snapshots")
+            flightrec.record("checkpoint_snapshot", seq=seq,
+                             n_chunks=int(forest.n_chunks),
+                             bytes=int(self.snapshot_bytes))
         return self.manifest_path
 
     # --- journal (the update_dirty seam's hook) ------------------------------
@@ -295,6 +299,9 @@ class CheckpointManager:
                 manifest["n_chunks"])
             replayed = self._replay(forest, lines, int(manifest["seq"]))
             telemetry.count("checkpoint.restores")
+            flightrec.record("checkpoint_restore",
+                             seq=int(manifest["seq"]),
+                             replayed_entries=int(replayed))
             forest.restored_journal_entries = replayed
             return forest
 

@@ -75,6 +75,7 @@ import threading
 import time
 
 from .. import telemetry
+from ..telemetry import flightrec
 
 SITES = ("dispatch", "future_settle", "serve_pump", "merkle_update")
 KINDS = ("raise", "latency", "compile_fail", "corrupt", "device_loss")
@@ -373,6 +374,8 @@ def maybe_inject(site: str, key: str = "") -> None:
     for rule in plan._take(site, key, ("raise", "latency",
                                        "compile_fail", "device_loss")):
         telemetry.count(f"faults.injected.{site}")
+        flightrec.record("fault_injected", site=site, key=key,
+                         fault=rule.kind)
         if rule.kind == "latency":
             time.sleep(rule.latency_ms / 1e3)
         elif rule.kind == "device_loss":
@@ -392,6 +395,8 @@ def corrupt(site: str, key: str, value):
         return value
     for rule in plan._take(site, key, ("corrupt",)):
         telemetry.count(f"faults.injected.{site}")
+        flightrec.record("fault_injected", site=site, key=key,
+                         fault="corrupt", mode=rule.mode)
         value = _corrupt_value(value, rule.mode)
     return value
 

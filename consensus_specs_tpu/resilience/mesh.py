@@ -51,6 +51,7 @@ from __future__ import annotations
 import time
 
 from .. import telemetry
+from ..telemetry import flightrec
 from .faults import MeshDeviceLost
 
 # exception class names that mean "the device/runtime died", as opposed
@@ -113,6 +114,8 @@ class MeshState:
         self.lost_events += 1
         telemetry.count("mesh.device_lost")
         telemetry.gauge("mesh.degraded_lanes", len(self.lost))
+        flightrec.record("mesh_device_lost", device=device,
+                         degraded_lanes=len(self.lost))
 
     def record_probe(self, ok: bool) -> None:
         """Outcome of a full-mesh half-open probe: success re-admits
@@ -121,6 +124,8 @@ class MeshState:
             if self.lost:
                 self.readmissions += 1
                 telemetry.count("mesh.readmitted", len(self.lost))
+                flightrec.record("mesh_device_back",
+                                 devices=sorted(self.lost))
             self.lost.clear()
             telemetry.gauge("mesh.degraded_lanes", 0)
         else:

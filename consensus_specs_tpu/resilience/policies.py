@@ -30,6 +30,7 @@ from __future__ import annotations
 import time
 
 from .. import telemetry
+from ..telemetry import flightrec
 
 CLOSED = "closed"
 OPEN = "open"
@@ -112,6 +113,8 @@ class CircuitBreaker:
             self._opened_at = self._clock()
             self._probe_inflight = False
         telemetry.count(f"resilience.breaker.{to}")
+        flightrec.record("breaker_transition", key=self.key,
+                         frm=frm, to=to)
         if self._on_transition is not None:
             self._on_transition({"key": self.key, "from": frm, "to": to,
                                  "t": self._clock()})

@@ -105,7 +105,7 @@ from collections import deque
 from .. import telemetry
 from ..resilience import faults
 from ..resilience.policies import DeadlineExceeded
-from ..telemetry import reqtrace
+from ..telemetry import flightrec, occupancy, reqtrace
 from .futures import DeviceFuture, FutureTimeout
 
 KINDS = ("verify", "pairing", "msm", "sha256", "fr", "proof", "das",
@@ -132,14 +132,16 @@ class _Request:
 
 
 class _Batch:
-    __slots__ = ("kind", "future", "reqs", "t_dispatch", "attempt")
+    __slots__ = ("kind", "future", "reqs", "t_dispatch", "attempt",
+                 "occ")
 
-    def __init__(self, kind, future, reqs, attempt=1):
+    def __init__(self, kind, future, reqs, attempt=1, occ=None):
         self.kind = kind
         self.future = future
         self.reqs = reqs
         self.t_dispatch = time.perf_counter()
         self.attempt = attempt
+        self.occ = occ          # occupancy.BatchSpan (None when off)
 
 
 def _depth_bucket(n: int) -> str:
@@ -297,6 +299,8 @@ class ServeExecutor:
         self._retries = 0
         self._fallbacks = 0
         self._shed = 0
+        self._poisoned_batches = 0
+        self._poison_dumped = False
         self._queue_hist: dict[str, int] = {}
         self._queue_max = 0
         self._inflight_max = 0
@@ -492,6 +496,10 @@ class ServeExecutor:
         batch_id = reqtrace.new_batch_id() if ctxs else None
         for ctx in ctxs:
             ctx.mark_dispatch(batch_id)
+        # occupancy ledger: the span opens in host-prep now; the device
+        # busy interval opens at mark_dispatch below and closes when
+        # _settle_batch fetches the answer
+        occ = occupancy.begin_batch(kind)
         try:
             # resilience seam: an injected fault here has exactly a real
             # host-prep failure's blast radius (THESE handles, no others)
@@ -558,6 +566,8 @@ class ServeExecutor:
             # host prep can fail before the batch ever reaches the
             # device (malformed payload, injected fault); same recovery
             # ladder as a failed device batch
+            if occ is not None:
+                occ.abandon()
             self._batch_failed(kind, reqs, exc, attempt, key)
             return
         for ctx in ctxs:
@@ -566,7 +576,10 @@ class ServeExecutor:
             reqtrace.note_batch(batch_id, kind,
                                 [c.trace_id for c in ctxs], attempt,
                                 len(reqs))
-        self._inflight.append(_Batch(kind, fut, reqs, attempt=attempt))
+        if occ is not None:
+            occ.mark_dispatch()
+        self._inflight.append(_Batch(kind, fut, reqs, attempt=attempt,
+                                     occ=occ))
         self._dispatched_batches += 1
         telemetry.count(f"serve.dispatch.{kind}")
         self._note_inflight()
@@ -705,6 +718,23 @@ class ServeExecutor:
                 req.ctx.complete("poisoned")
         self._failed += len(reqs)
         telemetry.count("serve.failed", len(reqs))
+        # flight recorder: a poisoned batch is an incident event, and a
+        # poison STORM (CST_FLIGHTREC_POISON_N) freezes the evidence
+        # once — the bundle carries the fault plan and breaker arc that
+        # explain it
+        self._poisoned_batches += 1
+        flightrec.record("batch_poisoned", batch_kind=kind,
+                         requests=len(reqs), attempt=attempt,
+                         error=f"{type(exc).__name__}: {exc}")
+        n = flightrec.poison_dump_threshold()
+        if n and self._poisoned_batches >= n \
+                and not self._poison_dumped:
+            self._poison_dumped = True
+            try:
+                flightrec.dump_bundle(reason="poison-storm")
+                telemetry.count("serve.incident_bundles")
+            except Exception:   # cst: allow(exc-swallow-device): evidence dump is best-effort — a failed incident write must never worsen the incident (the failure is counted)
+                telemetry.count("serve.incident_dump_failed")
 
     def _settle_batch(self, batch: _Batch, timeout=None) -> bool:
         """Settle one in-flight batch; returns False (re-queueing the
@@ -717,6 +747,8 @@ class ServeExecutor:
             try:
                 out = batch.future.result() if timeout is None \
                     else batch.future.result(timeout=timeout)
+                if batch.occ is not None:
+                    batch.occ.mark_answer()
                 for ctx in ctxs:
                     ctx.mark_device_done()
                 if batch.kind == "verify" and len(batch.reqs) > 1:
@@ -759,6 +791,8 @@ class ServeExecutor:
                 # a failed device batch — or a failed per-statement
                 # recheck dispatch — walks the recovery ladder; the
                 # executor itself keeps serving
+                if batch.occ is not None:
+                    batch.occ.abandon()
                 self._batch_failed(batch.kind, batch.reqs, exc,
                                    batch.attempt, key)
                 return True
@@ -773,6 +807,8 @@ class ServeExecutor:
                 self.latencies_s.append(now - req.t_enqueue)
             self._settled += len(batch.reqs)
             telemetry.count("serve.settled", len(batch.reqs))
+            if batch.occ is not None:
+                batch.occ.mark_settled()
             return True
 
     # --- accounting ---------------------------------------------------------
@@ -824,6 +860,13 @@ class ServeExecutor:
             out["breakers"] = self.breakers.states()
         if reqtrace.enabled():
             out["latency"] = reqtrace.rolling_summary()
+        occ = occupancy.live_summary()
+        if occ is not None:
+            out["occupancy"] = {
+                "device_busy_frac": occ["busy_frac"],
+                "bubble_seconds": occ["bubbles_s"],
+                "by_device": occ["devices"],
+            }
         return out
 
     def _maybe_dump_status(self) -> None:

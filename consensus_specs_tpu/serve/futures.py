@@ -59,6 +59,7 @@ from __future__ import annotations
 import numpy as np
 
 from ..resilience import faults
+from ..telemetry import occupancy
 
 _UNSET = object()
 
@@ -199,6 +200,10 @@ class DeviceFuture:
             self._state = DONE
             self._device = None      # release the device ref
             self._convert = None
+            # occupancy ledger: a device→host settle means everything
+            # enqueued before it on this device's in-order stream has
+            # executed — close the open kernel busy spans
+            occupancy.note_settled()
 
     def result(self, timeout: float | None = None):
         """The host value.  Device-backed futures fetch-and-convert on

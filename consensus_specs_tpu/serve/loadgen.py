@@ -59,7 +59,7 @@ import time
 from dataclasses import dataclass
 
 from .. import telemetry
-from ..telemetry import metrics_export, monitor, reqtrace
+from ..telemetry import metrics_export, monitor, occupancy, reqtrace
 from .executor import ServeExecutor
 
 SLOT_SECONDS = 12.0
@@ -517,6 +517,11 @@ def run_load(cfg: LoadConfig | None = None, executor=None) -> dict:
     # run's records must not pollute the attribution
     if reqtrace.enabled():
         reqtrace.reset()
+    # occupancy ledger (CST_OCCUPANCY): same scoping rule — the busy /
+    # bubble attribution must cover the measured load only, so warmup
+    # dispatch stamps are discarded here
+    if occupancy.enabled():
+        occupancy.reset()
     # live monitoring arms with the measured load (same placement rule
     # as the fault plan: warmup is setup, not served traffic) — the
     # CST_METRICS_PORT endpoint starts scraping this executor's status
@@ -566,6 +571,11 @@ def run_load(cfg: LoadConfig | None = None, executor=None) -> dict:
             break
     measured_s = time.perf_counter() - t0
     ex.drain()
+    # close the occupancy window AFTER the drain so the post-load tail
+    # shows up as the `drain` bubble cause instead of vanishing
+    occ_block = (occupancy.block(window=(t0, time.perf_counter()),
+                                 depth=cfg.depth)
+                 if occupancy.enabled() else None)
     # a final live scrape supersedes the mid-round one when it lands:
     # the endpoint and status provider are still wired, and with the
     # queue drained every served kind has completed — so the artifact
@@ -621,6 +631,8 @@ def run_load(cfg: LoadConfig | None = None, executor=None) -> dict:
     }
     if latency_attribution is not None:
         block["latency_attribution"] = latency_attribution
+    if occ_block is not None:
+        block["occupancy"] = occ_block
     if slo_block is not None:
         block["slo"] = slo_block
     return block

@@ -61,11 +61,23 @@ watchdog's round summary rides the serve block (`"slo"` sub-object,
 `validate_slo_block`), is mined into `slo::*` history records, and
 renders as the report's "SLO" section.
 
+Occupancy + flight recorder (`occupancy` + `flightrec` submodules):
+the per-device busy/bubble interval ledger (`CST_OCCUPANCY`) that
+attributes every idle gap in the serve pipeline to {host_prep,
+queue_starved, settle_serialized, drain} and scores how much host prep
+hid under device wall (the serve block's `"occupancy"` sub-object,
+`pipeline::*` history records, the report's "Pipeline occupancy"
+section, per-device Chrome busy tracks, `cst_serve_device_busy_frac`
+exposition), and the bounded cross-stack incident event ring whose
+`dump_bundle()` freezes breaker/fault/mesh/SLO/occupancy evidence into
+one self-contained directory on watchdog breach, poison storm, or
+`python -m consensus_specs_tpu.telemetry.flightrec`.
+
 Zero dependencies (stdlib only); never imports jax, numpy, or any spec
 module — safe to import from anywhere, including before backend pinning.
 """
 
-from . import costmodel, metrics_export, monitor, reqtrace
+from . import costmodel, flightrec, metrics_export, monitor, occupancy, reqtrace
 from .core import (
     add_event,
     configure,
@@ -93,6 +105,7 @@ from .export import (
     validate_forkchoice_block,
     validate_latency_attribution,
     validate_mesh_block,
+    validate_occupancy_block,
     validate_resilience_block,
     validate_scaling_block,
     validate_serve_block,
@@ -103,8 +116,8 @@ from .export import (
 
 __all__ = [
     "add_event", "configure", "costmodel", "count", "counter_value",
-    "enabled", "first_call", "gauge", "metrics_export", "monitor",
-    "observe", "reqtrace", "reset",
+    "enabled", "first_call", "flightrec", "gauge", "metrics_export",
+    "monitor", "observe", "occupancy", "reqtrace", "reset",
     "set_meta",
     "snapshot", "span", "span_seconds", "bench_block", "chrome_trace",
     "embed_bench_block", "validate_bench_block",
@@ -112,7 +125,7 @@ __all__ = [
     "validate_das_block", "validate_das_producer_block",
     "validate_forkchoice_block",
     "validate_latency_attribution",
-    "validate_mesh_block",
+    "validate_mesh_block", "validate_occupancy_block",
     "validate_resilience_block", "validate_scaling_block",
     "validate_serve_block", "validate_slo_block",
     "write_chrome_trace", "write_jsonl",

@@ -146,6 +146,13 @@ def reset(full: bool = False) -> None:
         from . import metrics_export, monitor
         monitor._reset_state()
         metrics_export._reset_state()
+        # the occupancy ledger and the incident event ring follow the
+        # process-level rule as well: per-config resets keep them (a
+        # run's incident evidence must survive its config loop), full
+        # resets restore the env-derived gates and empty both
+        from . import flightrec, occupancy
+        occupancy._reset_state()
+        flightrec._reset_state()
 
 
 # --- recording primitives ---------------------------------------------------
@@ -407,7 +414,9 @@ def snapshot() -> dict:
          "gauges":     {str: {"last","min","max","count"}},
          "events": int, "events_dropped": int,
          "costmodel": {"kernels": {...}, "watermarks": {...},
-                       "wm_events": int, "wm_events_dropped": int}}
+                       "wm_events": int, "wm_events_dropped": int},
+         "occupancy": {"enabled","events","open_spans",
+                       "events_dropped","live"}}
     """
     with _lock:
         snap = {
@@ -423,9 +432,10 @@ def snapshot() -> dict:
     # outside _lock: the cost-model and request-trace registries have
     # their own locks, and their snapshots must not nest under ours
     # (lock-order discipline)
-    from . import costmodel, reqtrace
+    from . import costmodel, occupancy, reqtrace
     snap["costmodel"] = costmodel.raw_snapshot()
     snap["reqtrace"] = reqtrace.raw_snapshot()
+    snap["occupancy"] = occupancy.raw_snapshot()
     return snap
 
 

@@ -92,6 +92,13 @@ def chrome_trace() -> dict:
     from . import reqtrace
 
     out.extend(reqtrace.chrome_events(pid, core._T0))
+    # device-occupancy busy tracks (CST_OCCUPANCY): one 'C' counter per
+    # device rising to 1 over each merged busy span, so pipeline
+    # bubbles are visible as flat-zero stretches next to the request
+    # and gauge tracks
+    from . import occupancy
+
+    out.extend(occupancy.chrome_events(pid, core._T0))
     trace = {"traceEvents": out, "displayTimeUnit": "ms"}
     if dropped or wm_dropped or g_dropped:
         trace["otherData"] = {
@@ -322,6 +329,11 @@ def validate_serve_block(obj) -> list[str]:
     slo = obj.get("slo")
     if slo is not None:
         problems.extend(validate_slo_block(slo))
+    # device-occupancy surface: optional — present on rounds armed with
+    # CST_OCCUPANCY
+    occ = obj.get("occupancy")
+    if occ is not None:
+        problems.extend(validate_occupancy_block(occ))
     return problems
 
 
@@ -388,6 +400,92 @@ def validate_slo_block(obj) -> list[str]:
     if not isinstance(profiles, list) or not all(
             isinstance(p, str) for p in profiles):
         problems.append("slo['profiles'] must be a list of paths")
+    return problems
+
+
+_BUBBLE_CAUSES = ("host_prep", "queue_starved", "settle_serialized",
+                  "drain")
+
+
+def validate_occupancy_block(obj) -> list[str]:
+    """Schema check for the serve block's `"occupancy"` sub-object
+    (`telemetry.occupancy.block`); returns problems (empty == valid).
+    Enforces the contiguity contract: busy plus the four bubble
+    components must sum to the measured wall within 1e-6 relative.
+    Pinned by `bench_smoke.py`'s serve round and
+    tests/test_occupancy.py."""
+    if not isinstance(obj, dict):
+        return [f"occupancy block is {type(obj).__name__}, not dict"]
+    problems: list[str] = []
+    if not isinstance(obj.get("enabled"), bool):
+        problems.append("occupancy['enabled'] must be a bool")
+    for key in ("wall_s", "busy_s", "busy_frac"):
+        v = obj.get(key)
+        if not isinstance(v, (int, float)) or isinstance(v, bool) \
+                or v < 0:
+            problems.append(f"occupancy[{key!r}] must be a non-negative "
+                            f"number, got {v!r}")
+    for key in ("events", "events_dropped"):
+        v = obj.get(key)
+        if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+            problems.append(f"occupancy[{key!r}] must be a non-negative "
+                            f"int, got {v!r}")
+    bub = obj.get("bubbles_s")
+    if not isinstance(bub, dict) or set(bub) != set(_BUBBLE_CAUSES):
+        problems.append(f"occupancy['bubbles_s'] must map exactly the "
+                        f"causes {_BUBBLE_CAUSES}")
+        bub = None
+    elif not all(isinstance(v, (int, float)) and not isinstance(v, bool)
+                 and v >= -1e-9 for v in bub.values()):
+        problems.append("occupancy bubble components must be "
+                        "non-negative numbers")
+        bub = None
+    wall, busy = obj.get("wall_s"), obj.get("busy_s")
+    if bub is not None and isinstance(wall, (int, float)) \
+            and isinstance(busy, (int, float)) and wall > 0:
+        total = busy + sum(bub.values())
+        if abs(total - wall) > 1e-6 * max(wall, 1e-12):
+            problems.append(f"occupancy busy+bubbles ({total}) != "
+                            f"wall_s ({wall}) beyond 1e-6 relative")
+    devs = obj.get("devices")
+    if not isinstance(devs, dict):
+        problems.append("occupancy['devices'] must be a dict")
+        devs = {}
+    for dev, blk in devs.items():
+        if not isinstance(blk, dict) \
+                or not isinstance(blk.get("busy_s"), (int, float)) \
+                or not isinstance(blk.get("busy_frac"), (int, float)) \
+                or not isinstance(blk.get("spans"), int) \
+                or not isinstance(blk.get("bubbles_s"), dict):
+            problems.append(f"occupancy device {dev!r} must carry "
+                            f"busy_s, busy_frac, spans, bubbles_s")
+    byk = obj.get("device_seconds_by_kind")
+    if not isinstance(byk, dict) or not all(
+            isinstance(k, str) and isinstance(v, (int, float))
+            and not isinstance(v, bool) for k, v in byk.items()):
+        problems.append("occupancy['device_seconds_by_kind'] must map "
+                        "str kinds to numbers")
+    ov = obj.get("overlap")
+    if not isinstance(ov, dict) \
+            or not isinstance(ov.get("prep_s"), (int, float)) \
+            or not isinstance(ov.get("hidden_s"), (int, float)):
+        problems.append("occupancy['overlap'] must carry numeric "
+                        "prep_s and hidden_s")
+    else:
+        score = ov.get("score")
+        if score is not None and (not isinstance(score, (int, float))
+                                  or isinstance(score, bool)
+                                  or not -1e-9 <= score <= 1 + 1e-9):
+            problems.append(f"occupancy overlap score must be in "
+                            f"[0, 1] or null, got {score!r}")
+        if ov["prep_s"] > 0 and score is None:
+            problems.append("occupancy overlap score must be present "
+                            "when prep_s > 0")
+    depth = obj.get("depth")
+    if depth is not None and (not isinstance(depth, int)
+                              or isinstance(depth, bool) or depth < 1):
+        problems.append(f"occupancy['depth'] must be a positive int or "
+                        f"null, got {depth!r}")
     return problems
 
 

@@ -1,0 +1,403 @@
+"""Incident flight recorder — a bounded structured event ring spanning
+the whole stack, plus a self-contained incident-bundle dump.
+
+The chaos and mesh rounds run on pods nobody can re-attach to: when a
+rule breaches there, the evidence today is scattered across stderr,
+`out/slo_breaches.json`, and the in-memory trace ring — all gone, or
+unreadable, by the time a human looks.  This module keeps a bounded
+ring of the *rare, causally interesting* events every subsystem already
+knows about at the moment they happen:
+
+    breaker_transition   resilience.policies CircuitBreaker._transition
+    fault_injected       resilience.faults maybe_inject / corrupt
+    mesh_device_lost     resilience.mesh MeshState.mark_lost
+    mesh_device_back     resilience.mesh MeshState.record_probe readmit
+    checkpoint_snapshot  resilience.checkpoint snapshot()
+    checkpoint_restore   resilience.checkpoint restore()
+    batch_poisoned       serve.executor _batch_failed poison path
+    slo_breach / slo_clear   telemetry.monitor rule transitions
+    occupancy_collapse   telemetry.monitor busy_frac falling off a cliff
+    dump                 every bundle dump records itself
+
+and `dump_bundle()` freezes the ring together with everything needed to
+read an incident offline into ONE directory:
+
+    manifest.json    format/schema tag, wall+mono timestamps, reason,
+                     breached rule, git sha, CST_* env-knob snapshot,
+                     fault-plan description (seed + rules) and fired
+                     injections, file inventory
+    events.jsonl     the ring, one JSON object per line, oldest first
+    exemplars.json   reqtrace worst-N exemplar traces + attribution
+    metrics.txt      a Prometheus exposition scrape (text format)
+    state.json       serve status (breakers, queues), SLO block,
+                     occupancy block — the live state at dump time
+
+Every file is plain JSON / Prometheus text: the bundle loads with no
+repo imports (pinned by tests/test_flightrec.py).
+
+Trigger matrix:
+    watchdog breach      CST_FLIGHTREC_ON_BREACH=1 — once per rule per
+                         watchdog install (rides the same once-gating
+                         discipline as CST_PROFILE_ON_BREACH)
+    poison storm         CST_FLIGHTREC_POISON_N=N — the executor dumps
+                         once after its N-th poisoned batch (0=off)
+    on demand            python -m consensus_specs_tpu.telemetry.flightrec
+                         (or `make incident`)
+
+Gating: the ring itself is ON by default (`CST_FLIGHTREC=0` disables) —
+these events fire a handful of times per run, never per request, so the
+recorder must not miss the incident nobody predicted.  The ring is a
+`deque(maxlen=CST_FLIGHTREC_CAP)` (default 4096): bounded memory,
+oldest events evicted, evictions counted.  Stdlib-only at module level;
+the dump's reads of sibling subsystems are lazy and individually
+fault-tolerant (a broken reader degrades that file, never the dump).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+from collections import deque
+
+MANIFEST_FORMAT = "cst-incident"
+MANIFEST_SCHEMA = 1
+DEFAULT_CAP = 4096
+DEFAULT_DIR = os.path.join("out", "incidents")
+
+EVENT_KINDS = (
+    "breaker_transition", "fault_injected", "mesh_device_lost",
+    "mesh_device_back", "checkpoint_snapshot", "checkpoint_restore",
+    "batch_poisoned", "slo_breach", "slo_clear", "occupancy_collapse",
+    "dump",
+)
+
+_lock = threading.Lock()
+
+
+def _env_enabled() -> bool:
+    return os.environ.get("CST_FLIGHTREC", "1") not in ("", "0")
+
+
+def _env_cap() -> int:
+    try:
+        cap = int(os.environ.get("CST_FLIGHTREC_CAP", str(DEFAULT_CAP)))
+    except ValueError:
+        return DEFAULT_CAP
+    return max(1, cap)
+
+
+_enabled = _env_enabled()
+_ring: deque = deque(maxlen=_env_cap())
+_seq = 0
+_evicted = 0
+_dumps = 0
+
+
+def enabled() -> bool:
+    """True while the recorder accepts events (default on —
+    `CST_FLIGHTREC=0` disables)."""
+    return _enabled
+
+
+def configure(enabled: bool | None = None,
+              cap: int | None = None) -> None:
+    """Programmatic override of the env gates (tests, benches).  A cap
+    change rebuilds the ring, keeping the newest events."""
+    global _enabled, _ring
+    if enabled is not None:
+        _enabled = enabled
+    if cap is not None:
+        with _lock:
+            _ring = deque(_ring, maxlen=max(1, cap))
+
+
+def _reset_state() -> None:
+    """Full test-isolation reset (telemetry.reset(full=True) hook)."""
+    global _enabled, _ring, _seq, _evicted, _dumps
+    with _lock:
+        _enabled = _env_enabled()
+        _ring = deque(maxlen=_env_cap())
+        _seq = 0
+        _evicted = 0
+        _dumps = 0
+
+
+def record(kind: str, /, **fields) -> None:
+    """Append one structured event to the ring.  `kind` is one of
+    EVENT_KINDS (unknown kinds are recorded too — the ring must not
+    drop the event a future subsystem invents); `fields` must be
+    JSON-serializable scalars/containers.  `kind` is positional-only so
+    a caller-supplied `kind=` field cannot collide with it (the event
+    kind always wins the dict slot).  Disabled cost: one global read."""
+    global _seq, _evicted
+    if not _enabled:
+        return
+    ev = {"seq": 0, "ts": round(time.time(), 6),
+          "t_mono": round(time.perf_counter(), 6)}
+    ev.update(fields)
+    ev["kind"] = kind
+    with _lock:
+        _seq += 1
+        ev["seq"] = _seq
+        if len(_ring) == _ring.maxlen:
+            _evicted += 1
+        _ring.append(ev)
+
+
+def events() -> list[dict]:
+    """Ring contents, oldest first (copies)."""
+    with _lock:
+        return [dict(ev) for ev in _ring]
+
+
+def stats() -> dict:
+    with _lock:
+        return {"enabled": _enabled, "events": len(_ring),
+                "cap": _ring.maxlen, "recorded": _seq,
+                "evicted": _evicted, "dumps": _dumps}
+
+
+# --- bundle dump -------------------------------------------------------------
+
+
+def _git_sha() -> str | None:
+    try:
+        out = subprocess.run(
+            ["git", "rev-parse", "HEAD"], capture_output=True,
+            text=True, timeout=5,
+            cwd=os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__)))))
+        sha = out.stdout.strip()
+        return sha if out.returncode == 0 and sha else None
+    except Exception:
+        return None
+
+
+def _env_knobs() -> dict:
+    return {k: v for k, v in sorted(os.environ.items())
+            if k.startswith("CST_")}
+
+
+def _fault_plan() -> dict | None:
+    try:
+        from ..resilience import faults
+        plan = faults.current()
+        if plan is None:
+            return None
+        desc = plan.describe()
+        desc["injections"] = faults.injections()
+        return desc
+    except Exception:
+        return None
+
+
+def _exemplars() -> dict:
+    try:
+        from . import reqtrace
+        att = reqtrace.attribution()
+        return {"worst": att.get("worst", []),
+                "attribution": att}
+    except Exception as exc:
+        return {"error": f"{type(exc).__name__}: {exc}"}
+
+
+def _metrics_text() -> str:
+    try:
+        from . import metrics_export
+        return metrics_export.render_exposition()
+    except Exception as exc:
+        return f"# flightrec: exposition unavailable: {exc}\n"
+
+
+def _state() -> dict:
+    state: dict = {}
+    try:
+        from . import metrics_export
+        state["serve_status"] = metrics_export.get_status()
+    except Exception:
+        state["serve_status"] = None
+    try:
+        from . import monitor
+        wd = monitor.current()
+        state["slo"] = wd.slo_block() if wd is not None else None
+    except Exception:
+        state["slo"] = None
+    try:
+        from . import occupancy
+        state["occupancy"] = (occupancy.block()
+                              if occupancy.enabled() else None)
+    except Exception:
+        state["occupancy"] = None
+    return state
+
+
+def validate_manifest(obj) -> list[str]:
+    """Schema check for a bundle manifest; returns a list of problems
+    (empty == valid).  The contract tests/test_flightrec.py and the
+    chaos smoke pin — an incident bundle a pod ships home must be
+    readable without guessing."""
+    problems: list[str] = []
+    if not isinstance(obj, dict):
+        return ["manifest: not an object"]
+    if obj.get("format") != MANIFEST_FORMAT:
+        problems.append(f"format: {obj.get('format')!r} != "
+                        f"{MANIFEST_FORMAT!r}")
+    if obj.get("schema") != MANIFEST_SCHEMA:
+        problems.append(f"schema: {obj.get('schema')!r} != "
+                        f"{MANIFEST_SCHEMA}")
+    for key, typ in (("created_unix", (int, float)),
+                     ("reason", str), ("events", int),
+                     ("env", dict), ("files", list)):
+        if not isinstance(obj.get(key), typ):
+            problems.append(f"{key}: missing or wrong type")
+    if "rule" in obj and obj["rule"] is not None \
+            and not isinstance(obj["rule"], str):
+        problems.append("rule: not a string")
+    if "git_sha" in obj and obj["git_sha"] is not None \
+            and not isinstance(obj["git_sha"], str):
+        problems.append("git_sha: not a string")
+    fp = obj.get("fault_plan")
+    if fp is not None:
+        if not isinstance(fp, dict):
+            problems.append("fault_plan: not an object")
+        else:
+            if not isinstance(fp.get("seed"), int):
+                problems.append("fault_plan.seed: missing int")
+            if not isinstance(fp.get("faults"), list):
+                problems.append("fault_plan.faults: missing list")
+    if isinstance(obj.get("files"), list):
+        for want in ("events.jsonl", "exemplars.json", "metrics.txt",
+                     "state.json"):
+            if want not in obj["files"]:
+                problems.append(f"files: {want} missing")
+    return problems
+
+
+def dump_bundle(directory: str | None = None, reason: str = "manual",
+                rule: str | None = None) -> str:
+    """Write a self-contained incident directory and return its path.
+
+    `directory` is the PARENT incidents dir (default
+    `CST_FLIGHTREC_DIR` or `out/incidents`); each dump creates a fresh
+    `incident-<n>-<reason>` inside it.  Never raises for a degraded
+    sub-reader — a bundle with a broken metrics scrape still carries
+    the ring and the manifest."""
+    global _dumps
+    parent = directory or os.environ.get("CST_FLIGHTREC_DIR",
+                                         DEFAULT_DIR)
+    with _lock:
+        _dumps += 1
+        n = _dumps
+    slug = "".join(c if c.isalnum() or c in "-_" else "-"
+                   for c in reason)[:48] or "manual"
+    path = os.path.join(parent, f"incident-{n:03d}-{slug}")
+    os.makedirs(path, exist_ok=True)
+
+    record("dump", reason=reason, rule=rule, path=path)
+    evs = events()
+
+    with io.open(os.path.join(path, "events.jsonl"), "w",
+                 encoding="utf-8") as fh:
+        for ev in evs:
+            fh.write(json.dumps(ev, sort_keys=True) + "\n")
+
+    def _write_json(name: str, obj) -> None:
+        with io.open(os.path.join(path, name), "w",
+                     encoding="utf-8") as fh:
+            json.dump(obj, fh, indent=2, sort_keys=True, default=str)
+            fh.write("\n")
+
+    _write_json("exemplars.json", _exemplars())
+    with io.open(os.path.join(path, "metrics.txt"), "w",
+                 encoding="utf-8") as fh:
+        fh.write(_metrics_text())
+    _write_json("state.json", _state())
+
+    st = stats()
+    manifest = {
+        "format": MANIFEST_FORMAT,
+        "schema": MANIFEST_SCHEMA,
+        "created_unix": round(time.time(), 6),
+        "reason": reason,
+        "rule": rule,
+        "git_sha": _git_sha(),
+        "env": _env_knobs(),
+        "fault_plan": _fault_plan(),
+        "events": len(evs),
+        "events_evicted": st["evicted"],
+        "files": ["manifest.json", "events.jsonl", "exemplars.json",
+                  "metrics.txt", "state.json"],
+    }
+    _write_json("manifest.json", manifest)
+    return path
+
+
+# --- env-gated triggers (read by monitor / executor) -------------------------
+
+
+def dump_on_breach() -> bool:
+    """Whether the watchdog should dump a bundle on a rule's first
+    breach (`CST_FLIGHTREC_ON_BREACH`, default off — smoke and pod
+    rounds arm it)."""
+    return os.environ.get("CST_FLIGHTREC_ON_BREACH", "0") \
+        not in ("", "0")
+
+
+def poison_dump_threshold() -> int:
+    """Poisoned-batch count after which the executor dumps a bundle
+    once (`CST_FLIGHTREC_POISON_N`, 0 = off)."""
+    try:
+        n = int(os.environ.get("CST_FLIGHTREC_POISON_N", "0"))
+    except ValueError:
+        return 0
+    return max(0, n)
+
+
+def main(argv: list[str] | None = None) -> int:
+    """`python -m consensus_specs_tpu.telemetry.flightrec` — on-demand
+    incident dump.  Prints the bundle path; exit 0 on a written
+    bundle, 2 on bad usage, 1 on failure."""
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        prog="consensus_specs_tpu.telemetry.flightrec",
+        description="dump a self-contained incident bundle")
+    parser.add_argument("--dir", default=None,
+                        help="parent incidents directory "
+                             f"(default: CST_FLIGHTREC_DIR or "
+                             f"{DEFAULT_DIR})")
+    parser.add_argument("--reason", default="manual",
+                        help="reason slug recorded in the manifest")
+    try:
+        args = parser.parse_args(argv)
+    except SystemExit as exc:
+        return 2 if exc.code not in (0, None) else 0
+    try:
+        path = dump_bundle(directory=args.dir, reason=args.reason)
+    except Exception as exc:
+        print(f"flightrec: dump failed: {type(exc).__name__}: {exc}",
+              file=sys.stderr)
+        return 1
+    manifest = os.path.join(path, "manifest.json")
+    try:
+        with io.open(manifest, "r", encoding="utf-8") as fh:
+            problems = validate_manifest(json.load(fh))
+    except Exception as exc:
+        print(f"flightrec: manifest unreadable: {exc}",
+              file=sys.stderr)
+        return 1
+    if problems:
+        print("flightrec: manifest invalid: " + "; ".join(problems),
+              file=sys.stderr)
+        return 1
+    print(path)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

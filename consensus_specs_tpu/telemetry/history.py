@@ -54,6 +54,13 @@ Record schema (version `SCHEMA`; one JSON object per line):
                                  # breach counts, per-rule worst
                                  # margins, and the non-chaos
                                  # clean-round 0/1 gate)
+     "occupancy": dict,          # compacted device-occupancy block
+                                 # (source "pipeline"; metric
+                                 # "pipeline::busy_frac" — the
+                                 # serve-occupancy threshold row's
+                                 # surface — plus
+                                 # "pipeline::bubble@<cause>" seconds
+                                 # and "pipeline::overlap_score")
      "resilience": dict,         # compacted chaos-round block (source
                                  # "resilience" only; metric
                                  # "resilience::<metric>" — recovery
@@ -114,7 +121,7 @@ SCHEMA = 1
 SOURCES = ("bench_round", "multichip_round", "baseline", "bench_emit",
            "pytest_snapshot", "costmodel", "serve", "resilience",
            "mesh", "checkpoint", "scaling", "das", "forkchoice",
-           "latency", "slo")
+           "latency", "slo", "pipeline")
 
 _ROUND_FILE_RE = re.compile(r"(?:BENCH|MULTICHIP)_r(\d+)\.json$")
 
@@ -243,6 +250,52 @@ def serve_records(metric: str, serve, chaos: bool = False,
         metric, serve.get("slo"),
         chaos=chaos or isinstance(serve.get("resilience"), dict),
         **context))
+    records.extend(occupancy_records(
+        metric, serve.get("occupancy"), **context))
+    return records
+
+
+def occupancy_records(metric: str, occ, **context) -> list[dict]:
+    """`pipeline`-source history records mined from a serve block's
+    `"occupancy"` sub-object (`telemetry.occupancy.block`, rounds armed
+    with CST_OCCUPANCY): the `pipeline::busy_frac` record — the
+    `serve-occupancy` threshold row's surface — carrying the compacted
+    block (wall, per-device busy, bubble attribution, depth), one
+    `pipeline::bubble@<cause>` seconds record per bubble cause, and
+    `pipeline::overlap_score` when any host prep was recorded.
+    Malformed blocks yield zero records, never an exception."""
+    if not isinstance(occ, dict):
+        return []
+    frac = occ.get("busy_frac")
+    if not isinstance(frac, (int, float)) or isinstance(frac, bool):
+        return []
+    compact = {k: occ[k] for k in (
+        "wall_s", "busy_s", "busy_frac", "bubbles_s", "depth",
+        "events", "events_dropped", "device_seconds_by_kind")
+        if k in occ}
+    devs = occ.get("devices")
+    if isinstance(devs, dict):
+        compact["devices"] = {
+            d: {k: b[k] for k in ("busy_s", "busy_frac", "spans")
+                if isinstance(b, dict) and k in b}
+            for d, b in devs.items()}
+    records = [make_record(
+        "pipeline", "pipeline::busy_frac", frac, unit="frac",
+        occupancy=compact, via_metric=metric, **context)]
+    bub = occ.get("bubbles_s")
+    if isinstance(bub, dict):
+        for cause, v in sorted(bub.items()):
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                records.append(make_record(
+                    "pipeline", f"pipeline::bubble@{cause}", v,
+                    unit="s", via_metric=metric, **context))
+    ov = occ.get("overlap")
+    if isinstance(ov, dict):
+        score = ov.get("score")
+        if isinstance(score, (int, float)) and not isinstance(score, bool):
+            records.append(make_record(
+                "pipeline", "pipeline::overlap_score", score,
+                unit="frac", overlap=ov, via_metric=metric, **context))
     return records
 
 

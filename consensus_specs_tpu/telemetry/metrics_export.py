@@ -39,7 +39,7 @@ import re
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from . import core, costmodel, reqtrace
+from . import core, costmodel, occupancy, reqtrace
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
@@ -261,6 +261,24 @@ def render_exposition(snap: dict | None = None,
                 state = b.get("state") if isinstance(b, dict) else b
                 L.sample("cst_serve_breaker_state",
                          _BREAKER_STATES.get(str(state), 0), {"key": key})
+
+    # device occupancy (CST_OCCUPANCY): live busy fraction per device +
+    # cumulative bubble attribution over the ledger's extent
+    occ = occupancy.live_summary()
+    if occ is not None:
+        L.family("cst_serve_device_busy_frac", "gauge",
+                 "device busy fraction from the occupancy ledger "
+                 "(at scrape)")
+        L.sample("cst_serve_device_busy_frac", occ["busy_frac"])
+        for dev, frac in sorted((occ.get("devices") or {}).items()):
+            L.sample("cst_serve_device_busy_frac", frac,
+                     {"device": dev})
+        L.family("cst_serve_bubble_seconds_total", "counter",
+                 "idle device wall attributed per pipeline-bubble "
+                 "cause")
+        for cause, v in sorted(occ["bubbles_s"].items()):
+            L.sample("cst_serve_bubble_seconds_total", v,
+                     {"cause": cause})
 
     # SLO watchdog (lazy import: monitor imports this module)
     from . import monitor

@@ -59,12 +59,14 @@ import sys
 import threading
 import time
 
-from . import core, costmodel, metrics_export, reqtrace
+from . import core, costmodel, flightrec, metrics_export, occupancy, \
+    reqtrace
 
 OPS = ("<", "<=", ">", ">=")
 SIGNALS = ("serve.p50_ms", "serve.p99_ms", "serve.throughput_rps",
            "serve.queue_depth", "serve.queue_age_s",
-           "serve.inflight_batches", "breaker.flaps", "mem.slope_mb_s")
+           "serve.inflight_batches", "breaker.flaps", "mem.slope_mb_s",
+           "serve.busy_frac")
 # signals that accept a {kind=...} label
 _KIND_SIGNALS = ("serve.p50_ms", "serve.p99_ms", "serve.throughput_rps")
 
@@ -277,7 +279,8 @@ class _RuleState:
     __slots__ = ("name", "metric", "kind", "op", "threshold",
                  "for_ticks", "clear_ticks", "window_s", "breaching",
                  "bad_streak", "ok_streak", "breaches", "clears",
-                 "worst_margin", "last_value", "ticks", "profiled")
+                 "worst_margin", "last_value", "ticks", "profiled",
+                 "dumped")
 
     def __init__(self, r: dict):
         self.metric = r["metric"]
@@ -298,6 +301,7 @@ class _RuleState:
         self.last_value = None
         self.ticks = 0
         self.profiled = False
+        self.dumped = False
 
     def describe(self) -> dict:
         out = {"name": self.name, "metric": self.metric, "op": self.op,
@@ -349,6 +353,8 @@ class Watchdog:
         self._flap_hist: collections.deque = collections.deque(
             maxlen=_HIST_LEN)           # (ts, transitions)
         self._wm_hist: dict[str, collections.deque] = {}
+        self._incidents: list[str] = []
+        self._occ_prev: float | None = None
 
     # --- the tick ------------------------------------------------------------
 
@@ -379,6 +385,7 @@ class Watchdog:
                                     exemplars=self._exemplars())
                     emitted.append(ev)
                     self._maybe_profile(st, now)
+                    self._maybe_flightrec(st)
             else:
                 st.ok_streak += 1
                 st.bad_streak = 0
@@ -404,6 +411,10 @@ class Watchdog:
                 self._events_dropped += 1
         core.count("slo.breaches" if phase == "breach" else "slo.clears")
         core.count(f"slo.{phase}.{st.name}")
+        flightrec.record(f"slo_{phase}", rule=st.name, metric=st.metric,
+                         value=round(float(value), 6),
+                         threshold=st.threshold,
+                         margin=round(float(margin), 6))
         return ev
 
     def _exemplars(self, n: int = 5) -> list[dict]:
@@ -491,6 +502,19 @@ class Watchdog:
         if m == "breaker.flaps":
             cut = now - st.window_s
             return float(sum(n for ts, n in self._flap_hist if ts > cut))
+        if m == "serve.busy_frac":
+            value = occupancy.live_busy_frac(st.window_s)
+            # occupancy-collapse edge: a pipeline that WAS keeping the
+            # device busy falling off a cliff is flight-recorder news
+            # even before the rule's `for=` streak confirms the breach
+            if value is not None:
+                prev, self._occ_prev = self._occ_prev, value
+                if prev is not None and prev >= 0.2 and value < 0.05:
+                    flightrec.record("occupancy_collapse",
+                                     prev=round(prev, 6),
+                                     value=round(value, 6),
+                                     rule=st.name)
+            return value
         if m == "mem.slope_mb_s":
             slopes = []
             for hist in self._wm_hist.values():
@@ -547,6 +571,23 @@ class Watchdog:
         self._profile_until = now + _PROFILE_GRAB_S
         self._profiles.append(path)
         core.count("slo.profiles")
+
+    def _maybe_flightrec(self, st: _RuleState) -> None:
+        """Breach-triggered incident dump (CST_FLIGHTREC_ON_BREACH) —
+        once per rule per watchdog install, the same gating discipline
+        as the CST_PROFILE_ON_BREACH grab: the first breach is the
+        incident, repeats are the same incident still happening."""
+        if st.dumped or not flightrec.dump_on_breach():
+            return
+        st.dumped = True
+        try:
+            path = flightrec.dump_bundle(reason=f"slo-{st.name}",
+                                         rule=st.name)
+        except Exception:
+            core.count("slo.incident_dump_failed")
+            return
+        self._incidents.append(path)
+        core.count("slo.incident_bundles")
 
     def _maybe_stop_profile(self, now: float) -> None:
         if self._profile_until is None or now < self._profile_until:
@@ -630,7 +671,8 @@ class Watchdog:
                 "rules": rules,
                 "events": bounded,
                 "events_dropped": dropped,
-                "profiles": list(self._profiles)}
+                "profiles": list(self._profiles),
+                "incidents": list(self._incidents)}
 
     def exposition_rows(self):
         """Metric families for the exposition endpoint:

@@ -253,6 +253,16 @@ THRESHOLDS = (
      "metric": r"checkpoint::restore",
      "field": "vs_baseline", "op": ">=", "target": 5.0,
      "tpu_only": False},
+    # device occupancy (PR 20): the depth-pipelined serve loop must
+    # keep the chip busy >= 70% of the measured wall on the pod round —
+    # the complementary fleet-side number to the per-kernel roofline
+    # table.  A CPU smoke's busy_frac measures interpreter overhead,
+    # not pipeline health, so the row is TPU-gated; the smoke instead
+    # pins the ledger's accounting (busy + bubbles == wall).
+    {"id": "serve-occupancy",
+     "title": "serve device busy fraction under sustained load",
+     "metric": r"pipeline::busy_frac",
+     "field": "value", "op": ">=", "target": 0.70, "tpu_only": True},
 )
 
 FLAGSHIP = "mainnet_epoch_sweep_1m_validators_wall"
@@ -978,6 +988,78 @@ def render_tail_latency(records) -> list[str]:
     return lines
 
 
+def render_occupancy(records) -> list[str]:
+    """The device-occupancy read side: latest `pipeline::*` records
+    (busy fraction, per-cause bubble seconds, overlap score) plus the
+    bubble-attribution and per-device summaries from the compact block
+    riding the `pipeline::busy_frac` record."""
+    lines = ["## Pipeline occupancy\n"]
+    recs = [r for r in records if r.get("source") == "pipeline"]
+    if not recs:
+        lines.append("No occupancy records — arm the device-occupancy "
+                     "ledger on a serve round (`CST_OCCUPANCY=1 make "
+                     "serve` / `make serve-smoke`) to measure device "
+                     "busy fraction and pipeline bubbles and produce "
+                     "`pipeline::*` records.\n")
+        return lines
+    lines.append("| metric | latest | where |")
+    lines.append("|---|---|---|")
+    latest_by_metric = {}
+    for metric, series in sorted(_by_metric(recs).items()):
+        latest = series[-1]
+        latest_by_metric[metric] = latest
+        val = "—" if latest.get("value") is None else \
+            f"{_fmt(latest['value'])} {latest.get('unit', '')}".rstrip()
+        lines.append(f"| `{metric}` | {val} | {_where(latest)} |")
+    lines.append("")
+    rec = latest_by_metric.get("pipeline::busy_frac")
+    compact = rec.get("occupancy") if rec else None
+    if isinstance(compact, dict):
+        frac = compact.get("busy_frac")
+        lines.append(
+            f"Latest armed round: device busy "
+            f"{'—' if frac is None else f'{float(frac) * 100:.1f}%'} "
+            f"of a {_fmt(compact.get('wall_s'), 2)} s wall at pipeline "
+            f"depth {compact.get('depth', '—')}"
+            + (f", {compact['events_dropped']} interval(s) dropped at "
+               f"the cap" if compact.get("events_dropped") else "")
+            + ".\n")
+        bub = compact.get("bubbles_s")
+        if isinstance(bub, dict) and bub:
+            lines.append("Idle-gap attribution (busy + bubbles sum to "
+                         "the wall — see the bubble-cause definitions "
+                         "in the README):\n")
+            lines.append("| bubble cause | seconds |")
+            lines.append("|---|---|")
+            for cause, v in sorted(bub.items()):
+                lines.append(f"| `{cause}` | {_fmt(v, 3)} |")
+            lines.append("")
+        devs = compact.get("devices")
+        if isinstance(devs, dict) and len(devs) > 1:
+            lines.append("| device | busy | spans |")
+            lines.append("|---|---|---|")
+            for dev, blk in sorted(devs.items()):
+                if not isinstance(blk, dict):
+                    continue
+                bf = blk.get("busy_frac")
+                lines.append(
+                    f"| `{dev}` "
+                    f"| {'—' if bf is None else f'{float(bf) * 100:.1f}%'} "
+                    f"| {blk.get('spans', '—')} |")
+            lines.append("")
+    score_rec = latest_by_metric.get("pipeline::overlap_score")
+    if score_rec is not None and score_rec.get("value") is not None:
+        ov = score_rec.get("overlap") or {}
+        lines.append(
+            f"Pipeline overlap score: "
+            f"{float(score_rec['value']) * 100:.0f}% of host prep hid "
+            f"under device busy ({_fmt(ov.get('hidden_s'), 3)} s of "
+            f"{_fmt(ov.get('prep_s'), 3)} s, {_where(score_rec)}) — "
+            f"low scores mean the depth knob is not covering host "
+            f"prep, the `host_prep` bubble's complement.\n")
+    return lines
+
+
 def render_scaling(records) -> list[str]:
     """The mesh-sharded flagship read side: per-rung × per-n_devices
     trend table from the latest `scaling::flagship@<n>` records (the
@@ -1251,6 +1333,7 @@ def render_report(result: dict) -> str:
     lines.extend(render_regressions(result["regressions"],
                                     result["max_regress_pct"]))
     lines.extend(render_tail_latency(result["records"]))
+    lines.extend(render_occupancy(result["records"]))
     lines.extend(render_slo(result["records"]))
     lines.extend(render_resilience(result["records"]))
     lines.extend(render_scaling(result["records"]))

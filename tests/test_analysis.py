@@ -9,15 +9,27 @@ import textwrap
 import pytest
 
 from consensus_specs_tpu.analysis import (
+    ALL_ROLES,
     RULE_IDS,
     analyze_source,
     analyze_tree,
     main,
 )
+from consensus_specs_tpu.analysis.core import ROLE_LEDGER
 
 
 def run(src, **kw):
+    # the occupancy-ledger role is file-targeted in the tree (only the
+    # sanctioned dispatch-seam files carry it), so snippets opt in via
+    # run_ledger — otherwise every instr test's bare `_dispatch` helper
+    # would trip the ledger rule
+    kw.setdefault("roles", ALL_ROLES - {ROLE_LEDGER})
     return analyze_source(textwrap.dedent(src), "snippet.py", **kw)
+
+
+def run_ledger(src):
+    return analyze_source(textwrap.dedent(src), "snippet.py",
+                          roles=frozenset({ROLE_LEDGER}))
 
 
 def rules_at(report):
@@ -598,6 +610,67 @@ def test_cost_coverage_chains_across_external_entries():
         """, external_covered=frozenset({"batch_verify"}),
              external_device=frozenset({"batch_verify"}),
              external_cost=frozenset({"batch_verify"}))
+    assert rules_at(report) == []
+
+
+# --- family: occupancy-ledger coverage (dispatch seams) ----------------------
+
+
+def test_uncovered_dispatch_seam_fires():
+    report = run_ledger("""\
+        def _dispatch(kernel, fn, args):
+            return fn(*args)
+        """)
+    assert rules_at(report) == [("instr-uncovered-dispatch-ledger", 1)]
+
+
+def test_ledger_stamp_covers_seam():
+    report = run_ledger("""\
+        from ..telemetry import occupancy
+
+        def _dispatch(kernel, fn, args):
+            occupancy.note_kernel_dispatched(kernel)
+            return fn(*args)
+        """)
+    assert rules_at(report) == []
+
+
+def test_ledger_enabled_gate_alone_does_not_cover():
+    # only the ledger calls count — a bare occupancy.enabled() check
+    # records no interval
+    report = run_ledger("""\
+        from ..telemetry import occupancy
+
+        def _dispatch(kernel, fn, args):
+            if occupancy.enabled():
+                pass
+            return fn(*args)
+        """)
+    assert rules_at(report) == [("instr-uncovered-dispatch-ledger", 3)]
+
+
+def test_ledger_coverage_propagates_through_local_calls():
+    report = run_ledger("""\
+        from ..telemetry import occupancy
+
+        def _note(dev):
+            occupancy.note_settled(dev)
+
+        def _settle_from_device(self, value):
+            _note("0")
+            return value
+        """)
+    assert rules_at(report) == []
+
+
+def test_ledger_rule_ignores_non_seam_functions():
+    report = run_ledger("""\
+        def helper(x):
+            return x
+
+        def settle(x):
+            return x
+        """)
     assert rules_at(report) == []
 
 

@@ -1,0 +1,297 @@
+"""Incident flight-recorder contract tests
+(`consensus_specs_tpu/telemetry/flightrec.py`).
+
+Pins the incident-evidence contracts the chaos round leans on: the
+event ring stays bounded (evictions counted, never unbounded growth),
+a caller-supplied `kind=` field can never clobber the event kind, the
+disabled path records nothing, `dump_bundle` writes a SELF-CONTAINED
+directory readable with nothing but the stdlib `json` module (manifest
+schema-valid, events replayable, fault plan + exemplars + metrics +
+state all present), the watchdog's breach trigger dumps exactly once
+per rule, the executor's poison-storm trigger dumps exactly once per
+process, and the `python -m ...flightrec` CLI exits 0 on a bundle that
+validates against its own schema.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from consensus_specs_tpu import telemetry
+from consensus_specs_tpu.telemetry import core, flightrec, monitor, reqtrace
+from consensus_specs_tpu.serve.executor import ServeExecutor
+from consensus_specs_tpu.serve.futures import DeviceFuture
+
+REQUIRED_FILES = ("manifest.json", "events.jsonl", "exemplars.json",
+                  "metrics.txt", "state.json")
+
+
+@pytest.fixture(autouse=True)
+def _clean_recorder(monkeypatch):
+    for knob in ("CST_FLIGHTREC", "CST_FLIGHTREC_CAP",
+                 "CST_FLIGHTREC_DIR", "CST_FLIGHTREC_ON_BREACH",
+                 "CST_FLIGHTREC_POISON_N"):
+        monkeypatch.delenv(knob, raising=False)
+    saved = core._save_state()
+    was_enabled = telemetry.enabled()
+    telemetry.configure(enabled=False)
+    telemetry.reset(full=True)          # also resets flightrec + monitor
+    flightrec.configure(enabled=True)
+    yield
+    flightrec._reset_state()
+    monitor._reset_state()
+    reqtrace.reset()
+    telemetry.configure(enabled=was_enabled)
+    core._restore_state(saved)
+
+
+# --- the ring ----------------------------------------------------------------
+
+
+def test_ring_bound_and_eviction_accounting():
+    flightrec.configure(cap=4)
+    for i in range(10):
+        flightrec.record("fault_injected", i=i)
+    evs = flightrec.events()
+    assert len(evs) == 4
+    assert [e["i"] for e in evs] == [6, 7, 8, 9]     # newest kept
+    assert [e["seq"] for e in evs] == [7, 8, 9, 10]  # seq never reused
+    st = flightrec.stats()
+    assert st["recorded"] == 10 and st["evicted"] == 6 and st["cap"] == 4
+
+
+def test_event_kind_wins_field_collision():
+    flightrec.record("breaker_transition", kind="verify", frm="closed",
+                     to="open")
+    ev = flightrec.events()[-1]
+    assert ev["kind"] == "breaker_transition"
+    assert ev["frm"] == "closed" and ev["to"] == "open"
+
+
+def test_event_carries_clocks_and_fields():
+    flightrec.record("slo_breach", rule="p99", value=612.5)
+    ev = flightrec.events()[-1]
+    assert ev["seq"] == 1 and ev["rule"] == "p99" and ev["value"] == 612.5
+    assert isinstance(ev["ts"], float) and isinstance(ev["t_mono"], float)
+
+
+def test_disabled_records_nothing():
+    flightrec.configure(enabled=False)
+    flightrec.record("fault_injected", site="x")
+    assert flightrec.events() == []
+    assert flightrec.stats()["recorded"] == 0
+
+
+def test_env_gate_and_cap(monkeypatch):
+    monkeypatch.setenv("CST_FLIGHTREC", "0")
+    flightrec._reset_state()
+    assert not flightrec.enabled()
+    monkeypatch.setenv("CST_FLIGHTREC", "1")
+    monkeypatch.setenv("CST_FLIGHTREC_CAP", "2")
+    flightrec._reset_state()
+    assert flightrec.enabled() and flightrec.stats()["cap"] == 2
+
+
+def test_cap_change_keeps_newest():
+    for i in range(6):
+        flightrec.record("poisoned_batch", i=i)
+    flightrec.configure(cap=3)
+    assert [e["i"] for e in flightrec.events()] == [3, 4, 5]
+
+
+# --- bundle dump -------------------------------------------------------------
+
+
+def test_dump_bundle_is_self_contained(tmp_path):
+    """The whole point: an incident directory must be readable with
+    nothing but stdlib json — no repo imports, no live process."""
+    flightrec.record("breaker_transition", key="verify", frm="closed",
+                     to="open")
+    flightrec.record("fault_injected", site="dispatch", fault="oracle")
+    path = flightrec.dump_bundle(directory=str(tmp_path),
+                                 reason="unit test!")
+    bundle = tmp_path / path.split("/")[-1]
+    assert bundle.name.startswith("incident-001-")
+    for name in REQUIRED_FILES:
+        assert (bundle / name).exists(), name
+    manifest = json.loads((bundle / "manifest.json").read_text())
+    assert flightrec.validate_manifest(manifest) == []
+    assert manifest["reason"] == "unit test!"
+    lines = [json.loads(ln) for ln in
+             (bundle / "events.jsonl").read_text().splitlines()]
+    assert manifest["events"] == len(lines)
+    kinds = [e["kind"] for e in lines]
+    assert kinds[-1] == "dump"                 # the dump records itself
+    assert "breaker_transition" in kinds and "fault_injected" in kinds
+    # the breaker arc is readable from the bundle alone
+    arc = [e for e in lines if e["kind"] == "breaker_transition"]
+    assert arc[0]["frm"] == "closed" and arc[0]["to"] == "open"
+    json.loads((bundle / "exemplars.json").read_text())
+    json.loads((bundle / "state.json").read_text())
+    assert isinstance((bundle / "metrics.txt").read_text(), str)
+
+
+def test_dump_numbers_increment_and_slug_sanitized(tmp_path):
+    p1 = flightrec.dump_bundle(directory=str(tmp_path),
+                               reason="a/b: c!")
+    p2 = flightrec.dump_bundle(directory=str(tmp_path), reason="x")
+    assert "incident-001-" in p1 and "incident-002-x" in p2
+    assert "/b" not in p1.split("/")[-1]       # no path separators leak
+    assert flightrec.stats()["dumps"] == 2
+
+
+def test_validate_manifest_rejects_malformed(tmp_path):
+    path = flightrec.dump_bundle(directory=str(tmp_path))
+    good = json.loads(
+        (tmp_path / path.split("/")[-1] / "manifest.json").read_text())
+    assert flightrec.validate_manifest(good) == []
+    assert flightrec.validate_manifest("nope") != []
+    assert flightrec.validate_manifest(
+        dict(good, format="other")) != []
+    assert flightrec.validate_manifest(dict(good, schema=99)) != []
+    bad = dict(good)
+    del bad["reason"]
+    assert flightrec.validate_manifest(bad) != []
+    assert flightrec.validate_manifest(
+        dict(good, files=[f for f in good["files"]
+                          if f != "events.jsonl"])) != []
+    assert flightrec.validate_manifest(dict(good, env=None)) != []
+
+
+# --- breach trigger (watchdog arc) -------------------------------------------
+
+
+BREACH_RULE = {"metric": "serve.queue_depth", "op": "<",
+               "threshold": 10, "for": 1, "clear": 1, "name": "q"}
+
+
+def _wd(**kw):
+    return monitor.Watchdog(
+        {"rules": [dict(BREACH_RULE)]},
+        clock=lambda: 0.0,
+        status_provider=lambda: {"queue": {"depth": 50}},   # breaching
+        summary_provider=lambda *_: {},
+        counter_provider=lambda name: 0,
+        watermark_provider=lambda: {},
+        **kw)
+
+
+def test_breach_dumps_once_per_rule(tmp_path, monkeypatch):
+    monkeypatch.setenv("CST_FLIGHTREC_ON_BREACH", "1")
+    monkeypatch.setenv("CST_FLIGHTREC_DIR", str(tmp_path))
+    wd = _wd()
+    wd.tick(now=0.0)
+    incidents = wd.slo_block()["incidents"]
+    assert len(incidents) == 1
+    # the same breach persisting does NOT re-dump
+    for t in (1.0, 2.0, 3.0):
+        wd.tick(now=t)
+    assert len(wd.slo_block()["incidents"]) == 1
+    assert flightrec.stats()["dumps"] == 1
+    manifest = json.loads(
+        (tmp_path / incidents[0].split("/")[-1] /
+         "manifest.json").read_text())
+    assert flightrec.validate_manifest(manifest) == []
+    assert manifest["reason"] == "slo-q" and manifest["rule"] == "q"
+    # the breach event itself made it into the bundle's ring
+    lines = [json.loads(ln) for ln in
+             (tmp_path / incidents[0].split("/")[-1] /
+              "events.jsonl").read_text().splitlines()]
+    breaches = [e for e in lines if e["kind"] == "slo_breach"]
+    assert breaches and breaches[0]["rule"] == "q"
+
+
+def test_breach_without_optin_does_not_dump(tmp_path, monkeypatch):
+    monkeypatch.setenv("CST_FLIGHTREC_DIR", str(tmp_path))
+    wd = _wd()
+    wd.tick(now=0.0)
+    assert wd.slo_block()["incidents"] == []
+    assert flightrec.stats()["dumps"] == 0
+    # the breach EVENT is still recorded — only the dump is opt-in
+    assert any(e["kind"] == "slo_breach" for e in flightrec.events())
+
+
+# --- poison-storm trigger (executor arc) -------------------------------------
+
+
+class _StubOps:
+    """Stand-in for ops.bls_batch (the test_serve.py pattern): an
+    Exception verdict fails the whole batch."""
+
+    def __init__(self):
+        self.verdicts: list[object] = []
+
+    def batch_verify_async(self, tasks, block=True):
+        v = self.verdicts.pop(0) if self.verdicts else True
+        if isinstance(v, Exception):
+            return DeviceFuture.failed(v)
+        return DeviceFuture.settled(v)
+
+    def pairing_check_device_async(self, pairs, block=True):
+        return DeviceFuture.settled(True)
+
+
+def test_poison_storm_dumps_once(tmp_path, monkeypatch):
+    from consensus_specs_tpu.serve import executor as ex_mod
+
+    stub = _StubOps()
+    stub.verdicts = [RuntimeError("dead lane"), RuntimeError("dead lane"),
+                     RuntimeError("dead lane")]
+    monkeypatch.setattr(ex_mod, "_ops_bls_batch", lambda: stub)
+    monkeypatch.setenv("CST_FLIGHTREC_POISON_N", "2")
+    monkeypatch.setenv("CST_FLIGHTREC_DIR", str(tmp_path))
+    ex = ServeExecutor(max_batch=1, depth=1)    # no retry, no breaker
+    futs = [ex.submit_verify_task(("pk", b"m", "sig")) for _ in range(3)]
+    ex.drain()
+    for fut in futs:
+        with pytest.raises(RuntimeError):
+            fut.result()
+    evs = [e for e in flightrec.events() if e["kind"] == "batch_poisoned"]
+    assert len(evs) == 3
+    assert evs[0]["batch_kind"] == "verify"     # kind field not clobbered
+    # threshold crossed at batch 2; batch 3 does not re-dump
+    assert flightrec.stats()["dumps"] == 1
+    bundles = [p for p in tmp_path.iterdir() if p.is_dir()]
+    assert len(bundles) == 1
+    manifest = json.loads((bundles[0] / "manifest.json").read_text())
+    assert flightrec.validate_manifest(manifest) == []
+    assert manifest["reason"] == "poison-storm"
+
+
+def test_poison_threshold_unset_never_dumps(tmp_path, monkeypatch):
+    from consensus_specs_tpu.serve import executor as ex_mod
+
+    stub = _StubOps()
+    stub.verdicts = [RuntimeError("x")]
+    monkeypatch.setattr(ex_mod, "_ops_bls_batch", lambda: stub)
+    monkeypatch.setenv("CST_FLIGHTREC_DIR", str(tmp_path))
+    ex = ServeExecutor(max_batch=1, depth=1)
+    fut = ex.submit_verify_task(("pk", b"m", "sig"))
+    ex.drain()
+    with pytest.raises(RuntimeError):
+        fut.result()
+    assert flightrec.stats()["dumps"] == 0
+    assert list(tmp_path.iterdir()) == []
+
+
+# --- CLI ---------------------------------------------------------------------
+
+
+def test_cli_dumps_and_validates(tmp_path, capsys):
+    flightrec.record("fault_injected", site="cli")
+    rc = flightrec.main(["--dir", str(tmp_path), "--reason", "ondemand"])
+    assert rc == 0
+    out = capsys.readouterr().out.strip()
+    assert "incident-001-ondemand" in out
+    manifest = json.loads(
+        (tmp_path / out.split("/")[-1] / "manifest.json").read_text())
+    assert flightrec.validate_manifest(manifest) == []
+
+
+def test_cli_bad_usage_exits_2(capsys):
+    # argparse's SystemExit is converted to the documented rc 2
+    assert flightrec.main(["--no-such-flag"]) == 2
+    assert flightrec.main(["--help"]) == 0
+    capsys.readouterr()

@@ -181,7 +181,7 @@ def test_snapshot_schema_stable():
     snap = telemetry.snapshot()
     assert set(snap) == {"enabled", "meta", "counters", "histograms",
                          "spans", "gauges", "events", "events_dropped",
-                         "costmodel", "reqtrace"}
+                         "costmodel", "reqtrace", "occupancy"}
     assert snap["enabled"] is True
     assert set(snap["histograms"]["h"]) == {"count", "total", "min", "max"}
     assert set(snap["gauges"]["g"]) == {"last", "min", "max", "count"}
@@ -191,6 +191,8 @@ def test_snapshot_schema_stable():
                                       "wm_events", "wm_events_dropped"}
     assert set(snap["reqtrace"]) >= {"enabled", "completed", "batches",
                                      "by_kind", "by_outcome"}
+    assert set(snap["occupancy"]) >= {"enabled", "events", "open_spans",
+                                      "events_dropped", "live"}
     json.dumps(snap)   # JSON-able end to end
 
 
